@@ -1,0 +1,55 @@
+"""AllGather kernel tests (reference: `test/nvidia/test_all_gather.py`,
+`test_fast_allgather.py`)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.kernels.allgather import (
+    AllGatherContext,
+    AllGatherMethod,
+    all_gather,
+)
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.testing import assert_allclose
+
+
+def _run_ag(mesh, x, method, axis="tp"):
+    ctx = AllGatherContext(axis=axis, world_size=mesh.shape[axis],
+                           method=method)
+    fn = shard_map_op(functools.partial(all_gather, ctx=ctx), mesh,
+                      in_specs=P(axis, None), out_specs=P(None, None))
+    return jax.jit(fn)(x)
+
+
+@pytest.mark.parametrize("method", [
+    AllGatherMethod.RING,
+    AllGatherMethod.PUSH_ALL,
+    AllGatherMethod.BIDIR_RING,
+    AllGatherMethod.XLA,
+])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_allgather_methods(tp8_mesh, method, dtype):
+    world = 8
+    m, n = 16, 128
+    x = jax.random.normal(jax.random.key(0), (world * m, n)).astype(dtype)
+    out = _run_ag(tp8_mesh, x, method)
+    assert out.shape == x.shape
+    assert_allclose(out.astype(jnp.float32), x.astype(jnp.float32),
+                    atol=0, rtol=0, name=f"allgather-{method.value}")
+
+
+def test_allgather_world4(tp4_mesh):
+    x = jnp.arange(4 * 8 * 128, dtype=jnp.float32).reshape(32, 128)
+    out = _run_ag(tp4_mesh, x, AllGatherMethod.RING)
+    assert_allclose(out, x, atol=0, rtol=0)
+
+
+def test_allgather_auto_select():
+    small = AllGatherContext(axis="tp", world_size=8)
+    assert small.resolve_method(1024) == AllGatherMethod.PUSH_ALL
+    assert small.resolve_method(10 << 20) == AllGatherMethod.RING

@@ -1,0 +1,70 @@
+"""Runtime core tests: mesh construction, topology, barrier, utils.
+
+Reference analogue: the implicit coverage `initialize_distributed` gets
+from every test, plus `test_common_ops.py`.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.kernels.common_ops import barrier_all_on_axis
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.parallel.mesh import (
+    MeshContext,
+    make_mesh,
+    node_topology,
+)
+from triton_distributed_tpu.utils.testing import assert_allclose, perf_func
+
+
+def test_make_mesh_default():
+    ctx = make_mesh()
+    assert ctx.num_devices == 8
+    assert ctx.axis_names == ("tp",)
+    assert ctx.axis_size("tp") == 8
+
+
+def test_make_mesh_2d():
+    ctx = make_mesh({"dp": 2, "tp": 4})
+    assert ctx.axis_names == ("dp", "tp")
+    assert ctx.axis_size("dp") == 2
+    assert ctx.axis_size("tp") == 4
+
+
+def test_topology():
+    topo = node_topology()
+    assert topo.num_devices == 8
+    assert topo.num_slices >= 1
+    assert topo.devices_per_slice * topo.num_slices == topo.num_devices
+
+
+def test_mesh_too_large():
+    with pytest.raises(ValueError):
+        make_mesh({"tp": 16})
+
+
+def test_barrier_all(tp8_mesh):
+    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(64, 128)
+    fn = shard_map_op(lambda s: barrier_all_on_axis(s, "tp"),
+                      tp8_mesh, in_specs=P("tp", None),
+                      out_specs=P("tp", None))
+    out = jax.jit(fn)(x)
+    assert_allclose(out, x, atol=0, rtol=0)
+
+
+def test_perf_func():
+    f = jax.jit(lambda: jnp.ones((8, 128)) * 2)
+    out, ms = perf_func(lambda: f(), iters=3, warmup_iters=1)
+    assert ms >= 0
+    assert out.shape == (8, 128)
+
+
+def test_assert_allclose_reports():
+    a = np.zeros((4, 4))
+    b = np.zeros((4, 4))
+    b[1, 2] = 1.0
+    with pytest.raises(AssertionError, match="mismatched"):
+        assert_allclose(a, b, atol=1e-6, rtol=0)

@@ -1,0 +1,16 @@
+"""Overlapping-kernel library (reference: `python/triton_dist/kernels/`).
+
+Each module mirrors one kernel family of the reference, re-designed for
+TPU: Pallas kernels using async remote DMA + semaphores over ICI, with
+XLA-collective golden paths for verification and DCN fallback.
+"""
+
+from triton_distributed_tpu.kernels.allgather import (  # noqa: F401
+    AllGatherContext,
+    AllGatherMethod,
+    all_gather,
+    create_allgather_context,
+)
+from triton_distributed_tpu.kernels.common_ops import (  # noqa: F401
+    barrier_all_on_axis,
+)

@@ -1,0 +1,53 @@
+"""Barrier and signal building blocks.
+
+Reference: `python/triton_dist/kernels/nvidia/common_ops.py` (441 LoC) —
+grid/node-scope barriers (`barrier_on_this_grid:58`,
+`barrier_all_intra_node_atomic_cas_block:135`), host-side
+`set_signal`/`wait_eq` stream ops (`:242-279`).
+
+On TPU, host-side stream-ordered signals don't exist (XLA owns the
+stream); ordering between kernels is expressed by data dependencies.
+What remains meaningful — and is provided here — are device barriers
+across a mesh axis, used standalone (a pallas_call) or via
+`language.barrier_all` inside larger kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.language import core as dl
+from triton_distributed_tpu.utils.platform import default_interpret
+
+
+def _barrier_kernel(axis, x_ref, o_ref, sem):
+    dl.barrier_all(axis)
+    cp = pltpu.make_async_copy(x_ref, o_ref, sem)
+    cp.start()
+    cp.wait()
+
+
+def barrier_all_on_axis(x, axis: str, *, collective_id: int = 7,
+                        interpret: Optional[bool] = None):
+    """Block every device on `axis` until all have arrived; returns `x`
+    unchanged (the data dependency orders subsequent ops after the
+    barrier).  Call inside shard_map.
+
+    Reference: `barrier_all_on_stream` (`common_ops.py:209-240`).
+    """
+    return pl.pallas_call(
+        functools.partial(_barrier_kernel, axis),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id),
+        interpret=default_interpret(interpret),
+    )(x)

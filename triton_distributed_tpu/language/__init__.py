@@ -1,0 +1,46 @@
+"""Device-side communication/synchronization language for Pallas kernels.
+
+This package plays the role of the reference's device DSL
+(`python/triton_dist/language/__init__.py:26-44` — `dl.*` builtins — and
+the backend-neutral SHMEM surface
+`python/triton_dist/language/extra/libshmem_device.py`).  Every function
+here is called *inside* a Pallas TPU kernel body.
+
+Mapping of concepts (see SURVEY.md §5 "Distributed communication
+backend" for the full table):
+
+=====================  =================================================
+reference primitive    TPU-native realisation (this module)
+=====================  =================================================
+``dl.rank``            :func:`rank` — mesh axis index
+``dl.num_ranks``       :func:`num_ranks` — mesh axis size
+``dl.notify``          :func:`notify` — remote semaphore signal
+``dl.wait``            :func:`wait` — semaphore wait (+ token)
+``dl.consume_token``   :func:`consume_token` — optimization-barrier tie
+``dl.symm_at``         implicit: remote refs are addressed by
+                       ``(ref, device_id)`` in :func:`put`
+``putmem(_nbi)_block`` :func:`put` / :func:`put_nbi` — async remote DMA
+``signal_op``          :func:`signal_op`
+``signal_wait_until``  :func:`signal_wait_until`
+``barrier_all``        :func:`barrier_all` — neighbor/global barrier
+multimem/NVLS          no ICI analogue — replaced by ring/tree
+                       reductions in kernels/allreduce.py
+=====================  =================================================
+"""
+
+from triton_distributed_tpu.language.core import (  # noqa: F401
+    barrier_all,
+    consume_token,
+    local_copy,
+    notify,
+    num_ranks,
+    put,
+    put_nbi,
+    rank,
+    remote_sem_signal,
+    signal_op,
+    signal_wait_until,
+    wait,
+    wait_recv,
+    wait_send,
+)

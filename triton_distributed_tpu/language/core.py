@@ -1,0 +1,210 @@
+"""Core in-kernel primitives: one-sided DMA, signals, waits, barriers.
+
+Reference parity (cited file:line are in /root/reference):
+- `dl.wait` / `dl.notify` / `dl.consume_token`
+  (`python/triton_dist/language/distributed_ops.py:57-109`): lowered on
+  NVIDIA to PTX spin loops and `st.release`/`nvshmemx_signal_op`
+  (`lib/Conversion/TritonDistributedToLLVM/NVIDIA/DistributedOpToLLVM.cpp:146-342`).
+  Here they are Pallas semaphore ops: TPU DMA hardware counts bytes into
+  semaphores and Mosaic emits the spin.
+- `libshmem_device.putmem_nbi_block` / `putmem_signal_nbi_block`
+  (`python/triton_dist/language/extra/libshmem_device.py`): here
+  :func:`put_nbi` / :func:`put_signal_nbi` built on
+  `pltpu.make_async_remote_copy`, which is precisely a one-sided
+  put-with-signal (recv semaphore on the target).
+
+Design note (TPU-first): there is no device-initiated *get* on ICI —
+remote reads are expressed as flipped puts (the owner pushes).  This is
+the same discipline the reference's fast paths use anyway (push-mode
+allgather, put-based all_to_all), so no capability is lost.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+# ---------------------------------------------------------------------------
+# SPMD identity
+# ---------------------------------------------------------------------------
+
+def rank(axis: str):
+    """This device's index along a mesh axis (reference: `dl.rank`,
+    `distributed_ops.py:84`)."""
+    return jax.lax.axis_index(axis)
+
+
+def num_ranks(axis: str) -> int:
+    """World size along a mesh axis (reference: `dl.num_ranks`)."""
+    return jax.lax.axis_size(axis)
+
+
+# ---------------------------------------------------------------------------
+# One-sided data movement
+# ---------------------------------------------------------------------------
+
+def put_nbi(src_ref, dst_ref, send_sem, recv_sem, device_id,
+            device_id_type=pltpu.DeviceIdType.LOGICAL):
+    """Non-blocking one-sided put: start an async remote DMA and return
+    its descriptor (call ``.wait_send()`` / ``.wait_recv()`` later).
+
+    Reference: `libshmem_device.putmem_nbi_block`.  The returned copy
+    descriptor doubles as the "signal": TPU remote DMA always signals
+    the destination's ``recv_sem`` on delivery, i.e. every put is a
+    `putmem_signal_nbi_block`.
+    """
+    rdma = pltpu.make_async_remote_copy(
+        src_ref=src_ref,
+        dst_ref=dst_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=device_id,
+        device_id_type=device_id_type,
+    )
+    rdma.start()
+    return rdma
+
+
+def put(src_ref, dst_ref, send_sem, recv_sem, device_id,
+        device_id_type=pltpu.DeviceIdType.LOGICAL):
+    """Blocking put (reference: `libshmem_device.putmem_block`):
+    start + wait-send.  NOTE: waits only for local completion (source
+    reusable), not remote delivery — matching SHMEM put semantics."""
+    rdma = put_nbi(src_ref, dst_ref, send_sem, recv_sem, device_id,
+                   device_id_type)
+    rdma.wait_send()
+    return rdma
+
+
+def local_copy(src_ref, dst_ref, sem):
+    """Async local DMA (HBM<->HBM/VMEM), blocking until done.
+    Reference analogue: the copy-engine `Tensor.copy_` path
+    (`kernels/nvidia/allgather.py:81-139`)."""
+    cp = pltpu.make_async_copy(src_ref, dst_ref, sem)
+    cp.start()
+    cp.wait()
+
+
+def wait_recv(ref, recv_sem):
+    """Wait until a put of ``ref.shape`` bytes has landed (drains the
+    recv semaphore).  Reference: the consumer side of
+    `putmem_signal` + `signal_wait_until`."""
+    pltpu.make_async_copy(ref, ref, recv_sem).wait()
+
+
+def wait_send(ref, send_sem):
+    """Wait until a started put of ``ref.shape`` bytes has left (drains
+    the send semaphore)."""
+    pltpu.make_async_copy(ref, ref, send_sem).wait()
+
+
+# ---------------------------------------------------------------------------
+# Signals (flags) — the reference's signal/notify/wait triplet
+# ---------------------------------------------------------------------------
+
+def notify(sem, device_id=None, inc: int = 1,
+           device_id_type=pltpu.DeviceIdType.LOGICAL):
+    """Set/advance a signal, optionally on a remote device.
+
+    Reference: `dl.notify` (`distributed_ops.py:103`, lowered at
+    `DistributedOpToLLVM.cpp:233-342`).  ``sem`` must be a REGULAR
+    semaphore ref; with ``device_id`` the signal rides ICI to the
+    peer's semaphore (the nvshmemx_signal_op path), without it the
+    signal is chip-local (the st.release path).
+    """
+    if device_id is None:
+        pltpu.semaphore_signal(sem, inc=inc)
+    else:
+        pltpu.semaphore_signal(sem, inc=inc, device_id=device_id,
+                               device_id_type=device_id_type)
+
+
+# `signal_op` with SIGNAL_SET has no TPU analogue (semaphores are
+# counters); SIGNAL_ADD is notify().  Alias for parity with
+# `libshmem_device.signal_op(..., NVSHMEM_SIGNAL_ADD, ...)`.
+signal_op = notify
+remote_sem_signal = notify
+
+
+def signal_wait_until(sem, value: int):
+    """Spin until the semaphore reaches ``value``, consuming it.
+
+    Reference: `libshmem_device.signal_wait_until(sig, NVSHMEM_CMP_GE,
+    value)`.  NOTE consuming semantics: TPU semaphore waits *decrement*
+    by ``value`` — kernels must re-arm by convention (every wait is
+    matched by exactly the signals it consumes; see the double-buffer
+    phase pattern in kernels/low_latency_all_to_all.py).
+    """
+    pltpu.semaphore_wait(sem, value)
+
+
+def wait(sem, value: int = 1):
+    """`dl.wait(barrier_ptrs, n, scope, semantic)` analogue
+    (`distributed_ops.py:57`): block until ``sem`` has accumulated
+    ``value`` signals, then consume them.  Returns a token to thread
+    through :func:`consume_token`."""
+    pltpu.semaphore_wait(sem, value)
+    return ()
+
+
+def consume_token(value, token):
+    """Tie a value's availability to a completed wait.
+
+    Reference: `dl.consume_token` (`distributed_ops.py:74`), a pure
+    dataflow edge erased at lowering
+    (`DistributedOpToLLVM.cpp:221-231`).  In Pallas, program order of
+    semaphore ops inside a kernel is already preserved by Mosaic, but
+    XLA-level code motion across the boundary is prevented with an
+    optimization barrier; use this when mixing waits with reads of
+    DMA-written buffers in the same basic block.
+    """
+    del token
+    return jax.lax.optimization_barrier(value)
+
+
+# ---------------------------------------------------------------------------
+# Barriers
+# ---------------------------------------------------------------------------
+
+def barrier_all(axis: str, sem=None):
+    """All-device barrier over a mesh axis, usable inside a kernel.
+
+    Reference: `libshmem_device.barrier_all` / the atomic-CAS intra-node
+    barrier (`kernels/nvidia/common_ops.py:135-207`).  Implementation:
+    every device signals every other device's barrier semaphore, then
+    waits for world-1 signals.  Uses the global Mosaic barrier
+    semaphore unless an explicit REGULAR sem ref is passed.
+
+    Kernels using this must set a ``collective_id`` in CompilerParams.
+    """
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    bsem = pltpu.get_barrier_semaphore() if sem is None else sem
+
+    def body(i, _):
+        peer = jax.lax.rem(me + i, n)
+        pltpu.semaphore_signal(bsem, inc=1, device_id=peer,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return 0
+
+    jax.lax.fori_loop(1, n, body, 0)
+    pltpu.semaphore_wait(bsem, n - 1)
+
+
+def barrier_neighbors(axis: str):
+    """Cheap ring barrier with left/right neighbors only (enough to
+    order ring-collective phases)."""
+    n = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    left = jax.lax.rem(me - 1 + n, n)
+    right = jax.lax.rem(me + 1, n)
+    bsem = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(bsem, inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(bsem, inc=1, device_id=right,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(bsem, 2)

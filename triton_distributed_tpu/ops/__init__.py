@@ -1,0 +1,13 @@
+"""High-level functional ops: shard_map-wrapped entry points.
+
+The kernels in :mod:`triton_distributed_tpu.kernels` are SPMD bodies
+(they run per-device inside shard_map).  This package provides the
+mesh-level wrappers users call on globally-sharded arrays — the role of
+the reference's op entry points exported at
+`python/triton_dist/kernels/nvidia/__init__.py:25-42`.
+"""
+
+from triton_distributed_tpu.ops.api import (  # noqa: F401
+    all_gather,
+    shard_map_op,
+)

@@ -1,0 +1,31 @@
+"""Mesh-level op wrappers."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_distributed_tpu.kernels import allgather as ag_mod
+
+
+def shard_map_op(fn, mesh: Mesh, in_specs, out_specs):
+    """shard_map with the framework's conventions (manual collectives,
+    no VMA checks — Pallas kernels are opaque to the sharding checker)."""
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+def all_gather(x, mesh: Mesh, axis: str = "tp",
+               method: ag_mod.AllGatherMethod = ag_mod.AllGatherMethod.AUTO,
+               **kw):
+    """Gather a row-sharded global array: (M, N) sharded on axis 0 →
+    replicated (M, N)."""
+    ctx = ag_mod.create_allgather_context(
+        axis=axis, world_size=mesh.shape[axis], method=method, **kw)
+    fn = shard_map_op(
+        functools.partial(ag_mod.all_gather, ctx=ctx),
+        mesh, in_specs=P(axis, None), out_specs=P(None, None))
+    return fn(x)

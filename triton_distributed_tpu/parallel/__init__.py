@@ -1,0 +1,10 @@
+"""SPMD mesh construction, topology discovery and sharding helpers."""
+
+from triton_distributed_tpu.parallel.mesh import (  # noqa: F401
+    MeshContext,
+    get_mesh_context,
+    initialize_distributed,
+    finalize_distributed,
+    make_mesh,
+    node_topology,
+)

@@ -1,0 +1,180 @@
+"""Distributed bootstrap, device-mesh construction and topology discovery.
+
+TPU-native equivalent of the reference's runtime-core
+(``python/triton_dist/utils.py:107-195`` — ``initialize_distributed``,
+``init_nvshmem_by_torch_process_group``, topology probes at
+``utils.py:595-871``).  On TPU the control plane is
+``jax.distributed`` + a ``jax.sharding.Mesh``; the "NVLink domain /
+NUMA node" concepts map to ICI slices, and the "inter-node" (IB) domain
+maps to DCN between slices.
+
+No NVSHMEM-style symmetric-heap bootstrap is needed: Pallas remote DMA
+addresses buffers by (device_id, ref) inside collective kernels, so any
+shard_map-ed kernel input/output plays the role of a symmetric tensor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+# Canonical axis names used throughout the framework.  Mirrors the role
+# of RANK/WORLD_SIZE/LOCAL_WORLD_SIZE env in the reference
+# (`scripts/launch.sh`, `utils.py:174-195`).
+TP_AXIS = "tp"   # tensor parallel (dense + MoE TP)
+EP_AXIS = "ep"   # expert parallel
+SP_AXIS = "sp"   # sequence parallel (long-context attention)
+DP_AXIS = "dp"   # data parallel (GSPMD gives this for free on TPU)
+PP_AXIS = "pp"   # pipeline parallel
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeTopology:
+    """ICI/DCN topology summary.
+
+    Reference analogue: NVLink-fullmesh / NUMA / NIC probing
+    (`utils.py:595-871`, `kernels/nvidia/comm_perf_model.py:34-66`).
+    On TPU: devices in the same slice share ICI (fast, one-sided DMA
+    capable); distinct slices are connected by DCN (collectives only).
+    """
+
+    num_devices: int
+    num_slices: int
+    devices_per_slice: int
+    platform: str
+
+    @property
+    def has_ici_fullmesh(self) -> bool:
+        # Within a slice, ICI is a torus: every device is reachable via
+        # one-sided remote DMA (the analogue of "full-mesh NVLink").
+        return self.num_slices == 1
+
+
+def node_topology(devices: Optional[Sequence[jax.Device]] = None) -> NodeTopology:
+    """Discover slice structure of the given devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    slice_ids = []
+    for d in devices:
+        slice_ids.append(getattr(d, "slice_index", 0) or 0)
+    num_slices = len(set(slice_ids)) or 1
+    return NodeTopology(
+        num_devices=len(devices),
+        num_slices=num_slices,
+        devices_per_slice=len(devices) // num_slices,
+        platform=devices[0].platform if devices else "cpu",
+    )
+
+
+@dataclasses.dataclass
+class MeshContext:
+    """The de-facto process-group handle of the framework.
+
+    Carries the mesh plus the axis names that parallel layers use.  The
+    reference's equivalent is the implicit global state set up by
+    `initialize_distributed` (`utils.py:174-195`) + per-op Context
+    dataclasses; here the mesh is explicit and threaded through ops.
+    """
+
+    mesh: Mesh
+    topology: NodeTopology
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    def axis_size(self, name: str) -> int:
+        return self.mesh.shape[name]
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+
+_GLOBAL_CONTEXT: Optional[MeshContext] = None
+
+
+def make_mesh(
+    axis_shapes: Optional[dict] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> MeshContext:
+    """Build a MeshContext.
+
+    ``axis_shapes`` maps axis name -> size, e.g. ``{"tp": 8}`` or
+    ``{"dp": 2, "tp": 4}``.  If omitted, all devices go onto a single
+    ``tp`` axis (the reference's default single-process-group world).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axis_shapes is None:
+        axis_shapes = {TP_AXIS: len(devices)}
+    sizes = list(axis_shapes.values())
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {axis_shapes} needs {total} devices, have {len(devices)}"
+        )
+    dev_array = np.array(devices[:total]).reshape(sizes)
+    mesh = Mesh(dev_array, tuple(axis_shapes.keys()))
+    return MeshContext(mesh=mesh, topology=node_topology(devices[:total]))
+
+
+def initialize_distributed(
+    axis_shapes: Optional[dict] = None,
+    *,
+    seed: int = 0,
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> MeshContext:
+    """Initialise multi-process JAX (if requested via args or env) and
+    build the global mesh.
+
+    Reference analogue: `initialize_distributed` (`utils.py:174-195`)
+    which does torch.distributed init → NVSHMEM UID broadcast →
+    nvshmem init → per-rank seeding.  On TPU there is no separate
+    data-plane bootstrap: `jax.distributed.initialize` wires up DCN,
+    and ICI needs no handshake.
+    """
+    global _GLOBAL_CONTEXT
+    num_processes = num_processes or int(os.environ.get("TDT_NUM_PROCESSES", "1"))
+    if num_processes > 1 or coordinator_address is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    ctx = make_mesh(axis_shapes)
+    _GLOBAL_CONTEXT = ctx
+    return ctx
+
+
+def finalize_distributed() -> None:
+    """Tear down multi-process state (reference: `utils.py:153`)."""
+    global _GLOBAL_CONTEXT
+    _GLOBAL_CONTEXT = None
+    try:
+        jax.distributed.shutdown()
+    except (RuntimeError, ValueError):
+        pass
+
+
+def get_mesh_context() -> MeshContext:
+    """Return the global MeshContext, creating a default one if needed."""
+    global _GLOBAL_CONTEXT
+    if _GLOBAL_CONTEXT is None:
+        _GLOBAL_CONTEXT = make_mesh()
+    return _GLOBAL_CONTEXT
+
+
+def set_mesh_context(ctx: MeshContext) -> None:
+    global _GLOBAL_CONTEXT
+    _GLOBAL_CONTEXT = ctx

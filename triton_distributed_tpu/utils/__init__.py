@@ -1,0 +1,13 @@
+"""Cross-cutting runtime utilities (reference: `python/triton_dist/utils.py`)."""
+
+from triton_distributed_tpu.utils.debug import dist_print, logger  # noqa: F401
+from triton_distributed_tpu.utils.platform import (  # noqa: F401
+    default_interpret,
+    is_cpu,
+    is_tpu,
+)
+from triton_distributed_tpu.utils.testing import (  # noqa: F401
+    assert_allclose,
+    perf_func,
+)
+from triton_distributed_tpu.utils.profiling import group_profile  # noqa: F401

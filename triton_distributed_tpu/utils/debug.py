@@ -1,0 +1,76 @@
+"""Rank-aware printing and logging.
+
+Reference analogues: `dist_print` (`python/triton_dist/utils.py:292-323`)
+and the colored logger in `python/triton_dist/models/utils.py`.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Optional
+
+import jax
+
+
+def _process_index() -> int:
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return 0
+
+
+def dist_print(
+    *args,
+    prefix: bool = True,
+    allowed_ranks: Optional[list] = None,
+    file=None,
+    **kwargs,
+) -> None:
+    """Print with a rank prefix, optionally restricted to some ranks.
+
+    `allowed_ranks` may be a list of process indices or the string
+    "all"; default is rank 0 only (matches the reference's common usage
+    `dist_print(..., allowed_ranks=[0])`).
+    """
+    rank = _process_index()
+    if allowed_ranks is None:
+        allowed_ranks = [0]
+    if allowed_ranks != "all" and rank not in allowed_ranks:
+        return
+    file = file or sys.stdout
+    if prefix:
+        print(f"[rank {rank}]", *args, file=file, **kwargs)
+    else:
+        print(*args, file=file, **kwargs)
+
+
+class _ColorFormatter(logging.Formatter):
+    COLORS = {
+        logging.DEBUG: "\x1b[36m",
+        logging.INFO: "\x1b[32m",
+        logging.WARNING: "\x1b[33m",
+        logging.ERROR: "\x1b[31m",
+        logging.CRITICAL: "\x1b[41m",
+    }
+    RESET = "\x1b[0m"
+
+    def format(self, record):
+        color = self.COLORS.get(record.levelno, "")
+        msg = super().format(record)
+        return f"{color}{msg}{self.RESET}" if sys.stderr.isatty() else msg
+
+
+def _make_logger() -> logging.Logger:
+    log = logging.getLogger("triton_distributed_tpu")
+    if not log.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            _ColorFormatter("[%(levelname)s %(asctime)s] %(message)s", "%H:%M:%S")
+        )
+        log.addHandler(handler)
+        log.setLevel(logging.INFO)
+    return log
+
+
+logger = _make_logger()

@@ -1,0 +1,48 @@
+"""Backend detection and Pallas interpret-mode policy.
+
+Kernels in this framework run in two modes:
+- compiled (Mosaic) on real TPU devices;
+- TPU interpret mode (`pltpu.InterpretParams`) everywhere else, which
+  faithfully simulates VMEM/HBM spaces, DMA and cross-device semaphores
+  on CPU — this is how the SPMD test harness exercises 8-device meshes
+  on one host (SURVEY.md §4: the reference has no mock backends and
+  tests only on real multi-GPU; on TPU we can do better).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+
+@functools.lru_cache(maxsize=None)
+def backend_platform() -> str:
+    return jax.default_backend()
+
+
+def is_tpu() -> bool:
+    # axon is the remote-TPU tunnel platform; it executes Mosaic kernels.
+    return backend_platform() in ("tpu", "axon")
+
+
+def is_cpu() -> bool:
+    return backend_platform() == "cpu"
+
+
+def default_interpret(interpret: Optional[bool] = None):
+    """Resolve an `interpret=` argument for pl.pallas_call.
+
+    Returns False on TPU (compile with Mosaic), an InterpretParams
+    instance elsewhere.  Pass an explicit bool/InterpretParams to
+    override.
+    """
+    if interpret is None:
+        interpret = not is_tpu()
+    if interpret is True:
+        return pltpu.InterpretParams()
+    if interpret is False:
+        return False
+    return interpret

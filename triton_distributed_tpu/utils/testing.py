@@ -1,0 +1,89 @@
+"""Numerics comparison and timing helpers.
+
+Reference analogues: `assert_allclose` with bitwise diagnostics
+(`python/triton_dist/utils.py:873-905`) and `perf_func` CUDA-event
+timing (`utils.py:277-291`).  On TPU, timing uses wall clock around
+`block_until_ready` on a jitted callable (first call excluded as
+compile warmup).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def assert_allclose(
+    actual,
+    expected,
+    atol: float = 1e-3,
+    rtol: float = 1e-3,
+    verbose: bool = True,
+    name: str = "",
+) -> None:
+    """np.testing-based allclose with mismatch diagnostics.
+
+    Unlike bare `np.testing.assert_allclose`, on failure this reports
+    the mismatch count, max abs/rel error and the worst offending
+    index — the role of the reference's sorted/bitwise diff report.
+    """
+    a = np.asarray(jax.device_get(actual), dtype=np.float64)
+    e = np.asarray(jax.device_get(expected), dtype=np.float64)
+    if a.shape != e.shape:
+        raise AssertionError(f"{name} shape mismatch: {a.shape} vs {e.shape}")
+    diff = np.abs(a - e)
+    tol = atol + rtol * np.abs(e)
+    bad = diff > tol
+    if bad.any():
+        n_bad = int(bad.sum())
+        idx = np.unravel_index(np.argmax(diff - tol), a.shape)
+        msg = (
+            f"{name} allclose failed: {n_bad}/{a.size} mismatched "
+            f"({100.0 * n_bad / a.size:.3f}%), max_abs={diff.max():.3e}, "
+            f"worst at {idx}: actual={a[idx]:.6e} expected={e[idx]:.6e} "
+            f"(atol={atol}, rtol={rtol})"
+        )
+        if verbose:
+            flat = np.argsort(-(diff - tol).ravel())[:8]
+            lines = [
+                f"  [{np.unravel_index(i, a.shape)}] "
+                f"actual={a.ravel()[i]:.6e} expected={e.ravel()[i]:.6e}"
+                for i in flat
+            ]
+            msg += "\n" + "\n".join(lines)
+        raise AssertionError(msg)
+
+
+def perf_func(
+    func: Callable,
+    iters: int = 10,
+    warmup_iters: int = 3,
+    sync: bool = True,
+) -> Tuple[object, float]:
+    """Return (last_output, avg_ms_per_iter).
+
+    `func` should be a zero-arg closure (typically over jitted
+    callables).  Outputs are blocked on to get device-complete timing.
+    """
+    out = None
+    for _ in range(warmup_iters):
+        out = func()
+    if sync:
+        jax.block_until_ready(out)
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = func()
+    if sync:
+        jax.block_until_ready(out)
+    elapsed_ms = (time.perf_counter() - start) * 1e3 / max(iters, 1)
+    return out, elapsed_ms
+
+
+def random_tensor(key, shape, dtype=jnp.float32, scale: float = 1.0):
+    """Deterministic random test tensor."""
+    x = jax.random.normal(key, shape, dtype=jnp.float32) * scale
+    return x.astype(dtype)
