@@ -1,0 +1,54 @@
+"""ReduceScatter tests (reference: `test/nvidia/test_reduce_scatter.py`)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.kernels.reduce_scatter import (
+    ReduceScatterContext,
+    ReduceScatterMethod,
+    reduce_scatter,
+)
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.testing import assert_allclose
+
+
+def _run_rs(mesh, x_partials, method, axis="tp"):
+    """x_partials: (world, world*m, n) — one partial full-array per
+    device."""
+    world = mesh.shape[axis]
+    ctx = ReduceScatterContext(axis=axis, world_size=world, method=method)
+    fn = shard_map_op(
+        lambda xs: reduce_scatter(xs[0], ctx),
+        mesh, in_specs=P(axis, None, None), out_specs=P(axis, None))
+    return jax.jit(fn)(x_partials)
+
+
+@pytest.mark.parametrize("method", [
+    ReduceScatterMethod.SCATTER_REDUCE,
+    ReduceScatterMethod.RING,
+    ReduceScatterMethod.XLA,
+])
+@pytest.mark.parametrize("world,mesh_name", [(4, "tp4_mesh"), (8, "tp8_mesh")])
+def test_reduce_scatter(request, method, world, mesh_name):
+    mesh = request.getfixturevalue(mesh_name)
+    m, n = 16, 128
+    x = jax.random.normal(jax.random.key(0), (world, world * m, n),
+                          dtype=jnp.float32)
+    out = _run_rs(mesh, x, method)
+    ref = x.sum(axis=0).reshape(world, m, n).reshape(world * m, n)
+    assert out.shape == (world * m, n)
+    assert_allclose(out, ref, atol=1e-4, rtol=1e-4,
+                    name=f"rs-{method.value}-w{world}")
+
+
+def test_rs_bf16(tp4_mesh):
+    world, m, n = 4, 8, 256
+    x = (jax.random.normal(jax.random.key(1), (world, world * m, n)) / 4
+         ).astype(jnp.bfloat16)
+    out = _run_rs(tp4_mesh, x, ReduceScatterMethod.SCATTER_REDUCE)
+    ref = x.astype(jnp.float32).sum(axis=0)
+    assert_allclose(out.astype(jnp.float32), ref, atol=5e-2, rtol=5e-2)

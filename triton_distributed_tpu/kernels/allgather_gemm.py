@@ -1,0 +1,174 @@
+"""Fused AllGather-GEMM — the flagship TP overlap op.
+
+Reference: `python/triton_dist/kernels/nvidia/allgather_gemm.py` (744
+LoC): a copy-engine/NVSHMEM producer streams remote A-shards into a
+symmetric workspace while a persistent GEMM consumer `dl.wait`s
+per-rank readiness flags and consumes tiles in rank-swizzled order
+(`kernel_consumer_gemm_persistent:146`, swizzle `:211-216`, wait
+`:223-224`).
+
+TPU re-design (one Pallas kernel per device, no producer/consumer
+split): the ICI DMA engine *is* the copy engine, so a single kernel
+
+  1. forwards the freshest A-chunk to the right neighbor (ring), and
+  2. feeds the chunk it already owns into a software-pipelined MXU
+     matmul (`emit_matmul`),
+
+so step s computes chunk (rank - s) while chunk (rank - s - 1) is in
+flight — the same "consume in arrival order, start from own rank"
+swizzle as the reference, expressed as loop order instead of
+threadblock remapping.  Per-chunk DMA semaphores are the readiness
+flags (`dl.wait(barrier_ptr + rank)` ↔ `wait_recv(recv_sems[chunk])`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.kernels.matmul import MatmulConfig, emit_matmul
+from triton_distributed_tpu.language import core as dl
+from triton_distributed_tpu.utils.platform import default_interpret
+
+
+@dataclasses.dataclass
+class AllGatherGEMMContext:
+    """Reference analogue: `AllGatherGEMMTensorParallelContext`
+    (`allgather_gemm.py:404-487`) minus the symmetric-buffer plumbing
+    (Pallas buffers are allocated per call by XLA; reuse across calls
+    comes from jit caching, the role CUDA graphs play in the
+    reference)."""
+
+    axis: str
+    world_size: int
+    gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
+    collective_id: int = 1
+    interpret: Optional[bool] = None
+
+
+def create_ag_gemm_context(axis: str, world_size: int, **kw) -> AllGatherGEMMContext:
+    return AllGatherGEMMContext(axis=axis, world_size=world_size, **kw)
+
+
+def _ag_gemm_fused_kernel(ctx: AllGatherGEMMContext, m, n, k,
+                          x_ref, b_ref, gathered_ref, out_ref,
+                          local_sem, send_sem, recv_sems):
+    world = ctx.world_size
+    my = jax.lax.axis_index(ctx.axis)
+    right = jax.lax.rem(my + 1, world)
+
+    dl.local_copy(x_ref, gathered_ref.at[my], local_sem)
+
+    # Python loop: `world` is static, so each step is unrolled and the
+    # Mosaic scheduler can overlap the RDMA of step s with the matmul
+    # pipeline of step s.
+    for s in range(world):
+        chunk = jax.lax.rem(my - s + 2 * world, world)
+        rdma = None
+        if s < world - 1:
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=gathered_ref.at[chunk],
+                dst_ref=gathered_ref.at[chunk],
+                send_sem=send_sem,
+                recv_sem=recv_sems.at[chunk],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+        # MXU work for the chunk we already hold overlaps the DMA.
+        emit_matmul(gathered_ref.at[chunk], b_ref, out_ref.at[chunk],
+                    m=m, n=n, k=k, config=ctx.gemm)
+        if rdma is not None:
+            exp = jax.lax.rem(my - s - 1 + 2 * world, world)
+            dl.wait_recv(gathered_ref.at[exp], recv_sems.at[exp])
+            rdma.wait_send()
+
+
+def ag_gemm(a_shard, b, ctx: AllGatherGEMMContext,
+            return_gathered: bool = False):
+    """C = all_gather(a, axis) @ b, overlapped.  Call inside shard_map.
+
+    a_shard: (m_local, k) — row shard of A over `ctx.axis`.
+    b:       (k, n_local) — this rank's column shard of B (weights).
+    Returns (world*m_local, n_local), and optionally gathered A
+    (the reference's `copy_to_local` path, `allgather_gemm.py:573`).
+    """
+    world = ctx.world_size
+    m, k = a_shard.shape
+    k2, n = b.shape
+    assert k == k2, (a_shard.shape, b.shape)
+
+    gathered, out = pl.pallas_call(
+        functools.partial(_ag_gemm_fused_kernel, ctx, m, n, k),
+        out_shape=(
+            jax.ShapeDtypeStruct((world, m, k), a_shard.dtype),
+            jax.ShapeDtypeStruct((world, m, n), a_shard.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((world,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=ctx.collective_id),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * world * m * n * k,
+            bytes_accessed=(world * m * k + k * n) * a_shard.dtype.itemsize
+            + world * m * n * a_shard.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=default_interpret(ctx.interpret),
+    )(a_shard, b)
+
+    out = out.reshape(world * m, n)
+    if return_gathered:
+        return out, gathered.reshape(world * m, k)
+    return out
+
+
+def ag_gemm_nonoverlap(a_shard, b, axis: str):
+    """Golden / baseline: XLA collective then matmul (the reference's
+    torch fwd mode, `layers/nvidia/tp_mlp.py` "torch" path)."""
+    a_full = jax.lax.all_gather(a_shard, axis, tiled=True)
+    return jnp.dot(a_full, b, preferred_element_type=jnp.float32).astype(
+        a_shard.dtype)
+
+
+def ag_gemm_ppermute(a_shard, b, axis: str):
+    """XLA-level overlap: ring of `lax.ppermute`s with the dot of the
+    previously-received chunk in between; XLA's latency-hiding
+    scheduler runs the collective-permute DMA concurrently with the
+    MXU.  Idiomatic-XLA middle ground between `ag_gemm_nonoverlap`
+    and the fused Pallas kernel."""
+    world = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    m, _ = a_shard.shape
+    n = b.shape[1]
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    out0 = jnp.dot(a_shard, b, preferred_element_type=jnp.float32)
+    outs = [(my, out0)]
+    cur = a_shard
+    for s in range(world - 1):
+        cur = jax.lax.ppermute(cur, axis, perm)
+        src = jax.lax.rem(my - s - 1 + 2 * world, world)
+        outs.append((src, jnp.dot(cur, b, preferred_element_type=jnp.float32)))
+
+    full = jnp.zeros((world * m, n), dtype=jnp.float32)
+    for src, val in outs:
+        full = jax.lax.dynamic_update_slice(full, val, (src * m, 0))
+    return full.astype(a_shard.dtype)

@@ -1,0 +1,155 @@
+"""Tiled MXU matmul building blocks.
+
+The reference's GEMMs are Triton tile kernels (persistent TMA consumers,
+`kernels/nvidia/allgather_gemm.py:146-286`).  The TPU equivalents here:
+
+- :func:`matmul` — standalone Pallas blocked matmul (pallas_call grid);
+- :func:`emit_matmul` — an *inner pipeline* over HBM refs, for use
+  inside larger overlap kernels (`pltpu.emit_pipeline` plays the role
+  of the persistent kernel's software pipelining: double-buffered
+  HBM→VMEM DMA feeding the MXU).
+
+Both accumulate in float32 regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.utils.platform import default_interpret
+
+
+def _pick_block(dim: int, preferred: int, align: int) -> int:
+    """Largest block <= preferred that divides dim, multiple of align
+    when possible."""
+    if dim <= preferred:
+        return dim
+    for b in range(preferred, align - 1, -align):
+        if dim % b == 0:
+            return b
+    return dim  # fall back to un-tiled
+
+
+@dataclasses.dataclass(frozen=True)
+class MatmulConfig:
+    block_m: int = 256
+    block_n: int = 256
+    block_k: int = 512
+
+    def resolve(self, m: int, n: int, k: int) -> "MatmulConfig":
+        return MatmulConfig(
+            block_m=_pick_block(m, self.block_m, 8),
+            block_n=_pick_block(n, self.block_n, 128),
+            block_k=_pick_block(k, self.block_k, 128),
+        )
+
+
+def _matmul_kernel(nk: int, a_ref, b_ref, o_ref, acc_ref):
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(a_ref[:], b_ref[:],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(kk == nk - 1)
+    def _():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+def matmul(a, b, config: Optional[MatmulConfig] = None,
+           out_dtype=None, interpret: Optional[bool] = None):
+    """C[m,n] = A[m,k] @ B[k,n], blocked for the MXU."""
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2, (a.shape, b.shape)
+    out_dtype = out_dtype or a.dtype
+    cfg = (config or MatmulConfig()).resolve(m, n, k)
+    nk = pl.cdiv(k, cfg.block_k)
+    grid = (pl.cdiv(m, cfg.block_m), pl.cdiv(n, cfg.block_n), nk)
+    return pl.pallas_call(
+        functools.partial(_matmul_kernel, nk),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((cfg.block_m, cfg.block_k),
+                             lambda i, j, kk: (i, kk),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((cfg.block_k, cfg.block_n),
+                             lambda i, j, kk: (kk, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((cfg.block_m, cfg.block_n),
+                                   lambda i, j, kk: (i, j),
+                                   memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((min(cfg.block_m, m), min(cfg.block_n, n)),
+                           jnp.float32)
+            ],
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * n * k,
+            bytes_accessed=(m * k + k * n) * a.dtype.itemsize
+            + m * n * jnp.dtype(out_dtype).itemsize,
+            transcendentals=0,
+        ),
+        interpret=default_interpret(interpret),
+    )(a, b)
+
+
+def emit_matmul(a_ref, b_ref, o_ref, *, m, n, k,
+                config: Optional[MatmulConfig] = None):
+    """Run a pipelined matmul over HBM refs from inside a kernel body.
+
+    ``a_ref``: (m, k), ``b_ref``: (k, n), ``o_ref``: (m, n) — all HBM/ANY
+    refs (may be `.at[...]` views of larger buffers).
+    """
+    cfg = (config or MatmulConfig()).resolve(m, n, k)
+    nk = pl.cdiv(k, cfg.block_k)
+
+    def inner(a_blk, b_blk, o_blk, acc_ref):
+        kk = pl.program_id(2)
+
+        @pl.when(kk == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        acc_ref[:] += jnp.dot(a_blk[:], b_blk[:],
+                              preferred_element_type=jnp.float32)
+
+        @pl.when(kk == nk - 1)
+        def _():
+            o_blk[:] = acc_ref[:].astype(o_blk.dtype)
+
+    def run(acc_ref):
+        pipeline = pltpu.emit_pipeline(
+            functools.partial(inner, acc_ref=acc_ref),
+            grid=(pl.cdiv(m, cfg.block_m), pl.cdiv(n, cfg.block_n), nk),
+            in_specs=[
+                pl.BlockSpec((cfg.block_m, cfg.block_k),
+                             lambda i, j, kk: (i, kk)),
+                pl.BlockSpec((cfg.block_k, cfg.block_n),
+                             lambda i, j, kk: (kk, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((cfg.block_m, cfg.block_n),
+                             lambda i, j, kk: (i, j)),
+            ],
+        )
+        pipeline(a_ref, b_ref, o_ref)
+
+    pl.run_scoped(
+        run,
+        acc_ref=pltpu.VMEM((min(cfg.block_m, m), min(cfg.block_n, n)),
+                           jnp.float32),
+    )

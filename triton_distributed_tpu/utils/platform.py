@@ -32,6 +32,27 @@ def is_cpu() -> bool:
     return backend_platform() == "cpu"
 
 
+@functools.lru_cache(maxsize=None)
+def _enable_cpu_simulation_shims() -> None:
+    """Make `pltpu.emit_pipeline` usable under interpret mode on CPU.
+
+    The Mosaic software-pipeline helper asks the runtime for the TPU
+    generation to pick DMA tilings even when interpreted; answer "v5"
+    when simulating.  Test-harness shim only — never active on TPU.
+    """
+    from jax._src.pallas.mosaic import pipeline as _pipeline
+
+    _orig = _pipeline._get_tpu_generation
+
+    def _get_gen():
+        try:
+            return _orig()
+        except ValueError:
+            return 5
+
+    _pipeline._get_tpu_generation = _get_gen
+
+
 def default_interpret(interpret: Optional[bool] = None):
     """Resolve an `interpret=` argument for pl.pallas_call.
 
@@ -41,8 +62,9 @@ def default_interpret(interpret: Optional[bool] = None):
     """
     if interpret is None:
         interpret = not is_tpu()
-    if interpret is True:
-        return pltpu.InterpretParams()
     if interpret is False:
         return False
+    _enable_cpu_simulation_shims()
+    if interpret is True:
+        return pltpu.InterpretParams()
     return interpret
