@@ -1,0 +1,58 @@
+"""GEMM-RS overlap tests (reference: `test/nvidia/test_gemm_rs.py`)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
+    GEMMReduceScatterContext,
+    gemm_rs,
+    gemm_rs_nonoverlap,
+    gemm_rs_ppermute,
+)
+from triton_distributed_tpu.kernels.matmul import MatmulConfig
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.testing import assert_allclose
+
+
+def _golden(a_full, b_full):
+    # a: (M, K) k-sharded over ranks → reference is full matmul.
+    return a_full.astype(jnp.float32) @ b_full.astype(jnp.float32)
+
+
+@pytest.mark.parametrize("world,mesh_name", [(4, "tp4_mesh"), (8, "tp8_mesh")])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_gemm_rs_fused(request, world, mesh_name, dtype):
+    mesh = request.getfixturevalue(mesh_name)
+    mt, k_loc, n = world * 8, 128, 128
+    a = (jax.random.normal(jax.random.key(0), (mt, world * k_loc)) / 16
+         ).astype(dtype)
+    b = (jax.random.normal(jax.random.key(1), (world * k_loc, n)) / 16
+         ).astype(dtype)
+
+    ctx = GEMMReduceScatterContext(axis="tp", world_size=world,
+                                   gemm=MatmulConfig(64, 128, 128))
+    fn = shard_map_op(functools.partial(gemm_rs, ctx=ctx), mesh,
+                      in_specs=(P(None, "tp"), P("tp", None)),
+                      out_specs=P("tp", None))
+    out = jax.jit(fn)(a, b)
+    assert out.shape == (mt, n)
+    tol = 1e-3 if dtype == jnp.float32 else 5e-2
+    assert_allclose(out.astype(jnp.float32), _golden(a, b), atol=tol,
+                    rtol=tol, name=f"gemm_rs-w{world}")
+
+
+@pytest.mark.parametrize("impl", [gemm_rs_nonoverlap, gemm_rs_ppermute])
+def test_gemm_rs_xla_variants(tp4_mesh, impl):
+    world, mt, k_loc, n = 4, 32, 64, 128
+    a = jax.random.normal(jax.random.key(2), (mt, world * k_loc)) / 8
+    b = jax.random.normal(jax.random.key(3), (world * k_loc, n)) / 8
+    fn = shard_map_op(functools.partial(impl, axis="tp"), tp4_mesh,
+                      in_specs=(P(None, "tp"), P("tp", None)),
+                      out_specs=P("tp", None))
+    out = jax.jit(fn)(a, b)
+    assert_allclose(out, _golden(a, b), atol=1e-3, rtol=1e-3,
+                    name=impl.__name__)

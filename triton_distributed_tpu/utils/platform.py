@@ -52,6 +52,28 @@ def _enable_cpu_simulation_shims() -> None:
 
     _pipeline._get_tpu_generation = _get_gen
 
+    # Deadlock fix for multi-device interpret simulation: stock
+    # `io_callback_impl` does `device_put(args, cpu_device0)` for every
+    # interpreter callback.  When device 0's execution thread is blocked
+    # inside a kernel (e.g. a semaphore wait), a transfer onto device 0
+    # queued by another device's callback can never complete → deadlock
+    # (timing-dependent; bites any collective kernel).  The interpreter
+    # callbacks are pure-host numpy code, so feed them host arrays
+    # directly instead.
+    import numpy as _np
+
+    from jax._src import callback as _cb
+
+    def _io_callback_impl_host(*args, result_avals, callback, sharding,
+                               ordered):
+        del result_avals, sharding, ordered
+        np_args = tuple(_np.asarray(a) for a in args)
+        import jax.tree_util as _tu
+
+        return _tu.tree_map(_np.asarray, callback(*np_args))
+
+    _cb.io_callback_impl = _io_callback_impl_host
+
 
 def default_interpret(interpret: Optional[bool] = None):
     """Resolve an `interpret=` argument for pl.pallas_call.
