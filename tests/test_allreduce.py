@@ -1,0 +1,70 @@
+"""AllReduce tests (reference: `test/nvidia/test_allreduce.py`)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.kernels.allreduce import (
+    AllReduceContext,
+    AllReduceMethod,
+    all_reduce,
+    get_auto_allreduce_method,
+)
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.testing import assert_allclose
+
+
+def _run_ar(mesh, x_per_rank, method, axis="tp"):
+    world = mesh.shape[axis]
+    ctx = AllReduceContext(axis=axis, world_size=world, method=method)
+    fn = shard_map_op(lambda xs: all_reduce(xs[0], ctx), mesh,
+                      in_specs=P(axis, None, None), out_specs=P(None, None))
+    return jax.jit(fn)(x_per_rank)
+
+
+@pytest.mark.parametrize("method", [
+    AllReduceMethod.ONE_SHOT,
+    AllReduceMethod.TWO_SHOT,
+    AllReduceMethod.RING,
+    AllReduceMethod.XLA,
+])
+@pytest.mark.parametrize("world,mesh_name", [(4, "tp4_mesh"), (8, "tp8_mesh")])
+def test_allreduce(request, method, world, mesh_name):
+    mesh = request.getfixturevalue(mesh_name)
+    m, n = 16, 128
+    xs = jax.random.normal(jax.random.key(0), (world, m, n), jnp.float32)
+    out = _run_ar(mesh, xs, method)
+    assert_allclose(out, xs.sum(axis=0), atol=1e-4, rtol=1e-4,
+                    name=f"ar-{method.value}-w{world}")
+
+
+def test_allreduce_bf16(tp4_mesh):
+    world, m, n = 4, 8, 256
+    xs = (jax.random.normal(jax.random.key(1), (world, m, n)) / 4
+          ).astype(jnp.bfloat16)
+    out = _run_ar(tp4_mesh, xs, AllReduceMethod.ONE_SHOT)
+    assert_allclose(out.astype(jnp.float32),
+                    xs.astype(jnp.float32).sum(axis=0), atol=5e-2, rtol=5e-2)
+
+
+def test_auto_select():
+    assert get_auto_allreduce_method(1024, 8) == AllReduceMethod.ONE_SHOT
+    assert get_auto_allreduce_method(1 << 20, 8) == AllReduceMethod.TWO_SHOT
+    assert get_auto_allreduce_method(64 << 20, 8) == AllReduceMethod.RING
+
+
+def test_straggler_injection(tp4_mesh):
+    """Straggler option must not change results (reference:
+    stress_test_ag_gemm straggler_option)."""
+    world, m, n = 4, 8, 128
+    xs = jax.random.normal(jax.random.key(2), (world, m, n), jnp.float32)
+    ctx = AllReduceContext(axis="tp", world_size=world,
+                           method=AllReduceMethod.ONE_SHOT,
+                           straggler=(1, 10_000))
+    fn = shard_map_op(lambda x: all_reduce(x[0], ctx), tp4_mesh,
+                      in_specs=P("tp", None, None), out_specs=P(None, None))
+    out = jax.jit(fn)(xs)
+    assert_allclose(out, xs.sum(axis=0), atol=1e-4, rtol=1e-4)
