@@ -1,0 +1,121 @@
+"""Low-latency AllToAll + MoE routing tests (reference:
+`test/nvidia/test_all_to_all.py`, `test_moe_utils.py`)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.kernels.low_latency_all_to_all import (
+    AllToAllContext,
+    all_to_all_post_process,
+    fast_all_to_all,
+)
+from triton_distributed_tpu.kernels import moe_utils
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.testing import assert_allclose
+
+
+def test_histogram():
+    ids = jnp.array([[0, 1], [1, 2], [2, 2]], jnp.int32)
+    h = moe_utils.histogram(ids, 4)
+    assert h.tolist() == [1, 2, 3, 0]
+
+
+def test_route_capacity_no_drop():
+    ids = jnp.array([[0, 1], [1, 0], [2, 3]], jnp.int32)
+    r = moe_utils.route_capacity(ids, 4, capacity=6)
+    assert r.counts.tolist() == [2, 2, 1, 1]
+    # expert 0 gets tokens 0 (slot 0) and 1 (slot 1); order stable
+    assert r.dispatch_index[0, 0] == 0 and r.dispatch_index[0, 1] == 1
+    assert r.dispatch_index[1, 0] == 0 and r.dispatch_index[1, 1] == 1
+    assert (r.slot_of_pair >= 0).all()
+
+
+def test_route_capacity_drop():
+    ids = jnp.zeros((4, 1), jnp.int32)  # all to expert 0
+    r = moe_utils.route_capacity(ids, 2, capacity=2)
+    assert r.counts[0] == 4
+    # only first two kept
+    assert r.slot_of_pair.reshape(-1).tolist() == [0, 1, -1, -1]
+    assert r.dispatch_index[0].tolist() == [0, 1]
+
+
+def test_gather_combine_roundtrip():
+    n, topk, E, cap, h = 6, 2, 4, 8, 16
+    key = jax.random.key(0)
+    tokens = jax.random.normal(key, (n, h))
+    ids = jax.random.randint(jax.random.key(1), (n, topk), 0, E)
+    w = jax.nn.softmax(jax.random.normal(jax.random.key(2), (n, topk)))
+    r = moe_utils.route_capacity(ids, E, cap)
+    buckets = moe_utils.gather_tokens(tokens, r.dispatch_index)
+    # identity expert → combine = sum_k w_k * token = token
+    out = moe_utils.combine_tokens(buckets, ids, r.slot_of_pair, w)
+    assert_allclose(out, tokens * w.sum(1, keepdims=True), atol=1e-5,
+                    rtol=1e-5)
+
+
+@pytest.mark.parametrize("world,mesh_name", [(4, "ep4_mesh"), (8, "tp8_mesh")])
+def test_fast_all_to_all(request, world, mesh_name):
+    mesh = request.getfixturevalue(mesh_name)
+    axis = list(mesh.axis_names)[0]
+    cap, hidden = 8, 128
+    key = jax.random.key(3)
+    # send[r, p] = tokens rank r sends to rank p
+    send = jax.random.normal(key, (world, world, cap, hidden), jnp.float32)
+    counts = jax.random.randint(jax.random.key(4), (world, world, 1), 1,
+                                cap + 1).astype(jnp.int32)
+
+    ctx = AllToAllContext(axis=axis, world_size=world,
+                          max_tokens_per_rank=cap, hidden=hidden)
+    fn = shard_map_op(
+        lambda s, c: fast_all_to_all(s[0], c[0], ctx),
+        mesh, in_specs=(P(axis, None, None, None), P(axis, None, None)),
+        out_specs=(P(axis, None, None), P(axis, None)))
+    recv, rcounts = jax.jit(fn)(send, counts)
+    recv = recv.reshape(world, world, cap, hidden)
+    rcounts = rcounts.reshape(world, world, 1)
+
+    # recv[r, p] must equal send[p, r]
+    expected = jnp.swapaxes(send, 0, 1)
+    assert_allclose(recv, expected, atol=0, rtol=0, name="a2a tokens")
+    assert_allclose(rcounts, jnp.swapaxes(counts, 0, 1), atol=0, rtol=0,
+                    name="a2a counts")
+
+
+def test_a2a_with_scales(ep4_mesh):
+    world, cap, hidden, nscale = 4, 4, 128, 8
+    send = jax.random.normal(jax.random.key(5), (world, world, cap, hidden))
+    scales = jax.random.normal(jax.random.key(6), (world, world, cap, nscale))
+    counts = jnp.ones((world, world, 1), jnp.int32) * cap
+    ctx = AllToAllContext(axis="ep", world_size=world,
+                          max_tokens_per_rank=cap, hidden=hidden)
+    fn = shard_map_op(
+        lambda s, c, sc: fast_all_to_all(s[0], c[0], ctx, send_scales=sc[0]),
+        ep4_mesh,
+        in_specs=(P("ep", None, None, None), P("ep", None, None),
+                  P("ep", None, None, None)),
+        out_specs=(P("ep", None, None), P("ep", None),
+                   P("ep", None, None)))
+    recv, rcounts, rscales = jax.jit(fn)(send, counts, scales)
+    assert_allclose(recv.reshape(world, world, cap, hidden),
+                    jnp.swapaxes(send, 0, 1), atol=0, rtol=0)
+    assert_allclose(rscales.reshape(world, world, cap, nscale),
+                    jnp.swapaxes(scales, 0, 1), atol=0, rtol=0)
+
+
+def test_post_process():
+    world, cap, hidden = 2, 4, 8
+    recv = jnp.arange(world * cap * hidden, dtype=jnp.float32).reshape(
+        world, cap, hidden)
+    counts = jnp.array([[2], [3]], jnp.int32)
+    dense, total = all_to_all_post_process(recv, counts, cap)
+    assert int(total) == 5
+    np.testing.assert_array_equal(np.asarray(dense[0]), np.asarray(recv[0, 0]))
+    np.testing.assert_array_equal(np.asarray(dense[1]), np.asarray(recv[0, 1]))
+    np.testing.assert_array_equal(np.asarray(dense[2]), np.asarray(recv[1, 0]))
+    np.testing.assert_array_equal(np.asarray(dense[4]), np.asarray(recv[1, 2]))
+    assert float(jnp.abs(dense[5:]).max()) == 0.0

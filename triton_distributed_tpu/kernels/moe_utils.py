@@ -1,0 +1,110 @@
+"""MoE routing utilities: histograms, capacity-padded routing, gather
+/ combine index computation.
+
+Reference: `python/triton_dist/kernels/nvidia/moe_utils.py` (394 LoC —
+gather/scatter index calc `:32-88`, histogram `:89+`) and the native
+alignment ops `csrc/lib/moe_utils.cu` (`moe_ag_scatter_align_block_size`)
+which compute block-aligned expert offsets so grouped-GEMM tiles are
+uniform.
+
+TPU re-design: dynamic token counts per expert are handled by
+**capacity padding** (fixed expert capacity, drop-or-pad), which keeps
+every shape static so XLA can tile the grouped GEMM onto the MXU — the
+TPU equivalent of block-aligning expert segments.  All routines are
+jit-friendly (no data-dependent shapes).  For exact no-drop parity with
+the reference, pass ``capacity = n_tokens * topk``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+def histogram(expert_ids, num_experts: int):
+    """Tokens per expert (reference `moe_utils.py` histogram kernel).
+    expert_ids: int32 (...,) → (num_experts,)."""
+    return jnp.zeros(num_experts, jnp.int32).at[expert_ids.reshape(-1)].add(1)
+
+
+class Routing(NamedTuple):
+    """Capacity-padded routing plan for one (token, topk) assignment.
+
+    dispatch_index: (num_experts, capacity) int32 — source token index
+      for each expert slot; `n_tokens` marks an empty slot.
+    slot_of_pair:   (n_tokens, topk) int32 — slot each (token, k) pair
+      landed in, -1 if dropped by capacity.
+    counts:         (num_experts,) int32 — true (uncapped) tokens/expert.
+    """
+
+    dispatch_index: jnp.ndarray
+    slot_of_pair: jnp.ndarray
+    counts: jnp.ndarray
+
+
+def route_capacity(expert_ids, num_experts: int, capacity: int) -> Routing:
+    """Build a capacity-padded routing plan.
+
+    expert_ids: (n_tokens, topk) int32.  Deterministic: earlier tokens
+    win slots (the stable order the reference gets from its sort-based
+    `calc_gather_index`).
+    """
+    n_tokens, topk = expert_ids.shape
+    npairs = n_tokens * topk
+    flat_e = expert_ids.reshape(-1)
+    flat_tok = jax.lax.broadcasted_iota(
+        jnp.int32, (n_tokens, topk), 0).reshape(-1)
+
+    order = jnp.argsort(flat_e, stable=True)
+    sorted_e = flat_e[order]
+    sorted_tok = flat_tok[order]
+    pos_in_expert = (
+        jax.lax.broadcasted_iota(jnp.int32, (npairs, 1), 0)[:, 0]
+        - jnp.searchsorted(sorted_e, sorted_e, side="left").astype(jnp.int32)
+    )
+    kept = pos_in_expert < capacity
+
+    dispatch_index = (
+        jnp.full((num_experts, capacity), n_tokens, jnp.int32)
+        .at[sorted_e, jnp.where(kept, pos_in_expert, capacity)]
+        .set(sorted_tok, mode="drop")
+    )
+    slot_sorted = jnp.where(kept, pos_in_expert, -1)
+    slot_of_pair = (
+        jnp.zeros(npairs, jnp.int32).at[order].set(slot_sorted)
+        .reshape(n_tokens, topk)
+    )
+    return Routing(dispatch_index=dispatch_index,
+                   slot_of_pair=slot_of_pair,
+                   counts=histogram(flat_e, num_experts))
+
+
+def gather_tokens(tokens, dispatch_index):
+    """Expand tokens into per-expert buckets: (E, capacity, hidden).
+    Empty slots read a zero row (sentinel index n_tokens)."""
+    padded = jnp.concatenate(
+        [tokens, jnp.zeros((1,) + tokens.shape[1:], tokens.dtype)], axis=0)
+    return padded[dispatch_index]
+
+
+def combine_tokens(expert_out, expert_ids, slot_of_pair, weights):
+    """Weighted combine of expert outputs back to token order.
+
+    expert_out: (E, capacity, H); expert_ids / slot_of_pair / weights:
+    (n_tokens, topk).  Dropped pairs contribute zero.  Returns
+    (n_tokens, H)."""
+    kept = slot_of_pair >= 0
+    safe_slot = jnp.where(kept, slot_of_pair, 0)
+    vals = expert_out[expert_ids, safe_slot]            # (n, topk, H)
+    w = jnp.where(kept, weights, 0.0)[..., None].astype(jnp.float32)
+    return (vals.astype(jnp.float32) * w).sum(axis=1).astype(expert_out.dtype)
+
+
+def tokens_per_rank(expert_ids, num_experts: int, ep_size: int):
+    """Split counts by destination EP rank (reference `bincount` +
+    cumsum preprocessing, `ep_a2a.py:310-377`)."""
+    experts_per_rank = num_experts // ep_size
+    counts = histogram(expert_ids, num_experts)
+    return counts.reshape(ep_size, experts_per_rank).sum(axis=1)
