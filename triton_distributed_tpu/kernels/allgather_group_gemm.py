@@ -1,0 +1,141 @@
+"""Fused AllGather + Grouped GEMM — the MoE TP prologue.
+
+Reference: `python/triton_dist/kernels/nvidia/allgather_group_gemm.py`
+(671 LoC): tokens are allgathered while an expert-grouped GEMM consumer
+waits per-rank readiness flags and processes tokens in a dynamically
+swizzled tile order (`MoEAllGatherGroupGEMMTensorParallelContext:199`,
+`ag_group_gemm:398`, consumer `:557`).
+
+TPU re-design: each rank pre-buckets its *local* tokens per expert
+(capacity-padded, moe_utils.route_capacity) so the payload exchanged is
+the bucket tensor (E, cap_loc, h) — static shapes, no device-side sort
+(the role of the reference's `calc_sorted_gather_index_kernel` is
+played by XLA-side routing).  The fused kernel then runs the proven
+ag_gemm ring: forward the freshest bucket-chunk to the right neighbor
+while the MXU computes that chunk's grouped GEMM against the local
+expert shards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.kernels.grouped_gemm import emit_grouped_matmul
+from triton_distributed_tpu.kernels.matmul import MatmulConfig
+from triton_distributed_tpu.language import core as dl
+from triton_distributed_tpu.utils.platform import default_interpret
+
+
+@dataclasses.dataclass
+class AGGroupGEMMContext:
+    """Reference analogue:
+    `MoEAllGatherGroupGEMMTensorParallelContext`
+    (`allgather_group_gemm.py:199`)."""
+    axis: str
+    world_size: int
+    num_experts: int
+    gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
+    collective_id: int = 6
+    interpret: Optional[bool] = None
+
+
+def create_ag_group_gemm_context(axis: str, world_size: int,
+                                 num_experts: int, **kw):
+    return AGGroupGEMMContext(axis=axis, world_size=world_size,
+                              num_experts=num_experts, **kw)
+
+
+def _ag_group_gemm_kernel(ctx: AGGroupGEMMContext, cap, n, k,
+                          x_ref, b_ref, gathered_ref, out_ref,
+                          local_sem, send_sem, recv_sems):
+    world = ctx.world_size
+    my = jax.lax.axis_index(ctx.axis)
+    right = jax.lax.rem(my + 1, world)
+
+    dl.local_copy(x_ref, gathered_ref.at[my], local_sem)
+
+    for s in range(world):
+        chunk = jax.lax.rem(my - s + 2 * world, world)
+        rdma = None
+        if s < world - 1:
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=gathered_ref.at[chunk],
+                dst_ref=gathered_ref.at[chunk],
+                send_sem=send_sem,
+                recv_sem=recv_sems.at[chunk],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+        emit_grouped_matmul(gathered_ref.at[chunk], b_ref,
+                            out_ref.at[chunk],
+                            num_experts=ctx.num_experts, m=cap, n=n, k=k,
+                            config=ctx.gemm)
+        if rdma is not None:
+            exp = jax.lax.rem(my - s - 1 + 2 * world, world)
+            dl.wait_recv(gathered_ref.at[exp], recv_sems.at[exp])
+            rdma.wait_send()
+
+
+def ag_group_gemm(buckets, expert_weights, ctx: AGGroupGEMMContext):
+    """Overlapped allgather(buckets) × expert_weights.
+
+    Call inside shard_map over `ctx.axis`.
+
+    buckets: (E, cap_loc, k) — this rank's tokens bucketed per expert
+      (moe_utils.route_capacity + gather_tokens).
+    expert_weights: (E, k, n_loc) — this rank's TP column shard of all
+      expert weights.
+    Returns (world, E, cap_loc, n_loc): per source-rank expert outputs
+    (chunk r = rank r's tokens), for downstream topk-combine.
+    """
+    world = ctx.world_size
+    e, cap, k = buckets.shape
+    e2, k2, n = expert_weights.shape
+    assert e == e2 == ctx.num_experts and k == k2
+
+    gathered, out = pl.pallas_call(
+        functools.partial(_ag_group_gemm_kernel, ctx, cap, n, k),
+        out_shape=(
+            jax.ShapeDtypeStruct((world, e, cap, k), buckets.dtype),
+            jax.ShapeDtypeStruct((world, e, cap, n), buckets.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((world,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=ctx.collective_id),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * world * e * cap * n * k,
+            bytes_accessed=(world * e * cap * k + e * k * n
+                            + world * e * cap * n) * buckets.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=default_interpret(ctx.interpret),
+    )(buckets, expert_weights)
+    return out
+
+
+def gated_silu(gate_up):
+    """Fused SiLU(gate) * up for stacked gate/up projections
+    (reference `gated_silu`, `allgather_group_gemm.py:410`).
+    gate_up: (..., 2*n) → (..., n)."""
+    gate, up = jnp.split(gate_up, 2, axis=-1)
+    return jax.nn.silu(gate.astype(jnp.float32)).astype(gate.dtype) * up

@@ -1,0 +1,94 @@
+"""Grouped (per-expert) GEMM building blocks.
+
+The reference implements grouped GEMM as Triton kernels over
+block-aligned ragged segments (`kernels/nvidia/allgather_group_gemm.py:557`,
+`moe_reduce_rs.py:1003`) with native helpers computing segment
+alignment (`csrc/lib/moe_utils.cu`).
+
+TPU re-design: experts are capacity-padded (see moe_utils), so a
+grouped GEMM is a *batched* matmul with static shapes
+(E, cap, k) × (E, k, n) → (E, cap, n) — exactly what the MXU wants.
+Provided as a standalone pallas_call and as `emit_grouped_matmul` for
+use inside overlap kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.kernels.matmul import MatmulConfig, emit_matmul
+from triton_distributed_tpu.utils.platform import default_interpret
+
+
+def _grouped_kernel(nk: int, a_ref, b_ref, o_ref, acc_ref):
+    kk = pl.program_id(3)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jax.lax.dot_general(
+        a_ref[0], b_ref[0],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(kk == nk - 1)
+    def _():
+        o_ref[0] = acc_ref[:].astype(o_ref.dtype)
+
+
+def grouped_matmul(a, b, config: Optional[MatmulConfig] = None,
+                   out_dtype=None, interpret: Optional[bool] = None):
+    """(E, m, k) @ (E, k, n) → (E, m, n), one expert per leading grid
+    step, blocked for the MXU."""
+    e, m, k = a.shape
+    e2, k2, n = b.shape
+    assert e == e2 and k == k2, (a.shape, b.shape)
+    out_dtype = out_dtype or a.dtype
+    cfg = (config or MatmulConfig()).resolve(m, n, k)
+    nk = pl.cdiv(k, cfg.block_k)
+    grid = (e, pl.cdiv(m, cfg.block_m), pl.cdiv(n, cfg.block_n), nk)
+    return pl.pallas_call(
+        functools.partial(_grouped_kernel, nk),
+        out_shape=jax.ShapeDtypeStruct((e, m, n), out_dtype),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, cfg.block_m, cfg.block_k),
+                             lambda g, i, j, kk: (g, i, kk),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, cfg.block_k, cfg.block_n),
+                             lambda g, i, j, kk: (g, kk, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, cfg.block_m, cfg.block_n),
+                                   lambda g, i, j, kk: (g, i, j),
+                                   memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((min(cfg.block_m, m), min(cfg.block_n, n)),
+                           jnp.float32)
+            ],
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * e * m * n * k,
+            bytes_accessed=(e * m * k + e * k * n) * a.dtype.itemsize
+            + e * m * n * jnp.dtype(out_dtype).itemsize,
+            transcendentals=0,
+        ),
+        interpret=default_interpret(interpret),
+    )(a, b)
+
+
+def emit_grouped_matmul(a_ref, b_ref, o_ref, *, num_experts, m, n, k,
+                        config: Optional[MatmulConfig] = None):
+    """Grouped matmul over HBM refs inside a kernel body:
+    a_ref (E, m, k), b_ref (E, k, n), o_ref (E, m, n)."""
+    for ex in range(num_experts):
+        emit_matmul(a_ref.at[ex], b_ref.at[ex], o_ref.at[ex],
+                    m=m, n=n, k=k, config=config)

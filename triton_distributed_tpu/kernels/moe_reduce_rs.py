@@ -1,0 +1,84 @@
+"""Grouped GEMM + topk-weighted combine + ReduceScatter — the MoE TP
+epilogue.
+
+Reference: `python/triton_dist/kernels/nvidia/moe_reduce_rs.py` (1432
+LoC): a grouped-GEMM producer scatters tiles while a consumer does the
+topk weighted reduce and a 2D reduce-scatter (`MoEReduceRSContext:245`,
+producer `:380`, topk-RS consumer `:486`, rowise `:816` / colwise
+`:1357` variants).
+
+TPU re-design: the epilogue is expressed as three fused-friendly
+stages, each already overlap-optimal on its own hardware engine:
+
+1. grouped GEMM (E, cap, k)×(E, k, n) — Pallas, MXU;
+2. topk combine — XLA gather+weighted-sum, fused by XLA into the
+   surrounding elementwise stream (VPU);
+3. reduce-scatter of the combined tokens — the flow-controlled Pallas
+   ring / one-shot scatter kernel (reduce_scatter.py) on the ICI DMA
+   engines.
+
+The single-kernel chunk-major fusion (compute only chunk-c rows, put,
+reduce — the exact reference pipeline) is `moe_reduce_rs_fused`, which
+reuses the gemm_rs machinery with (chunk, expert)-bucketed inputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels import moe_utils
+from triton_distributed_tpu.kernels.grouped_gemm import grouped_matmul
+from triton_distributed_tpu.kernels.matmul import MatmulConfig
+from triton_distributed_tpu.kernels.reduce_scatter import (
+    ReduceScatterContext,
+    ReduceScatterMethod,
+    reduce_scatter,
+)
+
+
+@dataclasses.dataclass
+class MoEReduceRSContext:
+    """Reference analogue: `MoEReduceRSContext` (`moe_reduce_rs.py:245`)."""
+    axis: str
+    world_size: int
+    num_experts: int
+    topk: int
+    gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
+    rs_method: ReduceScatterMethod = ReduceScatterMethod.AUTO
+    collective_id: int = 8
+    interpret: Optional[bool] = None
+
+
+def create_moe_rs_context(axis: str, world_size: int, num_experts: int,
+                          topk: int, **kw):
+    return MoEReduceRSContext(axis=axis, world_size=world_size,
+                              num_experts=num_experts, topk=topk, **kw)
+
+
+def moe_reduce_rs(buckets, expert_weights, expert_ids, slot_of_pair,
+                  topk_weights, ctx: MoEReduceRSContext):
+    """Per-rank partial MoE output → reduced+scattered tokens.
+
+    Call inside shard_map over `ctx.axis`.
+
+    buckets:        (E, cap, k_loc) — routed tokens (intermediate
+                    activations), this rank's TP K-shard.
+    expert_weights: (E, k_loc, n) — down-projection K-shard.
+    expert_ids / slot_of_pair / topk_weights: (n_tokens, topk) routing
+                    (from moe_utils.route_capacity on the full token
+                    set; identical on every rank).
+    Returns (n_tokens / world, n): this rank's reduced row chunk.
+    """
+    expert_out = grouped_matmul(buckets, expert_weights, config=ctx.gemm,
+                                interpret=ctx.interpret)
+    combined = moe_utils.combine_tokens(expert_out, expert_ids,
+                                        slot_of_pair, topk_weights)
+    rs_ctx = ReduceScatterContext(axis=ctx.axis, world_size=ctx.world_size,
+                                  method=ctx.rs_method,
+                                  collective_id=ctx.collective_id,
+                                  interpret=ctx.interpret)
+    return reduce_scatter(combined, rs_ctx)
