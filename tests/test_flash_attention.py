@@ -1,0 +1,50 @@
+"""Flash attention tests."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from triton_distributed_tpu.kernels.flash_attention import (
+    attention_reference,
+    flash_attention,
+)
+from triton_distributed_tpu.utils.testing import assert_allclose
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("gqa", [1, 4])
+def test_flash_attention(causal, gqa):
+    b, h, s, d = 2, 4, 64, 32
+    hkv = h // gqa
+    key = jax.random.key(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.float32)
+    k = jax.random.normal(kk, (b, hkv, s, d), jnp.float32)
+    v = jax.random.normal(kv, (b, hkv, s, d), jnp.float32)
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    ref = attention_reference(q, k, v, causal=causal)
+    assert_allclose(out, ref, atol=2e-3, rtol=2e-3,
+                    name=f"flash-causal{causal}-g{gqa}")
+
+
+def test_flash_attention_kv_offset():
+    b, h, s, d = 1, 2, 32, 32
+    sk = 64
+    q = jax.random.normal(jax.random.key(1), (b, h, s, d))
+    k = jax.random.normal(jax.random.key(2), (b, h, sk, d))
+    v = jax.random.normal(jax.random.key(3), (b, h, sk, d))
+    # queries logically at positions 32..63
+    out = flash_attention(q, k, v, causal=True, kv_offset=32,
+                          block_q=16, block_k=16)
+    ref = attention_reference(q, k, v, causal=True, kv_offset=32)
+    assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+
+def test_flash_attention_rect():
+    b, h, sq, sk, d = 1, 2, 16, 128, 64
+    q = jax.random.normal(jax.random.key(4), (b, h, sq, d))
+    k = jax.random.normal(jax.random.key(5), (b, h, sk, d))
+    v = jax.random.normal(jax.random.key(6), (b, h, sk, d))
+    out = flash_attention(q, k, v, causal=False, block_q=16, block_k=64)
+    ref = attention_reference(q, k, v, causal=False)
+    assert_allclose(out, ref, atol=2e-3, rtol=2e-3)

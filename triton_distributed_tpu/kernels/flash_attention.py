@@ -1,0 +1,148 @@
+"""Pallas flash attention (prefill) with GQA and causal masking.
+
+The single-chip compute core that the reference gets from Triton
+flash-attn kernels (`kernels/nvidia/sp_ag_attention_intra_node.py:187`
+`_flash_attn_forward_inner`, and the flash-decode family).  Online
+softmax over KV blocks, MXU matmuls, fp32 accumulation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.utils.platform import default_interpret
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(nk: int, scale: float, causal: bool, block_q: int,
+                  block_k: int, kv_offset: int,
+                  q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr):
+    """Grid: (B, H, nq, nk); blocks: q (1,1,bq,D), k/v (1,1,bk,D)."""
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, 0]                       # (bq, D)
+    k = k_ref[0, 0]                       # (bk, D)
+    v = v_ref[0, 0]
+
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # (bq, bk)
+
+    if causal:
+        q_pos = (qi * block_q
+                 + jax.lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 0)
+                 + kv_offset)
+        k_pos = (ki * block_k
+                 + jax.lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 1))
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+    m_prev = m_scr[:]                     # (bq, 1)
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                # (bq, bk)
+    l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+    l_scr[:] = l_new
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None,
+                    kv_offset: int = 0,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """q: (B, H, Sq, D); k, v: (B, Hkv, Sk, D) → (B, H, Sq, D).
+
+    `kv_offset` shifts the causal diagonal: query row i attends kv cols
+    <= i + kv_offset (used by SP attention where the local queries sit
+    at a global offset).
+    """
+    b, h, sq, d = q.shape
+    _, hkv, sk, _ = k.shape
+    assert h % hkv == 0
+    group = h // hkv
+    scale = scale if scale is not None else d ** -0.5
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    nq = pl.cdiv(sq, bq)
+    nk = pl.cdiv(sk, bk)
+
+    return pl.pallas_call(
+        functools.partial(_flash_kernel, nk, scale, causal, bq, bk,
+                          kv_offset),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        grid_spec=pl.GridSpec(
+            grid=(b, h, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda bb, hh, qi, ki: (bb, hh, qi, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bk, d),
+                             lambda bb, hh, qi, ki, g=group:
+                                 (bb, hh // g, ki, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bk, d),
+                             lambda bb, hh, qi, ki, g=group:
+                                 (bb, hh // g, ki, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, 1, bq, d),
+                                   lambda bb, hh, qi, ki: (bb, hh, qi, 0),
+                                   memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, d), jnp.float32),
+            ],
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * sq * sk * d,
+            bytes_accessed=(b * h * sq * d * 2
+                            + b * hkv * sk * d * 2) * q.dtype.itemsize,
+            transcendentals=b * h * sq * sk,
+        ),
+        interpret=default_interpret(interpret),
+    )(q, k, v)
+
+
+def attention_reference(q, k, v, *, causal: bool = True,
+                        scale: Optional[float] = None, kv_offset: int = 0):
+    """Golden dense attention (fp32)."""
+    b, h, sq, d = q.shape
+    _, hkv, sk, _ = k.shape
+    group = h // hkv
+    kf = jnp.repeat(k.astype(jnp.float32), group, axis=1)
+    vf = jnp.repeat(v.astype(jnp.float32), group, axis=1)
+    scale = scale if scale is not None else d ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), kf) * scale
+    if causal:
+        qpos = jnp.arange(sq)[:, None] + kv_offset
+        kpos = jnp.arange(sk)[None, :]
+        s = jnp.where(kpos <= qpos, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, vf).astype(q.dtype)

@@ -1,0 +1,185 @@
+"""Flash-Decode: split-KV GQA decode attention, single-chip and
+sequence-parallel distributed.
+
+Reference: `python/triton_dist/kernels/nvidia/flash_decode.py` (1161
+LoC) — split-kv kernel (`:130`), intra-rank combine (`:393`),
+inter-rank LSE-weighted combine (`:482`), distributed hosts
+(`:763-1160`); layer `SpGQAFlashDecodeAttention`
+(`layers/nvidia/sp_flash_decode_layer.py:83-183`).
+
+TPU re-design:
+- single chip: one Pallas kernel, grid over KV splits, online-softmax
+  partials (acc, m, l) carried in VMEM, masked by the true cache
+  length (static shapes; `kv_len` rides in SMEM).
+- distributed (SP): every rank runs the local kernel over its KV shard
+  emitting (out, lse); the tiny partials are exchanged with the
+  one-shot push allgather (the reference's LL-allgather of (out, lse))
+  and combined with LSE weights — `sp_flash_decode`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.utils.platform import default_interpret
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(nk: int, scale: float, block_k: int,
+                   kvlen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                   m_scr, l_scr, acc_scr):
+    """Grid: (B, Hkv, nk).  Blocks: q (1, 1, G, D) — all grouped query
+    heads of one kv head; k/v (1, 1, bk, D)."""
+    ki = pl.program_id(2)
+    bb = pl.program_id(0)
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, 0]                        # (G, D)
+    k = k_ref[0, 0]                        # (bk, D)
+    v = v_ref[0, 0]
+
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale     # (G, bk)
+
+    kv_len = kvlen_ref[bb]
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    s = jnp.where(k_pos < kv_len, s, NEG_INF)
+
+    m_prev = m_scr[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        # log-sum-exp for cross-rank combine
+        lse_ref[0, 0] = (m_scr[:] + jnp.log(l))[:, 0]
+
+
+def flash_decode(q, k_cache, v_cache, kv_len, *,
+                 scale: Optional[float] = None, block_k: int = 256,
+                 interpret: Optional[bool] = None):
+    """Single-position GQA decode.
+
+    q: (B, H, D); k_cache/v_cache: (B, Hkv, S, D); kv_len: (B,) int32
+    (true filled length, ≤ S).  Returns (out (B, H, D), lse (B, H)).
+    """
+    b, h, d = q.shape
+    _, hkv, s, _ = k_cache.shape
+    assert h % hkv == 0
+    g = h // hkv
+    scale = scale if scale is not None else d ** -0.5
+    bk = min(block_k, s)
+    nk = pl.cdiv(s, bk)
+
+    qg = q.reshape(b, hkv, g, d)
+    out, lse = pl.pallas_call(
+        functools.partial(_decode_kernel, nk, scale, bk),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hkv, g), jnp.float32),
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, hkv, nk),
+            in_specs=[
+                pl.BlockSpec((1, 1, g, d),
+                             lambda bb, hh, ki, *pre: (bb, hh, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bk, d),
+                             lambda bb, hh, ki, *pre: (bb, hh, ki, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bk, d),
+                             lambda bb, hh, ki, *pre: (bb, hh, ki, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, 1, g, d),
+                             lambda bb, hh, ki, *pre: (bb, hh, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, g),
+                             lambda bb, hh, ki, *pre: (bb, hh, 0),
+                             memory_space=pltpu.VMEM),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, d), jnp.float32),
+            ],
+        ),
+        interpret=default_interpret(interpret),
+    )(kv_len.astype(jnp.int32), qg, k_cache, v_cache)
+    return out.reshape(b, h, d), lse.reshape(b, h)
+
+
+def combine_partials(outs, lses):
+    """LSE-weighted combine of per-shard decode partials (reference
+    inter-rank combine kernel, `flash_decode.py:482`).
+
+    outs: (R, B, H, D); lses: (R, B, H) → (B, H, D)."""
+    m = jnp.max(lses, axis=0, keepdims=True)          # (1, B, H)
+    w = jnp.exp(lses - m)                             # (R, B, H)
+    denom = jnp.sum(w, axis=0)                        # (B, H)
+    num = jnp.einsum("rbh,rbhd->bhd", w, outs.astype(jnp.float32))
+    return (num / jnp.maximum(denom, 1e-30)[..., None]).astype(outs.dtype)
+
+
+def sp_flash_decode(q, k_shard, v_shard, kv_len_local, axis: str, *,
+                    scale: Optional[float] = None, block_k: int = 256,
+                    collective_id: int = 9,
+                    interpret: Optional[bool] = None):
+    """Sequence-parallel distributed flash-decode.  Call inside
+    shard_map over `axis`; each rank holds a KV shard.
+
+    q: (B, H, D) replicated; k/v_shard: (B, Hkv, S_loc, D);
+    kv_len_local: (B,) tokens valid in this rank's shard.
+    Returns (B, H, D) combined on every rank.
+
+    Pipeline = reference's: local split-KV kernel → LL allgather of
+    (out, lse) (KB-scale, latency-bound: one-shot push) → LSE combine.
+    """
+    from triton_distributed_tpu.kernels.allgather import (
+        AllGatherContext, AllGatherMethod, all_gather)
+
+    world = jax.lax.axis_size(axis)
+    b, h, d = q.shape
+    out, lse = flash_decode(q, k_shard, v_shard, kv_len_local,
+                            scale=scale, block_k=block_k,
+                            interpret=interpret)
+    # Empty shards (kv_len 0) have lse = -inf ⇒ zero weight.
+    lse = jnp.where(kv_len_local[:, None] > 0, lse, NEG_INF)
+
+    ag_ctx = AllGatherContext(axis=axis, world_size=world,
+                              method=AllGatherMethod.PUSH_ALL,
+                              collective_id=collective_id,
+                              interpret=interpret)
+    # Pack (out, lse) into one payload row per rank for a single LL AG.
+    payload = jnp.concatenate(
+        [out.astype(jnp.float32).reshape(b * h, d),
+         lse.reshape(b * h, 1)], axis=1)              # (B*H, D+1)
+    gathered = all_gather(payload, ag_ctx)            # (world*B*H, D+1)
+    gathered = gathered.reshape(world, b, h, d + 1)
+    outs = gathered[..., :d]
+    lses = gathered[..., d]
+    return combine_partials(outs, lses).astype(q.dtype)
