@@ -1,0 +1,41 @@
+"""SP attention tests (reference:
+`test/nvidia/test_sp_ag_attention_{intra,inter}_node.py`)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.kernels.flash_attention import (
+    attention_reference,
+)
+from triton_distributed_tpu.kernels.sp_ag_attention import (
+    sp_ag_attention_gather,
+    sp_ring_attention,
+)
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.testing import assert_allclose
+
+
+@pytest.mark.parametrize("impl", [sp_ring_attention, sp_ag_attention_gather])
+@pytest.mark.parametrize("gqa", [1, 2])
+def test_sp_attention(sp4_mesh, impl, gqa):
+    world, b, h, s_loc, d = 4, 1, 4, 32, 32
+    hkv = h // gqa
+    s = world * s_loc
+    q = jax.random.normal(jax.random.key(0), (b, h, s, d)) / 4
+    k = jax.random.normal(jax.random.key(1), (b, hkv, s, d)) / 4
+    v = jax.random.normal(jax.random.key(2), (b, hkv, s, d)) / 4
+
+    fn = shard_map_op(
+        functools.partial(impl, axis="sp", block_q=16, block_k=16),
+        sp4_mesh,
+        in_specs=(P(None, None, "sp", None), P(None, None, "sp", None),
+                  P(None, None, "sp", None)),
+        out_specs=P(None, None, "sp", None))
+    out = jax.jit(fn)(q, k, v)
+    ref = attention_reference(q, k, v, causal=True)
+    assert_allclose(out, ref, atol=3e-3, rtol=3e-3,
+                    name=f"{impl.__name__}-g{gqa}")

@@ -1,9 +1,12 @@
-"""Pallas flash attention (prefill) with GQA and causal masking.
+"""Pallas flash attention (prefill) with GQA, causal masking and
+log-sum-exp output for cross-shard combination.
 
 The single-chip compute core that the reference gets from Triton
 flash-attn kernels (`kernels/nvidia/sp_ag_attention_intra_node.py:187`
 `_flash_attn_forward_inner`, and the flash-decode family).  Online
-softmax over KV blocks, MXU matmuls, fp32 accumulation.
+softmax over KV blocks, MXU matmuls, fp32 accumulation.  `kv_offset`
+is a *traced* scalar (scalar-prefetch) so sequence-parallel callers can
+shift the causal diagonal per rank.
 """
 
 from __future__ import annotations
@@ -22,8 +25,8 @@ NEG_INF = -1e30
 
 
 def _flash_kernel(nk: int, scale: float, causal: bool, block_q: int,
-                  block_k: int, kv_offset: int,
-                  q_ref, k_ref, v_ref, o_ref,
+                  block_k: int,
+                  off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                   m_scr, l_scr, acc_scr):
     """Grid: (B, H, nq, nk); blocks: q (1,1,bq,D), k/v (1,1,bk,D)."""
     qi = pl.program_id(2)
@@ -47,7 +50,7 @@ def _flash_kernel(nk: int, scale: float, causal: bool, block_q: int,
         q_pos = (qi * block_q
                  + jax.lax.broadcasted_iota(jnp.int32,
                                             (block_q, block_k), 0)
-                 + kv_offset)
+                 + off_ref[0])
         k_pos = (ki * block_k
                  + jax.lax.broadcasted_iota(jnp.int32,
                                             (block_q, block_k), 1))
@@ -69,18 +72,23 @@ def _flash_kernel(nk: int, scale: float, causal: bool, block_q: int,
     def _():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:] + jnp.log(l))[:, 0]
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
-                    kv_offset: int = 0,
+                    kv_offset=0,
+                    return_lse: bool = False,
                     block_q: int = 128, block_k: int = 128,
                     interpret: Optional[bool] = None):
-    """q: (B, H, Sq, D); k, v: (B, Hkv, Sk, D) → (B, H, Sq, D).
+    """q: (B, H, Sq, D); k, v: (B, Hkv, Sk, D) → (B, H, Sq, D)
+    [, lse (B, H, Sq)].
 
-    `kv_offset` shifts the causal diagonal: query row i attends kv cols
-    <= i + kv_offset (used by SP attention where the local queries sit
-    at a global offset).
+    `kv_offset` (python int or traced scalar) shifts the causal
+    diagonal: query row i attends kv cols <= i + kv_offset (used by SP
+    attention where local queries sit at a global offset).  If all
+    positions of a row are masked the row output is 0 with lse ≈ -inf,
+    which drops out of an LSE-weighted combine.
     """
     b, h, sq, d = q.shape
     _, hkv, sk, _ = k.shape
@@ -91,29 +99,38 @@ def flash_attention(q, k, v, *, causal: bool = True,
     bk = min(block_k, sk)
     nq = pl.cdiv(sq, bq)
     nk = pl.cdiv(sk, bk)
+    off = jnp.asarray(kv_offset, jnp.int32).reshape(1)
 
-    return pl.pallas_call(
-        functools.partial(_flash_kernel, nk, scale, causal, bq, bk,
-                          kv_offset),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-        grid_spec=pl.GridSpec(
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_kernel, nk, scale, causal, bq, bk),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
             grid=(b, h, nq, nk),
             in_specs=[
                 pl.BlockSpec((1, 1, bq, d),
-                             lambda bb, hh, qi, ki: (bb, hh, qi, 0),
+                             lambda bb, hh, qi, ki, *pre: (bb, hh, qi, 0),
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((1, 1, bk, d),
-                             lambda bb, hh, qi, ki, g=group:
+                             lambda bb, hh, qi, ki, *pre, g=group:
                                  (bb, hh // g, ki, 0),
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((1, 1, bk, d),
-                             lambda bb, hh, qi, ki, g=group:
+                             lambda bb, hh, qi, ki, *pre, g=group:
                                  (bb, hh // g, ki, 0),
                              memory_space=pltpu.VMEM),
             ],
-            out_specs=pl.BlockSpec((1, 1, bq, d),
-                                   lambda bb, hh, qi, ki: (bb, hh, qi, 0),
-                                   memory_space=pltpu.VMEM),
+            out_specs=(
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda bb, hh, qi, ki, *pre: (bb, hh, qi, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, bq),
+                             lambda bb, hh, qi, ki, *pre: (bb, hh, qi),
+                             memory_space=pltpu.VMEM),
+            ),
             scratch_shapes=[
                 pltpu.VMEM((bq, 1), jnp.float32),
                 pltpu.VMEM((bq, 1), jnp.float32),
@@ -127,7 +144,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
             transcendentals=b * h * sq * sk,
         ),
         interpret=default_interpret(interpret),
-    )(q, k, v)
+    )(off, q, k, v)
+    if return_lse:
+        return out, lse
+    return out
 
 
 def attention_reference(q, k, v, *, causal: bool = True,

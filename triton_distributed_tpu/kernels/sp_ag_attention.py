@@ -1,0 +1,116 @@
+"""Sequence-parallel attention for long-context prefill.
+
+Reference: `python/triton_dist/kernels/nvidia/sp_ag_attention_intra_node.py`
+(521 LoC) and `sp_ag_attention_inter_node.py` (594 LoC): KV shards are
+allgathered via the copy engine / NVSHMEM 2D push while a persistent
+flash-attention consumer waits per-KV-chunk signals
+(`cp_engine_producer_kv_all_gather:105`,
+`kernel_consumer_flash_attn_forward:256`).
+
+TPU re-design — **ring attention**: instead of gathering the whole KV
+and signalling readiness per chunk, the KV shard travels the ring
+(`lax.ppermute` on ICI) while every rank folds the chunk it currently
+holds into its running online-softmax state (out, lse).  This is the
+same overlap (chunk arrival hides behind flash-attn compute) with
+world× less memory than a full gather — the canonical TPU long-context
+pattern.  Causal masking per source chunk is the rank-offset swizzle:
+chunks from later ranks are fully masked and cost ~nothing (their lse
+is -inf and the combine drops them).
+
+A full-gather variant (`sp_ag_attention_gather`) mirrors the
+reference's literal allgather-then-attend pipeline for comparison and
+for short-context cases where the gather is cheap.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels.flash_attention import flash_attention
+
+NEG_INF = -1e30
+
+
+def _merge(out_a, lse_a, out_b, lse_b):
+    """Combine two online-softmax partials (fp32)."""
+    m = jnp.maximum(lse_a, lse_b)
+    # guard fully-masked rows (both -inf)
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    wa = jnp.exp(lse_a - m_safe)
+    wb = jnp.exp(lse_b - m_safe)
+    denom = jnp.maximum(wa + wb, 1e-30)
+    out = (out_a.astype(jnp.float32) * wa[..., None]
+           + out_b.astype(jnp.float32) * wb[..., None]) / denom[..., None]
+    lse = m_safe + jnp.log(denom)
+    return out, lse
+
+
+def sp_ring_attention(q, k_shard, v_shard, axis: str, *,
+                      scale: Optional[float] = None,
+                      block_q: int = 128, block_k: int = 128,
+                      interpret: Optional[bool] = None):
+    """Causal ring attention.  Call inside shard_map over `axis`.
+
+    q:        (B, H, S_loc, D) — this rank's query rows (global rows
+              [rank*S_loc, (rank+1)*S_loc)).
+    k_shard:  (B, Hkv, S_loc, D) — this rank's KV rows (same layout).
+    Returns (B, H, S_loc, D).
+    """
+    world = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    s_loc = q.shape[2]
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    def chunk_attend(kv, src):
+        k_c, v_c = kv
+        # queries at global offset my*s_loc; kv chunk at src*s_loc.
+        off = (my - src) * s_loc
+        return flash_attention(q, k_c, v_c, causal=True, scale=scale,
+                               kv_offset=off, return_lse=True,
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret)
+
+    out, lse = chunk_attend((k_shard, v_shard), my)
+    out = out.astype(jnp.float32)
+    kv = (k_shard, v_shard)
+    for step in range(world - 1):
+        kv = jax.lax.ppermute(kv, axis, perm)
+        src = jax.lax.rem(my - step - 1 + 2 * world, world)
+        o_s, l_s = chunk_attend(kv, src)
+        out, lse = _merge(out, lse, o_s, l_s)
+    return out.astype(q.dtype)
+
+
+def sp_ag_attention_gather(q, k_shard, v_shard, axis: str, *,
+                           scale: Optional[float] = None,
+                           block_q: int = 128, block_k: int = 128,
+                           collective_id: int = 10,
+                           interpret: Optional[bool] = None):
+    """Literal allgather-KV-then-attend (the reference's intra-node
+    pipeline shape): gather the full KV with the overlap allgather
+    kernel, then one flash attention over it."""
+    from triton_distributed_tpu.kernels.allgather import (
+        AllGatherContext, AllGatherMethod, all_gather)
+
+    world = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    b, hkv, s_loc, d = k_shard.shape
+    ctx = AllGatherContext(axis=axis, world_size=world,
+                           method=AllGatherMethod.RING,
+                           collective_id=collective_id,
+                           interpret=interpret)
+    # Pack K and V into one ring payload: (2*B*Hkv*S_loc, D)
+    payload = jnp.concatenate(
+        [k_shard.reshape(-1, d), v_shard.reshape(-1, d)], axis=0)
+    gathered = all_gather(payload, ctx).reshape(world, 2, b, hkv, s_loc, d)
+    k_full = (gathered[:, 0].transpose(1, 2, 0, 3, 4)
+              .reshape(b, hkv, world * s_loc, d))
+    v_full = (gathered[:, 1].transpose(1, 2, 0, 3, 4)
+              .reshape(b, hkv, world * s_loc, d))
+    return flash_attention(q, k_full, v_full, causal=True, scale=scale,
+                           kv_offset=my * s_loc, block_q=block_q,
+                           block_k=block_k, interpret=interpret)

@@ -1,0 +1,218 @@
+"""Tensor-parallel attention (heads sharded over the tp axis).
+
+Reference: `python/triton_dist/layers/nvidia/tp_attn.py` (274 LoC):
+AG-GEMM for the fused QKV projection, RoPE cache
+(`_set_cos_sin_cache:69`), flash attention for prefill / flash-decode
+for decode, GEMM-RS for the output projection.
+
+TPU layout: per rank H_loc = H/world query heads and Hkv_loc kv heads;
+activations are M-sharded between layers (sequence parallel), gathered
+by the fused AG-GEMM for the projections — identical dataflow to the
+reference's `dist_triton_fwd`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels.allgather_gemm import (
+    AllGatherGEMMContext,
+    ag_gemm,
+)
+from triton_distributed_tpu.kernels.flash_attention import flash_attention
+from triton_distributed_tpu.kernels.flash_decode import flash_decode
+from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
+    GEMMReduceScatterContext,
+    gemm_rs,
+)
+from triton_distributed_tpu.kernels.matmul import MatmulConfig
+
+
+def rope_cos_sin(positions, dim: int, theta: float = 1e6,
+                 dtype=jnp.float32):
+    """RoPE tables (reference `_set_cos_sin_cache`, `tp_attn.py:69`).
+    positions: (S,) → cos/sin (S, dim/2)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, dim, 2,
+                                           dtype=jnp.float32) / dim))
+    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x, cos, sin):
+    """x: (..., S, D) with rotate-half convention."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    shape = (1,) * (x.ndim - 2) + cos.shape
+    c = cos.reshape(shape)
+    s = sin.reshape(shape)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                           axis=-1).astype(x.dtype)
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                   keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
+            ).astype(x.dtype) * weight
+
+
+@dataclasses.dataclass
+class TPAttention:
+    """Reference analogue: `TP_Attn` (`tp_attn.py:78`)."""
+
+    axis: str
+    world_size: int
+    hidden: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope_theta: float = 1e6
+    qk_norm: bool = True          # Qwen3-style per-head q/k RMSNorm
+    mode: str = "fused"           # xla | fused
+    gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
+    collective_ids: tuple = (14, 15)
+    interpret: Optional[bool] = None
+
+    @property
+    def h_loc(self):
+        return self.num_heads // self.world_size
+
+    @property
+    def hkv_loc(self):
+        return max(self.num_kv_heads // self.world_size, 1)
+
+    @property
+    def qkv_cols(self):
+        return (self.h_loc + 2 * self.hkv_loc) * self.head_dim
+
+    def init_params(self, key, dtype=jnp.bfloat16):
+        k1, k2 = jax.random.split(key)
+        scale = self.hidden ** -0.5
+        p = {
+            "wqkv": (jax.random.normal(
+                k1, (self.hidden, self.qkv_cols)) * scale).astype(dtype),
+            "wo": (jax.random.normal(
+                k2, (self.h_loc * self.head_dim, self.hidden))
+                * scale).astype(dtype),
+        }
+        if self.qk_norm:
+            p["q_norm"] = jnp.ones((self.head_dim,), dtype)
+            p["k_norm"] = jnp.ones((self.head_dim,), dtype)
+        return p
+
+    def global_param_specs(self):
+        from jax.sharding import PartitionSpec as P
+        specs = {"wqkv": P(None, self.axis), "wo": P(self.axis, None)}
+        if self.qk_norm:
+            specs["q_norm"] = P(None)
+            specs["k_norm"] = P(None)
+        return specs
+
+    # ------------------------------------------------------------------
+
+    def _project_qkv(self, x, params):
+        if self.mode == "fused":
+            ctx = AllGatherGEMMContext(
+                axis=self.axis, world_size=self.world_size,
+                gemm=self.gemm, collective_id=self.collective_ids[0],
+                interpret=self.interpret)
+            qkv = ag_gemm(x, params["wqkv"], ctx)
+        else:
+            full = jax.lax.all_gather(x, self.axis, tiled=True)
+            qkv = jnp.dot(full, params["wqkv"],
+                          preferred_element_type=jnp.float32
+                          ).astype(x.dtype)
+        return qkv  # (M, qkv_cols)
+
+    def _split_heads(self, qkv, batch, seq):
+        d = self.head_dim
+        q, k, v = jnp.split(
+            qkv.reshape(batch, seq, -1),
+            [self.h_loc * d, (self.h_loc + self.hkv_loc) * d], axis=-1)
+        q = q.reshape(batch, seq, self.h_loc, d).transpose(0, 2, 1, 3)
+        k = k.reshape(batch, seq, self.hkv_loc, d).transpose(0, 2, 1, 3)
+        v = v.reshape(batch, seq, self.hkv_loc, d).transpose(0, 2, 1, 3)
+        return q, k, v
+
+    def _out_proj(self, attn, x_dtype, params):
+        if self.mode == "fused":
+            ctx = GEMMReduceScatterContext(
+                axis=self.axis, world_size=self.world_size,
+                gemm=self.gemm, collective_id=self.collective_ids[1],
+                interpret=self.interpret)
+            return gemm_rs(attn, params["wo"], ctx)
+        partial = jnp.dot(attn, params["wo"],
+                          preferred_element_type=jnp.float32)
+        world = self.world_size
+        m = partial.shape[0]
+        return jax.lax.psum_scatter(
+            partial.reshape(world, m // world, -1), self.axis,
+            scatter_dimension=0, tiled=False).astype(x_dtype)
+
+    def prefill(self, x, params, batch: int):
+        """x: (M/world, hidden) M-sharded; returns same sharding, plus
+        this rank's KV (B, Hkv_loc, S, D) for the cache."""
+        qkv = self._project_qkv(x, params)          # (M, qkv_cols)
+        m = qkv.shape[0]
+        seq = m // batch
+        q, k, v = self._split_heads(qkv, batch, seq)
+        if self.qk_norm:
+            q = rms_norm(q, params["q_norm"])
+            k = rms_norm(k, params["k_norm"])
+        cos, sin = rope_cos_sin(jnp.arange(seq), self.head_dim,
+                                self.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn = flash_attention(q, k, v, causal=True,
+                               interpret=self.interpret)
+        attn = attn.transpose(0, 2, 1, 3).reshape(m, -1)
+        out = self._out_proj(attn, x.dtype, params)
+        return out, (k, v)
+
+    def decode(self, x, params, kv_cache, offset):
+        """x: (B/world... ) decode step with B*1 tokens: x is
+        (B/world rows? ) — following the reference, decode activations
+        are M=B-sharded; B must divide world or be replicated.
+
+        Here: x (B_loc, hidden) with B_loc = B/world when B >= world,
+        else x replicated (B, hidden) and mode falls back to gather.
+        kv_cache: (k, v) each (B, Hkv_loc, S_max, D); offset: (B,) int32
+        current lengths (same on all ranks).
+        Returns (out like x, updated cache)."""
+        k_cache, v_cache = kv_cache
+        b = k_cache.shape[0]
+        qkv = self._project_qkv(x, params)          # (B, qkv_cols)
+        q, k, v = self._split_heads(qkv, b, 1)
+        if self.qk_norm:
+            q = rms_norm(q, params["q_norm"])
+            k = rms_norm(k, params["k_norm"])
+        cos, sin = rope_cos_sin(offset, self.head_dim, self.rope_theta)
+
+        def rope1(x):  # x: (B, H, 1, D); cos/sin: (B, D/2)
+            d2 = x.shape[-1] // 2
+            c = cos[:, None, None, :].astype(jnp.float32)
+            s = sin[:, None, None, :].astype(jnp.float32)
+            x1, x2 = x[..., :d2], x[..., d2:]
+            return jnp.concatenate(
+                [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+        q = rope1(q)
+        k = rope1(k)
+
+        # scatter new kv at offset
+        k_cache = jax.vmap(
+            lambda c, u, o: jax.lax.dynamic_update_slice(
+                c, u, (0, o, 0)))(k_cache, k, offset)
+        v_cache = jax.vmap(
+            lambda c, u, o: jax.lax.dynamic_update_slice(
+                c, u, (0, o, 0)))(v_cache, v, offset)
+
+        out, _ = flash_decode(q.reshape(b, self.h_loc, self.head_dim),
+                              k_cache, v_cache, offset + 1,
+                              interpret=self.interpret)
+        attn = out.reshape(b, self.h_loc * self.head_dim)
+        return self._out_proj(attn, x.dtype, params), (k_cache, v_cache)

@@ -1,0 +1,126 @@
+"""Tensor-parallel MLP (gate/up column-parallel, down row-parallel).
+
+Reference: `python/triton_dist/layers/nvidia/tp_mlp.py` (241 LoC) —
+three forward modes: "torch" (GEMM + NCCL AllReduce), "dist_triton"
+(AG-GEMM → silu·mul → GEMM-RS, `dist_triton_fwd:143-166`) and
+"dist_triton_AR" (local GEMMs + Triton AllReduce, `:177`).
+
+TPU modes (same semantics, per-device code runs inside shard_map over
+the `tp` axis):
+- ``xla``: plain dots + `lax.psum` / `psum_scatter` — the GSPMD golden.
+- ``fused``: fused Pallas `ag_gemm` → gated-silu → fused `gemm_rs`.
+- ``fused_ar``: local GEMMs + Pallas AllReduce (replicated activations).
+
+Weights are plain pytrees; `init_params` gives the per-op sharded
+shapes.  Input x is row(M)-sharded for fused/xla (sequence-parallel
+activations, matching the reference's M/world layout), replicated for
+``fused_ar``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels.allgather_gemm import (
+    AllGatherGEMMContext,
+    ag_gemm,
+)
+from triton_distributed_tpu.kernels.allreduce import (
+    AllReduceContext,
+    all_reduce,
+)
+from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
+    GEMMReduceScatterContext,
+    gemm_rs,
+)
+from triton_distributed_tpu.kernels.allgather_group_gemm import gated_silu
+from triton_distributed_tpu.kernels.matmul import MatmulConfig
+
+
+@dataclasses.dataclass
+class TPMLP:
+    """Config + contexts for one TP MLP (reference `TP_MLP`)."""
+
+    axis: str
+    world_size: int
+    hidden: int
+    ffn: int
+    mode: str = "fused"           # xla | fused | fused_ar
+    gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
+    collective_ids: tuple = (11, 12, 13)
+    interpret: Optional[bool] = None
+
+    @property
+    def ffn_local(self) -> int:
+        return self.ffn // self.world_size
+
+    def init_params(self, key, dtype=jnp.bfloat16):
+        """Per-device weight shards (call inside shard_map, or build
+        global arrays with these shapes × world on the sharded dim)."""
+        k1, k2 = jax.random.split(key)
+        scale = self.hidden ** -0.5
+        return {
+            # gate and up stacked along columns: (h, 2*ffn_local)
+            "gate_up": (jax.random.normal(
+                k1, (self.hidden, 2 * self.ffn_local)) * scale
+            ).astype(dtype),
+            "down": (jax.random.normal(
+                k2, (self.ffn_local, self.hidden)) * scale).astype(dtype),
+        }
+
+    def global_param_specs(self):
+        from jax.sharding import PartitionSpec as P
+        return {"gate_up": P(None, self.axis), "down": P(self.axis, None)}
+
+    # -- forward modes (all run per-device inside shard_map) --
+
+    def _fwd_xla(self, x, params):
+        full = jax.lax.all_gather(x, self.axis, tiled=True)
+        h = jnp.dot(full, params["gate_up"],
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+        h = gated_silu(h)
+        partial = jnp.dot(h, params["down"],
+                          preferred_element_type=jnp.float32)
+        world = self.world_size
+        m = partial.shape[0]
+        return jax.lax.psum_scatter(
+            partial.reshape(world, m // world, -1), self.axis,
+            scatter_dimension=0, tiled=False).astype(x.dtype)
+
+    def _fwd_fused(self, x, params):
+        ag_ctx = AllGatherGEMMContext(
+            axis=self.axis, world_size=self.world_size, gemm=self.gemm,
+            collective_id=self.collective_ids[0],
+            interpret=self.interpret)
+        h = ag_gemm(x, params["gate_up"], ag_ctx)       # (M, 2*ffn_loc)
+        h = gated_silu(h)                               # (M, ffn_loc)
+        rs_ctx = GEMMReduceScatterContext(
+            axis=self.axis, world_size=self.world_size, gemm=self.gemm,
+            collective_id=self.collective_ids[1],
+            interpret=self.interpret)
+        return gemm_rs(h, params["down"], rs_ctx)       # (M/world, hidden)
+
+    def _fwd_fused_ar(self, x, params):
+        # x replicated (M, hidden)
+        h = jnp.dot(x, params["gate_up"],
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+        h = gated_silu(h)
+        partial = jnp.dot(h, params["down"],
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+        ar_ctx = AllReduceContext(
+            axis=self.axis, world_size=self.world_size,
+            collective_id=self.collective_ids[2], interpret=self.interpret)
+        return all_reduce(partial, ar_ctx)
+
+    def __call__(self, x, params):
+        if self.mode == "xla":
+            return self._fwd_xla(x, params)
+        if self.mode == "fused":
+            return self._fwd_fused(x, params)
+        if self.mode == "fused_ar":
+            return self._fwd_fused_ar(x, params)
+        raise ValueError(f"unknown mode {self.mode}")
