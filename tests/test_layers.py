@@ -1,0 +1,207 @@
+"""Layer tests (reference: `test/nvidia/test_tp_mlp.py`,
+`test_tp_attn.py`, `test_ep_a2a.py`)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.kernels import moe_utils
+from triton_distributed_tpu.kernels.allgather_group_gemm import gated_silu
+from triton_distributed_tpu.kernels.flash_attention import (
+    attention_reference,
+)
+from triton_distributed_tpu.kernels.matmul import MatmulConfig
+from triton_distributed_tpu.layers.ep_a2a_layer import EPAll2AllLayer
+from triton_distributed_tpu.layers.sp_flash_decode_layer import (
+    SpFlashDecodeAttention,
+)
+from triton_distributed_tpu.layers.tp_attn import TPAttention, rms_norm
+from triton_distributed_tpu.layers.tp_mlp import TPMLP
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.testing import assert_allclose
+
+
+def _mlp_golden(x, gate_up_full, down_full):
+    h = gated_silu(x @ gate_up_full)
+    return h @ down_full
+
+
+@pytest.mark.parametrize("mode", ["xla", "fused"])
+def test_tp_mlp(tp4_mesh, mode):
+    world, m, hidden, ffn = 4, 32, 128, 256
+    mlp = TPMLP(axis="tp", world_size=world, hidden=hidden, ffn=ffn,
+                mode=mode, gemm=MatmulConfig(64, 128, 128))
+    key = jax.random.key(0)
+    # global weights: gate/up interleaved per rank — build per-rank then
+    # concat so the sharded layout matches the golden
+    ranks = [mlp.init_params(jax.random.fold_in(key, r), jnp.float32)
+             for r in range(world)]
+    gate_up = jnp.concatenate([p["gate_up"] for p in ranks], axis=1)
+    down = jnp.concatenate([p["down"] for p in ranks], axis=0)
+    x = jax.random.normal(jax.random.key(1), (m, hidden)) / 8
+
+    fn = shard_map_op(
+        lambda xx, gu, dn: mlp(xx, {"gate_up": gu, "down": dn}),
+        tp4_mesh,
+        in_specs=(P("tp", None), P(None, "tp"), P("tp", None)),
+        out_specs=P("tp", None))
+    out = jax.jit(fn)(x, gate_up, down)
+
+    # golden: per-rank gated silu then sum of partials
+    parts = []
+    for r in range(world):
+        h = gated_silu(x @ ranks[r]["gate_up"])
+        parts.append(h @ ranks[r]["down"])
+    ref = sum(parts)
+    assert_allclose(out, ref, atol=2e-3, rtol=2e-3, name=f"tp_mlp-{mode}")
+
+
+def test_tp_mlp_fused_ar(tp4_mesh):
+    world, m, hidden, ffn = 4, 16, 128, 256
+    mlp = TPMLP(axis="tp", world_size=world, hidden=hidden, ffn=ffn,
+                mode="fused_ar")
+    key = jax.random.key(2)
+    ranks = [mlp.init_params(jax.random.fold_in(key, r), jnp.float32)
+             for r in range(world)]
+    gate_up = jnp.concatenate([p["gate_up"] for p in ranks], axis=1)
+    down = jnp.concatenate([p["down"] for p in ranks], axis=0)
+    x = jax.random.normal(jax.random.key(3), (m, hidden)) / 8
+
+    fn = shard_map_op(
+        lambda xx, gu, dn: mlp(xx, {"gate_up": gu, "down": dn}),
+        tp4_mesh,
+        in_specs=(P(None, None), P(None, "tp"), P("tp", None)),
+        out_specs=P(None, None))
+    out = jax.jit(fn)(x, gate_up, down)
+    ref = sum(gated_silu(x @ p["gate_up"]) @ p["down"] for p in ranks)
+    assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+
+@pytest.mark.parametrize("mode", ["xla", "fused"])
+def test_tp_attn_prefill(tp4_mesh, mode):
+    world, b, s, hidden = 4, 1, 32, 128
+    heads, kv_heads, d = 8, 4, 16
+    attn = TPAttention(axis="tp", world_size=world, hidden=hidden,
+                       num_heads=heads, num_kv_heads=kv_heads,
+                       head_dim=d, qk_norm=False, mode=mode,
+                       gemm=MatmulConfig(32, 64, 128))
+    key = jax.random.key(4)
+    ranks = [attn.init_params(jax.random.fold_in(key, r), jnp.float32)
+             for r in range(world)]
+    wqkv = jnp.concatenate([p["wqkv"] for p in ranks], axis=1)
+    wo = jnp.concatenate([p["wo"] for p in ranks], axis=0)
+    x = jax.random.normal(jax.random.key(5), (b * s, hidden)) / 8
+
+    fn = shard_map_op(
+        lambda xx, wq, w_o: attn.prefill(
+            xx, {"wqkv": wq, "wo": w_o}, batch=b)[0],
+        tp4_mesh,
+        in_specs=(P("tp", None), P(None, "tp"), P("tp", None)),
+        out_specs=P("tp", None))
+    out = jax.jit(fn)(x, wqkv, wo)
+    assert out.shape == (b * s, hidden)
+    assert jnp.isfinite(out).all()
+
+    if mode == "xla":
+        return
+    # fused must match xla exactly (same math, different kernels)
+    attn_x = TPAttention(axis="tp", world_size=world, hidden=hidden,
+                         num_heads=heads, num_kv_heads=kv_heads,
+                         head_dim=d, qk_norm=False, mode="xla")
+    fn2 = shard_map_op(
+        lambda xx, wq, w_o: attn_x.prefill(
+            xx, {"wqkv": wq, "wo": w_o}, batch=b)[0],
+        tp4_mesh,
+        in_specs=(P("tp", None), P(None, "tp"), P("tp", None)),
+        out_specs=P("tp", None))
+    ref = jax.jit(fn2)(x, wqkv, wo)
+    assert_allclose(out, ref, atol=2e-3, rtol=2e-3, name="attn fused vs xla")
+
+
+def test_tp_attn_decode(tp4_mesh):
+    world, b, hidden = 4, 4, 128
+    heads, kv_heads, d, s_max = 8, 4, 16, 64
+    attn = TPAttention(axis="tp", world_size=world, hidden=hidden,
+                       num_heads=heads, num_kv_heads=kv_heads,
+                       head_dim=d, qk_norm=False, mode="xla")
+    key = jax.random.key(6)
+    ranks = [attn.init_params(jax.random.fold_in(key, r), jnp.float32)
+             for r in range(world)]
+    wqkv = jnp.concatenate([p["wqkv"] for p in ranks], axis=1)
+    wo = jnp.concatenate([p["wo"] for p in ranks], axis=0)
+    x = jax.random.normal(jax.random.key(7), (b, hidden)) / 8
+    k_cache = jnp.zeros((world * b, kv_heads // world * b // b, s_max, d))
+    # simpler: per-rank cache shapes (B, hkv_loc, S, D)
+    k_cache = jnp.zeros((b, attn.hkv_loc * world, s_max, d))
+    v_cache = jnp.zeros_like(k_cache)
+    offset = jnp.zeros((b,), jnp.int32)
+
+    def step(xx, wq, w_o, kc, vc):
+        out, (nk, nv) = attn.decode(
+            xx, {"wqkv": wq, "wo": w_o}, (kc, vc), offset)
+        return out, nk, nv
+
+    fn = shard_map_op(
+        step, tp4_mesh,
+        in_specs=(P("tp", None), P(None, "tp"), P("tp", None),
+                  P(None, "tp", None, None), P(None, "tp", None, None)),
+        out_specs=(P("tp", None), P(None, "tp", None, None),
+                   P(None, "tp", None, None)))
+    out, nk, nv = jax.jit(fn)(x, wqkv, wo, k_cache, v_cache)
+    assert out.shape == (b, hidden)
+    assert jnp.isfinite(out).all()
+    # cache row 0 must now be nonzero where written
+    assert float(jnp.abs(nk[:, :, 0]).max()) > 0
+
+
+def test_ep_a2a_layer(ep4_mesh):
+    ep, E, topk, n_loc, hidden, cap = 4, 8, 2, 8, 64, 32
+    layer = EPAll2AllLayer(axis="ep", ep_size=ep, num_experts=E,
+                           topk=topk, max_tokens_per_rank=cap,
+                           hidden=hidden)
+    key = jax.random.key(8)
+    tokens = jax.random.normal(key, (ep * n_loc, hidden))
+    ids = jax.random.randint(jax.random.key(9), (ep * n_loc, topk), 0, E)
+    w = jax.nn.softmax(jax.random.normal(jax.random.key(10),
+                                         (ep * n_loc, topk)))
+
+    def roundtrip(tok, eid, ww):
+        recv, recv_e, counts, plan = layer.dispatch(tok, eid)
+        # identity "experts": just pass tokens through
+        return layer.combine(recv, counts, plan, ww, eid)
+
+    fn = shard_map_op(roundtrip, ep4_mesh,
+                      in_specs=(P("ep", None), P("ep", None),
+                                P("ep", None)),
+                      out_specs=P("ep", None))
+    out = jax.jit(fn)(tokens, ids, w)
+    # identity experts → combine = sum_k w_k * token
+    ref = tokens * w.sum(axis=1, keepdims=True)
+    assert_allclose(out, ref, atol=1e-4, rtol=1e-4, name="ep_roundtrip")
+
+
+def test_sp_decode_layer(sp4_mesh):
+    world, b, h, hkv, d, s_loc = 4, 2, 8, 4, 32, 16
+    layer = SpFlashDecodeAttention(axis="sp", sp_size=world, num_heads=h,
+                                   num_kv_heads=hkv, head_dim=d,
+                                   max_seq_per_rank=s_loc)
+    s = world * s_loc
+    q = jax.random.normal(jax.random.key(11), (b, h, d))
+    k = jax.random.normal(jax.random.key(12), (b, hkv, s, d))
+    v = jax.random.normal(jax.random.key(13), (b, hkv, s, d))
+    total = jnp.array([s, 40], jnp.int32)
+
+    fn = shard_map_op(
+        lambda qq, kk, vv: layer(qq, kk, vv, total),
+        sp4_mesh,
+        in_specs=(P(None, None, None), P(None, None, "sp", None),
+                  P(None, None, "sp", None)),
+        out_specs=P(None, None, None))
+    out = jax.jit(fn)(q, k, v)
+
+    from tests.test_flash_decode import _decode_ref
+    ref = _decode_ref(q, k, v, total)
+    assert_allclose(out, ref, atol=3e-3, rtol=3e-3, name="sp_decode_layer")

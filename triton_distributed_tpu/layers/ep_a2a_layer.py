@@ -1,0 +1,116 @@
+"""Expert-parallel AllToAll layer: dispatch / combine.
+
+Reference: `python/triton_dist/layers/nvidia/ep_a2a_layer.py` (248 LoC)
+— `EPAll2AllLayer.dispatch/combine` (`:195,240`) over symmetric
+send/recv/signal buffers (`:76-104`), preprocessing at `:118-138`
+(bincount splits, cumsum), kernels `kernels/nvidia/ep_a2a.py`
+(dispatch `:37`, combine `:152`).
+
+TPU re-design: routing runs in XLA (static capacity buckets,
+moe_utils); the wire exchange is the low-latency Pallas AllToAll
+(`fast_all_to_all`).  Dispatch groups each rank's (token, k) pairs by
+destination EP rank (= expert // experts_per_rank), pads to capacity,
+exchanges, and re-buckets received tokens by local expert.  Combine
+reverses the exchange and applies the topk-weighted sum.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels import moe_utils
+from triton_distributed_tpu.kernels.low_latency_all_to_all import (
+    AllToAllContext,
+    fast_all_to_all,
+)
+
+
+@dataclasses.dataclass
+class EPAll2AllLayer:
+    """Reference analogue: `EPAll2AllLayer` (`ep_a2a_layer.py:40`)."""
+
+    axis: str
+    ep_size: int
+    num_experts: int
+    topk: int
+    max_tokens_per_rank: int      # send capacity per (src, dst) pair
+    hidden: int
+    collective_ids: tuple = (16, 17)
+    interpret: Optional[bool] = None
+
+    @property
+    def experts_per_rank(self) -> int:
+        return self.num_experts // self.ep_size
+
+    def _a2a_ctx(self, cid):
+        return AllToAllContext(
+            axis=self.axis, world_size=self.ep_size,
+            max_tokens_per_rank=self.max_tokens_per_rank,
+            hidden=self.hidden, collective_id=cid,
+            interpret=self.interpret)
+
+    def dispatch(self, tokens, expert_ids):
+        """Route local tokens to expert-owner ranks.
+
+        tokens: (n_loc, hidden); expert_ids: (n_loc, topk).
+        Returns (recv_tokens (ep, cap, hidden), recv_expert (ep, cap)
+        int32 local-expert id per received row, recv_counts (ep, 1),
+        send_plan) — send_plan is needed by `combine`.
+        """
+        cap = self.max_tokens_per_rank
+        n_loc, topk = expert_ids.shape
+        dest_rank = expert_ids // self.experts_per_rank      # (n, topk)
+
+        # Slot each (token, k) pair within its destination rank's block
+        # (stable, capacity-dropped) — same machinery as expert routing.
+        routing = moe_utils.route_capacity(dest_rank, self.ep_size, cap)
+
+        send_tokens = jnp.zeros((self.ep_size, cap, self.hidden),
+                                tokens.dtype)
+        send_expert = jnp.zeros((self.ep_size, cap), jnp.int32)
+        kept = routing.slot_of_pair >= 0                      # (n, topk)
+        flat_tok = jax.lax.broadcasted_iota(jnp.int32, (n_loc, topk), 0)
+        r_idx = jnp.where(kept, dest_rank, self.ep_size)
+        s_idx = jnp.where(kept, routing.slot_of_pair, 0)
+        send_tokens = send_tokens.at[r_idx, s_idx].set(
+            tokens[flat_tok], mode="drop")
+        local_expert = expert_ids % self.experts_per_rank
+        send_expert = send_expert.at[r_idx, s_idx].set(
+            local_expert, mode="drop")
+        counts = jnp.minimum(routing.counts, cap)[:, None]    # (ep, 1)
+
+        ctx = self._a2a_ctx(self.collective_ids[0])
+        # Ship expert ids as a narrow second payload (scale slot).
+        recv_tokens, recv_counts, recv_expert = fast_all_to_all(
+            send_tokens, counts, ctx,
+            send_scales=send_expert[..., None].astype(jnp.float32))
+        recv_expert = recv_expert[..., 0].astype(jnp.int32)
+        send_plan = (routing, kept)
+        return recv_tokens, recv_expert, recv_counts, send_plan
+
+    def combine(self, expert_out, recv_counts, send_plan, topk_weights,
+                expert_ids):
+        """Return expert outputs to token owners and topk-reduce.
+
+        expert_out: (ep, cap, hidden) — processed tokens still in
+        arrival layout (block p = tokens from rank p).
+        Returns (n_loc, hidden)."""
+        ctx = self._a2a_ctx(self.collective_ids[1])
+        # Send processed block p back to rank p: layout is already
+        # (dst_rank, cap, hidden) from the receiver's perspective.
+        back_tokens, _ = fast_all_to_all(expert_out, recv_counts, ctx)
+
+        routing, kept = send_plan
+        n_loc, topk = expert_ids.shape
+        dest_rank = expert_ids // self.experts_per_rank
+        slot = routing.slot_of_pair                          # (n, topk)
+        safe_r = jnp.where(kept, dest_rank, 0)
+        safe_s = jnp.where(kept, slot, 0)
+        vals = back_tokens[safe_r, safe_s]                   # (n, topk, H)
+        w = jnp.where(kept, topk_weights, 0.0)[..., None]
+        return (vals.astype(jnp.float32) * w).sum(axis=1).astype(
+            expert_out.dtype)

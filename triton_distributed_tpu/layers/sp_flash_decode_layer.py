@@ -1,0 +1,53 @@
+"""Sequence-parallel GQA flash-decode layer.
+
+Reference: `python/triton_dist/layers/nvidia/sp_flash_decode_layer.py`
+(185 LoC) — `SpGQAFlashDecodeAttention.forward` (`:83-183`) with
+dynamic workspace grow/shrink (`:116-133`).
+
+TPU: the workspace is implicit (XLA-managed buffers, shapes static per
+jit cache entry); the layer tracks which rank owns which KV range and
+drives `sp_flash_decode`.  KV shards grow round-robin: token t lives on
+rank (t // block) % world when written with `append_position`; for the
+standard contiguous layout each rank owns rows
+[rank*S_loc, (rank+1)*S_loc).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels.flash_decode import sp_flash_decode
+
+
+@dataclasses.dataclass
+class SpFlashDecodeAttention:
+    """Reference analogue: `SpGQAFlashDecodeAttention`."""
+
+    axis: str
+    sp_size: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    max_seq_per_rank: int
+    collective_id: int = 18
+    interpret: Optional[bool] = None
+
+    def local_kv_len(self, total_len, rank):
+        """Contiguous layout: rank r holds rows
+        [r*S_loc, (r+1)*S_loc) → valid = clamp(total - r*S_loc)."""
+        s_loc = self.max_seq_per_rank
+        return jnp.clip(total_len - rank * s_loc, 0, s_loc)
+
+    def __call__(self, q, k_shard, v_shard, total_len):
+        """q: (B, H, D) replicated; k/v_shard: (B, Hkv, S_loc, D);
+        total_len: (B,) int32 global KV lengths.
+        Returns (B, H, D) on every rank."""
+        rank = jax.lax.axis_index(self.axis)
+        kv_len_local = self.local_kv_len(total_len, rank)
+        return sp_flash_decode(
+            q, k_shard, v_shard, kv_len_local, self.axis,
+            collective_id=self.collective_id, interpret=self.interpret)
