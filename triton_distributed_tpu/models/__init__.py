@@ -1,0 +1,16 @@
+"""Model zoo + serving engine
+(reference: `python/triton_dist/models/`)."""
+
+from triton_distributed_tpu.models.config import ModelConfig  # noqa: F401
+from triton_distributed_tpu.models.kv_cache import KVCache  # noqa: F401
+from triton_distributed_tpu.models.qwen import Qwen3  # noqa: F401
+from triton_distributed_tpu.models.engine import Engine  # noqa: F401
+
+
+def AutoLLM(config, mesh, **kw):
+    """Model registry (reference `AutoLLM`, `models/__init__.py`):
+    dispatch on architecture name."""
+    arch = (config.architecture or "qwen3").lower()
+    if "qwen" in arch or "llama" in arch:
+        return Qwen3(config, mesh, **kw)
+    raise ValueError(f"unknown architecture: {config.architecture}")

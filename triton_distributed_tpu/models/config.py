@@ -1,0 +1,74 @@
+"""Model configuration (reference: `python/triton_dist/models/config.py`
+`ModelConfig:31`)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    architecture: str = "qwen3"
+    vocab_size: int = 151936
+    hidden_size: int = 2048
+    intermediate_size: int = 6144
+    num_layers: int = 28
+    num_heads: int = 16
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1e6
+    qk_norm: bool = True
+    tie_word_embeddings: bool = True
+    max_seq_len: int = 4096
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def qwen3_0_6b(cls):
+        return cls(hidden_size=1024, intermediate_size=3072,
+                   num_layers=28, num_heads=16, num_kv_heads=8,
+                   head_dim=128)
+
+    @classmethod
+    def qwen3_8b(cls):
+        return cls(hidden_size=4096, intermediate_size=12288,
+                   num_layers=36, num_heads=32, num_kv_heads=8,
+                   head_dim=128, tie_word_embeddings=False)
+
+    @classmethod
+    def qwen3_32b(cls):
+        return cls(hidden_size=5120, intermediate_size=25600,
+                   num_layers=64, num_heads=64, num_kv_heads=8,
+                   head_dim=128, tie_word_embeddings=False)
+
+    @classmethod
+    def tiny(cls, **kw):
+        """Test-size config."""
+        d = dict(vocab_size=256, hidden_size=128, intermediate_size=256,
+                 num_layers=2, num_heads=8, num_kv_heads=4, head_dim=16,
+                 max_seq_len=128)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def from_hf(cls, model_name_or_path: str):
+        """Build from a HuggingFace config (reference loads HF weights;
+        here we map the config; weights via `Qwen3.load_hf_weights`)."""
+        from transformers import AutoConfig
+        hf = AutoConfig.from_pretrained(model_name_or_path)
+        return cls(
+            architecture=(hf.architectures or ["qwen3"])[0],
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size,
+            num_layers=hf.num_hidden_layers,
+            num_heads=hf.num_attention_heads,
+            num_kv_heads=getattr(hf, "num_key_value_heads",
+                                 hf.num_attention_heads),
+            head_dim=getattr(hf, "head_dim",
+                             hf.hidden_size // hf.num_attention_heads),
+            rms_norm_eps=getattr(hf, "rms_norm_eps", 1e-6),
+            rope_theta=getattr(hf, "rope_theta", 1e6),
+            tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
+        )

@@ -1,0 +1,61 @@
+"""Static KV cache with offset tracking.
+
+Reference: `python/triton_dist/models/kv_cache.py` (`KV_Cache:29-66`) —
+per-layer static tensors + `inc_offset`.
+
+TPU: a pytree of per-layer (k, v) arrays with a shared offset vector;
+updates are functional (`jax.lax.dynamic_update_slice`) and the whole
+cache is donated through the jitted decode step, so XLA updates it in
+place — the role CUDA graphs + in-place writes play in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    ks: List[jnp.ndarray]          # per layer: (B, Hkv_loc, S_max, D)
+    vs: List[jnp.ndarray]
+    offset: jnp.ndarray            # (B,) int32 — filled length
+
+    @classmethod
+    def create(cls, num_layers: int, batch: int, num_kv_heads: int,
+               max_seq: int, head_dim: int, dtype=jnp.bfloat16):
+        shape = (batch, num_kv_heads, max_seq, head_dim)
+        return cls(
+            ks=[jnp.zeros(shape, dtype) for _ in range(num_layers)],
+            vs=[jnp.zeros(shape, dtype) for _ in range(num_layers)],
+            offset=jnp.zeros((batch,), jnp.int32),
+        )
+
+    def write_prefill(self, layer: int, k, v):
+        """k/v: (B, Hkv, S, D) — fill from position 0."""
+        ks = list(self.ks)
+        vs = list(self.vs)
+        ks[layer] = jax.lax.dynamic_update_slice(
+            self.ks[layer], k.astype(self.ks[layer].dtype), (0, 0, 0, 0))
+        vs[layer] = jax.lax.dynamic_update_slice(
+            self.vs[layer], v.astype(self.vs[layer].dtype), (0, 0, 0, 0))
+        return dataclasses.replace(self, ks=ks, vs=vs)
+
+    def set_layer(self, layer: int, k, v):
+        ks = list(self.ks)
+        vs = list(self.vs)
+        ks[layer] = k
+        vs[layer] = v
+        return dataclasses.replace(self, ks=ks, vs=vs)
+
+    def inc_offset(self, n: int = 1):
+        return dataclasses.replace(self, offset=self.offset + n)
+
+    def set_offset(self, value):
+        return dataclasses.replace(
+            self, offset=jnp.broadcast_to(
+                jnp.asarray(value, jnp.int32), self.offset.shape))

@@ -1,0 +1,317 @@
+"""Qwen3-family tensor-parallel model.
+
+Reference: `python/triton_dist/models/qwen.py` (229 LoC) — `Qwen3Layer`
+(`:54`, fwd `:98-113`: rmsnorm → TP_Attn → rmsnorm → TP_MLP with
+residuals), `Qwen3` (`:115`) loading HF weights, `set_fwd` switching
+torch / triton_dist / triton_dist_AR backends.
+
+TPU: the model is a pytree of global weights + pure per-device forward
+functions run under shard_map over the `tp` axis.  `set_mode` switches
+the per-op backend ("xla" golden ↔ "fused" Pallas overlap kernels) —
+the analogue of the reference's backend switch.  Activations between
+layers are sequence(M)-sharded, the layout the fused AG-GEMM/GEMM-RS
+pair maintains.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.kernels.matmul import MatmulConfig
+from triton_distributed_tpu.layers.tp_attn import TPAttention, rms_norm
+from triton_distributed_tpu.layers.tp_mlp import TPMLP
+from triton_distributed_tpu.models.config import ModelConfig
+from triton_distributed_tpu.models.kv_cache import KVCache
+
+
+class Qwen3:
+    def __init__(self, config: ModelConfig, mesh: Mesh, axis: str = "tp",
+                 mode: str = "fused", interpret: Optional[bool] = None,
+                 gemm: Optional[MatmulConfig] = None):
+        self.config = config
+        self.mesh = mesh
+        self.axis = axis
+        self.world = mesh.shape[axis]
+        self.mode = mode
+        self.interpret = interpret
+        self.dtype = jnp.dtype(config.dtype)
+        gemm = gemm or MatmulConfig()
+        self.attn = TPAttention(
+            axis=axis, world_size=self.world, hidden=config.hidden_size,
+            num_heads=config.num_heads, num_kv_heads=config.num_kv_heads,
+            head_dim=config.head_dim, rope_theta=config.rope_theta,
+            qk_norm=config.qk_norm, mode=mode, gemm=gemm,
+            interpret=interpret)
+        self.mlp = TPMLP(
+            axis=axis, world_size=self.world, hidden=config.hidden_size,
+            ffn=config.intermediate_size, mode=mode, gemm=gemm,
+            interpret=interpret)
+
+    # ------------------------------------------------------------------
+    # parameters
+    # ------------------------------------------------------------------
+
+    def set_mode(self, mode: str):
+        """Backend switch (reference `set_fwd`, `models/qwen.py`)."""
+        self.mode = mode
+        self.attn = dataclasses.replace(self.attn, mode=mode)
+        self.mlp = dataclasses.replace(
+            self.mlp, mode=mode if mode == "xla" else "fused")
+
+    def init_params(self, key):
+        """Global (mesh-sharded) parameter pytree."""
+        cfg = self.config
+        keys = jax.random.split(key, cfg.num_layers + 2)
+        h = cfg.hidden_size
+
+        def one_layer(k):
+            k1, k2 = jax.random.split(k)
+            # build per-rank shards then concat → global layout matches
+            # per-device expectations exactly
+            attn_shards = [
+                self.attn.init_params(jax.random.fold_in(k1, r),
+                                      self.dtype)
+                for r in range(self.world)]
+            mlp_shards = [
+                self.mlp.init_params(jax.random.fold_in(k2, r),
+                                     self.dtype)
+                for r in range(self.world)]
+            layer = {
+                "ln1": jnp.ones((h,), self.dtype),
+                "ln2": jnp.ones((h,), self.dtype),
+                "attn": {
+                    "wqkv": jnp.concatenate(
+                        [p["wqkv"] for p in attn_shards], axis=1),
+                    "wo": jnp.concatenate(
+                        [p["wo"] for p in attn_shards], axis=0),
+                },
+                "mlp": {
+                    "gate_up": jnp.concatenate(
+                        [p["gate_up"] for p in mlp_shards], axis=1),
+                    "down": jnp.concatenate(
+                        [p["down"] for p in mlp_shards], axis=0),
+                },
+            }
+            if cfg.qk_norm:
+                layer["attn"]["q_norm"] = attn_shards[0]["q_norm"]
+                layer["attn"]["k_norm"] = attn_shards[0]["k_norm"]
+            return layer
+
+        embed = (jax.random.normal(keys[-1], (cfg.vocab_size, h))
+                 * h ** -0.5).astype(self.dtype)
+        params = {
+            "embed": embed,
+            "layers": [one_layer(keys[i]) for i in range(cfg.num_layers)],
+            "ln_f": jnp.ones((h,), self.dtype),
+            "lm_head": (embed.T if cfg.tie_word_embeddings else
+                        (jax.random.normal(keys[-2], (h, cfg.vocab_size))
+                         * h ** -0.5).astype(self.dtype)),
+        }
+        return params
+
+    def param_specs(self):
+        cfg = self.config
+        layer = {
+            "ln1": P(None),
+            "ln2": P(None),
+            "attn": {"wqkv": P(None, self.axis),
+                     "wo": P(self.axis, None)},
+            "mlp": {"gate_up": P(None, self.axis),
+                    "down": P(self.axis, None)},
+        }
+        if cfg.qk_norm:
+            layer["attn"]["q_norm"] = P(None)
+            layer["attn"]["k_norm"] = P(None)
+        return {
+            "embed": P(None, None),
+            "layers": [layer] * cfg.num_layers,
+            "ln_f": P(None),
+            "lm_head": P(None, self.axis),
+        }
+
+    def load_hf_weights(self, model_name_or_path: str):
+        """Load HF safetensors into the global layout (reference:
+        `Qwen3Layer.init_parameters`, `models/qwen.py:73-83`)."""
+        import numpy as np
+        from transformers import AutoModelForCausalLM
+        hf = AutoModelForCausalLM.from_pretrained(model_name_or_path,
+                                                  torch_dtype="float32")
+        sd = {k: np.asarray(v) for k, v in hf.state_dict().items()}
+        cfg = self.config
+        d = cfg.head_dim
+
+        def t(name):
+            return jnp.asarray(sd[name].T, self.dtype)
+
+        layers = []
+        for i in range(cfg.num_layers):
+            pre = f"model.layers.{i}."
+            wq = t(pre + "self_attn.q_proj.weight")
+            wk = t(pre + "self_attn.k_proj.weight")
+            wv = t(pre + "self_attn.v_proj.weight")
+            # interleave per rank: [q_r | k_r | v_r] for each rank r
+            hq = cfg.num_heads // self.world * d
+            hkv = cfg.num_kv_heads // self.world * d
+            wqkv = jnp.concatenate([
+                jnp.concatenate([wq[:, r*hq:(r+1)*hq],
+                                 wk[:, r*hkv:(r+1)*hkv],
+                                 wv[:, r*hkv:(r+1)*hkv]], axis=1)
+                for r in range(self.world)], axis=1)
+            layer = {
+                "ln1": jnp.asarray(sd[pre + "input_layernorm.weight"],
+                                   self.dtype),
+                "ln2": jnp.asarray(
+                    sd[pre + "post_attention_layernorm.weight"],
+                    self.dtype),
+                "attn": {"wqkv": wqkv,
+                         "wo": t(pre + "self_attn.o_proj.weight")},
+                "mlp": {
+                    "gate_up": _interleave_gate_up(
+                        t(pre + "mlp.gate_proj.weight"),
+                        t(pre + "mlp.up_proj.weight"), self.world),
+                    "down": t(pre + "mlp.down_proj.weight"),
+                },
+            }
+            if cfg.qk_norm:
+                layer["attn"]["q_norm"] = jnp.asarray(
+                    sd[pre + "self_attn.q_norm.weight"], self.dtype)
+                layer["attn"]["k_norm"] = jnp.asarray(
+                    sd[pre + "self_attn.k_norm.weight"], self.dtype)
+            layers.append(layer)
+
+        embed = jnp.asarray(sd["model.embed_tokens.weight"], self.dtype)
+        lm = (embed.T if cfg.tie_word_embeddings
+              else t("lm_head.weight"))
+        return {"embed": embed, "layers": layers,
+                "ln_f": jnp.asarray(sd["model.norm.weight"], self.dtype),
+                "lm_head": lm}
+
+    # ------------------------------------------------------------------
+    # per-device forward bodies (called inside shard_map)
+    # ------------------------------------------------------------------
+
+    def _layer_fwd_prefill(self, x, lp, batch, cache, li):
+        cfg = self.config
+        res = x
+        h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps)
+        h, (k, v) = self.attn.prefill(h, lp["attn"], batch)
+        x = res + h
+        res = x
+        h = rms_norm(x, lp["ln2"], cfg.rms_norm_eps)
+        h = self.mlp(h, lp["mlp"])
+        x = res + h
+        cache = cache.write_prefill(li, k, v) if cache is not None else None
+        return x, cache
+
+    def prefill_shard(self, params, input_ids, cache: Optional[KVCache]):
+        """Runs inside shard_map.  input_ids: (B, S) replicated.
+        Returns (logits_local (B, V/world), cache)."""
+        cfg = self.config
+        b, s = input_ids.shape
+        my = jax.lax.axis_index(self.axis)
+        m = b * s
+        m_loc = m // self.world
+        x = params["embed"][input_ids].reshape(m, -1)
+        x = jax.lax.dynamic_slice_in_dim(x, my * m_loc, m_loc, 0)
+
+        for li, lp in enumerate(params["layers"]):
+            x, cache = self._layer_fwd_prefill(x, lp, b, cache, li)
+
+        x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
+        # logits for the last position of each sequence
+        x_full = jax.lax.all_gather(x, self.axis, tiled=True)
+        last = x_full.reshape(b, s, -1)[:, -1]
+        logits = jnp.dot(last, params["lm_head"],
+                         preferred_element_type=jnp.float32)
+        if cache is not None:
+            cache = cache.set_offset(s)
+        return logits, cache
+
+    def decode_shard(self, params, tokens, cache: KVCache):
+        """One decode step inside shard_map.  tokens: (B,) replicated.
+        Returns (logits_local (B, V/world), cache)."""
+        cfg = self.config
+        b = tokens.shape[0]
+        my = jax.lax.axis_index(self.axis)
+        b_loc = b // self.world
+        x = params["embed"][tokens]                 # (B, h)
+        x = jax.lax.dynamic_slice_in_dim(x, my * b_loc, b_loc, 0)
+
+        offset = cache.offset
+        for li, lp in enumerate(params["layers"]):
+            res = x
+            h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps)
+            h, (nk, nv) = self.attn.decode(
+                h, lp["attn"], (cache.ks[li], cache.vs[li]), offset)
+            cache = cache.set_layer(li, nk, nv)
+            x = res + h
+            res = x
+            h = rms_norm(x, lp["ln2"], cfg.rms_norm_eps)
+            h = self.mlp(h, lp["mlp"])
+            x = res + h
+
+        x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
+        x_full = jax.lax.all_gather(x, self.axis, tiled=True)  # (B, h)
+        logits = jnp.dot(x_full, params["lm_head"],
+                         preferred_element_type=jnp.float32)
+        return logits, cache.inc_offset(1)
+
+    # ------------------------------------------------------------------
+    # mesh-level entry points
+    # ------------------------------------------------------------------
+
+    def _cache_specs(self, cache):
+        n = self.config.num_layers
+        return KVCache(
+            ks=[P(None, self.axis, None, None)] * n,
+            vs=[P(None, self.axis, None, None)] * n,
+            offset=P(None),
+        )
+
+    def make_prefill_fn(self):
+        specs = self.param_specs()
+
+        def fn(params, input_ids, cache):
+            return self.prefill_shard(params, input_ids, cache)
+
+        return jax.shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(specs, P(None, None), self._cache_specs(None)),
+            out_specs=(P(None, self.axis), self._cache_specs(None)),
+            check_vma=False)
+
+    def make_decode_fn(self):
+        specs = self.param_specs()
+
+        def fn(params, tokens, cache):
+            return self.decode_shard(params, tokens, cache)
+
+        return jax.shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(specs, P(None), self._cache_specs(None)),
+            out_specs=(P(None, self.axis), self._cache_specs(None)),
+            check_vma=False)
+
+    def create_cache(self, batch: int, max_seq: Optional[int] = None):
+        cfg = self.config
+        # global cache: kv heads sharded over tp
+        return KVCache.create(
+            cfg.num_layers, batch, max(cfg.num_kv_heads, self.world),
+            max_seq or cfg.max_seq_len, cfg.head_dim, self.dtype)
+
+
+def _interleave_gate_up(gate, up, world: int):
+    """Stack gate/up as [gate_r | up_r] per rank so each rank's column
+    shard contains its own gate and up halves."""
+    ffn = gate.shape[1]
+    f_loc = ffn // world
+    return jnp.concatenate([
+        jnp.concatenate([gate[:, r*f_loc:(r+1)*f_loc],
+                         up[:, r*f_loc:(r+1)*f_loc]], axis=1)
+        for r in range(world)], axis=1)

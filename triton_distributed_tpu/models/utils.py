@@ -1,0 +1,22 @@
+"""Sampling + misc model utilities
+(reference: `python/triton_dist/models/utils.py` — logger,
+`sample_token`)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.utils.debug import logger  # noqa: F401
+
+
+def sample_token(logits, key=None, temperature: float = 0.0,
+                 top_k: int = 0):
+    """logits: (B, V) → (B,) int32.  temperature 0 = greedy."""
+    if temperature <= 0.0 or key is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
