@@ -36,7 +36,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu.language import core as dl
-from triton_distributed_tpu.utils.platform import default_interpret
+from triton_distributed_tpu.utils.platform import (
+    comm_compiler_params,
+    default_interpret,
+)
 
 
 class AllGatherMethod(enum.Enum):
@@ -217,8 +220,7 @@ def all_gather(x, ctx: AllGatherContext):
         return jax.lax.all_gather(x, ctx.axis, tiled=True)
 
     interpret = default_interpret(ctx.interpret)
-    cparams = pltpu.CompilerParams(
-        has_side_effects=True, collective_id=ctx.collective_id)
+    cparams = comm_compiler_params(ctx.collective_id, world)
 
     if method == AllGatherMethod.BIDIR_RING and m % 2 == 0 and world > 2:
         xr = x.reshape(2, m // 2, n)

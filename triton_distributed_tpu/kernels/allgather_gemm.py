@@ -34,7 +34,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu.kernels.matmul import MatmulConfig, emit_matmul
 from triton_distributed_tpu.language import core as dl
-from triton_distributed_tpu.utils.platform import default_interpret
+from triton_distributed_tpu.utils.platform import (
+    comm_compiler_params,
+    default_interpret,
+)
 
 
 @dataclasses.dataclass
@@ -104,6 +107,16 @@ def ag_gemm(a_shard, b, ctx: AllGatherGEMMContext,
     k2, n = b.shape
     assert k == k2, (a_shard.shape, b.shape)
 
+    # Tile-friendliness gate (reference analogue: method auto-select).
+    # Mosaic DMA slices need the sublane dim aligned to the dtype
+    # packing; tiny decode GEMMs go down the XLA path instead.
+    min_rows = 16 if a_shard.dtype.itemsize < 4 else 8
+    if m % min_rows != 0:
+        a_full = jax.lax.all_gather(a_shard, ctx.axis, tiled=True)
+        out = jnp.dot(a_full, b, preferred_element_type=jnp.float32
+                      ).astype(a_shard.dtype)
+        return (out, a_full) if return_gathered else out
+
     gathered, out = pl.pallas_call(
         functools.partial(_ag_gemm_fused_kernel, ctx, m, n, k),
         out_shape=(
@@ -123,8 +136,7 @@ def ag_gemm(a_shard, b, ctx: AllGatherGEMMContext,
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((world,)),
         ],
-        compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=ctx.collective_id),
+        compiler_params=comm_compiler_params(ctx.collective_id, world),
         cost_estimate=pl.CostEstimate(
             flops=2 * world * m * n * k,
             bytes_accessed=(world * m * k + k * n) * a_shard.dtype.itemsize

@@ -30,7 +30,10 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_distributed_tpu.kernels.grouped_gemm import emit_grouped_matmul
 from triton_distributed_tpu.kernels.matmul import MatmulConfig
 from triton_distributed_tpu.language import core as dl
-from triton_distributed_tpu.utils.platform import default_interpret
+from triton_distributed_tpu.utils.platform import (
+    comm_compiler_params,
+    default_interpret,
+)
 
 
 @dataclasses.dataclass
@@ -120,8 +123,7 @@ def ag_group_gemm(buckets, expert_weights, ctx: AGGroupGEMMContext):
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((world,)),
         ],
-        compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=ctx.collective_id),
+        compiler_params=comm_compiler_params(ctx.collective_id, world),
         cost_estimate=pl.CostEstimate(
             flops=2 * world * e * cap * n * k,
             bytes_accessed=(world * e * cap * k + e * k * n

@@ -39,7 +39,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu.kernels.reduce_scatter import _emit_reduce_sum
 from triton_distributed_tpu.language import core as dl
-from triton_distributed_tpu.utils.platform import default_interpret, is_tpu
+from triton_distributed_tpu.utils.platform import (
+    comm_compiler_params,
+    default_interpret,
+    is_tpu,
+)
 
 
 class AllReduceMethod(enum.Enum):
@@ -197,18 +201,21 @@ def all_reduce(x, ctx: AllReduceContext):
             return all_gather(chunk, ag_ctx)
 
     interpret = default_interpret(ctx.interpret)
-    cparams = pltpu.CompilerParams(
-        has_side_effects=True, collective_id=ctx.collective_id)
+    cparams = comm_compiler_params(ctx.collective_id, world)
 
+    # NOTE: HBM communication buffers are extra *outputs* (discarded),
+    # not scratch — Mosaic only allows vmem/smem/semaphore scratch.
     if method == AllReduceMethod.TWO_SHOT and m % world == 0:
         mc = m // world
-        out = pl.pallas_call(
+        out, _ = pl.pallas_call(
             functools.partial(_two_shot_kernel, ctx, mc, n),
-            out_shape=jax.ShapeDtypeStruct((world, mc, n), x.dtype),
+            out_shape=(
+                jax.ShapeDtypeStruct((world, mc, n), x.dtype),
+                jax.ShapeDtypeStruct((world, mc, n), x.dtype),
+            ),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 2,
             scratch_shapes=[
-                pltpu.HBM((world, mc, n), x.dtype),
                 pltpu.SemaphoreType.DMA(()),
                 pltpu.SemaphoreType.DMA(()),
                 pltpu.SemaphoreType.DMA(()),
@@ -221,13 +228,15 @@ def all_reduce(x, ctx: AllReduceContext):
         return out.reshape(m, n)
 
     # ONE_SHOT (also the fallback when shapes don't tile)
-    return pl.pallas_call(
+    out, _ = pl.pallas_call(
         functools.partial(_one_shot_kernel, ctx, m, n),
-        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((m, n), x.dtype),
+            jax.ShapeDtypeStruct((world, m, n), x.dtype),
+        ),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 2,
         scratch_shapes=[
-            pltpu.HBM((world, m, n), x.dtype),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((world,)),
@@ -235,3 +244,4 @@ def all_reduce(x, ctx: AllReduceContext):
         compiler_params=cparams,
         interpret=interpret,
     )(x)
+    return out

@@ -72,7 +72,7 @@ def _flash_kernel(nk: int, scale: float, causal: bool, block_q: int,
     def _():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_scr[:] + jnp.log(l))[:, 0]
+        lse_ref[0, 0] = m_scr[:] + jnp.log(l)   # (bq, 1)
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
@@ -105,7 +105,7 @@ def flash_attention(q, k, v, *, causal: bool = True,
         functools.partial(_flash_kernel, nk, scale, causal, bq, bk),
         out_shape=(
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -127,8 +127,8 @@ def flash_attention(q, k, v, *, causal: bool = True,
                 pl.BlockSpec((1, 1, bq, d),
                              lambda bb, hh, qi, ki, *pre: (bb, hh, qi, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, 1, bq),
-                             lambda bb, hh, qi, ki, *pre: (bb, hh, qi),
+                pl.BlockSpec((1, 1, bq, 1),
+                             lambda bb, hh, qi, ki, *pre: (bb, hh, qi, 0),
                              memory_space=pltpu.VMEM),
             ),
             scratch_shapes=[
@@ -146,7 +146,7 @@ def flash_attention(q, k, v, *, causal: bool = True,
         interpret=default_interpret(interpret),
     )(off, q, k, v)
     if return_lse:
-        return out, lse
+        return out, lse[..., 0]
     return out
 
 

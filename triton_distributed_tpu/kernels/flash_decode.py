@@ -73,8 +73,8 @@ def _decode_kernel(nk: int, scale: float, block_k: int,
     def _():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        # log-sum-exp for cross-rank combine
-        lse_ref[0, 0] = (m_scr[:] + jnp.log(l))[:, 0]
+        # log-sum-exp for cross-rank combine, (G, 1)
+        lse_ref[0, 0] = m_scr[:] + jnp.log(l)
 
 
 def flash_decode(q, k_cache, v_cache, kv_len, *,
@@ -98,7 +98,7 @@ def flash_decode(q, k_cache, v_cache, kv_len, *,
         functools.partial(_decode_kernel, nk, scale, bk),
         out_shape=(
             jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
-            jax.ShapeDtypeStruct((b, hkv, g), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, g, 1), jnp.float32),
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -118,8 +118,8 @@ def flash_decode(q, k_cache, v_cache, kv_len, *,
                 pl.BlockSpec((1, 1, g, d),
                              lambda bb, hh, ki, *pre: (bb, hh, 0, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, 1, g),
-                             lambda bb, hh, ki, *pre: (bb, hh, 0),
+                pl.BlockSpec((1, 1, g, 1),
+                             lambda bb, hh, ki, *pre: (bb, hh, 0, 0),
                              memory_space=pltpu.VMEM),
             ),
             scratch_shapes=[
@@ -131,6 +131,7 @@ def flash_decode(q, k_cache, v_cache, kv_len, *,
         interpret=default_interpret(interpret),
     )(kv_len.astype(jnp.int32), qg, k_cache, v_cache)
     return out.reshape(b, h, d), lse.reshape(b, h)
+
 
 
 def combine_partials(outs, lses):

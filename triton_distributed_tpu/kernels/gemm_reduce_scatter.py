@@ -30,7 +30,10 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_distributed_tpu.kernels.matmul import MatmulConfig, emit_matmul
 from triton_distributed_tpu.kernels.reduce_scatter import _emit_reduce_sum
 from triton_distributed_tpu.language import core as dl
-from triton_distributed_tpu.utils.platform import default_interpret
+from triton_distributed_tpu.utils.platform import (
+    comm_compiler_params,
+    default_interpret,
+)
 
 
 @dataclasses.dataclass
@@ -108,22 +111,31 @@ def gemm_rs(a, b, ctx: GEMMReduceScatterContext):
     assert k == k2 and mt % world == 0, (a.shape, b.shape, world)
     mc = mt // world
 
-    out = pl.pallas_call(
+    # Tile-friendliness gate (see ag_gemm): tiny decode GEMMs use the
+    # XLA path.
+    min_rows = 16 if a.dtype.itemsize < 4 else 8
+    if mc % min_rows != 0:
+        return gemm_rs_nonoverlap(a, b, ctx.axis)
+
+    # HBM receive/staging buffers are extra outputs (discarded) —
+    # Mosaic only allows vmem/smem/semaphore scratch.
+    out, _, _ = pl.pallas_call(
         functools.partial(_gemm_rs_fused_kernel, ctx, mc, n, k),
-        out_shape=jax.ShapeDtypeStruct((mc, n), a.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((mc, n), a.dtype),
+            jax.ShapeDtypeStruct((world, mc, n), a.dtype),
+            jax.ShapeDtypeStruct((2, mc, n), a.dtype),
+        ),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 3,
         scratch_shapes=[
-            pltpu.HBM((world, mc, n), a.dtype),
-            pltpu.HBM((2, mc, n), a.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((world,)),
         ],
-        compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=ctx.collective_id),
+        compiler_params=comm_compiler_params(ctx.collective_id, world),
         cost_estimate=pl.CostEstimate(
             flops=2 * mt * n * k,
             bytes_accessed=(mt * k + k * n + world * mc * n)

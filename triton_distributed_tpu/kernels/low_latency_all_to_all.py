@@ -33,7 +33,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu.language import core as dl
-from triton_distributed_tpu.utils.platform import default_interpret
+from triton_distributed_tpu.utils.platform import (
+    comm_compiler_params,
+    default_interpret,
+)
 
 
 @dataclasses.dataclass
@@ -165,8 +168,7 @@ def fast_all_to_all(send_tokens, send_counts, ctx: AllToAllContext,
         out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY)
                         for _ in out_shapes),
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=ctx.collective_id),
+        compiler_params=comm_compiler_params(ctx.collective_id, world),
         interpret=default_interpret(ctx.interpret),
     )(*operands)
 

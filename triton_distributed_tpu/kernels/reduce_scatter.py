@@ -34,7 +34,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu.language import core as dl
-from triton_distributed_tpu.utils.platform import default_interpret
+from triton_distributed_tpu.utils.platform import (
+    comm_compiler_params,
+    default_interpret,
+)
 
 
 class ReduceScatterMethod(enum.Enum):
@@ -213,18 +216,21 @@ def reduce_scatter(x, ctx: ReduceScatterContext):
             tiled=False)
 
     interpret = default_interpret(ctx.interpret)
-    cparams = pltpu.CompilerParams(
-        has_side_effects=True, collective_id=ctx.collective_id)
+    cparams = comm_compiler_params(ctx.collective_id, world)
     xr = x.reshape(world, m, n)
 
+    # NOTE: HBM communication buffers are extra *outputs* (discarded),
+    # not scratch — Mosaic only allows vmem/smem/semaphore scratch.
     if method == ReduceScatterMethod.SCATTER_REDUCE:
-        return pl.pallas_call(
+        out, _ = pl.pallas_call(
             functools.partial(_scatter_reduce_kernel, ctx, m, n),
-            out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+            out_shape=(
+                jax.ShapeDtypeStruct((m, n), x.dtype),
+                jax.ShapeDtypeStruct((world, m, n), x.dtype),
+            ),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 2,
             scratch_shapes=[
-                pltpu.HBM((world, m, n), x.dtype),
                 pltpu.SemaphoreType.DMA(()),
                 pltpu.SemaphoreType.DMA(()),
                 pltpu.SemaphoreType.DMA((world,)),
@@ -232,16 +238,19 @@ def reduce_scatter(x, ctx: ReduceScatterContext):
             compiler_params=cparams,
             interpret=interpret,
         )(xr)
+        return out
 
     # RING
-    return pl.pallas_call(
+    out, _, _ = pl.pallas_call(
         functools.partial(_ring_rs_kernel, ctx, m, n),
-        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((m, n), x.dtype),
+            jax.ShapeDtypeStruct((2, m, n), x.dtype),   # staging (recv)
+            jax.ShapeDtypeStruct((2, m, n), x.dtype),   # accum (send)
+        ),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 3,
         scratch_shapes=[
-            pltpu.HBM((2, m, n), x.dtype),   # staging (recv)
-            pltpu.HBM((2, m, n), x.dtype),   # accum (send)
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((2,)),
@@ -250,3 +259,4 @@ def reduce_scatter(x, ctx: ReduceScatterContext):
         compiler_params=cparams,
         interpret=interpret,
     )(xr)
+    return out

@@ -75,6 +75,17 @@ def _enable_cpu_simulation_shims() -> None:
     _cb.io_callback_impl = _io_callback_impl_host
 
 
+def comm_compiler_params(collective_id: Optional[int], world_size: int):
+    """CompilerParams for communication kernels.  Mosaic requires
+    `collective_id` to be absent when the compiled kernel contains no
+    cross-device barrier/collective — which is the case when
+    world_size == 1 and all remote-DMA loops trace away."""
+    if world_size <= 1 or collective_id is None:
+        return pltpu.CompilerParams(has_side_effects=True)
+    return pltpu.CompilerParams(has_side_effects=True,
+                                collective_id=collective_id)
+
+
 def default_interpret(interpret: Optional[bool] = None):
     """Resolve an `interpret=` argument for pl.pallas_call.
 
