@@ -1,0 +1,99 @@
+"""Driver benchmark: prints ONE JSON line.
+
+Measures the flagship AG-GEMM op at the reference's headline hidden
+size (7168, BASELINE.md) on the available chip(s).  On one chip the
+ring degenerates to the fused Pallas matmul pipeline; vs_baseline is
+the speedup over the non-overlapped XLA path (collective + jnp.dot) —
+the same baseline definition BASELINE.json prescribes.
+"""
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _time(step, a, b, iters=20):
+    """Time `iters` dependence-chained executions of `step(a, b) -> a'`
+    inside one jitted scan, ending with a host fetch.  Robust against
+    async dispatch that ignores block_until_ready (e.g. remote-TPU
+    tunnels): the chain forces sequential device execution and the
+    scalar fetch forces completion."""
+
+    @jax.jit
+    def run(a, b):
+        def body(x, _):
+            return step(x, b), ()
+        x, _ = jax.lax.scan(body, a, None, length=iters)
+        return x.astype(jnp.float32).mean()
+
+    s = run(a, b)          # compile + warm
+    float(s)
+    t0 = time.perf_counter()
+    float(run(a, b))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    from triton_distributed_tpu.kernels.allgather_gemm import (
+        AllGatherGEMMContext,
+        ag_gemm,
+        ag_gemm_nonoverlap,
+    )
+    from triton_distributed_tpu.kernels.matmul import MatmulConfig
+    from triton_distributed_tpu.ops import shard_map_op
+
+    devices = jax.devices()
+    world = len(devices)
+    mesh = Mesh(np.array(devices), ("tp",))
+
+    m_total, k, n_total = 4096, 7168, 7168
+    m_loc = m_total // world
+    n_loc = n_total // world
+    dtype = jnp.bfloat16
+
+    a = jax.random.normal(jax.random.key(0), (m_total, k)).astype(dtype)
+    b = jax.random.normal(jax.random.key(1), (k, n_total)).astype(dtype)
+
+    ctx = AllGatherGEMMContext(
+        axis="tp", world_size=world,
+        gemm=MatmulConfig(block_m=512, block_n=512, block_k=1024))
+    fused = shard_map_op(
+        functools.partial(ag_gemm, ctx=ctx), mesh,
+        in_specs=(P("tp", None), P(None, "tp")),
+        out_specs=P(None, "tp"))
+    baseline = shard_map_op(
+        functools.partial(ag_gemm_nonoverlap, axis="tp"), mesh,
+        in_specs=(P("tp", None), P(None, "tp")),
+        out_specs=P(None, "tp"))
+
+    # output (M, N) feeds back as next input's A rows (chain forces
+    # sequential execution); scale keeps magnitudes stable.
+    def chain(step):
+        def f(x, b):
+            out = step(x, b)
+            nxt = (out[:, :k] * jnp.bfloat16(1e-3)
+                   + x * jnp.bfloat16(0.5)) if n_total >= k else x
+            return nxt
+        return f
+
+    t_fused = _time(chain(fused), a, b)
+    t_base = _time(chain(baseline), a, b)
+
+    flops = 2 * m_total * k * n_total
+    print(json.dumps({
+        "metric": f"ag_gemm latency M={m_total} K={k} N={n_total} bf16 "
+                  f"({world} chip{'s' if world > 1 else ''}); "
+                  f"{flops / t_fused / 1e12:.1f} TFLOP/s",
+        "value": round(t_fused * 1e6, 1),
+        "unit": "us",
+        "vs_baseline": round(t_base / t_fused, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

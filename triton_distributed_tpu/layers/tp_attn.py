@@ -23,7 +23,10 @@ from triton_distributed_tpu.kernels.allgather_gemm import (
     AllGatherGEMMContext,
     ag_gemm,
 )
-from triton_distributed_tpu.kernels.flash_attention import flash_attention
+from triton_distributed_tpu.kernels.flash_attention import (
+    attention_reference,
+    flash_attention,
+)
 from triton_distributed_tpu.kernels.flash_decode import flash_decode
 from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
     GEMMReduceScatterContext,
@@ -167,8 +170,12 @@ class TPAttention:
                                 self.rope_theta)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        attn = flash_attention(q, k, v, causal=True,
-                               interpret=self.interpret)
+        if self.mode == "xla":
+            # differentiable path (training); fused path has no VJP yet
+            attn = attention_reference(q, k, v, causal=True)
+        else:
+            attn = flash_attention(q, k, v, causal=True,
+                                   interpret=self.interpret)
         attn = attn.transpose(0, 2, 1, 3).reshape(m, -1)
         out = self._out_proj(attn, x.dtype, params)
         return out, (k, v)
