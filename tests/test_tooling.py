@@ -1,0 +1,116 @@
+"""Autotuner, perf-model and low-latency AG tests
+(reference: autotuner docs/tests, `test_fast_allgather.py`)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.autotuner import (
+    ContextualAutotuner,
+    contextual_autotune,
+)
+from triton_distributed_tpu.kernels.comm_perf_model import (
+    estimate_all_gather_time_us,
+    estimate_all_reduce_time_us,
+    estimate_one_shot_time_us,
+    get_ici_spec,
+)
+from triton_distributed_tpu.kernels.gemm_perf_model import (
+    estimate_gemm_time_us,
+    gemm_is_compute_bound,
+    get_max_mxu_tflops,
+)
+from triton_distributed_tpu.kernels.low_latency_allgather import (
+    create_fast_allgather_context,
+    fast_allgather,
+    fast_allgather_packed,
+)
+from triton_distributed_tpu.kernels.matmul import MatmulConfig, matmul
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.testing import assert_allclose
+
+
+def test_autotuner_picks_and_caches():
+    calls = []
+
+    @contextual_autotune(configs=[MatmulConfig(32, 128, 64),
+                                  MatmulConfig(64, 128, 128)],
+                         iters=1, warmup=0)
+    def op(a, b, *, config):
+        calls.append(config)
+        return matmul(a, b, config=config)
+
+    a = jax.random.normal(jax.random.key(0), (64, 128))
+    b = jax.random.normal(jax.random.key(1), (128, 128))
+    out1 = op(a, b)
+    n_after_first = len(calls)
+    out2 = op(a, b)
+    assert_allclose(out1, a @ b, atol=1e-4, rtol=1e-4)
+    assert_allclose(out2, a @ b, atol=1e-4, rtol=1e-4)
+    # second call must reuse cache: exactly one extra invocation
+    assert len(calls) == n_after_first + 1
+    assert len(op.cache) == 1
+
+
+def test_autotuner_skips_broken_configs():
+    @contextual_autotune(configs=["broken", MatmulConfig(64, 128, 128)],
+                         iters=1, warmup=0)
+    def op(a, b, *, config):
+        if config == "broken":
+            raise ValueError("bad config")
+        return matmul(a, b, config=config)
+
+    a = jax.random.normal(jax.random.key(2), (64, 128))
+    b = jax.random.normal(jax.random.key(3), (128, 128))
+    assert_allclose(op(a, b), a @ b, atol=1e-4, rtol=1e-4)
+
+
+def test_comm_perf_model():
+    spec = get_ici_spec()
+    assert spec.link_gbps > 0
+    t_ring = estimate_all_gather_time_us(1 << 20, 8)
+    t_tiny = estimate_one_shot_time_us(1024, 8)
+    assert t_ring > 0 and t_tiny > 0
+    # one-shot must win for tiny payloads
+    assert t_tiny < estimate_all_gather_time_us(1024, 8)
+    assert estimate_all_reduce_time_us(1 << 20, 8) > 0
+
+
+def test_gemm_perf_model():
+    assert get_max_mxu_tflops() > 0
+    t = estimate_gemm_time_us(4096, 4096, 4096)
+    assert t > 0
+    assert gemm_is_compute_bound(4096, 4096, 4096)
+    assert not gemm_is_compute_bound(8, 128, 128)
+
+
+def test_fast_allgather(tp8_mesh):
+    world, m, n = 8, 8, 128
+    x = jax.random.normal(jax.random.key(4), (world * m, n))
+    ctx = create_fast_allgather_context("tp", world)
+    fn = shard_map_op(functools.partial(fast_allgather, ctx=ctx),
+                      tp8_mesh, in_specs=P("tp", None),
+                      out_specs=P(None, None))
+    out = jax.jit(fn)(x)
+    assert_allclose(out, x, atol=0, rtol=0)
+
+
+def test_fast_allgather_packed(tp4_mesh):
+    world = 4
+    a = jax.random.normal(jax.random.key(5), (world * 2, 40))
+    b = jax.random.normal(jax.random.key(6), (world * 1, 7))
+    ctx = create_fast_allgather_context("tp", world)
+
+    def body(a_sh, b_sh):
+        outs = fast_allgather_packed([a_sh, b_sh], ctx)
+        return tuple(outs)
+
+    fn = shard_map_op(body, tp4_mesh,
+                      in_specs=(P("tp", None), P("tp", None)),
+                      out_specs=(P(None, None), P(None, None)))
+    ga, gb = jax.jit(fn)(a, b)
+    assert_allclose(ga, a, atol=0, rtol=0)
+    assert_allclose(gb, b, atol=0, rtol=0)

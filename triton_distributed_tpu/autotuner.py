@@ -1,0 +1,125 @@
+"""Contextual autotuner: tunes whole multi-kernel, side-effectful,
+distributed thunks — not single kernels.
+
+Reference: `python/triton_dist/autotuner.py` (256 LoC) —
+`ContextualAutoTuner.__call__:68-93`, `contextual_autotune:95`,
+`_do_bench_iterator:104`; config errors → skip & retry; per-rank logs
+`.autotune_logs/rank-N.log`; distributed aggregation so every rank
+picks the same winner (docs/autotuner.md).
+
+TPU notes: a "config" here is typically a `MatmulConfig` or a method
+enum; candidates that fail to compile (Mosaic tiling limits) are
+skipped like the reference skips CUDA OOM configs.  Under multi-process
+JAX, every process times the same candidates on its own devices and the
+winner is agreed by broadcasting process 0's choice, so all ranks run
+identical programs afterwards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import time
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+import jax
+
+from triton_distributed_tpu.utils.debug import logger
+
+
+@dataclasses.dataclass
+class _Entry:
+    config: Any
+    time_s: float
+
+
+class ContextualAutotuner:
+    def __init__(self, fn: Callable, configs: Sequence[Any],
+                 key_fn: Optional[Callable] = None,
+                 iters: int = 5, warmup: int = 2,
+                 log_dir: str = ".autotune_logs"):
+        self.fn = fn
+        self.configs = list(configs)
+        self.key_fn = key_fn or self._default_key
+        self.iters = iters
+        self.warmup = warmup
+        self.log_dir = log_dir
+        self.cache = {}
+
+    @staticmethod
+    def _default_key(*args, **kwargs):
+        def leaf_key(x):
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                return (tuple(x.shape), str(x.dtype))
+            return x if isinstance(x, (int, float, str, bool, tuple)) else None
+        return tuple(jax.tree.map(leaf_key, (args, tuple(sorted(
+            kwargs.items())))) .__repr__().split())  # stable string key
+
+    def _bench_one(self, config, args, kwargs) -> float:
+        run = functools.partial(self.fn, *args, config=config, **kwargs)
+        out = None
+        for _ in range(self.warmup):
+            out = run()
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(self.iters):
+            out = run()
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / self.iters
+
+    def _log(self, msg: str):
+        try:
+            os.makedirs(self.log_dir, exist_ok=True)
+            rank = jax.process_index()
+            with open(os.path.join(self.log_dir, f"rank-{rank}.log"),
+                      "a") as f:
+                f.write(msg + "\n")
+        except Exception:
+            pass
+
+    def _agree(self, choice_idx: int) -> int:
+        """All processes adopt process 0's winner (reference:
+        distributed aggregation of tuning results)."""
+        if jax.process_count() <= 1:
+            return choice_idx
+        from jax.experimental import multihost_utils
+        import numpy as np
+        return int(multihost_utils.broadcast_one_to_all(
+            np.int32(choice_idx)))
+
+    def __call__(self, *args, **kwargs):
+        key = self.key_fn(*args, **kwargs)
+        if key not in self.cache:
+            results = []
+            for i, cfg in enumerate(self.configs):
+                try:
+                    t = self._bench_one(cfg, args, kwargs)
+                    results.append((t, i))
+                    self._log(f"{key}: config[{i}]={cfg} -> {t*1e3:.3f} ms")
+                except Exception as e:  # config invalid on this hw
+                    self._log(f"{key}: config[{i}]={cfg} FAILED: {e}")
+            if not results:
+                raise RuntimeError(
+                    f"autotune: every config failed for key {key}")
+            results.sort()
+            best_idx = self._agree(results[0][1])
+            self.cache[key] = _Entry(self.configs[best_idx], results[0][0])
+            logger.info("autotune %s: best=%s (%.3f ms)", key,
+                        self.configs[best_idx], results[0][0] * 1e3)
+        return self.fn(*args, config=self.cache[key].config, **kwargs)
+
+
+def contextual_autotune(configs: Sequence[Any],
+                        key_fn: Optional[Callable] = None,
+                        iters: int = 5, warmup: int = 2):
+    """Decorator form (reference `contextual_autotune(is_dist=...)`):
+
+        @contextual_autotune(configs=[MatmulConfig(...), ...])
+        def my_op(a, b, *, config): ...
+    """
+    def deco(fn):
+        tuner = ContextualAutotuner(fn, configs, key_fn, iters, warmup)
+        functools.update_wrapper(tuner, fn, updated=[])
+        return tuner
+    return deco

@@ -1,0 +1,73 @@
+"""Analytic communication performance model for method auto-selection.
+
+Reference: `python/triton_dist/kernels/nvidia/comm_perf_model.py` (114
+LoC) — `estimate_reduce_scatter_time_ms` / `estimate_all_gather_time_ms`
+(`:93-114`), NIC bandwidth tables (`:34-80`).
+
+TPU tables: per-generation ICI link bandwidth (per direction, per
+link), links per chip, and DCN bandwidth for inter-slice.  Numbers are
+the published per-chip figures; they parameterize the same
+latency-vs-bandwidth decisions the reference makes with NVLink/PCIe/NIC
+probes (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class IciSpec:
+    link_gbps: float        # per link, per direction (GB/s)
+    num_links: int          # torus links per chip
+    latency_us: float       # per-hop latency
+
+
+# Published per-chip interconnect characteristics.
+_ICI_TABLE = {
+    "v4": IciSpec(link_gbps=50.0, num_links=6, latency_us=1.0),
+    "v5e": IciSpec(link_gbps=50.0, num_links=4, latency_us=1.0),
+    "v5p": IciSpec(link_gbps=100.0, num_links=6, latency_us=1.0),
+    "v6e": IciSpec(link_gbps=100.0, num_links=4, latency_us=1.0),
+}
+
+_DCN_GBPS = 25.0  # per host, typical
+
+
+def get_ici_spec(device=None) -> IciSpec:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, spec in _ICI_TABLE.items():
+        if key in kind.replace(" ", ""):
+            return spec
+    return _ICI_TABLE["v5e"]
+
+
+def estimate_all_gather_time_us(nbytes_per_shard: int, world: int,
+                                spec: IciSpec = None) -> float:
+    """Ring AG: (world-1) steps, each shipping one shard over one link
+    pair (bidir ring uses 2)."""
+    spec = spec or get_ici_spec()
+    bw = spec.link_gbps * 1e9 * 2  # bidirectional ring
+    return (world - 1) * (nbytes_per_shard / bw * 1e6 + spec.latency_us)
+
+
+def estimate_reduce_scatter_time_us(nbytes_per_shard: int, world: int,
+                                    spec: IciSpec = None) -> float:
+    return estimate_all_gather_time_us(nbytes_per_shard, world, spec)
+
+
+def estimate_all_reduce_time_us(nbytes: int, world: int,
+                                spec: IciSpec = None) -> float:
+    """ring AR = RS + AG over chunks of nbytes/world."""
+    return 2 * estimate_all_gather_time_us(nbytes // world, world, spec)
+
+
+def estimate_one_shot_time_us(nbytes: int, world: int,
+                              spec: IciSpec = None) -> float:
+    """One-shot push: world-1 concurrent puts share the chip's links."""
+    spec = spec or get_ici_spec()
+    bw = spec.link_gbps * 1e9 * spec.num_links
+    return (world - 1) * nbytes / bw * 1e6 + spec.latency_us

@@ -1,0 +1,72 @@
+"""Analytic GEMM performance model (roofline).
+
+Reference: `python/triton_dist/kernels/nvidia/gemm_perf_model.py` (247
+LoC) — `get_max_tensorcore_tflops:61`, `get_tflops_approx:126`, used to
+balance communication vs compute resources.
+
+TPU: per-generation MXU peak and HBM bandwidth; `estimate_gemm_time_us`
+is the max of the compute and memory rooflines.  Overlap kernels use it
+to decide whether a chunk's matmul hides a chunk's DMA (the decision
+the reference makes by partitioning SMs between comm and compute).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    bf16_tflops: float
+    int8_tops: float
+    hbm_gbps: float
+
+
+_CHIP_TABLE = {
+    "v4": ChipSpec(bf16_tflops=275.0, int8_tops=275.0, hbm_gbps=1228.0),
+    "v5e": ChipSpec(bf16_tflops=197.0, int8_tops=394.0, hbm_gbps=819.0),
+    "v5p": ChipSpec(bf16_tflops=459.0, int8_tops=918.0, hbm_gbps=2765.0),
+    "v6e": ChipSpec(bf16_tflops=918.0, int8_tops=1836.0, hbm_gbps=1640.0),
+}
+
+
+def get_chip_spec(device=None) -> ChipSpec:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
+    for key, spec in _CHIP_TABLE.items():
+        if key in kind:
+            return spec
+    return _CHIP_TABLE["v5e"]
+
+
+def get_max_mxu_tflops(dtype=jnp.bfloat16, device=None) -> float:
+    spec = get_chip_spec(device)
+    if jnp.dtype(dtype).itemsize == 1:
+        return spec.int8_tops
+    return spec.bf16_tflops
+
+
+def estimate_gemm_time_us(m: int, n: int, k: int, dtype=jnp.bfloat16,
+                          efficiency: float = 0.6, device=None) -> float:
+    """max(compute, memory) roofline with an efficiency derate."""
+    spec = get_chip_spec(device)
+    itemsize = jnp.dtype(dtype).itemsize
+    flops = 2.0 * m * n * k
+    t_compute = flops / (get_max_mxu_tflops(dtype, device) * 1e12
+                         * efficiency)
+    nbytes = (m * k + k * n + m * n) * itemsize
+    t_mem = nbytes / (spec.hbm_gbps * 1e9)
+    return max(t_compute, t_mem) * 1e6
+
+
+def gemm_is_compute_bound(m: int, n: int, k: int,
+                          dtype=jnp.bfloat16, device=None) -> bool:
+    spec = get_chip_spec(device)
+    itemsize = jnp.dtype(dtype).itemsize
+    intensity = (2.0 * m * n * k) / ((m * k + k * n + m * n) * itemsize)
+    ridge = get_max_mxu_tflops(dtype, device) * 1e12 / (
+        spec.hbm_gbps * 1e9)
+    return intensity >= ridge
