@@ -1,0 +1,172 @@
+// tdt_aot_runtime implementation — see tdt_aot_runtime.h.
+//
+// Bundle layout (written by tools/compile_aot.py):
+//   manifest.json   human-readable metadata
+//   index.bin       TLV index parsed here:
+//                     u32 magic 'TDTA', u32 version,
+//                     u32 n, then per variant:
+//                       u16 name_len, name bytes,
+//                       u16 file_len, file bytes
+//   *.jaxexp        serialized jax.export payloads
+
+#include "tdt_aot_runtime.h"
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x41544454;  // "TDTA" little-endian
+constexpr uint32_t kVersion = 1;
+
+struct Variant {
+  std::string name;
+  std::string file;
+};
+
+}  // namespace
+
+struct tdt_bundle {
+  std::string path;
+  std::vector<Variant> variants;
+};
+
+struct tdt_executable {
+  std::vector<uint8_t> bytes;
+};
+
+static std::string g_pjrt_library;
+
+extern "C" {
+
+tdt_status tdt_bundle_open(const char* path, tdt_bundle** out) {
+  if (!path || !out) return TDT_ERR_IO;
+  std::string idx = std::string(path) + "/index.bin";
+  FILE* f = std::fopen(idx.c_str(), "rb");
+  if (!f) return TDT_ERR_IO;
+
+  auto read_u32 = [&](uint32_t* v) {
+    return std::fread(v, sizeof(uint32_t), 1, f) == 1;
+  };
+  auto read_u16 = [&](uint16_t* v) {
+    return std::fread(v, sizeof(uint16_t), 1, f) == 1;
+  };
+  auto read_str = [&](std::string* s, uint16_t len) {
+    s->resize(len);
+    return len == 0 || std::fread(&(*s)[0], 1, len, f) == len;
+  };
+
+  uint32_t magic = 0, version = 0, n = 0;
+  if (!read_u32(&magic) || magic != kMagic || !read_u32(&version) ||
+      version != kVersion || !read_u32(&n) || n > 4096) {
+    std::fclose(f);
+    return TDT_ERR_FORMAT;
+  }
+
+  auto* b = new tdt_bundle();
+  b->path = path;
+  for (uint32_t i = 0; i < n; ++i) {
+    uint16_t ln = 0, lf = 0;
+    Variant v;
+    if (!read_u16(&ln) || !read_str(&v.name, ln) || !read_u16(&lf) ||
+        !read_str(&v.file, lf)) {
+      delete b;
+      std::fclose(f);
+      return TDT_ERR_FORMAT;
+    }
+    b->variants.push_back(std::move(v));
+  }
+  std::fclose(f);
+  *out = b;
+  return TDT_OK;
+}
+
+void tdt_bundle_close(tdt_bundle* b) { delete b; }
+
+int tdt_bundle_num_variants(const tdt_bundle* b) {
+  return b ? static_cast<int>(b->variants.size()) : 0;
+}
+
+const char* tdt_bundle_variant_name(const tdt_bundle* b, int i) {
+  if (!b || i < 0 || i >= static_cast<int>(b->variants.size()))
+    return nullptr;
+  return b->variants[i].name.c_str();
+}
+
+tdt_status tdt_bundle_load_variant(tdt_bundle* b, const char* variant,
+                                   tdt_executable** out) {
+  if (!b || !variant || !out) return TDT_ERR_IO;
+  for (const auto& v : b->variants) {
+    if (v.name == variant) {
+      std::string fn = b->path + "/" + v.file;
+      FILE* f = std::fopen(fn.c_str(), "rb");
+      if (!f) return TDT_ERR_IO;
+      std::fseek(f, 0, SEEK_END);
+      long sz = std::ftell(f);
+      std::fseek(f, 0, SEEK_SET);
+      auto* e = new tdt_executable();
+      e->bytes.resize(sz);
+      if (sz > 0 &&
+          std::fread(e->bytes.data(), 1, sz, f) !=
+              static_cast<size_t>(sz)) {
+        delete e;
+        std::fclose(f);
+        return TDT_ERR_IO;
+      }
+      std::fclose(f);
+      // jax.export payloads are flatbuffers-framed; sanity check size.
+      if (e->bytes.size() < 16) {
+        delete e;
+        return TDT_ERR_FORMAT;
+      }
+      *out = e;
+      return TDT_OK;
+    }
+  }
+  return TDT_ERR_NOT_FOUND;
+}
+
+void tdt_executable_free(tdt_executable* e) { delete e; }
+
+const uint8_t* tdt_executable_bytes(const tdt_executable* e) {
+  return e ? e->bytes.data() : nullptr;
+}
+
+size_t tdt_executable_size(const tdt_executable* e) {
+  return e ? e->bytes.size() : 0;
+}
+
+tdt_status tdt_set_pjrt_library(const char* libtpu_path) {
+  if (!libtpu_path) return TDT_ERR_IO;
+  g_pjrt_library = libtpu_path;
+  return TDT_OK;
+}
+
+tdt_status tdt_executable_execute(tdt_executable* e, const void** args,
+                                  int nargs, void** outs, int nouts) {
+  (void)e;
+  (void)args;
+  (void)nargs;
+  (void)outs;
+  (void)nouts;
+  // Dispatch through the PJRT C API (dlopen(g_pjrt_library) →
+  // GetPjrtApi → compile+execute). Deferred until a PJRT SDK with
+  // stable headers is vendored; callers fall back to the Python
+  // executor (tools.compile_aot.load_bundle).
+  return TDT_ERR_NO_BACKEND;
+}
+
+const char* tdt_status_str(tdt_status s) {
+  switch (s) {
+    case TDT_OK: return "ok";
+    case TDT_ERR_IO: return "io error";
+    case TDT_ERR_FORMAT: return "bad bundle format";
+    case TDT_ERR_NOT_FOUND: return "variant not found";
+    case TDT_ERR_NO_BACKEND: return "no pjrt backend linked";
+  }
+  return "unknown";
+}
+
+}  // extern "C"

@@ -1,0 +1,110 @@
+/* Native MoE alignment + tile-swizzle helpers.
+ *
+ * Reference analogue: csrc/lib/moe_utils.cu
+ * (`moe_ag_scatter_align_block_size`) and the AG-MoE threadblock
+ * swizzle family (kernels/nvidia/threadblock_swizzle_ag_moe.cc) —
+ * host/device helpers that compute block-aligned expert segment
+ * offsets and the tile execution order that matches data arrival.
+ *
+ * On TPU these run on the host as planning steps (grid orders and
+ * segment tables are baked into the compiled program), so plain C is
+ * the right tool.  Exposed via ctypes (tools/native.py) with numpy
+ * fallbacks.
+ */
+
+#include <stdint.h>
+#include <string.h>
+
+/* Sort (stable) token-pairs by expert and compute block-aligned
+ * segments.
+ *
+ * expert_ids:  n entries (one per token-pair), values in [0, E).
+ * block:       tile size to align each expert's segment to.
+ * sorted_ids:  out, capacity cap = sum_e ceil(count_e/block)*block;
+ *              padded slots get n (sentinel).
+ * expert_off:  out, E+1 entries — aligned start offset per expert.
+ * Returns the number of aligned slots used, or -1 on error.
+ */
+int64_t tdt_moe_align_block_size(const int32_t* expert_ids, int64_t n,
+                                 int32_t num_experts, int32_t block,
+                                 int64_t cap, int32_t* sorted_ids,
+                                 int64_t* expert_off) {
+  if (!expert_ids || !sorted_ids || !expert_off || num_experts <= 0 ||
+      block <= 0)
+    return -1;
+
+  /* counts */
+  int64_t* counts = (int64_t*)__builtin_alloca(
+      sizeof(int64_t) * (size_t)num_experts);
+  memset(counts, 0, sizeof(int64_t) * (size_t)num_experts);
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t e = expert_ids[i];
+    if (e < 0 || e >= num_experts) return -1;
+    counts[e]++;
+  }
+
+  /* aligned offsets */
+  int64_t total = 0;
+  for (int32_t e = 0; e < num_experts; ++e) {
+    expert_off[e] = total;
+    int64_t aligned = (counts[e] + block - 1) / block * block;
+    total += aligned;
+  }
+  expert_off[num_experts] = total;
+  if (total > cap) return -1;
+
+  /* fill with sentinel, then stable scatter */
+  for (int64_t i = 0; i < total; ++i) sorted_ids[i] = (int32_t)n;
+  int64_t* cursor = (int64_t*)__builtin_alloca(
+      sizeof(int64_t) * (size_t)num_experts);
+  memcpy(cursor, expert_off, sizeof(int64_t) * (size_t)num_experts);
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t e = expert_ids[i];
+    sorted_ids[cursor[e]++] = (int32_t)i;
+  }
+  return total;
+}
+
+/* Rank-offset swizzle for AllGather-consumer tile order: chunk c is
+ * processed in arrival order starting from this rank's own chunk
+ * (reference: rank-offset swizzle `allgather_gemm.py:211-216`).
+ * order: out, world entries. */
+void tdt_swizzle_ag_order(int32_t world, int32_t rank, int32_t* order) {
+  for (int32_t s = 0; s < world; ++s) {
+    order[s] = ((rank - s) % world + world) % world;
+  }
+}
+
+/* Scatter-producer swizzle for GEMM-RS: start with the chunk owned by
+ * rank+1 so communication starts immediately and the own chunk (no
+ * transfer needed) is computed last (reference:
+ * gemm_rs_threadblock_swizzle.py). */
+void tdt_swizzle_rs_order(int32_t world, int32_t rank, int32_t* order) {
+  for (int32_t s = 0; s < world; ++s) {
+    order[s] = (rank + 1 + s) % world;
+  }
+}
+
+/* Dynamic MoE tile swizzle: order expert tiles by (arrival_chunk,
+ * expert) so tiles whose tokens arrived first run first (reference:
+ * threadblock_swizzle_ag_moe).  tiles_per_expert entries give the tile
+ * count per (chunk, expert) cell; out receives linearized tile ids in
+ * execution order.  Returns total tiles. */
+int64_t tdt_swizzle_ag_moe(int32_t world, int32_t rank,
+                           int32_t num_experts,
+                           const int32_t* tiles_per_cell,
+                           int32_t* out) {
+  int64_t pos = 0;
+  for (int32_t s = 0; s < world; ++s) {
+    int32_t chunk = ((rank - s) % world + world) % world;
+    for (int32_t e = 0; e < num_experts; ++e) {
+      int64_t cell = (int64_t)chunk * num_experts + e;
+      int64_t base = 0;
+      for (int64_t c = 0; c < cell; ++c) base += tiles_per_cell[c];
+      for (int32_t t = 0; t < tiles_per_cell[cell]; ++t) {
+        out[pos++] = (int32_t)(base + t);
+      }
+    }
+  }
+  return pos;
+}

@@ -1,0 +1,63 @@
+/* tdt_aot_runtime — native loader for triton_distributed_tpu AOT
+ * bundles.
+ *
+ * Reference analogue: python/triton_dist/tools/runtime/
+ * triton_aot_runtime.h (CUDA-driver module/kernel loader,
+ * multi-context safe).  Here the artifact is a jax.export StableHLO
+ * bundle (see tools/compile_aot.py); this runtime parses and
+ * validates bundles natively and hands serialized executables to a
+ * PJRT dispatch hook.  Pure C ABI so it is usable from C, C++ and
+ * Python ctypes.
+ */
+#ifndef TDT_AOT_RUNTIME_H_
+#define TDT_AOT_RUNTIME_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum tdt_status {
+  TDT_OK = 0,
+  TDT_ERR_IO = 1,
+  TDT_ERR_FORMAT = 2,
+  TDT_ERR_NOT_FOUND = 3,
+  TDT_ERR_NO_BACKEND = 4,
+} tdt_status;
+
+typedef struct tdt_bundle tdt_bundle;
+typedef struct tdt_executable tdt_executable;
+
+/* Open a bundle directory (reads index.bin written by compile_aot). */
+tdt_status tdt_bundle_open(const char* path, tdt_bundle** out);
+void tdt_bundle_close(tdt_bundle* b);
+
+/* Introspection. */
+int tdt_bundle_num_variants(const tdt_bundle* b);
+const char* tdt_bundle_variant_name(const tdt_bundle* b, int i);
+
+/* Load one variant's serialized executable into memory. */
+tdt_status tdt_bundle_load_variant(tdt_bundle* b, const char* variant,
+                                   tdt_executable** out);
+void tdt_executable_free(tdt_executable* e);
+
+/* Serialized payload access (StableHLO jax.export bytes). */
+const uint8_t* tdt_executable_bytes(const tdt_executable* e);
+size_t tdt_executable_size(const tdt_executable* e);
+
+/* Execution dispatch: requires a PJRT plugin (libtpu) registered via
+ * tdt_set_pjrt_library; returns TDT_ERR_NO_BACKEND otherwise. */
+tdt_status tdt_set_pjrt_library(const char* libtpu_path);
+tdt_status tdt_executable_execute(tdt_executable* e,
+                                  const void** args, int nargs,
+                                  void** outs, int nouts);
+
+const char* tdt_status_str(tdt_status s);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TDT_AOT_RUNTIME_H_ */

@@ -1,0 +1,9 @@
+"""AOT compilation + deployment tooling
+(reference: `python/triton_dist/tools/`)."""
+
+from triton_distributed_tpu.tools.compile_aot import (  # noqa: F401
+    AotBundle,
+    aot_compile_spaces,
+    compile_aot,
+    load_bundle,
+)

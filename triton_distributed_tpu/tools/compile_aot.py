@@ -1,0 +1,142 @@
+"""AOT compiler: serialize jitted (distributed) functions into
+self-contained deployable bundles + generated C header.
+
+Reference: `python/triton_dist/tools/compile_aot.py` (877 LoC) — the
+`aot_compile_spaces` decorator declares signature/grid spaces
+(`:61`), `_compile_kernel:204` emits C sources + cubins loaded by the C
+runtime `tools/runtime/triton_aot_runtime.{h,cc}`.
+
+TPU re-design: ahead-of-time artifacts are `jax.export` StableHLO
+payloads (hermetic, version-stamped, multi-platform) instead of cubins.
+A bundle is a directory:
+
+    bundle/
+      manifest.json            # entry points, shapes, dtypes, configs
+      <name>__<variant>.jaxexp # serialized exported function
+      <name>.h                 # generated C header (ABI for csrc/
+                               # aot_runtime.cc, reference
+                               # triton_aot_runtime.h analogue)
+
+The C runtime (csrc/aot_runtime.cc) parses bundles natively; execution
+dispatches through PJRT when linked against libtpu (round-2 scope), and
+`load_bundle` gives the Python-side executor today.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+from jax import export as jax_export
+
+
+@dataclasses.dataclass
+class AotVariant:
+    name: str
+    arg_shapes: Sequence[Sequence[int]]
+    arg_dtypes: Sequence[str]
+    config: Optional[dict] = None
+
+
+@dataclasses.dataclass
+class AotBundle:
+    path: str
+    manifest: dict
+    _loaded: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def variants(self):
+        return list(self.manifest["variants"].keys())
+
+    def call(self, variant: str, *args):
+        if variant not in self._loaded:
+            fn = os.path.join(self.path,
+                              self.manifest["variants"][variant]["file"])
+            with open(fn, "rb") as f:
+                self._loaded[variant] = jax_export.deserialize(f.read())
+        return self._loaded[variant].call(*args)
+
+
+def compile_aot(fn: Callable, name: str, variants: Sequence[AotVariant],
+                out_dir: str, platforms: Optional[Sequence[str]] = None):
+    """Export `fn` for each variant and write a bundle."""
+    os.makedirs(out_dir, exist_ok=True)
+    manifest = {"name": name, "format": "jax.export.v1", "variants": {}}
+    jit_fn = fn if isinstance(fn, jax.stages.Wrapped) else jax.jit(fn)
+    for v in variants:
+        args = [jax.ShapeDtypeStruct(tuple(s), d)
+                for s, d in zip(v.arg_shapes, v.arg_dtypes)]
+        exp = jax_export.export(jit_fn, platforms=platforms)(*args)
+        fname = f"{name}__{v.name}.jaxexp"
+        with open(os.path.join(out_dir, fname), "wb") as f:
+            f.write(exp.serialize())
+        manifest["variants"][v.name] = {
+            "file": fname,
+            "arg_shapes": [list(s) for s in v.arg_shapes],
+            "arg_dtypes": list(v.arg_dtypes),
+            "config": v.config,
+        }
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    _write_c_header(name, manifest, out_dir)
+    return AotBundle(path=out_dir, manifest=manifest)
+
+
+def load_bundle(path: str) -> AotBundle:
+    with open(os.path.join(path, "manifest.json")) as f:
+        return AotBundle(path=path, manifest=json.load(f))
+
+
+def _write_c_header(name: str, manifest: dict, out_dir: str):
+    """Generated ABI header consumed by csrc/aot_runtime.cc (the
+    reference's generated `<kernel>.h` + `triton_aot_runtime.h`)."""
+    guard = f"TDT_AOT_{name.upper()}_H_"
+    lines = [
+        f"#ifndef {guard}",
+        f"#define {guard}",
+        "",
+        '#include "tdt_aot_runtime.h"',
+        "",
+        f'static const char k{name.title().replace("_", "")}Bundle[] = '
+        f'"{name}";',
+        "",
+    ]
+    for vname, v in manifest["variants"].items():
+        sym = f"tdt_{name}_{vname}"
+        lines += [
+            f"/* variant {vname}: shapes "
+            f"{v['arg_shapes']} dtypes {v['arg_dtypes']} */",
+            f"static inline tdt_status {sym}_load(tdt_bundle* b, "
+            "tdt_executable** out) {",
+            f'  return tdt_bundle_load_variant(b, "{vname}", out);',
+            "}",
+            "",
+        ]
+    lines += [f"#endif  /* {guard} */", ""]
+    with open(os.path.join(out_dir, f"{name}.h"), "w") as f:
+        f.write("\n".join(lines))
+
+
+def aot_compile_spaces(spaces: Dict[str, dict], out_dir: str = "aot_out"):
+    """Decorator (reference `aot_compile_spaces:61`): declare named
+    shape/dtype spaces; `fn.compile_aot()` builds the bundle.
+
+        @aot_compile_spaces({
+            "bs1": {"arg_shapes": [(1, 128)], "arg_dtypes": ["float32"]},
+        })
+        def step(x): ...
+    """
+    def deco(fn):
+        variants = [AotVariant(name=k, **v) for k, v in spaces.items()]
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            return fn(*a, **kw)
+
+        wrapper.compile_aot = lambda name=None, path=None: compile_aot(
+            fn, name or fn.__name__, variants, path or out_dir)
+        return wrapper
+    return deco

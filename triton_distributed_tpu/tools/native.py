@@ -1,0 +1,173 @@
+"""ctypes bindings for the native library (csrc/libtdt.so) with numpy
+fallbacks.
+
+Reference analogue: the pybind'd native ops (`csrc/lib/op_pybind.cc` →
+`libtriton_distributed`) and the AOT C runtime.  We bind with ctypes
+(no pybind11 in the image) and degrade gracefully to numpy when the
+library hasn't been built (`make -C csrc`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_LIB_PATH = os.path.join(_CSRC, "build", "libtdt.so")
+
+
+@functools.lru_cache(maxsize=None)
+def _load(build_if_missing: bool = True) -> Optional[ctypes.CDLL]:
+    if not os.path.exists(_LIB_PATH) and build_if_missing:
+        try:
+            subprocess.run(["make", "-C", _CSRC], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            return None
+    if not os.path.exists(_LIB_PATH):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.tdt_moe_align_block_size.restype = ctypes.c_int64
+    lib.tdt_moe_align_block_size.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.tdt_swizzle_ag_order.restype = None
+    lib.tdt_swizzle_ag_order.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
+    lib.tdt_swizzle_rs_order.restype = None
+    lib.tdt_swizzle_rs_order.argtypes = lib.tdt_swizzle_ag_order.argtypes
+    lib.tdt_bundle_open.restype = ctypes.c_int
+    lib.tdt_bundle_open.argtypes = [ctypes.c_char_p,
+                                    ctypes.POINTER(ctypes.c_void_p)]
+    lib.tdt_bundle_num_variants.restype = ctypes.c_int
+    lib.tdt_bundle_num_variants.argtypes = [ctypes.c_void_p]
+    lib.tdt_bundle_variant_name.restype = ctypes.c_char_p
+    lib.tdt_bundle_variant_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.tdt_bundle_load_variant.restype = ctypes.c_int
+    lib.tdt_bundle_load_variant.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+    lib.tdt_executable_size.restype = ctypes.c_size_t
+    lib.tdt_executable_size.argtypes = [ctypes.c_void_p]
+    lib.tdt_bundle_close.argtypes = [ctypes.c_void_p]
+    lib.tdt_executable_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def have_native() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# MoE alignment
+# ---------------------------------------------------------------------------
+
+def moe_align_block_size(expert_ids: np.ndarray, num_experts: int,
+                         block: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Stable-sort token-pairs by expert with block-aligned segments.
+
+    Returns (sorted_ids (total,), expert_off (E+1,)); padded slots hold
+    the sentinel `len(expert_ids)`.
+    """
+    expert_ids = np.ascontiguousarray(expert_ids, np.int32)
+    n = expert_ids.size
+    counts = np.bincount(expert_ids, minlength=num_experts)
+    cap = int(((counts + block - 1) // block * block).sum())
+
+    lib = _load()
+    if lib is not None:
+        sorted_ids = np.empty(cap, np.int32)
+        off = np.empty(num_experts + 1, np.int64)
+        total = lib.tdt_moe_align_block_size(
+            expert_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n, num_experts, block, cap,
+            sorted_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if total >= 0:
+            return sorted_ids[:total], off
+
+    # numpy fallback
+    order = np.argsort(expert_ids, kind="stable")
+    off = np.zeros(num_experts + 1, np.int64)
+    aligned = (counts + block - 1) // block * block
+    off[1:] = np.cumsum(aligned)
+    sorted_ids = np.full(cap, n, np.int32)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    for e in range(num_experts):
+        seg = order[starts[e]:starts[e] + counts[e]]
+        sorted_ids[off[e]:off[e] + counts[e]] = seg
+    return sorted_ids, off
+
+
+def swizzle_ag_order(world: int, rank: int) -> np.ndarray:
+    lib = _load()
+    if lib is not None:
+        out = np.empty(world, np.int32)
+        lib.tdt_swizzle_ag_order(
+            world, rank, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out
+    return np.array([(rank - s) % world for s in range(world)], np.int32)
+
+
+def swizzle_rs_order(world: int, rank: int) -> np.ndarray:
+    lib = _load()
+    if lib is not None:
+        out = np.empty(world, np.int32)
+        lib.tdt_swizzle_rs_order(
+            world, rank, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out
+    return np.array([(rank + 1 + s) % world for s in range(world)], np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Native AOT bundle loader
+# ---------------------------------------------------------------------------
+
+def write_bundle_index(bundle_dir: str) -> None:
+    """Emit index.bin for the C runtime from manifest.json."""
+    import json
+    import struct
+
+    with open(os.path.join(bundle_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    blob = struct.pack("<III", 0x41544454, 1,
+                       len(manifest["variants"]))
+    for name, v in manifest["variants"].items():
+        nb = name.encode()
+        fb = v["file"].encode()
+        blob += struct.pack("<H", len(nb)) + nb
+        blob += struct.pack("<H", len(fb)) + fb
+    with open(os.path.join(bundle_dir, "index.bin"), "wb") as f:
+        f.write(blob)
+
+
+def native_open_bundle(bundle_dir: str):
+    """Open a bundle with the C runtime; returns (handle, names)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library not built (make -C csrc)")
+    h = ctypes.c_void_p()
+    rc = lib.tdt_bundle_open(bundle_dir.encode(), ctypes.byref(h))
+    if rc != 0:
+        raise RuntimeError(f"tdt_bundle_open failed: rc={rc}")
+    n = lib.tdt_bundle_num_variants(h)
+    names = [lib.tdt_bundle_variant_name(h, i).decode() for i in range(n)]
+    return h, names
+
+
+def native_load_variant_size(handle, variant: str) -> int:
+    lib = _load()
+    e = ctypes.c_void_p()
+    rc = lib.tdt_bundle_load_variant(handle, variant.encode(),
+                                     ctypes.byref(e))
+    if rc != 0:
+        raise RuntimeError(f"load_variant failed: rc={rc}")
+    size = lib.tdt_executable_size(e)
+    lib.tdt_executable_free(e)
+    return int(size)
