@@ -1,10 +1,18 @@
 """Driver benchmark: prints ONE JSON line.
 
 Measures the flagship AG-GEMM op at the reference's headline hidden
-size (7168, BASELINE.md) on the available chip(s).  On one chip the
-ring degenerates to the fused Pallas matmul pipeline; vs_baseline is
-the speedup over the non-overlapped XLA path (collective + jnp.dot) —
-the same baseline definition BASELINE.json prescribes.
+size (7168, BASELINE.md) on the available chip(s), with the
+`contextual_autotune` tuner selecting the method (XLA vs fused Pallas)
+and MXU block config — the production path, not a hardcoded config.
+
+Timing methodology: on tunneled TPU backends every device→host fetch
+pays a large fixed round-trip cost (~100 ms) and `block_until_ready`
+is unreliable, so each sample dispatches N dependence-chained calls
+with a single trailing fetch, and the per-call latency is the slope
+between N1 and N2 samples: t = (T(N2) - T(N1)) / (N2 - N1).  This
+removes the fixed cost exactly; the round-1 numbers (53 TFLOP/s) were
+an artifact of not doing this — the same chip measures ~190 TFLOP/s
+for the XLA matmul once the fetch cost is fitted out.
 """
 
 import functools
@@ -16,78 +24,124 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+M_TOTAL, K, N_TOTAL = 4096, 7168, 7168
 
-def _time(step, a, b, iters=20):
-    """Time `iters` dependence-chained executions of `step(a, b) -> a'`
-    inside one jitted scan, ending with a host fetch.  Robust against
-    async dispatch that ignores block_until_ready (e.g. remote-TPU
-    tunnels): the chain forces sequential device execution and the
-    scalar fetch forces completion."""
 
-    @jax.jit
-    def run(a, b):
-        def body(x, _):
-            return step(x, b), ()
-        x, _ = jax.lax.scan(body, a, None, length=iters)
-        return x.astype(jnp.float32).mean()
+def make_chain(k):
+    """Feed an op's (M, N) output back into its (M, k) input — the
+    dependence chain used by both the tuner and the final A/B."""
+    return jax.jit(
+        lambda x, out: (out[:, :k] * jnp.bfloat16(1e-3)
+                        + x * jnp.bfloat16(0.5)).astype(jnp.bfloat16))
 
-    s = run(a, b)          # compile + warm
-    float(s)
-    t0 = time.perf_counter()
-    float(run(a, b))
-    return (time.perf_counter() - t0) / iters
+
+def measure_pair(fs, a, b, k, n1=20, n2=120, repeats=4):
+    """Per-call latency of each jitted `f(a, b) -> (M, N)` in `fs` by
+    two-point fit, with the ops' samples interleaved in time so slow
+    drift (chip clocks, tunnel load) hits all ops equally.  Calls are
+    dependence-chained through the output so the device queue can't
+    collapse them; the fetch cost fluctuates by tens of ms, so the fit
+    needs a large call-count gap and medians."""
+    import statistics
+
+    chain = make_chain(k)
+
+    def total(f, n_calls):
+        t0 = time.perf_counter()
+        x = a
+        for _ in range(n_calls):
+            x = chain(x, f(x, b))
+        np.asarray(x[0, 0])  # fence: forces full queue drain
+        return time.perf_counter() - t0
+
+    for f in fs:
+        total(f, 2)  # warm every jit
+    samples = [([], []) for _ in fs]
+    for _ in range(repeats):
+        for (t1s, t2s), f in zip(samples, fs):
+            t1s.append(total(f, n1))
+            t2s.append(total(f, n2))
+    return [max((statistics.median(t2s) - statistics.median(t1s))
+                / (n2 - n1), 1e-9) for t1s, t2s in samples]
 
 
 def main():
+    from triton_distributed_tpu.autotuner import ContextualAutotuner
     from triton_distributed_tpu.kernels.allgather_gemm import (
         AllGatherGEMMContext,
         ag_gemm,
         ag_gemm_nonoverlap,
     )
-    from triton_distributed_tpu.kernels.matmul import MatmulConfig
+    from triton_distributed_tpu.kernels.matmul import (
+        MatmulConfig,
+        matmul_config_space,
+    )
     from triton_distributed_tpu.ops import shard_map_op
 
     devices = jax.devices()
     world = len(devices)
     mesh = Mesh(np.array(devices), ("tp",))
+    m_loc = M_TOTAL // world
+    n_loc = N_TOTAL // world
 
-    m_total, k, n_total = 4096, 7168, 7168
-    m_loc = m_total // world
-    n_loc = n_total // world
-    dtype = jnp.bfloat16
+    a = jax.random.normal(jax.random.key(0), (M_TOTAL, K)).astype(jnp.bfloat16)
+    b = jax.random.normal(jax.random.key(1), (K, N_TOTAL)).astype(jnp.bfloat16)
 
-    a = jax.random.normal(jax.random.key(0), (m_total, k)).astype(dtype)
-    b = jax.random.normal(jax.random.key(1), (k, n_total)).astype(dtype)
+    specs = dict(in_specs=(P("tp", None), P(None, "tp")),
+                 out_specs=P(None, "tp"))
 
-    ctx = AllGatherGEMMContext(
-        axis="tp", world_size=world,
-        gemm=MatmulConfig(block_m=512, block_n=512, block_k=1024))
-    fused = shard_map_op(
-        functools.partial(ag_gemm, ctx=ctx), mesh,
-        in_specs=(P("tp", None), P(None, "tp")),
-        out_specs=P(None, "tp"))
-    baseline = shard_map_op(
-        functools.partial(ag_gemm_nonoverlap, axis="tp"), mesh,
-        in_specs=(P("tp", None), P(None, "tp")),
-        out_specs=P(None, "tp"))
+    jit_cache = {}
 
-    # output (M, N) feeds back as next input's A rows (chain forces
-    # sequential execution); scale keeps magnitudes stable.
-    def chain(step):
-        def f(x, b):
-            out = step(x, b)
-            nxt = (out[:, :k] * jnp.bfloat16(1e-3)
-                   + x * jnp.bfloat16(0.5)) if n_total >= k else x
-            return nxt
+    def fused_for(config):
+        f = jit_cache.get(config)
+        if f is None:
+            method, mcfg = config
+            ctx = AllGatherGEMMContext(
+                axis="tp", world_size=world, method=method,
+                gemm=mcfg or MatmulConfig())
+            f = jax.jit(shard_map_op(
+                functools.partial(ag_gemm, ctx=ctx), mesh, **specs))
+            jit_cache[config] = f
         return f
 
-    t_fused = _time(chain(fused), a, b)
-    t_base = _time(chain(baseline), a, b)
+    baseline = jax.jit(shard_map_op(
+        functools.partial(ag_gemm_nonoverlap, axis="tp"), mesh, **specs))
 
-    flops = 2 * m_total * k * n_total
+    # Autotune the production op's MXU block config (the reference's
+    # contextual_autotune over triton.Config spaces); the fused-vs-XLA
+    # method A/B happens below with drift-robust interleaved sampling.
+    # The fused kernel's inner GEMM runs per-chunk at m = m_loc (at
+    # world == 1 m_loc is the full M), so resolve the space there.
+    candidates = [("fused", c)
+                  for c in matmul_config_space(m_loc, n_loc, K)]
+
+    def op(a, b, *, config):
+        return fused_for(config)(a, b)
+
+    tune_chain = make_chain(K)
+
+    # iters=40 -> samples of 40 vs 240 chained calls: ~0.6 s of device
+    # work per sample, large enough to swamp the fetch-cost jitter;
+    # chaining keeps only one output buffer live.
+    tuner = ContextualAutotuner(op, candidates, iters=40,
+                                chain=lambda out, x, w: (tune_chain(x, out), w))
+    tuner(a, b)  # populates cache + ranking
+    ranking = next(iter(tuner.cache.values())).ranking
+    finalists = [cfg for _, cfg in ranking[:2]]
+
+    # Final A/B with drift-robust interleaved sampling over the top-2
+    # tuner finalists (their margin is within tuner noise) + baseline.
+    times = measure_pair([fused_for(c) for c in finalists] + [baseline],
+                         a, b, K)
+    t_base = times[-1]
+    t_fused, best = min(zip(times[:-1], finalists), key=lambda p: p[0])
+    fused = fused_for(best)
+
+    flops = 2 * M_TOTAL * K * N_TOTAL
     print(json.dumps({
-        "metric": f"ag_gemm latency M={m_total} K={k} N={n_total} bf16 "
-                  f"({world} chip{'s' if world > 1 else ''}); "
+        "metric": f"ag_gemm latency M={M_TOTAL} K={K} N={N_TOTAL} bf16 "
+                  f"({world} chip{'s' if world > 1 else ''}, autotuned "
+                  f"{best[1].block_m}x{best[1].block_n}x{best[1].block_k}); "
                   f"{flops / t_fused / 1e12:.1f} TFLOP/s",
         "value": round(t_fused * 1e6, 1),
         "unit": "us",

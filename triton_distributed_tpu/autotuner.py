@@ -32,19 +32,29 @@ from triton_distributed_tpu.utils.debug import logger
 class _Entry:
     config: Any
     time_s: float
+    #: Full (time_s, config) ranking, fastest first — lets callers
+    #: re-examine finalists whose margin is within measurement noise.
+    ranking: list = dataclasses.field(default_factory=list)
 
 
 class ContextualAutotuner:
     def __init__(self, fn: Callable, configs: Sequence[Any],
                  key_fn: Optional[Callable] = None,
                  iters: int = 5, warmup: int = 2,
-                 log_dir: str = ".autotune_logs"):
+                 log_dir: str = ".autotune_logs",
+                 chain: Optional[Callable] = None):
         self.fn = fn
         self.configs = list(configs)
         self.key_fn = key_fn or self._default_key
         self.iters = iters
         self.warmup = warmup
         self.log_dir = log_dir
+        #: Optional ``chain(out, *args) -> new_args``: threads each
+        #: call's output back into the next call's inputs.  Without it
+        #: N queued calls keep N live output buffers (HBM pressure
+        #: distorts timings at large N), so unchained runs should keep
+        #: ``iters`` modest.
+        self.chain = chain
         self.cache = {}
 
     @staticmethod
@@ -56,17 +66,49 @@ class ContextualAutotuner:
         return tuple(jax.tree.map(leaf_key, (args, tuple(sorted(
             kwargs.items())))) .__repr__().split())  # stable string key
 
+    @staticmethod
+    def _fetch(out):
+        """Force completion with a device→host fetch.  On tunneled
+        platforms (axon) `block_until_ready` returns before the device
+        is actually done; a host fetch of one leaf element is the only
+        reliable fence."""
+        import numpy as np
+        leaves = [x for x in jax.tree.leaves(out)
+                  if hasattr(x, "dtype") and hasattr(x, "shape")]
+        if leaves:
+            x = leaves[0]
+            np.asarray(x.ravel()[:1] if x.ndim else x)
+        return out
+
     def _bench_one(self, config, args, kwargs) -> float:
-        run = functools.partial(self.fn, *args, config=config, **kwargs)
-        out = None
-        for _ in range(self.warmup):
-            out = run()
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for _ in range(self.iters):
-            out = run()
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / self.iters
+        """Two-point fit: dispatches pipeline on the device queue, but
+        every *fetch* pays a large fixed round-trip cost on remote
+        backends (~100 ms on the axon tunnel).  Timing N1 and N2
+        dispatches with a single trailing fetch each and differencing
+        removes the fixed cost:  t = (T(N2) - T(N1)) / (N2 - N1)."""
+        for _ in range(max(self.warmup, 1)):
+            out = self.fn(*args, config=config, **kwargs)
+        self._fetch(out)
+
+        def total(n_calls: int) -> float:
+            t0 = time.perf_counter()
+            cur = args
+            out = None
+            for _ in range(n_calls):
+                out = self.fn(*cur, config=config, **kwargs)
+                if self.chain is not None:
+                    cur = self.chain(out, *cur)
+            self._fetch(out)
+            return time.perf_counter() - t0
+
+        import statistics
+        n1, n2 = self.iters, 6 * self.iters
+        t1s, t2s = [], []
+        for _ in range(3):  # interleave to decorrelate drift
+            t1s.append(total(n1))
+            t2s.append(total(n2))
+        return max((statistics.median(t2s) - statistics.median(t1s))
+                   / (n2 - n1), 1e-9)
 
     def _log(self, msg: str):
         try:
@@ -104,7 +146,9 @@ class ContextualAutotuner:
                     f"autotune: every config failed for key {key}")
             results.sort()
             best_idx = self._agree(results[0][1])
-            self.cache[key] = _Entry(self.configs[best_idx], results[0][0])
+            ranking = [(t, self.configs[i]) for t, i in results]
+            self.cache[key] = _Entry(self.configs[best_idx], results[0][0],
+                                     ranking)
             logger.info("autotune %s: best=%s (%.3f ms)", key,
                         self.configs[best_idx], results[0][0] * 1e3)
         return self.fn(*args, config=self.cache[key].config, **kwargs)

@@ -40,19 +40,37 @@ from triton_distributed_tpu.utils.platform import (
 )
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class AllGatherGEMMContext:
     """Reference analogue: `AllGatherGEMMTensorParallelContext`
     (`allgather_gemm.py:404-487`) minus the symmetric-buffer plumbing
     (Pallas buffers are allocated per call by XLA; reuse across calls
     comes from jit caching, the role CUDA graphs play in the
-    reference)."""
+    reference).
+
+    ``method``: "auto" | "fused" | "xla" — the reference's method
+    auto-select (`get_auto_all_gather_method`).  "auto" picks "xla"
+    when there is no communication to overlap (world_size == 1 — the
+    XLA matmul already runs at ~96% MFU, there is nothing to win) or
+    when M is too small for Mosaic DMA tiling (decode shapes), and
+    the fused single kernel otherwise."""
 
     axis: str
     world_size: int
     gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
+    method: str = "auto"
     collective_id: int = 1
     interpret: Optional[bool] = None
+
+    def resolve_method(self, m: int, dtype) -> str:
+        if self.method != "auto":
+            return self.method
+        if self.world_size <= 1:
+            return "xla"
+        min_rows = 16 if jnp.dtype(dtype).itemsize < 4 else 8
+        if m % min_rows != 0:
+            return "xla"
+        return "fused"
 
 
 def create_ag_gemm_context(axis: str, world_size: int, **kw) -> AllGatherGEMMContext:
@@ -107,15 +125,24 @@ def ag_gemm(a_shard, b, ctx: AllGatherGEMMContext,
     k2, n = b.shape
     assert k == k2, (a_shard.shape, b.shape)
 
-    # Tile-friendliness gate (reference analogue: method auto-select).
+    method = ctx.resolve_method(m, a_shard.dtype)
     # Mosaic DMA slices need the sublane dim aligned to the dtype
-    # packing; tiny decode GEMMs go down the XLA path instead.
+    # packing; guard explicit method="fused" too, not just "auto".
     min_rows = 16 if a_shard.dtype.itemsize < 4 else 8
-    if m % min_rows != 0:
+    if method == "fused" and m % min_rows != 0:
+        method = "xla"
+    if method == "xla":
         a_full = jax.lax.all_gather(a_shard, ctx.axis, tiled=True)
         out = jnp.dot(a_full, b, preferred_element_type=jnp.float32
                       ).astype(a_shard.dtype)
         return (out, a_full) if return_gathered else out
+
+    if world <= 1:
+        # Fused requested on one device: no comm buffer needed — run
+        # the tuned MXU pipeline directly.
+        from triton_distributed_tpu.kernels.matmul import matmul
+        out = matmul(a_shard, b, config=ctx.gemm, interpret=ctx.interpret)
+        return (out, a_shard) if return_gathered else out
 
     gathered, out = pl.pallas_call(
         functools.partial(_ag_gemm_fused_kernel, ctx, m, n, k),

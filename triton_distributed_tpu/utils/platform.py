@@ -75,15 +75,25 @@ def _enable_cpu_simulation_shims() -> None:
     _cb.io_callback_impl = _io_callback_impl_host
 
 
+#: Scoped-VMEM ceiling for Pallas kernels (Mosaic defaults to 16 MiB;
+#: the traffic-minimising GEMM configs want big f32 accumulators,
+#: and v5e/v5p have 128 MiB of VMEM).  Shared by matmul and the
+#: fused comm kernels so a retune stays consistent.
+SCOPED_VMEM_LIMIT = 100 * 1024 * 1024
+COMM_VMEM_LIMIT = SCOPED_VMEM_LIMIT
+
+
 def comm_compiler_params(collective_id: Optional[int], world_size: int):
     """CompilerParams for communication kernels.  Mosaic requires
     `collective_id` to be absent when the compiled kernel contains no
     cross-device barrier/collective — which is the case when
     world_size == 1 and all remote-DMA loops trace away."""
     if world_size <= 1 or collective_id is None:
-        return pltpu.CompilerParams(has_side_effects=True)
+        return pltpu.CompilerParams(has_side_effects=True,
+                                    vmem_limit_bytes=COMM_VMEM_LIMIT)
     return pltpu.CompilerParams(has_side_effects=True,
-                                collective_id=collective_id)
+                                collective_id=collective_id,
+                                vmem_limit_bytes=COMM_VMEM_LIMIT)
 
 
 def default_interpret(interpret: Optional[bool] = None):
