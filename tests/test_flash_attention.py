@@ -40,6 +40,22 @@ def test_flash_attention_kv_offset():
     assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
 
 
+@pytest.mark.parametrize("causal,kv_offset", [(False, 0), (True, 256)])
+def test_flash_attention_ragged_kv(causal, kv_offset):
+    """Sk not a multiple of block_k: the padded columns of the last KV
+    block must be masked out (ADVICE r1 repro: sk=192, block_k=128)."""
+    b, h, s, d = 1, 2, 64, 32
+    sk = 192
+    q = jax.random.normal(jax.random.key(7), (b, h, s, d))
+    k = jax.random.normal(jax.random.key(8), (b, h, sk, d))
+    v = jax.random.normal(jax.random.key(9), (b, h, sk, d))
+    out = flash_attention(q, k, v, causal=causal, kv_offset=kv_offset,
+                          block_q=64, block_k=128)
+    ref = attention_reference(q, k, v, causal=causal, kv_offset=kv_offset)
+    assert_allclose(out, ref, atol=2e-3, rtol=2e-3,
+                    name=f"ragged-kv-causal{causal}-off{kv_offset}")
+
+
 def test_flash_attention_rect():
     b, h, sq, sk, d = 1, 2, 16, 128, 64
     q = jax.random.normal(jax.random.key(4), (b, h, sq, d))

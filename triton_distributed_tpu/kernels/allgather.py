@@ -65,11 +65,15 @@ class AllGatherContext:
 
     def resolve_method(self, nbytes_per_shard: int) -> AllGatherMethod:
         """Auto-select like `get_auto_all_gather_method`
-        (`allgather.py:57-72`): small messages are latency-bound →
-        one-shot push; large are bandwidth-bound → ring."""
+        (`allgather.py:57-72`), driven by the analytic ICI perf model
+        rather than a fixed byte cutoff: one-shot push wins while
+        latency-bound, the ring wins once its single-hop transfers
+        beat the push's multi-hop link contention."""
         if self.method != AllGatherMethod.AUTO:
             return self.method
-        if nbytes_per_shard <= 64 * 1024:
+        from triton_distributed_tpu.kernels.comm_perf_model import (
+            one_shot_beats_ring)
+        if one_shot_beats_ring(nbytes_per_shard, self.world_size):
             return AllGatherMethod.PUSH_ALL
         return AllGatherMethod.RING
 
@@ -89,6 +93,10 @@ def _ring_ag_kernel(axis, world, x_ref, o_ref, local_sem, send_sem,
                     recv_sems):
     my = jax.lax.axis_index(axis)
     right = jax.lax.rem(my + 1, world)
+
+    # Entry barrier: the left neighbor must not put into our o_ref
+    # while we are still in the previous program (ADVICE r1).
+    dl.entry_barrier(axis, world, neighbors_only=True)
 
     # Place the local shard into slot `my` of the output.
     dl.local_copy(x_ref, o_ref.at[my], local_sem)
@@ -126,6 +134,7 @@ def _ring_ag_kernel(axis, world, x_ref, o_ref, local_sem, send_sem,
 def _push_all_ag_kernel(axis, world, x_ref, o_ref, local_sem, send_sem,
                         recv_sems):
     my = jax.lax.axis_index(axis)
+    dl.entry_barrier(axis, world)  # every peer puts into our o_ref
     dl.local_copy(x_ref, o_ref.at[my], local_sem)
 
     def send(i, _):
@@ -168,6 +177,7 @@ def _bidir_ring_ag_kernel(axis, world, x_ref, o_ref, local_sem, send_sems,
     right = jax.lax.rem(my + 1, world)
     left = jax.lax.rem(my - 1 + world, world)
 
+    dl.entry_barrier(axis, world, neighbors_only=True)
     dl.local_copy(x_ref, o_ref.at[my], local_sem)
 
     def step(s, _):

@@ -84,6 +84,10 @@ def _ag_gemm_fused_kernel(ctx: AllGatherGEMMContext, m, n, k,
     my = jax.lax.axis_index(ctx.axis)
     right = jax.lax.rem(my + 1, world)
 
+    # Entry barrier with ring neighbors before they put into
+    # gathered_ref (ADVICE r1: reused output buffers may alias the
+    # previous program's live memory on a slow device).
+    dl.entry_barrier(ctx.axis, world, neighbors_only=True)
     dl.local_copy(x_ref, gathered_ref.at[my], local_sem)
 
     # Python loop: `world` is static, so each step is unrolled and the

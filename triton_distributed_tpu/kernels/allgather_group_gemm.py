@@ -62,6 +62,7 @@ def _ag_group_gemm_kernel(ctx: AGGroupGEMMContext, cap, n, k,
     my = jax.lax.axis_index(ctx.axis)
     right = jax.lax.rem(my + 1, world)
 
+    dl.entry_barrier(ctx.axis, world, neighbors_only=True)
     dl.local_copy(x_ref, gathered_ref.at[my], local_sem)
 
     for s in range(world):

@@ -55,14 +55,23 @@ class AllReduceMethod(enum.Enum):
 
 
 def get_auto_allreduce_method(nbytes: int, world_size: int) -> AllReduceMethod:
-    """Size-based selection (reference `get_auto_allreduce_method`,
-    `allreduce.py:1039`): tiny → one-shot (1 hop), medium → two-shot,
-    large → ring."""
-    if nbytes <= 128 * 1024:
-        return AllReduceMethod.ONE_SHOT
-    if nbytes <= 8 << 20:
-        return AllReduceMethod.TWO_SHOT
-    return AllReduceMethod.RING
+    """Perf-model-driven selection (reference
+    `get_auto_allreduce_method`, `allreduce.py:1039`): compare the
+    predicted cost of each method on this chip generation's ICI —
+    tiny payloads are latency-bound → one-shot (1 hop), medium →
+    two-shot (scatter + broadcast), large → bandwidth-optimal ring."""
+    from triton_distributed_tpu.kernels.comm_perf_model import (
+        estimate_all_reduce_time_us, estimate_one_shot_time_us,
+        estimate_two_shot_time_us)
+    w = world_size
+    t_one = estimate_one_shot_time_us(nbytes, w)
+    t_two = estimate_two_shot_time_us(nbytes, w)
+    t_ring = estimate_all_reduce_time_us(nbytes, w)
+    best = min((t_one, AllReduceMethod.ONE_SHOT),
+               (t_two, AllReduceMethod.TWO_SHOT),
+               (t_ring, AllReduceMethod.RING),
+               key=lambda p: p[0])
+    return best[1]
 
 
 @dataclasses.dataclass
@@ -98,6 +107,7 @@ def _one_shot_kernel(ctx, m, n, x_ref, o_ref, rbuf_ref, local_sem,
     world = ctx.world_size
     my = jax.lax.axis_index(ctx.axis)
     _maybe_straggle(ctx)
+    dl.entry_barrier(ctx.axis, world)  # every peer puts into rbuf_ref
 
     dl.local_copy(x_ref, rbuf_ref.at[my], local_sem)
     for i in range(1, world):
@@ -125,6 +135,7 @@ def _two_shot_kernel(ctx, mc, n, x_ref, o_ref, rbuf_ref, local_sem,
     world = ctx.world_size
     my = jax.lax.axis_index(ctx.axis)
     _maybe_straggle(ctx)
+    dl.entry_barrier(ctx.axis, world)  # peers put into rbuf/o_ref
 
     # -- scatter partials --
     dl.local_copy(x_ref.at[my], rbuf_ref.at[my], local_sem)
@@ -184,8 +195,11 @@ def all_reduce(x, ctx: AllReduceContext):
         from triton_distributed_tpu.kernels.reduce_scatter import (
             ReduceScatterContext, ReduceScatterMethod, reduce_scatter)
         if m % world != 0:
-            method = AllReduceMethod.TWO_SHOT if m % world == 0 else (
-                AllReduceMethod.ONE_SHOT)
+            # Rows don't tile across ranks: fall back to one-shot.
+            # (Padding m up to a multiple of world would keep RING
+            # usable for large non-divisible tensors; the pad/unpad
+            # copies cost about what one-shot loses, so keep simple.)
+            method = AllReduceMethod.ONE_SHOT
         else:
             rs_ctx = ReduceScatterContext(
                 axis=ctx.axis, world_size=world,

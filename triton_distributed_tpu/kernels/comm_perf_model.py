@@ -47,10 +47,11 @@ def get_ici_spec(device=None) -> IciSpec:
 
 def estimate_all_gather_time_us(nbytes_per_shard: int, world: int,
                                 spec: IciSpec = None) -> float:
-    """Ring AG: (world-1) steps, each shipping one shard over one link
-    pair (bidir ring uses 2)."""
+    """Ring AG: (world-1) steps, each shipping one shard one hop along
+    the axis ring — every directed link carries each shard exactly
+    once, the bandwidth-optimal schedule."""
     spec = spec or get_ici_spec()
-    bw = spec.link_gbps * 1e9 * 2  # bidirectional ring
+    bw = spec.link_gbps * 1e9
     return (world - 1) * (nbytes_per_shard / bw * 1e6 + spec.latency_us)
 
 
@@ -67,7 +68,37 @@ def estimate_all_reduce_time_us(nbytes: int, world: int,
 
 def estimate_one_shot_time_us(nbytes: int, world: int,
                               spec: IciSpec = None) -> float:
-    """One-shot push: world-1 concurrent puts share the chip's links."""
+    """One-shot push: world-1 concurrent direct puts on the axis ring.
+
+    Unlike a ring schedule (single-hop transfers only), a direct put
+    to a peer at distance d occupies d links; summed over both ring
+    directions the busiest directed link carries ~world²/8 payload
+    transits.  That link is the bottleneck, so one-shot loses to the
+    ring for large payloads at scale but wins the latency race
+    (1 hop vs world-1 serialized hops) for small ones — the same
+    topology-awareness as the reference's
+    `get_auto_all_gather_method`."""
     spec = spec or get_ici_spec()
-    bw = spec.link_gbps * 1e9 * spec.num_links
-    return (world - 1) * nbytes / bw * 1e6 + spec.latency_us
+    bw = spec.link_gbps * 1e9
+    link_transits = max(1.0, world * world / 8.0)
+    # Farthest put crosses world/2 ring hops — the latency term is the
+    # longest path, not a single hop.
+    lat = max(1.0, world / 2.0) * spec.latency_us
+    return link_transits * nbytes / bw * 1e6 + lat
+
+
+def estimate_two_shot_time_us(nbytes: int, world: int,
+                              spec: IciSpec = None) -> float:
+    """Two-shot AR: scatter partial chunks to their owners, then
+    broadcast reduced chunks — two serialized one-shot rounds on
+    1/world-size payloads."""
+    return 2 * estimate_one_shot_time_us(max(nbytes // world, 1), world,
+                                         spec)
+
+
+def one_shot_beats_ring(nbytes: int, world: int,
+                        spec: IciSpec = None) -> bool:
+    """Shared crossover decision for AG/RS method auto-selection, so
+    all collectives agree on the same perf-model comparison."""
+    return (estimate_one_shot_time_us(nbytes, world, spec)
+            <= estimate_all_gather_time_us(nbytes, world, spec))

@@ -24,8 +24,8 @@ from triton_distributed_tpu.utils.platform import default_interpret
 NEG_INF = -1e30
 
 
-def _flash_kernel(nk: int, scale: float, causal: bool, block_q: int,
-                  block_k: int,
+def _flash_kernel(nk: int, sk: int, scale: float, causal: bool,
+                  block_q: int, block_k: int,
                   off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                   m_scr, l_scr, acc_scr):
     """Grid: (B, H, nq, nk); blocks: q (1,1,bq,D), k/v (1,1,bk,D)."""
@@ -46,14 +46,19 @@ def _flash_kernel(nk: int, scale: float, causal: bool, block_q: int,
         q, k, dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale   # (bq, bk)
 
+    k_pos = (ki * block_k
+             + jax.lax.broadcasted_iota(jnp.int32,
+                                        (block_q, block_k), 1))
+    if sk % block_k != 0:
+        # KV-length bound mask: the last block's padded columns must
+        # not reach the softmax (they'd contribute garbage whenever
+        # causal=False or kv_offset > 0 lets them through).
+        s = jnp.where(k_pos < sk, s, NEG_INF)
     if causal:
         q_pos = (qi * block_q
                  + jax.lax.broadcasted_iota(jnp.int32,
                                             (block_q, block_k), 0)
                  + off_ref[0])
-        k_pos = (ki * block_k
-                 + jax.lax.broadcasted_iota(jnp.int32,
-                                            (block_q, block_k), 1))
         s = jnp.where(k_pos <= q_pos, s, NEG_INF)
 
     m_prev = m_scr[:]                     # (bq, 1)
@@ -102,7 +107,7 @@ def flash_attention(q, k, v, *, causal: bool = True,
     off = jnp.asarray(kv_offset, jnp.int32).reshape(1)
 
     out, lse = pl.pallas_call(
-        functools.partial(_flash_kernel, nk, scale, causal, bq, bk),
+        functools.partial(_flash_kernel, nk, sk, scale, causal, bq, bk),
         out_shape=(
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),

@@ -57,6 +57,7 @@ def _gemm_rs_fused_kernel(ctx: GEMMReduceScatterContext, mc, n, k,
                           send_sems, recv_sems):
     world = ctx.world_size
     my = jax.lax.axis_index(ctx.axis)
+    dl.entry_barrier(ctx.axis, world)  # every peer puts into rbuf_ref
 
     # Per-slot send semaphores: a shared counter would let wait_send be
     # satisfied by the *other* in-flight transfer and free a staging

@@ -67,6 +67,7 @@ def _a2a_kernel(ctx: AllToAllContext, has_scale,
                 local_sem, send_sem, tok_sems, cnt_sems, scl_sems):
     world = ctx.world_size
     my = jax.lax.axis_index(ctx.axis)
+    dl.entry_barrier(ctx.axis, world)  # every peer puts into recv bufs
 
     # Local slice: my tokens destined to myself.
     dl.local_copy(send_ref.at[my], recv_ref.at[my], local_sem)

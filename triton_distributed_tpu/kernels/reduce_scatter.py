@@ -60,9 +60,12 @@ class ReduceScatterContext:
     def resolve_method(self, nbytes_per_chunk: int) -> ReduceScatterMethod:
         if self.method != ReduceScatterMethod.AUTO:
             return self.method
-        # One-shot wins until chunks are large enough that n-1 parallel
-        # long-haul puts congest the torus links.
-        if nbytes_per_chunk <= 1 << 20:
+        # Perf-model-driven: one-shot wins until chunks are large
+        # enough that world-1 parallel long-haul puts congest the
+        # torus links (see estimate_one_shot_time_us).
+        from triton_distributed_tpu.kernels.comm_perf_model import (
+            one_shot_beats_ring)
+        if one_shot_beats_ring(nbytes_per_chunk, self.world_size):
             return ReduceScatterMethod.SCATTER_REDUCE
         return ReduceScatterMethod.RING
 
@@ -109,6 +112,7 @@ def _scatter_reduce_kernel(ctx, m, n, x_ref, out_ref, rbuf_ref,
                            local_sem, send_sem, recv_sems):
     world = ctx.world_size
     my = jax.lax.axis_index(ctx.axis)
+    dl.entry_barrier(ctx.axis, world)  # every peer puts into rbuf_ref
 
     # Our own partial for our own chunk.
     dl.local_copy(x_ref.at[my], rbuf_ref.at[my], local_sem)
@@ -147,6 +151,7 @@ def _ring_rs_kernel(ctx, m, n, x_ref, out_ref, staging_ref, accum_ref,
     my = jax.lax.axis_index(ctx.axis)
     right = jax.lax.rem(my + 1, world)
     left = jax.lax.rem(my - 1 + world, world)
+    dl.entry_barrier(ctx.axis, world, neighbors_only=True)
 
     def add_into(dst, a_ref, b_ref):
         # dst = a + b, pipelined (dst may alias a_ref).

@@ -195,6 +195,30 @@ def barrier_all(axis: str, sem=None):
     pltpu.semaphore_wait(bsem, n - 1)
 
 
+def entry_barrier(axis: str, world: int, neighbors_only: bool = False):
+    """Barrier with the peers that will DMA into this device's output
+    buffers, issued at kernel entry before the first remote put.
+
+    Why: on real hardware a fast device can start its RDMA while a
+    slow peer is still executing the *previous* program, whose live
+    intermediates may alias the (reused) destination buffer —
+    timing-dependent corruption.  The canonical Pallas distributed
+    pattern barriers at kernel entry (reference analogue: the
+    `barrier_all_on_stream` reset before every overlap op,
+    `kernels/nvidia/allgather_gemm.py:101-117`).
+
+    ``world`` is the static axis size: at 1 this is a no-op so
+    single-device programs need no collective_id.  ``neighbors_only``
+    is enough for ring kernels (only left/right write into us).
+    """
+    if world <= 1:
+        return
+    if neighbors_only:
+        barrier_neighbors(axis)
+    else:
+        barrier_all(axis)
+
+
 def barrier_neighbors(axis: str):
     """Cheap ring barrier with left/right neighbors only (enough to
     order ring-collective phases)."""

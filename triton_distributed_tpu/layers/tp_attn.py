@@ -80,13 +80,21 @@ class TPAttention:
     collective_ids: tuple = (14, 15)
     interpret: Optional[bool] = None
 
+    def __post_init__(self):
+        # Exact per-rank splits only — head replication is unsupported
+        # (weights, cache and sharding specs all assume it).
+        assert self.num_heads % self.world_size == 0, (
+            self.num_heads, self.world_size)
+        assert self.num_kv_heads % self.world_size == 0, (
+            self.num_kv_heads, self.world_size)
+
     @property
     def h_loc(self):
         return self.num_heads // self.world_size
 
     @property
     def hkv_loc(self):
-        return max(self.num_kv_heads // self.world_size, 1)
+        return self.num_kv_heads // self.world_size
 
     @property
     def qkv_cols(self):

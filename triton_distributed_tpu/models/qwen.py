@@ -38,6 +38,15 @@ class Qwen3:
         self.mesh = mesh
         self.axis = axis
         self.world = mesh.shape[axis]
+        # KV-head replication is not implemented: weights, cache and
+        # sharding specs all assume an exact per-rank split.  Fail
+        # loudly here rather than numerically downstream (ADVICE r1).
+        assert config.num_heads % self.world == 0, (
+            f"num_heads={config.num_heads} not divisible by "
+            f"tp={self.world}")
+        assert config.num_kv_heads % self.world == 0, (
+            f"num_kv_heads={config.num_kv_heads} not divisible by "
+            f"tp={self.world}; KV-head replication is unsupported")
         self.mode = mode
         self.interpret = interpret
         self.dtype = jnp.dtype(config.dtype)
@@ -302,7 +311,7 @@ class Qwen3:
         cfg = self.config
         # global cache: kv heads sharded over tp
         return KVCache.create(
-            cfg.num_layers, batch, max(cfg.num_kv_heads, self.world),
+            cfg.num_layers, batch, cfg.num_kv_heads,
             max_seq or cfg.max_seq_len, cfg.head_dim, self.dtype)
 
 
