@@ -88,7 +88,97 @@ def grouped_matmul(a, b, config: Optional[MatmulConfig] = None,
 def emit_grouped_matmul(a_ref, b_ref, o_ref, *, num_experts, m, n, k,
                         config: Optional[MatmulConfig] = None):
     """Grouped matmul over HBM refs inside a kernel body:
-    a_ref (E, m, k), b_ref (E, k, n), o_ref (E, m, n)."""
-    for ex in range(num_experts):
-        emit_matmul(a_ref.at[ex], b_ref.at[ex], o_ref.at[ex],
-                    m=m, n=n, k=k, config=config)
+    a_ref (E, m, k), b_ref (E, k, n), o_ref (E, m, n).
+
+    One `emit_pipeline` with the expert index as the leading grid
+    dimension — a single software pipeline whose DMA prefetch crosses
+    expert boundaries (the role of the reference's cross-expert tile
+    scheduler `threadblock_swizzle_ag_moe.cu`), instead of E
+    independent pipelines each paying setup cost."""
+    cfg = (config or MatmulConfig()).resolve(m, n, k)
+    nk = pl.cdiv(k, cfg.block_k)
+
+    def inner(a_blk, b_blk, o_blk, acc_ref):
+        kk = pl.program_id(3)
+
+        @pl.when(kk == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        acc_ref[:] += jax.lax.dot_general(
+            a_blk[0], b_blk[0],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(kk == nk - 1)
+        def _():
+            o_blk[0] = acc_ref[:].astype(o_blk.dtype)
+
+    def run(acc_ref):
+        pipeline = pltpu.emit_pipeline(
+            functools.partial(inner, acc_ref=acc_ref),
+            grid=(num_experts, pl.cdiv(m, cfg.block_m),
+                  pl.cdiv(n, cfg.block_n), nk),
+            in_specs=[
+                pl.BlockSpec((1, cfg.block_m, cfg.block_k),
+                             lambda g, i, j, kk: (g, i, kk)),
+                pl.BlockSpec((1, cfg.block_k, cfg.block_n),
+                             lambda g, i, j, kk: (g, kk, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, cfg.block_m, cfg.block_n),
+                             lambda g, i, j, kk: (g, i, j)),
+            ],
+        )
+        pipeline(a_ref, b_ref, o_ref)
+
+    pl.run_scoped(
+        run,
+        acc_ref=pltpu.VMEM((min(cfg.block_m, m), min(cfg.block_n, n)),
+                           jnp.float32),
+    )
+
+
+def emit_combine_matmul(cmat_ref, stage_ref, o_ref, *, num_experts, m,
+                        cap, n, block_m: int = 256, block_n: int = 512):
+    """o[m,n] = sum_e cmat[e] (m, cap) @ stage[e] (cap, n) — the
+    topk-weighted combine expressed as an accumulating one-hot matmul
+    (gathers become MXU work; the TPU analogue of the reference's
+    topk-reduce consumer, `moe_reduce_rs.py:486`)."""
+    bm = min(block_m, m)
+    bn = min(block_n, n)
+
+    def inner(c_blk, s_blk, o_blk, acc_ref):
+        e = pl.program_id(2)
+
+        @pl.when(e == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        # f32 x f32 products: identical math to the staged
+        # combine_tokens (f32 weights x f32-cast values), so the fused
+        # epilogue matches the staged one to summation order.
+        acc_ref[:] += jax.lax.dot_general(
+            c_blk[0].astype(jnp.float32), s_blk[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(e == num_experts - 1)
+        def _():
+            o_blk[:] = acc_ref[:].astype(o_blk.dtype)
+
+    def run(acc_ref):
+        pipeline = pltpu.emit_pipeline(
+            functools.partial(inner, acc_ref=acc_ref),
+            grid=(pl.cdiv(m, bm), pl.cdiv(n, bn), num_experts),
+            in_specs=[
+                pl.BlockSpec((1, bm, cap), lambda i, j, e: (e, i, 0)),
+                pl.BlockSpec((1, cap, bn), lambda i, j, e: (e, 0, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((bm, bn), lambda i, j, e: (i, j)),
+            ],
+        )
+        pipeline(cmat_ref, stage_ref, o_ref)
+
+    pl.run_scoped(run, acc_ref=pltpu.VMEM((bm, bn), jnp.float32))

@@ -7,36 +7,49 @@ topk weighted reduce and a 2D reduce-scatter (`MoEReduceRSContext:245`,
 producer `:380`, topk-RS consumer `:486`, rowise `:816` / colwise
 `:1357` variants).
 
-TPU re-design: the epilogue is expressed as three fused-friendly
-stages, each already overlap-optimal on its own hardware engine:
+Two implementations:
 
-1. grouped GEMM (E, cap, k)×(E, k, n) — Pallas, MXU;
-2. topk combine — XLA gather+weighted-sum, fused by XLA into the
-   surrounding elementwise stream (VPU);
-3. reduce-scatter of the combined tokens — the flow-controlled Pallas
-   ring / one-shot scatter kernel (reduce_scatter.py) on the ICI DMA
-   engines.
-
-The single-kernel chunk-major fusion (compute only chunk-c rows, put,
-reduce — the exact reference pipeline) is `moe_reduce_rs_fused`, which
-reuses the gemm_rs machinery with (chunk, expert)-bucketed inputs.
+- :func:`moe_reduce_rs` — staged: grouped GEMM (Pallas/MXU), topk
+  combine (XLA gather+weighted-sum), reduce-scatter (Pallas ring).
+  Golden reference for the fused kernel.
+- :func:`moe_reduce_rs_fused` — the reference's actual pipeline as ONE
+  Pallas kernel, chunk-major: for each destination rank's chunk (in
+  rank+1 swizzled order, the gemm_rs schedule) run the grouped GEMM
+  for that chunk's expert buckets, apply the topk combine as an
+  accumulating one-hot matmul (`emit_combine_matmul` — gathers become
+  MXU work), and put the combined chunk to its owner over ICI while
+  the next chunk computes; a final pipelined VPU reduction sums the
+  `world` received partials.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu.kernels import moe_utils
-from triton_distributed_tpu.kernels.grouped_gemm import grouped_matmul
+from triton_distributed_tpu.kernels.grouped_gemm import (
+    emit_combine_matmul,
+    emit_grouped_matmul,
+    grouped_matmul,
+)
 from triton_distributed_tpu.kernels.matmul import MatmulConfig
 from triton_distributed_tpu.kernels.reduce_scatter import (
     ReduceScatterContext,
     ReduceScatterMethod,
+    _emit_reduce_sum,
     reduce_scatter,
+)
+from triton_distributed_tpu.language import core as dl
+from triton_distributed_tpu.utils.platform import (
+    comm_compiler_params,
+    default_interpret,
 )
 
 
@@ -82,3 +95,100 @@ def moe_reduce_rs(buckets, expert_weights, expert_ids, slot_of_pair,
                                   collective_id=ctx.collective_id,
                                   interpret=ctx.interpret)
     return reduce_scatter(combined, rs_ctx)
+
+
+def _moe_rs_fused_kernel(ctx: MoEReduceRSContext, e, cap, mc, n, k,
+                         buckets_ref, w_ref, cmat_ref,
+                         out_ref, rbuf_ref, gstage_ref, cstage_ref,
+                         send_sems, recv_sems):
+    world = ctx.world_size
+    my = jax.lax.axis_index(ctx.axis)
+    dl.entry_barrier(ctx.axis, world)  # every peer puts into rbuf_ref
+
+    pending = []
+    for s in range(world):
+        # gemm_rs swizzle: remote chunks first (comm starts after the
+        # first chunk), own chunk last (needs no transfer).
+        chunk = jax.lax.rem(my + 1 + s, world)
+        emit_grouped_matmul(buckets_ref.at[chunk], w_ref, gstage_ref,
+                            num_experts=e, m=cap, n=n, k=k,
+                            config=ctx.gemm)
+        if s == world - 1:
+            # Own chunk: combine straight into our receive slot.
+            emit_combine_matmul(cmat_ref.at[chunk], gstage_ref,
+                                rbuf_ref.at[my], num_experts=e,
+                                m=mc, cap=cap, n=n)
+        else:
+            slot = s % 2
+            if len(pending) >= 2:
+                # Free the cstage slot we are about to overwrite.
+                pending.pop(0).wait_send()
+            emit_combine_matmul(cmat_ref.at[chunk], gstage_ref,
+                                cstage_ref.at[slot], num_experts=e,
+                                m=mc, cap=cap, n=n)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=cstage_ref.at[slot],
+                dst_ref=rbuf_ref.at[my],
+                send_sem=send_sems.at[slot],
+                recv_sem=recv_sems.at[my],
+                device_id=chunk,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            pending.append(rdma)
+
+    for rdma in pending:
+        rdma.wait_send()
+    for i in range(1, world):
+        peer = jax.lax.rem(my + i, world)
+        dl.wait_recv(rbuf_ref.at[peer], recv_sems.at[peer])
+
+    _emit_reduce_sum(rbuf_ref, out_ref, world=world, m=mc, n=n)
+
+
+def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
+                        ctx: MoEReduceRSContext):
+    """Single-kernel fused MoE epilogue (reference
+    `moe_reduce_rs.py:380-486`: grouped-GEMM producer + topk-RS
+    consumer).  Call inside shard_map over `ctx.axis`.
+
+    buckets:        (world, E, cap, k_loc) — per-destination-chunk
+                    expert buckets of intermediate activations (e.g.
+                    the activated output of `ag_group_gemm`, whose
+                    leading dim is already the source-rank chunk).
+    expert_weights: (E, k_loc, n) — down-projection TP K-shard.
+    combine_mats:   (world, E, mc, cap) — per-chunk one-hot combine
+                    weights (`moe_utils.plan_chunks`), replicated.
+    Returns (mc, n): this rank's reduced output chunk.
+    """
+    world, e, cap, k = buckets.shape
+    e2, k2, n = expert_weights.shape
+    assert world == ctx.world_size and e == e2 == ctx.num_experts
+    assert k == k2, (buckets.shape, expert_weights.shape)
+    w2, e3, mc, cap2 = combine_mats.shape
+    assert w2 == world and e3 == e and cap2 == cap, combine_mats.shape
+
+    out, _, _, _ = pl.pallas_call(
+        functools.partial(_moe_rs_fused_kernel, ctx, e, cap, mc, n, k),
+        out_shape=(
+            jax.ShapeDtypeStruct((mc, n), buckets.dtype),
+            jax.ShapeDtypeStruct((world, mc, n), buckets.dtype),  # rbuf
+            jax.ShapeDtypeStruct((e, cap, n), buckets.dtype),     # gstage
+            jax.ShapeDtypeStruct((2, mc, n), buckets.dtype),      # cstage
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 4,
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((world,)),
+        ],
+        compiler_params=comm_compiler_params(ctx.collective_id, world),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * world * e * cap * n * k + 2 * world * mc * e * cap * n,
+            bytes_accessed=(world * e * cap * k + e * k * n
+                            + world * mc * n) * buckets.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=default_interpret(ctx.interpret),
+    )(buckets, expert_weights, combine_mats)
+    return out

@@ -102,6 +102,65 @@ def combine_tokens(expert_out, expert_ids, slot_of_pair, weights):
     return (vals.astype(jnp.float32) * w).sum(axis=1).astype(expert_out.dtype)
 
 
+def combine_matrix(expert_ids, slot_of_pair, weights, num_experts: int,
+                   capacity: int, dtype=jnp.float32):
+    """Materialise the topk-weighted combine as a dense one-hot matrix
+    W (n_tokens, num_experts, capacity): token i's output row is
+    `sum_e W[i, e] @ expert_out[e]` — a gather turned into MXU work so
+    the fused epilogue can run it inside a Pallas kernel.
+
+    Dropped pairs (slot < 0) contribute zero.  Duplicate (expert,
+    slot) pairs accumulate, matching `combine_tokens`."""
+    n_tokens, topk = expert_ids.shape
+    kept = slot_of_pair >= 0
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n_tokens, topk), 0)
+    safe_slot = jnp.where(kept, slot_of_pair, 0)
+    w = jnp.where(kept, weights, 0.0).astype(dtype)
+    return (jnp.zeros((n_tokens, num_experts, capacity), dtype)
+            .at[rows.reshape(-1),
+                expert_ids.reshape(-1),
+                safe_slot.reshape(-1)]
+            .add(w.reshape(-1)))
+
+
+class ChunkPlan(NamedTuple):
+    """Per-chunk (destination-rank) routing for the fused MoE epilogue.
+
+    All fields are replicated on every rank (each rank computes every
+    chunk's partial output):
+
+    dispatch_index: (world, E, cap) int32 — chunk-local source token
+      index per expert slot (sentinel mc = empty).
+    combine_mats:   (world, E, mc, cap) — one-hot combine weights per
+      chunk, laid out expert-major for `emit_combine_matmul`.
+    """
+
+    dispatch_index: jnp.ndarray
+    combine_mats: jnp.ndarray
+
+
+def plan_chunks(expert_ids, weights, world: int, num_experts: int,
+                capacity: int, dtype=jnp.float32) -> ChunkPlan:
+    """Build per-chunk routing plans: tokens are row-partitioned into
+    `world` chunks (chunk c = rows destined for rank c after the
+    reduce-scatter) and each chunk is routed independently with its
+    own capacity.  expert_ids / weights: (n_tokens, topk)."""
+    n_tokens, topk = expert_ids.shape
+    assert n_tokens % world == 0, (n_tokens, world)
+    mc = n_tokens // world
+    ids_c = expert_ids.reshape(world, mc, topk)
+    w_c = weights.reshape(world, mc, topk)
+
+    def per_chunk(ids, w):
+        r = route_capacity(ids, num_experts, capacity)
+        cm = combine_matrix(ids, r.slot_of_pair, w, num_experts,
+                            capacity, dtype)
+        return r.dispatch_index, cm.transpose(1, 0, 2)  # (E, mc, cap)
+
+    dispatch, cmats = jax.vmap(per_chunk)(ids_c, w_c)
+    return ChunkPlan(dispatch_index=dispatch, combine_mats=cmats)
+
+
 def tokens_per_rank(expert_ids, num_experts: int, ep_size: int):
     """Split counts by destination EP rank (reference `bincount` +
     cumsum preprocessing, `ep_a2a.py:310-377`)."""

@@ -2,6 +2,7 @@
 (reference: `python/triton_dist/layers/nvidia/`)."""
 
 from triton_distributed_tpu.layers.tp_mlp import TPMLP  # noqa: F401
+from triton_distributed_tpu.layers.moe_mlp import MoEMLP  # noqa: F401
 from triton_distributed_tpu.layers.tp_attn import TPAttention  # noqa: F401
 from triton_distributed_tpu.layers.ep_a2a_layer import EPAll2AllLayer  # noqa: F401
 from triton_distributed_tpu.layers.sp_flash_decode_layer import (  # noqa: F401

@@ -1,0 +1,184 @@
+"""Tensor-parallel MoE MLP — the fused AG-MoE-RS module.
+
+Reference: `python/triton_dist/kernels/nvidia/ag_moe_rs.py` (195 LoC) —
+`AllGatherMoe` (`:19`, AG + grouped gate/up GEMM), gated silu,
+`MoEReduceRSTensorParallel` (`:72`, grouped down GEMM + topk reduce +
+RS), composed end-to-end by `AG_MOE_RS` (`:140`).
+
+TPU pipeline (per device, inside shard_map over the `tp` axis; input
+x is sequence(M)-sharded like TPMLP):
+
+1. router: topk expert ids/weights for the *local* tokens (the router
+   weight is replicated, so only ids/weights — a few KB — need to be
+   shared, not the tokens themselves);
+2. bucket local tokens per expert with capacity padding
+   (`moe_utils.route_capacity` — the static-shape stand-in for the
+   reference's block-aligned ragged segments);
+3. `ag_group_gemm`: ring-allgather the buckets while the MXU runs the
+   gate/up grouped GEMM per arrived chunk → (world, E, cap, 2*f_loc);
+4. gated silu (XLA fuses this elementwise stage);
+5. `moe_reduce_rs_fused`: per destination chunk, grouped down GEMM +
+   one-hot combine matmul, chunk put to its owner over ICI while the
+   next chunk computes, final VPU reduction → (mc, hidden).
+
+Mode "xla" is the same math in pure XLA ops (golden / GSPMD baseline).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels import moe_utils
+from triton_distributed_tpu.kernels.allgather_group_gemm import (
+    AGGroupGEMMContext,
+    ag_group_gemm,
+    gated_silu,
+)
+from triton_distributed_tpu.kernels.matmul import MatmulConfig
+from triton_distributed_tpu.kernels.moe_reduce_rs import (
+    MoEReduceRSContext,
+    moe_reduce_rs_fused,
+)
+
+
+def _round_up(x: int, mult: int) -> int:
+    return (x + mult - 1) // mult * mult
+
+
+@dataclasses.dataclass
+class MoEMLP:
+    """Config for one TP MoE MLP (reference `AG_MOE_RS`)."""
+
+    axis: str
+    world_size: int
+    hidden: int
+    ffn: int                       # per-expert intermediate size
+    num_experts: int
+    topk: int = 2
+    capacity_factor: float = 2.0   # per-chunk expert capacity headroom
+    mode: str = "fused"            # xla | fused
+    gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
+    collective_ids: tuple = (16, 17)
+    interpret: Optional[bool] = None
+
+    @property
+    def ffn_local(self) -> int:
+        return self.ffn // self.world_size
+
+    def capacity(self, tokens_per_chunk: int) -> int:
+        """Per-chunk expert capacity: even share × headroom, padded to
+        the bf16 sublane multiple so Mosaic tiles cleanly."""
+        even = tokens_per_chunk * self.topk / self.num_experts
+        return _round_up(max(int(even * self.capacity_factor), 16), 16)
+
+    def init_params(self, key, dtype=jnp.bfloat16):
+        """Per-device weight shards."""
+        k1, k2, k3 = jax.random.split(key, 3)
+        scale = self.hidden ** -0.5
+        e, f = self.num_experts, self.ffn_local
+        return {
+            "router": (jax.random.normal(k1, (self.hidden, e))
+                       * scale).astype(jnp.float32),
+            "gate_up": (jax.random.normal(k2, (e, self.hidden, 2 * f))
+                        * scale).astype(dtype),
+            "down": (jax.random.normal(k3, (e, f, self.hidden))
+                     * scale).astype(dtype),
+        }
+
+    def global_param_specs(self):
+        from jax.sharding import PartitionSpec as P
+        return {"router": P(None, None),
+                "gate_up": P(None, None, self.axis),
+                "down": P(None, self.axis, None)}
+
+    # ------------------------------------------------------------------
+
+    def _route(self, x, router):
+        """topk ids/weights for tokens x (deterministic)."""
+        logits = jnp.dot(x.astype(jnp.float32), router)
+        probs = jax.nn.softmax(logits, axis=-1)
+        w, ids = jax.lax.top_k(probs, self.topk)
+        w = w / jnp.maximum(w.sum(axis=-1, keepdims=True), 1e-9)
+        return ids.astype(jnp.int32), w.astype(jnp.float32)
+
+    def _chunk_plan(self, ids_all, w_all, cap):
+        return moe_utils.plan_chunks(
+            ids_all, w_all, self.world_size, self.num_experts, cap)
+
+    def _fwd_xla(self, x, params):
+        """Golden: same per-chunk capacity semantics, pure XLA ops."""
+        world = self.world_size
+        mc = x.shape[0]
+        cap = self.capacity(mc)
+        x_full = jax.lax.all_gather(x, self.axis, tiled=True)
+        ids, w = self._route(x_full, params["router"])
+        plan = self._chunk_plan(ids, w, cap)
+
+        xc = x_full.reshape(world, mc, -1)
+        buckets = jax.vmap(moe_utils.gather_tokens)(
+            xc, plan.dispatch_index)                 # (w, E, cap, h)
+        inter = jnp.einsum("wech,ehf->wecf", buckets, params["gate_up"],
+                           preferred_element_type=jnp.float32
+                           ).astype(x.dtype)
+        act = gated_silu(inter)                      # (w, E, cap, f_loc)
+        partial = jnp.einsum("wecf,efh->wech", act, params["down"],
+                             preferred_element_type=jnp.float32)
+        # per-chunk combine: (E, mc, cap) x (E, cap, h) summed over E
+        combined = jnp.einsum("wemc,wech->wmh",
+                              plan.combine_mats,
+                              partial).astype(x.dtype)  # (w, mc, h)
+        return jax.lax.psum_scatter(combined, self.axis,
+                                    scatter_dimension=0, tiled=False)
+
+    def _fwd_fused(self, x, params):
+        world = self.world_size
+        mc = x.shape[0]
+        cap = self.capacity(mc)
+
+        # 1-2. local routing + bucketing
+        ids_loc, w_loc = self._route(x, params["router"])
+        routing = moe_utils.route_capacity(ids_loc, self.num_experts, cap)
+        buckets = moe_utils.gather_tokens(x, routing.dispatch_index)
+
+        # 3. overlapped AG + gate/up grouped GEMM
+        ag_ctx = AGGroupGEMMContext(
+            axis=self.axis, world_size=world,
+            num_experts=self.num_experts, gemm=self.gemm,
+            collective_id=self.collective_ids[0],
+            interpret=self.interpret)
+        inter = ag_group_gemm(buckets, params["gate_up"], ag_ctx)
+
+        # 4. activation (XLA elementwise, fused into the surroundings)
+        act = gated_silu(inter)                      # (w, E, cap, f_loc)
+
+        # 5. routing metadata for every chunk (tiny allgather), then
+        #    the fused grouped-GEMM + combine + RS epilogue
+        ids_all = jax.lax.all_gather(ids_loc, self.axis, tiled=True)
+        w_all = jax.lax.all_gather(w_loc, self.axis, tiled=True)
+        plan = self._chunk_plan(ids_all, w_all, cap)
+        rs_ctx = MoEReduceRSContext(
+            axis=self.axis, world_size=world,
+            num_experts=self.num_experts, topk=self.topk,
+            gemm=self.gemm, collective_id=self.collective_ids[1],
+            interpret=self.interpret)
+        return moe_reduce_rs_fused(act, params["down"],
+                                   plan.combine_mats, rs_ctx)
+
+    def __call__(self, x, params):
+        mc = x.shape[0]
+        min_rows = 16 if x.dtype.itemsize < 4 else 8
+        mode = self.mode
+        if mode == "fused" and (self.world_size <= 1
+                                or mc % min_rows != 0):
+            # Decode-shaped or single-device: the XLA path wins
+            # (nothing to overlap / Mosaic tiling limits).
+            mode = "xla"
+        if mode == "xla":
+            return self._fwd_xla(x, params)
+        if mode == "fused":
+            return self._fwd_fused(x, params)
+        raise ValueError(f"unknown mode {self.mode}")

@@ -23,6 +23,16 @@ class ModelConfig:
     tie_word_embeddings: bool = True
     max_seq_len: int = 4096
     dtype: str = "bfloat16"
+    # MoE (Qwen3-MoE style: every MLP is an expert layer when
+    # num_experts > 0; reference e2e: test_ep_moe_inference.py)
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: Optional[int] = None   # per-expert ffn
+    moe_capacity_factor: float = 2.0
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
 
     @classmethod
     def qwen3_0_6b(cls):
@@ -52,6 +62,14 @@ class ModelConfig:
         return cls(**d)
 
     @classmethod
+    def tiny_moe(cls, **kw):
+        """Test-size MoE config."""
+        d = dict(num_experts=4, num_experts_per_tok=2,
+                 moe_intermediate_size=128)
+        d.update(kw)
+        return cls.tiny(**d)
+
+    @classmethod
     def from_hf(cls, model_name_or_path: str):
         """Build from a HuggingFace config (reference loads HF weights;
         here we map the config; weights via `Qwen3.load_hf_weights`)."""
@@ -71,4 +89,8 @@ class ModelConfig:
             rms_norm_eps=getattr(hf, "rms_norm_eps", 1e-6),
             rope_theta=getattr(hf, "rope_theta", 1e6),
             tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
+            num_experts=getattr(hf, "num_experts", 0),
+            num_experts_per_tok=getattr(hf, "num_experts_per_tok", 2),
+            moe_intermediate_size=getattr(hf, "moe_intermediate_size",
+                                          None),
         )

@@ -57,10 +57,23 @@ class Qwen3:
             head_dim=config.head_dim, rope_theta=config.rope_theta,
             qk_norm=config.qk_norm, mode=mode, gemm=gemm,
             interpret=interpret)
-        self.mlp = TPMLP(
-            axis=axis, world_size=self.world, hidden=config.hidden_size,
-            ffn=config.intermediate_size, mode=mode, gemm=gemm,
-            interpret=interpret)
+        if config.is_moe:
+            from triton_distributed_tpu.layers.moe_mlp import MoEMLP
+            self.mlp = MoEMLP(
+                axis=axis, world_size=self.world,
+                hidden=config.hidden_size,
+                ffn=(config.moe_intermediate_size
+                     or config.intermediate_size),
+                num_experts=config.num_experts,
+                topk=config.num_experts_per_tok,
+                capacity_factor=config.moe_capacity_factor,
+                mode=mode, gemm=gemm, interpret=interpret)
+        else:
+            self.mlp = TPMLP(
+                axis=axis, world_size=self.world,
+                hidden=config.hidden_size,
+                ffn=config.intermediate_size, mode=mode, gemm=gemm,
+                interpret=interpret)
 
     # ------------------------------------------------------------------
     # parameters
@@ -91,6 +104,21 @@ class Qwen3:
                 self.mlp.init_params(jax.random.fold_in(k2, r),
                                      self.dtype)
                 for r in range(self.world)]
+            if cfg.is_moe:
+                mlp_p = {
+                    "router": mlp_shards[0]["router"],
+                    "gate_up": jnp.concatenate(
+                        [p["gate_up"] for p in mlp_shards], axis=2),
+                    "down": jnp.concatenate(
+                        [p["down"] for p in mlp_shards], axis=1),
+                }
+            else:
+                mlp_p = {
+                    "gate_up": jnp.concatenate(
+                        [p["gate_up"] for p in mlp_shards], axis=1),
+                    "down": jnp.concatenate(
+                        [p["down"] for p in mlp_shards], axis=0),
+                }
             layer = {
                 "ln1": jnp.ones((h,), self.dtype),
                 "ln2": jnp.ones((h,), self.dtype),
@@ -100,12 +128,7 @@ class Qwen3:
                     "wo": jnp.concatenate(
                         [p["wo"] for p in attn_shards], axis=0),
                 },
-                "mlp": {
-                    "gate_up": jnp.concatenate(
-                        [p["gate_up"] for p in mlp_shards], axis=1),
-                    "down": jnp.concatenate(
-                        [p["down"] for p in mlp_shards], axis=0),
-                },
+                "mlp": mlp_p,
             }
             if cfg.qk_norm:
                 layer["attn"]["q_norm"] = attn_shards[0]["q_norm"]
@@ -131,8 +154,7 @@ class Qwen3:
             "ln2": P(None),
             "attn": {"wqkv": P(None, self.axis),
                      "wo": P(self.axis, None)},
-            "mlp": {"gate_up": P(None, self.axis),
-                    "down": P(self.axis, None)},
+            "mlp": self.mlp.global_param_specs(),
         }
         if cfg.qk_norm:
             layer["attn"]["q_norm"] = P(None)
