@@ -54,3 +54,10 @@ def sp4_mesh(devices):
 @pytest.fixture(scope="session")
 def dp2_tp4_mesh(devices):
     return Mesh(np.array(devices).reshape(2, 4), ("dp", "tp"))
+
+
+@pytest.fixture(scope="session")
+def dcn2_ici4_mesh(devices):
+    """Two-level mesh: axis "dcn" plays the inter-slice fabric, "ici"
+    the intra-slice torus (hierarchical collective tests)."""
+    return Mesh(np.array(devices).reshape(2, 4), ("dcn", "ici"))

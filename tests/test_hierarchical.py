@@ -1,0 +1,162 @@
+"""Two-level (ICI-slice × DCN) collective tests.
+
+Reference analogues: the inter-node 2D paths —
+`test/nvidia/test_all_gather.py` ring-2d cases, `reduce_scatter_2d_op`
+(`reduce_scatter.py:873`), node-proxy EP a2a (`test_ep_a2a.py`).
+The 8-device harness splits into a (2, 4) mesh, treating the leading
+axis as DCN (XLA collectives only) and the trailing one as the ICI
+slice (Pallas one-sided kernels).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.kernels.hierarchical import (
+    HierarchicalContext,
+    all_gather_2d,
+    all_reduce_2d,
+    hierarchical_all_to_all,
+    reduce_scatter_2d,
+)
+from triton_distributed_tpu.layers.ep_a2a_layer import (
+    EPAll2AllLayer,
+    HierarchicalEPAll2AllLayer,
+)
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.testing import assert_allclose
+
+
+DCN, ICI = 2, 4
+WORLD = DCN * ICI
+
+
+def _hctx(**kw):
+    return HierarchicalContext(ici_axis="ici", dcn_axis="dcn",
+                               ici_size=ICI, dcn_size=DCN, **kw)
+
+
+def test_all_gather_2d(dcn2_ici4_mesh):
+    m, n = 8, 128
+    x = jax.random.normal(jax.random.key(0), (WORLD * m, n), jnp.float32)
+    fn = shard_map_op(
+        functools.partial(all_gather_2d, ctx=_hctx()),
+        dcn2_ici4_mesh,
+        in_specs=P(("dcn", "ici"), None), out_specs=P(None, None))
+    out = jax.jit(fn)(x)
+    assert_allclose(out, x, atol=0, rtol=0, name="ag2d")
+
+
+def test_reduce_scatter_2d(dcn2_ici4_mesh):
+    m, n = 8, 128
+    # Per-device partials of the full (WORLD*m, n) array.
+    x = jax.random.normal(jax.random.key(1), (WORLD, WORLD * m, n),
+                          jnp.float32)
+    fn = shard_map_op(
+        lambda xx: reduce_scatter_2d(xx[0], _hctx()),
+        dcn2_ici4_mesh,
+        in_specs=P(("dcn", "ici"), None, None),
+        out_specs=P(("dcn", "ici"), None))
+    out = jax.jit(fn)(x)
+    ref = x.sum(axis=0)
+    assert_allclose(out, ref, atol=1e-4, rtol=1e-4, name="rs2d")
+
+
+@pytest.mark.parametrize("m", [16, 12])  # 12: not divisible by ici → pad
+def test_all_reduce_2d(dcn2_ici4_mesh, m):
+    n = 128
+    x = jax.random.normal(jax.random.key(2), (WORLD, m, n), jnp.float32)
+    fn = shard_map_op(
+        lambda xx: all_reduce_2d(xx[0], _hctx()),
+        dcn2_ici4_mesh,
+        in_specs=P(("dcn", "ici"), None, None),
+        out_specs=P(None, None))
+    out = jax.jit(fn)(x)
+    assert_allclose(out, x.sum(axis=0), atol=1e-4, rtol=1e-4, name="ar2d")
+
+
+@pytest.mark.parametrize("with_scales", [False, True])
+def test_hierarchical_all_to_all(dcn2_ici4_mesh, with_scales):
+    cap, hidden, ns = 8, 128, 8
+    key = jax.random.key(3)
+    # send[r, g] = tokens global rank r sends to global rank g.
+    send = jax.random.normal(key, (WORLD, WORLD, cap, hidden), jnp.float32)
+    counts = jax.random.randint(jax.random.key(4), (WORLD, WORLD, 1), 1,
+                                cap + 1).astype(jnp.int32)
+    scales = jax.random.normal(jax.random.key(5), (WORLD, WORLD, cap, ns))
+
+    if with_scales:
+        fn = shard_map_op(
+            lambda s, c, sc: hierarchical_all_to_all(
+                s[0], c[0], _hctx(), send_scales=sc[0]),
+            dcn2_ici4_mesh,
+            in_specs=(P(("dcn", "ici"), None, None, None),
+                      P(("dcn", "ici"), None, None),
+                      P(("dcn", "ici"), None, None, None)),
+            out_specs=(P(("dcn", "ici"), None, None),
+                       P(("dcn", "ici"), None),
+                       P(("dcn", "ici"), None, None)))
+        recv, rcounts, rscales = jax.jit(fn)(send, counts, scales)
+        assert_allclose(rscales.reshape(WORLD, WORLD, cap, ns),
+                        jnp.swapaxes(scales, 0, 1), atol=0, rtol=0,
+                        name="a2a2d scales")
+    else:
+        fn = shard_map_op(
+            lambda s, c: hierarchical_all_to_all(s[0], c[0], _hctx()),
+            dcn2_ici4_mesh,
+            in_specs=(P(("dcn", "ici"), None, None, None),
+                      P(("dcn", "ici"), None, None)),
+            out_specs=(P(("dcn", "ici"), None, None),
+                       P(("dcn", "ici"), None)))
+        recv, rcounts = jax.jit(fn)(send, counts)
+
+    assert_allclose(recv.reshape(WORLD, WORLD, cap, hidden),
+                    jnp.swapaxes(send, 0, 1), atol=0, rtol=0,
+                    name="a2a2d tokens")
+    assert_allclose(rcounts.reshape(WORLD, WORLD, 1),
+                    jnp.swapaxes(counts, 0, 1), atol=0, rtol=0,
+                    name="a2a2d counts")
+
+
+def test_hierarchical_ep_layer_matches_flat(devices):
+    """Slice-proxy dispatch/combine must be bit-identical to the flat
+    single-level EP layer on the same 8-rank problem."""
+    from jax.sharding import Mesh
+
+    E, topk, n_loc, hidden, cap = 16, 2, 8, 64, 32
+    n_tot = WORLD * n_loc
+    tokens = jax.random.normal(jax.random.key(6), (n_tot, hidden))
+    eids = jax.random.randint(jax.random.key(7), (n_tot, topk), 0, E)
+    w = jax.nn.softmax(jax.random.normal(jax.random.key(8),
+                                         (n_tot, topk)))
+
+    def ep_step(layer, tok, eid, ww):
+        recv, recv_e, counts, plan = layer.dispatch(tok, eid)
+        return layer.combine(recv, counts, plan, ww, eid)
+
+    flat_mesh = Mesh(np.array(devices), ("ep",))
+    flat = EPAll2AllLayer(axis="ep", ep_size=WORLD, num_experts=E,
+                          topk=topk, max_tokens_per_rank=cap,
+                          hidden=hidden)
+    flat_fn = shard_map_op(
+        functools.partial(ep_step, flat), flat_mesh,
+        in_specs=(P("ep", None),) * 3, out_specs=P("ep", None))
+    out_flat = jax.jit(flat_fn)(tokens, eids, w)
+
+    hier_mesh = Mesh(np.array(devices).reshape(DCN, ICI), ("dcn", "ici"))
+    hier = HierarchicalEPAll2AllLayer(
+        axis="ici", ep_size=WORLD, num_experts=E, topk=topk,
+        max_tokens_per_rank=cap, hidden=hidden,
+        dcn_axis="dcn", dcn_size=DCN)
+    hier_fn = shard_map_op(
+        functools.partial(ep_step, hier), hier_mesh,
+        in_specs=(P(("dcn", "ici"), None),) * 3,
+        out_specs=P(("dcn", "ici"), None))
+    out_hier = jax.jit(hier_fn)(tokens, eids, w)
+
+    assert_allclose(out_hier, out_flat, atol=0, rtol=0,
+                    name="hier-vs-flat-ep")

@@ -111,8 +111,8 @@ def _ring_ag_kernel(axis, world, x_ref, o_ref, local_sem, send_sem,
             dst_ref=o_ref.at[src_chunk],
             send_sem=send_sem,
             recv_sem=recv_sems.at[src_chunk],
-            device_id=right,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id=dl.peer_id(axis, right),
+            device_id_type=pltpu.DeviceIdType.MESH,
         )
         rdma.start()
         # Our left neighbor concurrently sends us the chunk that
@@ -144,8 +144,8 @@ def _push_all_ag_kernel(axis, world, x_ref, o_ref, local_sem, send_sem,
             dst_ref=o_ref.at[my],
             send_sem=send_sem,
             recv_sem=recv_sems.at[my],
-            device_id=peer,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id=dl.peer_id(axis, peer),
+            device_id_type=pltpu.DeviceIdType.MESH,
         ).start()
         return 0
 
@@ -188,16 +188,16 @@ def _bidir_ring_ag_kernel(axis, world, x_ref, o_ref, local_sem, send_sems,
             dst_ref=o_ref.at[fwd_chunk, 0],
             send_sem=send_sems.at[0],
             recv_sem=recv_sems.at[fwd_chunk, 0],
-            device_id=right,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id=dl.peer_id(axis, right),
+            device_id_type=pltpu.DeviceIdType.MESH,
         )
         r1 = pltpu.make_async_remote_copy(
             src_ref=o_ref.at[bwd_chunk, 1],
             dst_ref=o_ref.at[bwd_chunk, 1],
             send_sem=send_sems.at[1],
             recv_sem=recv_sems.at[bwd_chunk, 1],
-            device_id=left,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id=dl.peer_id(axis, left),
+            device_id_type=pltpu.DeviceIdType.MESH,
         )
         r0.start()
         r1.start()

@@ -102,8 +102,8 @@ def _ag_gemm_fused_kernel(ctx: AllGatherGEMMContext, m, n, k,
                 dst_ref=gathered_ref.at[chunk],
                 send_sem=send_sem,
                 recv_sem=recv_sems.at[chunk],
-                device_id=right,
-                device_id_type=pltpu.DeviceIdType.LOGICAL,
+                device_id=dl.peer_id(ctx.axis, right),
+                device_id_type=pltpu.DeviceIdType.MESH,
             )
             rdma.start()
         # MXU work for the chunk we already hold overlaps the DMA.

@@ -74,8 +74,8 @@ def _ag_group_gemm_kernel(ctx: AGGroupGEMMContext, cap, n, k,
                 dst_ref=gathered_ref.at[chunk],
                 send_sem=send_sem,
                 recv_sem=recv_sems.at[chunk],
-                device_id=right,
-                device_id_type=pltpu.DeviceIdType.LOGICAL,
+                device_id=dl.peer_id(ctx.axis, right),
+                device_id_type=pltpu.DeviceIdType.MESH,
             )
             rdma.start()
         emit_grouped_matmul(gathered_ref.at[chunk], b_ref,

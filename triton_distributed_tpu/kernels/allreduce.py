@@ -117,8 +117,8 @@ def _one_shot_kernel(ctx, m, n, x_ref, o_ref, rbuf_ref, local_sem,
             dst_ref=rbuf_ref.at[my],
             send_sem=send_sem,
             recv_sem=recv_sems.at[my],
-            device_id=peer,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id=dl.peer_id(ctx.axis, peer),
+            device_id_type=pltpu.DeviceIdType.MESH,
         ).start()
     for i in range(1, world):
         peer = jax.lax.rem(my + i, world)
@@ -146,8 +146,8 @@ def _two_shot_kernel(ctx, mc, n, x_ref, o_ref, rbuf_ref, local_sem,
             dst_ref=rbuf_ref.at[my],
             send_sem=send_sem,
             recv_sem=recv_sems.at[my],
-            device_id=peer,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id=dl.peer_id(ctx.axis, peer),
+            device_id_type=pltpu.DeviceIdType.MESH,
         ).start()
     for i in range(1, world):
         peer = jax.lax.rem(my + i, world)
@@ -166,8 +166,8 @@ def _two_shot_kernel(ctx, mc, n, x_ref, o_ref, rbuf_ref, local_sem,
             dst_ref=o_ref.at[my],
             send_sem=bcast_send_sem,
             recv_sem=bcast_sems.at[my],
-            device_id=peer,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id=dl.peer_id(ctx.axis, peer),
+            device_id_type=pltpu.DeviceIdType.MESH,
         ).start()
     for i in range(1, world):
         peer = jax.lax.rem(my + i, world)

@@ -81,8 +81,8 @@ def _gemm_rs_fused_kernel(ctx: GEMMReduceScatterContext, mc, n, k,
                 dst_ref=rbuf_ref.at[my],
                 send_sem=send_sems.at[slot],
                 recv_sem=recv_sems.at[my],
-                device_id=chunk,
-                device_id_type=pltpu.DeviceIdType.LOGICAL,
+                device_id=dl.peer_id(ctx.axis, chunk),
+                device_id_type=pltpu.DeviceIdType.MESH,
             )
             rdma.start()
             pending.append(rdma)

@@ -1,0 +1,252 @@
+"""Two-level (ICI-slice × DCN) hierarchical collectives.
+
+Reference: every kernel family in Triton-distributed has an inter-node
+story layered over the intra-node one — 2D ring AllGather
+(`python/triton_dist/kernels/nvidia/allgather.py:293`), the 2D
+ReduceScatter context (`reduce_scatter.py:46-146`,
+`reduce_scatter_2d_op:873`), and the node-proxy EP dispatch/combine
+(`ep_a2a.py:37-152`).  The NVLink domain maps to an ICI slice (fast,
+one-sided DMA capable) and the IB fabric maps to DCN between slices
+(collectives only — no one-sided remote DMA across DCN).
+
+Design: two mesh axes.  The **ICI stage** runs the framework's Pallas
+kernels (ring/one-shot with per-chunk semaphores); the **DCN stage**
+runs XLA collectives, which is what DCN supports and what XLA already
+schedules/overlaps well.  Stage order minimises DCN bytes — the scarce
+resource — exactly like the reference keeps IB traffic to the
+1/LOCAL_WORLD_SIZE slice (`reduce_scatter.py:518`):
+
+- AllGather: DCN first (each shard crosses DCN once, as `m` rows),
+  then the ICI Pallas ring carries the aggregated slice data.
+- ReduceScatter: ICI first (partials are reduced within the slice
+  before anything crosses DCN), then a DCN `psum_scatter` on the
+  already-reduced 1/ici_size chunk.
+- AllReduce: ICI reduce-scatter → DCN psum on the chunk → ICI
+  all-gather (the canonical hierarchical allreduce).
+- AllToAll: slice-proxy two-stage fan-out (`ep_a2a.py:37`): tokens hop
+  DCN to the same-ICI-position proxy in the destination slice, then
+  the low-latency Pallas AllToAll delivers within the slice.
+
+Global rank convention: ``g = dcn_index * ici_size + ici_index`` (DCN
+axis major), matching a ``Mesh(devs.reshape(dcn, ici), ("dcn", "ici"))``
+row-major device order, so data ordered by global rank shards naturally
+with ``P(("dcn", "ici"), ...)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels.allgather import (
+    AllGatherContext,
+    AllGatherMethod,
+    all_gather,
+)
+from triton_distributed_tpu.kernels.low_latency_all_to_all import (
+    AllToAllContext,
+    fast_all_to_all,
+)
+from triton_distributed_tpu.kernels.reduce_scatter import (
+    ReduceScatterContext,
+    ReduceScatterMethod,
+    reduce_scatter,
+)
+
+
+@dataclasses.dataclass
+class HierarchicalContext:
+    """Two-level topology handle (reference analogue:
+    `ReduceScatter2DContext` (`reduce_scatter.py:46-146`) with its
+    nnodes / local_world_size split).
+
+    `ici_axis` spans devices inside one slice (Pallas one-sided DMA);
+    `dcn_axis` spans slices (XLA collectives only).
+    """
+
+    ici_axis: str
+    dcn_axis: str
+    ici_size: int
+    dcn_size: int
+    ag_method: AllGatherMethod = AllGatherMethod.AUTO
+    rs_method: ReduceScatterMethod = ReduceScatterMethod.AUTO
+    collective_id: int = 12
+    interpret: Optional[bool] = None
+
+    @property
+    def world_size(self) -> int:
+        return self.ici_size * self.dcn_size
+
+    def _ag_ctx(self) -> AllGatherContext:
+        return AllGatherContext(
+            axis=self.ici_axis, world_size=self.ici_size,
+            method=self.ag_method, collective_id=self.collective_id,
+            interpret=self.interpret)
+
+    def _rs_ctx(self) -> ReduceScatterContext:
+        return ReduceScatterContext(
+            axis=self.ici_axis, world_size=self.ici_size,
+            method=self.rs_method, collective_id=self.collective_id,
+            interpret=self.interpret)
+
+
+def create_hierarchical_context(mesh, ici_axis: str, dcn_axis: str,
+                                **kw) -> HierarchicalContext:
+    """Build from a mesh whose `dcn_axis` spans slices (as discovered by
+    `parallel.mesh.node_topology`)."""
+    return HierarchicalContext(
+        ici_axis=ici_axis, dcn_axis=dcn_axis,
+        ici_size=mesh.shape[ici_axis], dcn_size=mesh.shape[dcn_axis],
+        **kw)
+
+
+# ---------------------------------------------------------------------------
+# AllGather 2D  (reference: inter-node 2D ring, allgather.py:293)
+# ---------------------------------------------------------------------------
+
+def all_gather_2d(x, ctx: HierarchicalContext):
+    """Gather row shards over both levels.
+
+    Input (inside shard_map over both axes): this device's shard
+    (m, n) of a (world * m, n) global array ordered by global rank.
+    Output: the full (world * m, n) array, replicated.
+    """
+    m, n = x.shape
+    # DCN stage first: each shard crosses DCN exactly once (m rows per
+    # device) — same-ICI-position devices gather across slices.
+    xd = jax.lax.all_gather(x, ctx.dcn_axis, tiled=False)  # (dcn, m, n)
+    # ICI stage: Pallas ring/one-shot on the concatenated rows.
+    full = all_gather(xd.reshape(ctx.dcn_size * m, n), ctx._ag_ctx())
+    full = full.reshape(ctx.ici_size, ctx.dcn_size, m, n)
+    # (ici, dcn, m, n) → global-rank-major (dcn, ici, m, n).
+    return jnp.transpose(full, (1, 0, 2, 3)).reshape(
+        ctx.world_size * m, n)
+
+
+# ---------------------------------------------------------------------------
+# ReduceScatter 2D  (reference: reduce_scatter_2d_op, reduce_scatter.py:873)
+# ---------------------------------------------------------------------------
+
+def reduce_scatter_2d(x, ctx: HierarchicalContext):
+    """Reduce per-device partials of the full array and scatter chunks.
+
+    Input: (world * m, n) partials (global-rank-ordered chunks).
+    Output: this device's reduced chunk (m, n).
+    """
+    world = ctx.world_size
+    mt, n = x.shape
+    assert mt % world == 0, (x.shape, world)
+    m = mt // world
+    xr = x.reshape(ctx.dcn_size, ctx.ici_size, m, n)
+    # ICI stage first: partials meet inside the slice before anything
+    # crosses DCN.  Chunk by ICI position → this device keeps the
+    # slice-reduced partials of its ICI column, one per slice.
+    xi = jnp.transpose(xr, (1, 0, 2, 3)).reshape(
+        ctx.ici_size * ctx.dcn_size * m, n)
+    mine = reduce_scatter(xi, ctx._rs_ctx())          # (dcn * m, n)
+    # DCN stage: scatter-reduce the per-slice chunks across slices.
+    return jax.lax.psum_scatter(
+        mine.reshape(ctx.dcn_size, m, n), ctx.dcn_axis,
+        scatter_dimension=0, tiled=False)
+
+
+# ---------------------------------------------------------------------------
+# AllReduce 2D  (hierarchical RS → psum → AG)
+# ---------------------------------------------------------------------------
+
+def all_reduce_2d(x, ctx: HierarchicalContext):
+    """Sum per-device partials (m, n) over both levels; replicated out.
+
+    DCN carries only m/ici_size rows per device — the hierarchical
+    schedule the reference approximates with its 2D RS + inter-node p2p
+    (`reduce_scatter.py:518`)."""
+    m, n = x.shape
+    ici = ctx.ici_size
+    pad = (-m) % ici
+    xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+    chunk = reduce_scatter(xp, ctx._rs_ctx())         # (mp / ici, n)
+    chunk = jax.lax.psum(chunk, ctx.dcn_axis)
+    full = all_gather(chunk, ctx._ag_ctx())           # (mp, n)
+    return full[:m] if pad else full
+
+
+# ---------------------------------------------------------------------------
+# AllToAll 2D — slice-proxy dispatch (reference: ep_a2a.py:37-152)
+# ---------------------------------------------------------------------------
+
+def _stage1_dcn(t, ctx):
+    """DCN hop to the same-ICI-position proxy in each destination
+    slice.  t: (dcn, ici, ...) by destination (slice, local) →
+    returns (dcn, ici, ...) by (source slice, destination local)."""
+    return jax.lax.all_to_all(t, ctx.dcn_axis, split_axis=0,
+                              concat_axis=0, tiled=False)
+
+
+def hierarchical_all_to_all(send_tokens, send_counts,
+                            ctx: HierarchicalContext, send_scales=None):
+    """Two-stage AllToAll over (dcn, ici): the TPU analogue of the
+    reference's node-proxy EP dispatch (`kernel_dispatch_token`,
+    `ep_a2a.py:37`): stage 1 ships each destination-slice group over
+    DCN to the proxy device (same ICI position, destination slice);
+    stage 2 fans out within the slice via the low-latency Pallas
+    AllToAll (one more traversal, ICI this time).
+
+    send_tokens: (world, cap, hidden) — block g holds tokens for global
+      rank g (= dcn_index * ici_size + ici_index), padded to cap.
+    send_counts: (world, 1) int32 true counts per block.
+    send_scales: optional (world, cap, n_scales) extra payload.
+
+    Returns (recv_tokens, recv_counts[, recv_scales]) with block g of
+    recv_tokens holding what global rank g sent here.
+    """
+    dcn, ici = ctx.dcn_size, ctx.ici_size
+    world = dcn * ici
+    _, cap, hidden = send_tokens.shape
+    assert send_tokens.shape[0] == world, (send_tokens.shape, world)
+    has_scale = send_scales is not None
+
+    # ---- stage 1: DCN hop to the destination slice's proxy ----------
+    t1 = _stage1_dcn(send_tokens.reshape(dcn, ici, cap, hidden), ctx)
+    c1 = _stage1_dcn(send_counts.reshape(dcn, ici, 1).astype(jnp.int32),
+                     ctx)
+    if has_scale:
+        ns = send_scales.shape[-1]
+        s1 = _stage1_dcn(send_scales.reshape(dcn, ici, cap, ns), ctx)
+
+    # t1[s0, d] = tokens from (slice s0, my ICI position) destined to
+    # local rank d of my slice.  Regroup by destination local rank for
+    # the ICI fan-out: each ICI block carries dcn sub-blocks of cap.
+    t2 = jnp.transpose(t1, (1, 0, 2, 3)).reshape(ici, dcn * cap, hidden)
+    c2 = jnp.transpose(c1, (1, 0, 2))                  # (ici, dcn, 1)
+    coarse = c2.sum(axis=1).astype(jnp.int32)          # (ici, 1)
+
+    ici_ctx = AllToAllContext(
+        axis=ctx.ici_axis, world_size=ici,
+        max_tokens_per_rank=dcn * cap, hidden=hidden,
+        collective_id=ctx.collective_id, interpret=ctx.interpret)
+
+    # ---- stage 2: ICI fan-out (Pallas, one-sided puts) --------------
+    if has_scale:
+        s2 = jnp.transpose(s1, (1, 0, 2, 3)).reshape(ici, dcn * cap, ns)
+        rt, _, rs = fast_all_to_all(t2, coarse, ici_ctx, send_scales=s2)
+    else:
+        rt, _ = fast_all_to_all(t2, coarse, ici_ctx)
+
+    # Fine per-source counts ride the same two-stage path (tiny; XLA).
+    rc = jax.lax.all_to_all(c2, ctx.ici_axis, split_axis=0,
+                            concat_axis=0, tiled=False)  # (ici, dcn, 1)
+
+    # Back to global-rank-major layout: block (s0, i_src) = what global
+    # rank s0 * ici + i_src sent here.
+    def to_global(a, last):
+        return jnp.transpose(a.reshape(ici, dcn, cap, last),
+                             (1, 0, 2, 3)).reshape(world, cap, last)
+
+    recv_tokens = to_global(rt, hidden)
+    recv_counts = jnp.transpose(rc, (1, 0, 2)).reshape(world, 1)
+    if has_scale:
+        return recv_tokens, recv_counts, to_global(rs, ns)
+    return recv_tokens, recv_counts

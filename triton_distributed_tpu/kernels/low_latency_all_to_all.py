@@ -81,19 +81,19 @@ def _a2a_kernel(ctx: AllToAllContext, has_scale,
         pltpu.make_async_remote_copy(
             src_ref=send_ref.at[peer], dst_ref=recv_ref.at[my],
             send_sem=send_sem, recv_sem=tok_sems.at[my],
-            device_id=peer,
-            device_id_type=pltpu.DeviceIdType.LOGICAL).start()
+            device_id=dl.peer_id(ctx.axis, peer),
+            device_id_type=pltpu.DeviceIdType.MESH).start()
         pltpu.make_async_remote_copy(
             src_ref=counts_ref.at[peer], dst_ref=rcounts_ref.at[my],
             send_sem=send_sem, recv_sem=cnt_sems.at[my],
-            device_id=peer,
-            device_id_type=pltpu.DeviceIdType.LOGICAL).start()
+            device_id=dl.peer_id(ctx.axis, peer),
+            device_id_type=pltpu.DeviceIdType.MESH).start()
         if has_scale:
             pltpu.make_async_remote_copy(
                 src_ref=scale_ref.at[peer], dst_ref=rscale_ref.at[my],
                 send_sem=send_sem, recv_sem=scl_sems.at[my],
-                device_id=peer,
-                device_id_type=pltpu.DeviceIdType.LOGICAL).start()
+                device_id=dl.peer_id(ctx.axis, peer),
+                device_id_type=pltpu.DeviceIdType.MESH).start()
 
     # Arrival waits (the reference's signal_wait_until on per-src flags).
     for i in range(1, world):

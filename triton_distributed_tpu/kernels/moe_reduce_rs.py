@@ -131,8 +131,8 @@ def _moe_rs_fused_kernel(ctx: MoEReduceRSContext, e, cap, mc, n, k,
                 dst_ref=rbuf_ref.at[my],
                 send_sem=send_sems.at[slot],
                 recv_sem=recv_sems.at[my],
-                device_id=chunk,
-                device_id_type=pltpu.DeviceIdType.LOGICAL,
+                device_id=dl.peer_id(ctx.axis, chunk),
+                device_id_type=pltpu.DeviceIdType.MESH,
             )
             rdma.start()
             pending.append(rdma)

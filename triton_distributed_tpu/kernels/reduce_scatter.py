@@ -125,8 +125,8 @@ def _scatter_reduce_kernel(ctx, m, n, x_ref, out_ref, rbuf_ref,
             dst_ref=rbuf_ref.at[my],
             send_sem=send_sem,
             recv_sem=recv_sems.at[my],
-            device_id=peer,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id=dl.peer_id(ctx.axis, peer),
+            device_id_type=pltpu.DeviceIdType.MESH,
         ).start()
 
     # Wait for the other world-1 partials of *our* chunk to land.
@@ -178,8 +178,8 @@ def _ring_rs_kernel(ctx, m, n, x_ref, out_ref, staging_ref, accum_ref,
             dst_ref=staging_ref.at[slot],
             send_sem=send_sem,
             recv_sem=recv_sems.at[slot],
-            device_id=right,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
+            device_id=dl.peer_id(ctx.axis, right),
+            device_id_type=pltpu.DeviceIdType.MESH,
         )
         rdma.start()
 
@@ -193,8 +193,8 @@ def _ring_rs_kernel(ctx, m, n, x_ref, out_ref, staging_ref, accum_ref,
         else:
             add_into(out_ref, staging_ref.at[slot], x_ref.at[recv_chunk])
         # Tell the left neighbor the slot is free again.
-        pltpu.semaphore_signal(ack_sem, inc=1, device_id=left,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(ack_sem, inc=1, device_id=dl.peer_id(ctx.axis, left),
+                               device_id_type=pltpu.DeviceIdType.MESH)
         rdma.wait_send()
 
     # Drain leftover acks (the last two signals are never waited on).

@@ -43,12 +43,26 @@ def num_ranks(axis: str) -> int:
     return jax.lax.axis_size(axis)
 
 
+def peer_id(axis: str, index):
+    """Address of the device at ``index`` along ``axis``, keeping this
+    device's coordinates on every other mesh axis.
+
+    All kernels address peers this way (MESH-coordinate dict) rather
+    than with flat LOGICAL ids: an axis-local index is only a valid
+    logical id on a 1-axis mesh, and silently targets the wrong chip on
+    any multi-axis mesh (dp×tp, dcn×ici, ...).  Reference analogue:
+    NVSHMEM PE ids are team-relative for the same reason
+    (`libshmem_device.py` team APIs).
+    """
+    return {axis: index}
+
+
 # ---------------------------------------------------------------------------
 # One-sided data movement
 # ---------------------------------------------------------------------------
 
 def put_nbi(src_ref, dst_ref, send_sem, recv_sem, device_id,
-            device_id_type=pltpu.DeviceIdType.LOGICAL):
+            device_id_type=pltpu.DeviceIdType.MESH):
     """Non-blocking one-sided put: start an async remote DMA and return
     its descriptor (call ``.wait_send()`` / ``.wait_recv()`` later).
 
@@ -70,7 +84,7 @@ def put_nbi(src_ref, dst_ref, send_sem, recv_sem, device_id,
 
 
 def put(src_ref, dst_ref, send_sem, recv_sem, device_id,
-        device_id_type=pltpu.DeviceIdType.LOGICAL):
+        device_id_type=pltpu.DeviceIdType.MESH):
     """Blocking put (reference: `libshmem_device.putmem_block`):
     start + wait-send.  NOTE: waits only for local completion (source
     reusable), not remote delivery — matching SHMEM put semantics."""
@@ -107,7 +121,7 @@ def wait_send(ref, send_sem):
 # ---------------------------------------------------------------------------
 
 def notify(sem, device_id=None, inc: int = 1,
-           device_id_type=pltpu.DeviceIdType.LOGICAL):
+           device_id_type=pltpu.DeviceIdType.MESH):
     """Set/advance a signal, optionally on a remote device.
 
     Reference: `dl.notify` (`distributed_ops.py:103`, lowered at
@@ -187,8 +201,8 @@ def barrier_all(axis: str, sem=None):
 
     def body(i, _):
         peer = jax.lax.rem(me + i, n)
-        pltpu.semaphore_signal(bsem, inc=1, device_id=peer,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(bsem, inc=1, device_id=peer_id(axis, peer),
+                               device_id_type=pltpu.DeviceIdType.MESH)
         return 0
 
     jax.lax.fori_loop(1, n, body, 0)
@@ -227,8 +241,8 @@ def barrier_neighbors(axis: str):
     left = jax.lax.rem(me - 1 + n, n)
     right = jax.lax.rem(me + 1, n)
     bsem = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(bsem, inc=1, device_id=left,
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
-    pltpu.semaphore_signal(bsem, inc=1, device_id=right,
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(bsem, inc=1, device_id=peer_id(axis, left),
+                           device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_signal(bsem, inc=1, device_id=peer_id(axis, right),
+                           device_id_type=pltpu.DeviceIdType.MESH)
     pltpu.semaphore_wait(bsem, 2)

@@ -22,7 +22,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from triton_distributed_tpu.kernels import moe_utils
+from triton_distributed_tpu.kernels import hierarchical, moe_utils
 from triton_distributed_tpu.kernels.low_latency_all_to_all import (
     AllToAllContext,
     fast_all_to_all,
@@ -52,6 +52,11 @@ class EPAll2AllLayer:
             max_tokens_per_rank=self.max_tokens_per_rank,
             hidden=self.hidden, collective_id=cid,
             interpret=self.interpret)
+
+    def _exchange(self, send_tokens, counts, cid, send_scales=None):
+        """The wire exchange; hierarchical subclass swaps the backend."""
+        return fast_all_to_all(send_tokens, counts, self._a2a_ctx(cid),
+                               send_scales=send_scales)
 
     def dispatch(self, tokens, expert_ids):
         """Route local tokens to expert-owner ranks.
@@ -83,10 +88,9 @@ class EPAll2AllLayer:
             local_expert, mode="drop")
         counts = jnp.minimum(routing.counts, cap)[:, None]    # (ep, 1)
 
-        ctx = self._a2a_ctx(self.collective_ids[0])
         # Ship expert ids as a narrow second payload (scale slot).
-        recv_tokens, recv_counts, recv_expert = fast_all_to_all(
-            send_tokens, counts, ctx,
+        recv_tokens, recv_counts, recv_expert = self._exchange(
+            send_tokens, counts, self.collective_ids[0],
             send_scales=send_expert[..., None].astype(jnp.float32))
         recv_expert = recv_expert[..., 0].astype(jnp.int32)
         send_plan = (routing, kept)
@@ -99,18 +103,45 @@ class EPAll2AllLayer:
         expert_out: (ep, cap, hidden) — processed tokens still in
         arrival layout (block p = tokens from rank p).
         Returns (n_loc, hidden)."""
-        ctx = self._a2a_ctx(self.collective_ids[1])
         # Send processed block p back to rank p: layout is already
         # (dst_rank, cap, hidden) from the receiver's perspective.
-        back_tokens, _ = fast_all_to_all(expert_out, recv_counts, ctx)
+        back_tokens, _ = self._exchange(expert_out, recv_counts,
+                                        self.collective_ids[1])
 
-        routing, kept = send_plan
-        n_loc, topk = expert_ids.shape
+        routing, _kept = send_plan
         dest_rank = expert_ids // self.experts_per_rank
-        slot = routing.slot_of_pair                          # (n, topk)
-        safe_r = jnp.where(kept, dest_rank, 0)
-        safe_s = jnp.where(kept, slot, 0)
-        vals = back_tokens[safe_r, safe_s]                   # (n, topk, H)
-        w = jnp.where(kept, topk_weights, 0.0)[..., None]
-        return (vals.astype(jnp.float32) * w).sum(axis=1).astype(
-            expert_out.dtype)
+        # Same gather-and-weight semantics as expert combine, with the
+        # destination rank playing the "expert" role.
+        return moe_utils.combine_tokens(back_tokens, dest_rank,
+                                        routing.slot_of_pair, topk_weights)
+
+
+@dataclasses.dataclass
+class HierarchicalEPAll2AllLayer(EPAll2AllLayer):
+    """Two-level EP AllToAll: slice-proxy dispatch over (dcn, ici).
+
+    Reference analogue: the node-proxy dispatch/combine kernels
+    (`kernels/nvidia/ep_a2a.py:37,152`) — tokens hop the slow fabric
+    once to a proxy in the destination node/slice, then fan out on the
+    fast fabric.  Here `axis` is the ICI (intra-slice) mesh axis and
+    `dcn_axis` spans slices; global EP rank g = dcn_index * ici_size +
+    ici_index, and `ep_size` is the total (dcn * ici) world.
+    """
+
+    dcn_axis: str = "dcn"
+    dcn_size: int = 1
+
+    @property
+    def ici_size(self) -> int:
+        return self.ep_size // self.dcn_size
+
+    def _hctx(self, cid):
+        return hierarchical.HierarchicalContext(
+            ici_axis=self.axis, dcn_axis=self.dcn_axis,
+            ici_size=self.ici_size, dcn_size=self.dcn_size,
+            collective_id=cid, interpret=self.interpret)
+
+    def _exchange(self, send_tokens, counts, cid, send_scales=None):
+        return hierarchical.hierarchical_all_to_all(
+            send_tokens, counts, self._hctx(cid),
+            send_scales=send_scales)
