@@ -35,13 +35,20 @@ def make_chain(k):
                         + x * jnp.bfloat16(0.5)).astype(jnp.bfloat16))
 
 
-def measure_pair(fs, a, b, k, n1=20, n2=120, repeats=4):
+def measure_pair(fs, a, b, k, n1=20, n2=220, repeats=6):
     """Per-call latency of each jitted `f(a, b) -> (M, N)` in `fs` by
     two-point fit, with the ops' samples interleaved in time so slow
     drift (chip clocks, tunnel load) hits all ops equally.  Calls are
     dependence-chained through the output so the device queue can't
-    collapse them; the fetch cost fluctuates by tens of ms, so the fit
-    needs a large call-count gap and medians."""
+    collapse them.
+
+    The fetch cost fluctuates by tens of ms, so (a) the call-count gap
+    is large enough that the slope denominator (~n2-n1 calls of device
+    work) swamps it, and (b) the slope is computed *per repeat* from
+    the adjacent (n1, n2) pair — minutes-scale drift then cancels
+    within each repeat — and the median of the per-repeat slopes is
+    returned (median-of-slopes, not slope-of-medians: the latter mixes
+    samples taken far apart in time)."""
     import statistics
 
     chain = make_chain(k)
@@ -56,13 +63,13 @@ def measure_pair(fs, a, b, k, n1=20, n2=120, repeats=4):
 
     for f in fs:
         total(f, 2)  # warm every jit
-    samples = [([], []) for _ in fs]
+    slopes = [[] for _ in fs]
     for _ in range(repeats):
-        for (t1s, t2s), f in zip(samples, fs):
-            t1s.append(total(f, n1))
-            t2s.append(total(f, n2))
-    return [max((statistics.median(t2s) - statistics.median(t1s))
-                / (n2 - n1), 1e-9) for t1s, t2s in samples]
+        for sl, f in zip(slopes, fs):
+            t1 = total(f, n1)
+            t2 = total(f, n2)
+            sl.append(max((t2 - t1) / (n2 - n1), 1e-9))
+    return [statistics.median(sl) for sl in slopes]
 
 
 def main():

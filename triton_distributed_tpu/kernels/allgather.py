@@ -131,10 +131,16 @@ def _ring_ag_kernel(axis, world, x_ref, o_ref, local_sem, send_sem,
 # One-shot push all-gather (latency optimal)
 # ---------------------------------------------------------------------------
 
-def _push_all_ag_kernel(axis, world, x_ref, o_ref, local_sem, send_sem,
-                        recv_sems):
+def emit_push_allgather(axis, world, x_ref, o_ref, local_sem, send_sem,
+                        recv_sems, *, barrier: bool = True):
+    """One-shot push AG usable from inside larger kernels: the local
+    shard ``x_ref`` lands in ``o_ref[my]`` and is pushed to every
+    peer's same slot (1 hop, all peers concurrent).  ``recv_sems``
+    must have shape (world,).  Shared by the standalone PUSH_ALL
+    collective and the fused low-latency overlap kernels."""
     my = jax.lax.axis_index(axis)
-    dl.entry_barrier(axis, world)  # every peer puts into our o_ref
+    if barrier:
+        dl.entry_barrier(axis, world)  # every peer puts into our o_ref
     dl.local_copy(x_ref, o_ref.at[my], local_sem)
 
     def send(i, _):
@@ -163,6 +169,12 @@ def _push_all_ag_kernel(axis, world, x_ref, o_ref, local_sem, send_sem,
         dl.wait_send(o_ref.at[my], send_sem)
         return 0
     jax.lax.fori_loop(1, world, drain, 0, unroll=True)
+
+
+def _push_all_ag_kernel(axis, world, x_ref, o_ref, local_sem, send_sem,
+                        recv_sems):
+    emit_push_allgather(axis, world, x_ref, o_ref, local_sem, send_sem,
+                        recv_sems)
 
 
 # ---------------------------------------------------------------------------

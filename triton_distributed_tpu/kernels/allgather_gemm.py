@@ -32,7 +32,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from triton_distributed_tpu.kernels.matmul import MatmulConfig, emit_matmul
+from triton_distributed_tpu.kernels.allgather import emit_push_allgather
+from triton_distributed_tpu.kernels.matmul import (
+    MatmulConfig,
+    emit_chunked_matmul,
+    emit_matmul,
+    round_up_rows,
+)
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
     comm_compiler_params,
@@ -48,12 +54,14 @@ class AllGatherGEMMContext:
     comes from jit caching, the role CUDA graphs play in the
     reference).
 
-    ``method``: "auto" | "fused" | "xla" — the reference's method
-    auto-select (`get_auto_all_gather_method`).  "auto" picks "xla"
-    when there is no communication to overlap (world_size == 1 — the
-    XLA matmul already runs at ~96% MFU, there is nothing to win) or
-    when M is too small for Mosaic DMA tiling (decode shapes), and
-    the fused single kernel otherwise."""
+    ``method``: "auto" | "fused" | "ll" | "xla" — the reference's
+    method auto-select (`get_auto_all_gather_method`).  "auto" picks
+    "xla" when there is no communication to overlap (world_size == 1 —
+    the XLA matmul already runs at ~96% MFU, there is nothing to win),
+    the low-latency one-shot path ("ll") in the decode regime (few
+    gathered rows: latency-bound, B-streaming-dominated — the
+    reference's `low_latency_allgather.py` family), and the fused
+    ring kernel otherwise."""
 
     axis: str
     world_size: int
@@ -62,14 +70,21 @@ class AllGatherGEMMContext:
     collective_id: int = 1
     interpret: Optional[bool] = None
 
+    #: "auto" switches to the one-shot low-latency path when the
+    #: gathered matrix has at most this many (padded) rows — the
+    #: decode regime.  Mid-size prefill stays on the ring kernel the
+    #: real-TPU autotune validated (vs_baseline 1.0-1.15); the ll
+    #: crossover above this has not been measured on hardware.
+    LL_MAX_GATHERED_ROWS = 256
+
     def resolve_method(self, m: int, dtype) -> str:
         if self.method != "auto":
             return self.method
         if self.world_size <= 1:
             return "xla"
-        min_rows = 16 if jnp.dtype(dtype).itemsize < 4 else 8
-        if m % min_rows != 0:
-            return "xla"
+        mp = round_up_rows(m, dtype)
+        if self.world_size * mp <= self.LL_MAX_GATHERED_ROWS:
+            return "ll"
         return "fused"
 
 
@@ -115,6 +130,20 @@ def _ag_gemm_fused_kernel(ctx: AllGatherGEMMContext, m, n, k,
             rdma.wait_send()
 
 
+def _ag_gemm_ll_kernel(ctx: AllGatherGEMMContext, mp, n, k,
+                       x_ref, b_ref, gathered_ref, out_ref,
+                       local_sem, send_sem, recv_sems):
+    """Low-latency variant: one-shot push AG (1 hop, all peers
+    concurrent — reference `low_latency_allgather.py:48-217`) then a
+    single chunked matmul that streams B exactly once.  No per-chunk
+    overlap: in this regime comm is microseconds while the GEMM is
+    B-bandwidth-bound, so reading B once IS the optimisation."""
+    emit_push_allgather(ctx.axis, ctx.world_size, x_ref, gathered_ref,
+                        local_sem, send_sem, recv_sems)
+    emit_chunked_matmul(gathered_ref, b_ref, out_ref, chunks=ctx.world_size,
+                        mc=mp, n=n, k=k, config=ctx.gemm)
+
+
 def ag_gemm(a_shard, b, ctx: AllGatherGEMMContext,
             return_gathered: bool = False):
     """C = all_gather(a, axis) @ b, overlapped.  Call inside shard_map.
@@ -123,6 +152,10 @@ def ag_gemm(a_shard, b, ctx: AllGatherGEMMContext,
     b:       (k, n_local) — this rank's column shard of B (weights).
     Returns (world*m_local, n_local), and optionally gathered A
     (the reference's `copy_to_local` path, `allgather_gemm.py:573`).
+
+    Any m is supported on the fused paths: rows are padded to the
+    Mosaic sublane multiple inside the op and sliced back out — decode
+    shapes (m = 1..8) run the Pallas "ll" path, not an XLA fallback.
     """
     world = ctx.world_size
     m, k = a_shard.shape
@@ -130,29 +163,30 @@ def ag_gemm(a_shard, b, ctx: AllGatherGEMMContext,
     assert k == k2, (a_shard.shape, b.shape)
 
     method = ctx.resolve_method(m, a_shard.dtype)
-    # Mosaic DMA slices need the sublane dim aligned to the dtype
-    # packing; guard explicit method="fused" too, not just "auto".
-    min_rows = 16 if a_shard.dtype.itemsize < 4 else 8
-    if method == "fused" and m % min_rows != 0:
-        method = "xla"
-    if method == "xla":
+    if method == "xla" and world > 1:
         a_full = jax.lax.all_gather(a_shard, ctx.axis, tiled=True)
         out = jnp.dot(a_full, b, preferred_element_type=jnp.float32
                       ).astype(a_shard.dtype)
         return (out, a_full) if return_gathered else out
 
     if world <= 1:
-        # Fused requested on one device: no comm buffer needed — run
-        # the tuned MXU pipeline directly.
+        # Single device: no comm — run the tuned MXU pipeline directly.
         from triton_distributed_tpu.kernels.matmul import matmul
         out = matmul(a_shard, b, config=ctx.gemm, interpret=ctx.interpret)
         return (out, a_shard) if return_gathered else out
 
+    # Pad rows to the Mosaic sublane multiple (sliced back below).
+    mp = round_up_rows(m, a_shard.dtype)
+    a_p = (a_shard if mp == m
+           else jnp.pad(a_shard, ((0, mp - m), (0, 0))))
+
+    kernel = (_ag_gemm_ll_kernel if method == "ll"
+              else _ag_gemm_fused_kernel)
     gathered, out = pl.pallas_call(
-        functools.partial(_ag_gemm_fused_kernel, ctx, m, n, k),
+        functools.partial(kernel, ctx, mp, n, k),
         out_shape=(
-            jax.ShapeDtypeStruct((world, m, k), a_shard.dtype),
-            jax.ShapeDtypeStruct((world, m, n), a_shard.dtype),
+            jax.ShapeDtypeStruct((world, mp, k), a_shard.dtype),
+            jax.ShapeDtypeStruct((world, mp, n), a_shard.dtype),
         ),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
@@ -169,17 +203,20 @@ def ag_gemm(a_shard, b, ctx: AllGatherGEMMContext,
         ],
         compiler_params=comm_compiler_params(ctx.collective_id, world),
         cost_estimate=pl.CostEstimate(
-            flops=2 * world * m * n * k,
-            bytes_accessed=(world * m * k + k * n) * a_shard.dtype.itemsize
-            + world * m * n * a_shard.dtype.itemsize,
+            flops=2 * world * mp * n * k,
+            bytes_accessed=(world * mp * k + k * n) * a_shard.dtype.itemsize
+            + world * mp * n * a_shard.dtype.itemsize,
             transcendentals=0,
         ),
         interpret=default_interpret(ctx.interpret),
-    )(a_shard, b)
+    )(a_p, b)
 
+    if mp != m:
+        out = out[:, :m]
     out = out.reshape(world * m, n)
     if return_gathered:
-        return out, gathered.reshape(world * m, k)
+        g = gathered[:, :m] if mp != m else gathered
+        return out, g.reshape(world * m, k)
     return out
 
 

@@ -27,8 +27,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from triton_distributed_tpu.kernels.matmul import MatmulConfig, emit_matmul
-from triton_distributed_tpu.kernels.reduce_scatter import _emit_reduce_sum
+from triton_distributed_tpu.kernels.matmul import (
+    MatmulConfig,
+    emit_chunked_matmul,
+    emit_matmul,
+    round_up_rows,
+)
+from triton_distributed_tpu.kernels.reduce_scatter import (
+    _emit_reduce_sum,
+    emit_scatter_reduce,
+)
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
     comm_compiler_params,
@@ -44,8 +52,25 @@ class GEMMReduceScatterContext:
     axis: str
     world_size: int
     gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
+    method: str = "auto"          # auto | fused | ll | xla
     collective_id: int = 3
     interpret: Optional[bool] = None
+
+    #: "auto" switches to the one-shot low-latency path when the
+    #: partial matrix has at most this many (padded) rows — the decode
+    #: regime; the crossover above this is unmeasured on hardware, so
+    #: mid-size M stays on the validated ring/swizzle kernel.
+    LL_MAX_ROWS = 256
+
+    def resolve_method(self, mc: int, dtype) -> str:
+        if self.method != "auto":
+            return self.method
+        if self.world_size <= 1:
+            return "xla"
+        mcp = round_up_rows(mc, dtype)
+        if self.world_size * mcp <= self.LL_MAX_ROWS:
+            return "ll"
+        return "fused"
 
 
 def create_gemm_rs_context(axis: str, world_size: int, **kw):
@@ -98,6 +123,23 @@ def _gemm_rs_fused_kernel(ctx: GEMMReduceScatterContext, mc, n, k,
     _emit_reduce_sum(rbuf_ref, out_ref, world=world, m=mc, n=n)
 
 
+def _gemm_rs_ll_kernel(ctx: GEMMReduceScatterContext, mcp, n, k,
+                       a_ref, b_ref, out_ref, rbuf_ref, cstage_ref,
+                       local_sem, send_sem, recv_sems):
+    """Low-latency variant: one chunked matmul (streams B once), then
+    a one-shot scatter of every remote chunk to its owner (1 hop, all
+    peers concurrent), then the local reduction.  The decode-regime
+    `gemm_rs` — reference analogue: the low-latency RS composition
+    rather than the persistent tile-scatter producer."""
+    world = ctx.world_size
+    dl.entry_barrier(ctx.axis, world)  # every peer puts into rbuf_ref
+    emit_chunked_matmul(a_ref, b_ref, cstage_ref, chunks=world,
+                        mc=mcp, n=n, k=k, config=ctx.gemm)
+    emit_scatter_reduce(ctx.axis, world, cstage_ref, out_ref, rbuf_ref,
+                        local_sem, send_sem, recv_sems, m=mcp, n=n,
+                        barrier=False)
+
+
 def gemm_rs(a, b, ctx: GEMMReduceScatterContext):
     """reduce_scatter(a @ b) over `ctx.axis`, overlapped.
     Call inside shard_map.
@@ -105,6 +147,10 @@ def gemm_rs(a, b, ctx: GEMMReduceScatterContext):
     a: (M, k_local) — this rank's K-shard of the activation.
     b: (k_local, n) — this rank's K-shard of the (row-parallel) weight.
     Returns this rank's reduced output rows: (M / world, n).
+
+    Any chunk size is supported on the fused paths: chunks are padded
+    to the Mosaic sublane multiple inside the op and sliced back —
+    decode shapes run the Pallas "ll" path, not an XLA fallback.
     """
     world = ctx.world_size
     mt, k = a.shape
@@ -112,40 +158,60 @@ def gemm_rs(a, b, ctx: GEMMReduceScatterContext):
     assert k == k2 and mt % world == 0, (a.shape, b.shape, world)
     mc = mt // world
 
-    # Tile-friendliness gate (see ag_gemm): tiny decode GEMMs use the
-    # XLA path.
-    min_rows = 16 if a.dtype.itemsize < 4 else 8
-    if mc % min_rows != 0:
+    method = ctx.resolve_method(mc, a.dtype)
+    if method == "xla" or world <= 1:
         return gemm_rs_nonoverlap(a, b, ctx.axis)
+
+    # Pad each chunk's rows to the sublane multiple (sliced back
+    # below; padded partial rows are computed but discarded).
+    mcp = round_up_rows(mc, a.dtype)
+    a3 = a.reshape(world, mc, k)
+    if mcp != mc:
+        a3 = jnp.pad(a3, ((0, 0), (0, mcp - mc), (0, 0)))
+
+    if method == "ll":
+        kernel = _gemm_rs_ll_kernel
+        # Full-width compute staging (chunked matmul output).
+        stage_shape = (world, mcp, n)
+        scratch = [
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((world,)),
+        ]
+    else:
+        kernel = _gemm_rs_fused_kernel
+        # Double-buffered send staging (per-chunk matmul + put).
+        stage_shape = (2, mcp, n)
+        scratch = [
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((world,)),
+        ]
 
     # HBM receive/staging buffers are extra outputs (discarded) —
     # Mosaic only allows vmem/smem/semaphore scratch.
     out, _, _ = pl.pallas_call(
-        functools.partial(_gemm_rs_fused_kernel, ctx, mc, n, k),
+        functools.partial(kernel, ctx, mcp, n, k),
         out_shape=(
-            jax.ShapeDtypeStruct((mc, n), a.dtype),
-            jax.ShapeDtypeStruct((world, mc, n), a.dtype),
-            jax.ShapeDtypeStruct((2, mc, n), a.dtype),
+            jax.ShapeDtypeStruct((mcp, n), a.dtype),
+            jax.ShapeDtypeStruct((world, mcp, n), a.dtype),
+            jax.ShapeDtypeStruct(stage_shape, a.dtype),
         ),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 3,
-        scratch_shapes=[
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((world,)),
-        ],
+        scratch_shapes=scratch,
         compiler_params=comm_compiler_params(ctx.collective_id, world),
         cost_estimate=pl.CostEstimate(
-            flops=2 * mt * n * k,
-            bytes_accessed=(mt * k + k * n + world * mc * n)
+            flops=2 * world * mcp * n * k,
+            bytes_accessed=(world * mcp * k + k * n + world * mcp * n)
             * a.dtype.itemsize,
             transcendentals=0,
         ),
         interpret=default_interpret(ctx.interpret),
-    )(a.reshape(world, mc, k), b)
-    return out
+    )(a3, b)
+    return out[:mc] if mcp != mc else out
 
 
 def gemm_rs_nonoverlap(a, b, axis: str):

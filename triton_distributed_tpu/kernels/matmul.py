@@ -195,3 +195,65 @@ def emit_matmul(a_ref, b_ref, o_ref, *, m, n, k,
         acc_ref=pltpu.VMEM((min(cfg.block_m, m), min(cfg.block_n, n)),
                            jnp.float32),
     )
+
+
+def emit_chunked_matmul(a_ref, b_ref, o_ref, *, chunks, mc, n, k,
+                        config: Optional[MatmulConfig] = None):
+    """O[w] = A[w] @ B for all ``chunks`` row-chunks in ONE pipeline.
+
+    ``a_ref``: (chunks, mc, k), ``o_ref``: (chunks, mc, n) HBM refs.
+
+    For the latency regime (decode: mc is a handful of rows) the cost
+    of a GEMM is streaming B from HBM, not FLOPs — so unlike a loop of
+    per-chunk `emit_matmul` (which would re-read B per chunk, a
+    ``chunks``× bandwidth blowup) every B block is fetched exactly
+    once and multiplied against *all* chunks while resident in VMEM.
+    The accumulator holds all chunks of one N block: chunks*mc rows,
+    small by the regime's definition.  Reference analogue: the
+    low-latency AG + single GEMM composition
+    (`kernels/nvidia/low_latency_allgather.py:48-217`).
+    """
+    cfg = (config or MatmulConfig()).resolve(chunks * mc, n, k)
+    nk = pl.cdiv(k, cfg.block_k)
+    bn = min(cfg.block_n, n)
+
+    def inner(a_blk, b_blk, o_blk, acc_ref):
+        kk = pl.program_id(1)
+
+        @pl.when(kk == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        a2 = a_blk[:].reshape(chunks * mc, a_blk.shape[-1])
+        acc_ref[:] += jnp.dot(a2, b_blk[:],
+                              preferred_element_type=jnp.float32)
+
+        @pl.when(kk == nk - 1)
+        def _():
+            o_blk[:] = acc_ref[:].reshape(o_blk.shape).astype(o_blk.dtype)
+
+    def run(acc_ref):
+        pipeline = pltpu.emit_pipeline(
+            functools.partial(inner, acc_ref=acc_ref),
+            grid=(pl.cdiv(n, bn), nk),
+            in_specs=[
+                pl.BlockSpec((chunks, mc, cfg.block_k),
+                             lambda j, kk: (0, 0, kk)),
+                pl.BlockSpec((cfg.block_k, bn), lambda j, kk: (kk, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((chunks, mc, bn), lambda j, kk: (0, 0, j)),
+            ],
+        )
+        pipeline(a_ref, b_ref, o_ref)
+
+    pl.run_scoped(
+        run,
+        acc_ref=pltpu.VMEM((chunks * mc, bn), jnp.float32),
+    )
+
+
+def round_up_rows(m: int, dtype) -> int:
+    """Pad row counts to the Mosaic sublane multiple for the dtype."""
+    min_rows = 16 if jnp.dtype(dtype).itemsize < 4 else 8
+    return (m + min_rows - 1) // min_rows * min_rows

@@ -108,24 +108,31 @@ def _emit_reduce_sum(src_ref, out_ref, *, world, m, n, block_m=256,
 # One-shot scatter + local reduce
 # ---------------------------------------------------------------------------
 
-def _scatter_reduce_kernel(ctx, m, n, x_ref, out_ref, rbuf_ref,
-                           local_sem, send_sem, recv_sems):
-    world = ctx.world_size
-    my = jax.lax.axis_index(ctx.axis)
-    dl.entry_barrier(ctx.axis, world)  # every peer puts into rbuf_ref
+def emit_scatter_reduce(axis, world, src_ref, out_ref, rbuf_ref,
+                        local_sem, send_sem, recv_sems, *, m, n,
+                        barrier: bool = True):
+    """One-shot scatter-reduce usable from inside larger kernels:
+    chunk c of ``src_ref`` (world, m, n) is put to owner c (1 hop, all
+    peers concurrent; slot = sender's rank on the receiver), then the
+    ``world`` received partials are summed into ``out_ref`` (m, n).
+    Shared by the standalone SCATTER_REDUCE collective and the fused
+    low-latency overlap kernels."""
+    my = jax.lax.axis_index(axis)
+    if barrier:
+        dl.entry_barrier(axis, world)  # every peer puts into rbuf_ref
 
     # Our own partial for our own chunk.
-    dl.local_copy(x_ref.at[my], rbuf_ref.at[my], local_sem)
+    dl.local_copy(src_ref.at[my], rbuf_ref.at[my], local_sem)
 
     # Push partial chunk c to owner c; slot = my rank on the receiver.
     for i in range(1, world):
         peer = jax.lax.rem(my + i, world)
         pltpu.make_async_remote_copy(
-            src_ref=x_ref.at[peer],
+            src_ref=src_ref.at[peer],
             dst_ref=rbuf_ref.at[my],
             send_sem=send_sem,
             recv_sem=recv_sems.at[my],
-            device_id=dl.peer_id(ctx.axis, peer),
+            device_id=dl.peer_id(axis, peer),
             device_id_type=pltpu.DeviceIdType.MESH,
         ).start()
 
@@ -139,6 +146,13 @@ def _scatter_reduce_kernel(ctx, m, n, x_ref, out_ref, rbuf_ref,
         dl.wait_send(rbuf_ref.at[my], send_sem)
 
     _emit_reduce_sum(rbuf_ref, out_ref, world=world, m=m, n=n)
+
+
+def _scatter_reduce_kernel(ctx, m, n, x_ref, out_ref, rbuf_ref,
+                           local_sem, send_sem, recv_sems):
+    emit_scatter_reduce(ctx.axis, ctx.world_size, x_ref, out_ref,
+                        rbuf_ref, local_sem, send_sem, recv_sems,
+                        m=m, n=n)
 
 
 # ---------------------------------------------------------------------------
