@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Multi-process SPMD launcher — the reference's `scripts/launch.sh`
+(torchrun + NVSHMEM env bootstrap) re-done for JAX.
+
+Spawns N copies of a script with the environment that
+`triton_distributed_tpu.parallel.mesh.initialize_distributed` reads
+(`TDT_NUM_PROCESSES` / `TDT_PROCESS_ID` / `TDT_COORDINATOR`), waits for
+all of them, and tears the group down on first failure — the role
+torchrun plays for the reference (RANK/WORLD_SIZE env + rendezvous).
+
+On a TPU pod each host launches one process (`--nproc` defaults to 1
+there; the TPU runtime supplies inter-host topology).  On CPU the same
+flow runs an N-process gloo-backed group on one machine — the
+multi-process test harness.
+
+Usage:
+    python scripts/launch.py --nproc 4 your_script.py [args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nproc", type=int, default=1,
+                    help="processes to spawn on this host")
+    ap.add_argument("--coordinator", default="127.0.0.1:12357",
+                    help="coordinator address (host:port)")
+    ap.add_argument("--node-rank", type=int, default=0,
+                    help="index of this host in a multi-host launch")
+    ap.add_argument("--nnodes", type=int, default=1)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (test harness)")
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+
+    world = args.nproc * args.nnodes
+    procs = []
+    for local in range(args.nproc):
+        rank = args.node_rank * args.nproc + local
+        env = dict(os.environ)
+        env["TDT_NUM_PROCESSES"] = str(world)
+        env["TDT_PROCESS_ID"] = str(rank)
+        env["TDT_COORDINATOR"] = args.coordinator
+        if args.cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+        procs.append(subprocess.Popen(
+            [sys.executable, args.script, *args.script_args], env=env))
+
+    rc = 0
+    try:
+        # First failure kills the group (a hung peer would otherwise
+        # deadlock the collectives).
+        pending = {p.pid: p for p in procs}
+        while pending and rc == 0:
+            pid, status = os.wait()
+            p = pending.pop(pid, None)
+            if p is None:
+                continue
+            code = os.waitstatus_to_exitcode(status)
+            if code != 0:
+                rc = code
+        for p in pending.values():
+            p.send_signal(signal.SIGTERM)
+        for p in pending.values():
+            p.wait()
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGINT)
+        rc = 130
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Real-TPU kernel sweep (VERDICT r1 next-step #6): compiles + checks
+# every Pallas kernel family with Mosaic on the attached chip(s).
+# The CPU harness (tests/) cannot catch Mosaic-acceptance breakage;
+# this can.  Usage: bash scripts/run_tpu.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m pytest tests_tpu -q "$@"

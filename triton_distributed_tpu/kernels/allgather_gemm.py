@@ -78,6 +78,7 @@ class AllGatherGEMMContext:
     LL_MAX_GATHERED_ROWS = 256
 
     def resolve_method(self, m: int, dtype) -> str:
+        assert self.method in ("auto", "fused", "ll", "xla"), self.method
         if self.method != "auto":
             return self.method
         if self.world_size <= 1:

@@ -63,6 +63,7 @@ class GEMMReduceScatterContext:
     LL_MAX_ROWS = 256
 
     def resolve_method(self, mc: int, dtype) -> str:
+        assert self.method in ("auto", "fused", "ll", "xla"), self.method
         if self.method != "auto":
             return self.method
         if self.world_size <= 1:

@@ -29,8 +29,15 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu.kernels.flash_attention import flash_attention
+from triton_distributed_tpu.language import core as dl
+from triton_distributed_tpu.utils.platform import (
+    comm_compiler_params,
+    default_interpret,
+)
 
 NEG_INF = -1e30
 
@@ -82,6 +89,310 @@ def sp_ring_attention(q, k_shard, v_shard, axis: str, *,
         src = jax.lax.rem(my - step - 1 + 2 * world, world)
         o_s, l_s = chunk_attend(kv, src)
         out, lse = _merge(out, lse, o_s, l_s)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Fully fused variant: ring producer + in-kernel flash consumer
+# ---------------------------------------------------------------------------
+
+def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
+                      b, h, group, sq, sk, d, block_q, block_k,
+                      prev=None, final=False):
+    """One chunk's flash attention over HBM refs, from inside a kernel,
+    merged with the running cross-chunk state in the same pipeline.
+
+    Same online-softmax math as `flash_attention._flash_kernel`, but
+    ``off`` (the causal-diagonal shift, q_global - kv_chunk_global) is
+    a *traced in-kernel scalar*, so the caller can attend chunks whose
+    origin rank is only known at run time.
+
+    ``prev`` is the previous chunks' (out, lse) state (f32 HBM refs) —
+    streamed in as extra pipeline inputs and merged at the last KV
+    block, so each ring step costs one state read + one state write
+    (no separate merge pass).  With ``final`` the merged result is
+    cast into ``out_o``'s dtype (the kernel output); otherwise it goes
+    to the f32 ping-pong state.
+    """
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    nq = pl.cdiv(sq, bq)
+    nk = pl.cdiv(sk, bk)
+
+    def inner(*refs, m_scr, l_scr, acc_scr):
+        if prev is not None:
+            q_blk, k_blk, v_blk, po_blk, pl_blk, oo_blk, ol_blk = refs
+        else:
+            q_blk, k_blk, v_blk, oo_blk, ol_blk = refs
+            po_blk = pl_blk = None
+        qi = pl.program_id(2)
+        ki = pl.program_id(3)
+
+        @pl.when(ki == 0)
+        def _():
+            m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+            l_scr[:] = jnp.zeros_like(l_scr)
+            acc_scr[:] = jnp.zeros_like(acc_scr)
+
+        q = q_blk[0, 0]
+        k = k_blk[0, 0]
+        v = v_blk[0, 0]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+        k_pos = (ki * bk
+                 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
+        if sk % bk != 0:
+            s = jnp.where(k_pos < sk, s, NEG_INF)
+        q_pos = (qi * bq
+                 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                 + off)
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+        @pl.when(ki == nk - 1)
+        def _():
+            l = jnp.maximum(l_scr[:], 1e-30)
+            o_c = acc_scr[:] / l
+            l_c = m_scr[:] + jnp.log(l)
+            if prev is not None:
+                la = pl_blk[0, 0]
+                m = jnp.maximum(jnp.maximum(la, l_c), NEG_INF / 2)
+                wa = jnp.exp(la - m)
+                wb = jnp.exp(l_c - m)
+                denom = jnp.maximum(wa + wb, 1e-30)
+                o_c = (po_blk[0, 0] * wa + o_c * wb) / denom
+                l_c = m + jnp.log(denom)
+            oo_blk[0, 0] = o_c.astype(oo_blk.dtype) if final else o_c
+            ol_blk[0, 0] = l_c
+
+    qspec = pl.BlockSpec((1, 1, bq, d),
+                         lambda bb, hh, qi, ki: (bb, hh, qi, 0))
+    lspec = pl.BlockSpec((1, 1, bq, 1),
+                         lambda bb, hh, qi, ki: (bb, hh, qi, 0))
+    kvspec = pl.BlockSpec((1, 1, bk, d),
+                          lambda bb, hh, qi, ki, g=group:
+                              (bb, hh // g, ki, 0))
+    in_specs = [qspec, kvspec, kvspec]
+    operands = [q_ref, k_ref, v_ref]
+    if prev is not None:
+        in_specs += [qspec, lspec]
+        operands += list(prev)
+
+    def run(m_scr, l_scr, acc_scr):
+        pipeline = pltpu.emit_pipeline(
+            functools.partial(inner, m_scr=m_scr, l_scr=l_scr,
+                              acc_scr=acc_scr),
+            grid=(b, h, nq, nk),
+            in_specs=in_specs,
+            out_specs=[qspec, lspec],
+        )
+        pipeline(*operands, out_o, out_l)
+
+    pl.run_scoped(
+        run,
+        m_scr=pltpu.VMEM((bq, 1), jnp.float32),
+        l_scr=pltpu.VMEM((bq, 1), jnp.float32),
+        acc_scr=pltpu.VMEM((bq, d), jnp.float32),
+    )
+
+
+def _sp_ag_attn_fused_kernel(axis, world, scale, block_q, block_k, group,
+                             b, h, hkv, s_loc, d,
+                             qoff_ref, base_ref,
+                             q_ref, k_ref, v_ref,
+                             o_ref, lse_ref, kbuf_ref, vbuf_ref,
+                             sto_ref, stl_ref,
+                             local_sem, ksend_sem, vsend_sem,
+                             krecv_sems, vrecv_sems):
+    """The reference's signature attention trick in one Pallas kernel
+    (`sp_ag_attention_intra_node.py:105-430`): the ring producer DMAs
+    the freshest KV chunk to the right neighbor while the flash
+    consumer attends the chunk already held, waiting each next chunk's
+    recv semaphore — per-chunk readiness flags, not a bulk gather.
+    The running (out, lse) state ping-pongs between two f32 HBM
+    buffers; each chunk's flash pipeline streams the previous state in
+    and writes the merged state out (one read + one write per step)."""
+    my = jax.lax.axis_index(axis)
+    right = jax.lax.rem(my + 1, world)
+    q_off = qoff_ref[0]
+    base = base_ref[0]
+
+    dl.entry_barrier(axis, world, neighbors_only=True)
+    dl.local_copy(k_ref, kbuf_ref.at[my], local_sem)
+    dl.local_copy(v_ref, vbuf_ref.at[my], local_sem)
+
+    for s in range(world):
+        chunk = jax.lax.rem(my - s + 2 * world, world)
+        rk = rv = None
+        if s < world - 1:
+            rk = pltpu.make_async_remote_copy(
+                src_ref=kbuf_ref.at[chunk], dst_ref=kbuf_ref.at[chunk],
+                send_sem=ksend_sem, recv_sem=krecv_sems.at[chunk],
+                device_id=dl.peer_id(axis, right),
+                device_id_type=pltpu.DeviceIdType.MESH)
+            rv = pltpu.make_async_remote_copy(
+                src_ref=vbuf_ref.at[chunk], dst_ref=vbuf_ref.at[chunk],
+                send_sem=vsend_sem, recv_sem=vrecv_sems.at[chunk],
+                device_id=dl.peer_id(axis, right),
+                device_id_type=pltpu.DeviceIdType.MESH)
+            rk.start()
+            rv.start()
+
+        # Attend the chunk we hold while the DMA ships it onward,
+        # merging into the running state within the same pipeline.
+        final = s == world - 1
+        _emit_flash_chunk(
+            q_ref, kbuf_ref.at[chunk], vbuf_ref.at[chunk],
+            o_ref if final else sto_ref.at[s % 2],
+            lse_ref if final else stl_ref.at[s % 2],
+            off=q_off - (base + chunk * s_loc), scale=scale,
+            b=b, h=h, group=group, sq=s_loc, sk=s_loc, d=d,
+            block_q=block_q, block_k=block_k,
+            prev=(None if s == 0
+                  else (sto_ref.at[(s - 1) % 2], stl_ref.at[(s - 1) % 2])),
+            final=final)
+
+        if rk is not None:
+            nxt = jax.lax.rem(my - s - 1 + 2 * world, world)
+            dl.wait_recv(kbuf_ref.at[nxt], krecv_sems.at[nxt])
+            dl.wait_recv(vbuf_ref.at[nxt], vrecv_sems.at[nxt])
+            rk.wait_send()
+            rv.wait_send()
+
+
+def sp_ag_attention_fused(q, k_shard, v_shard, axis: str, *,
+                          scale: Optional[float] = None,
+                          block_q: int = 128, block_k: int = 128,
+                          q_offset=None, kv_base=0,
+                          return_lse: bool = False,
+                          collective_id: int = 11,
+                          interpret: Optional[bool] = None):
+    """Fully fused SP allgather-attention (causal prefill).  Call
+    inside shard_map over `axis`.
+
+    One Pallas kernel: KV shards ride the ICI ring chunk-by-chunk while
+    the flash consumer folds each held chunk into the running
+    online-softmax state; per-chunk DMA recv semaphores are the
+    readiness flags the reference's persistent consumer spins on
+    (`kernel_consumer_flash_attn_forward:256`).
+
+    q: (B, H, S_loc, D); k/v_shard: (B, Hkv, S_loc, D).
+    ``q_offset``/``kv_base`` (traced ints) place this rank's queries
+    and the KV chunks in the *global* sequence (defaults: rank * S_loc
+    and 0) — the hooks the two-level variant uses.  Chunks entirely in
+    the causal future still traverse the ring (their contribution
+    merges out at lse ≈ -inf), matching the reference's all-chunk
+    schedule.
+    """
+    world = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    b, h, s_loc, d = q.shape
+    _, hkv, sk, _ = k_shard.shape
+    assert sk == s_loc and h % hkv == 0, (q.shape, k_shard.shape)
+    scale = scale if scale is not None else d ** -0.5
+    if q_offset is None:
+        q_offset = my * s_loc
+
+    if world == 1:
+        out, lse = flash_attention(
+            q, k_shard, v_shard, causal=True, scale=scale,
+            kv_offset=jnp.asarray(q_offset) - jnp.asarray(kv_base),
+            return_lse=True, block_q=block_q, block_k=block_k,
+            interpret=interpret)
+        return (out, lse) if return_lse else out
+
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    base = jnp.asarray(kv_base, jnp.int32).reshape(1)
+
+    out, lse, *_ = pl.pallas_call(
+        functools.partial(_sp_ag_attn_fused_kernel, axis, world, scale,
+                          block_q, block_k, h // hkv, b, h, hkv, s_loc, d),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, s_loc, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s_loc, 1), jnp.float32),
+            jax.ShapeDtypeStruct((world, b, hkv, s_loc, d), q.dtype),
+            jax.ShapeDtypeStruct((world, b, hkv, s_loc, d), q.dtype),
+            jax.ShapeDtypeStruct((2, b, h, s_loc, d), jnp.float32),
+            jax.ShapeDtypeStruct((2, b, h, s_loc, 1), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 6,
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((world,)),
+            pltpu.SemaphoreType.DMA((world,)),
+        ],
+        compiler_params=comm_compiler_params(collective_id, world),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * s_loc * world * s_loc * d,
+            # q re-read per chunk + 2x KV ring buffers + f32 state
+            # ping-pong (read + write per step).
+            bytes_accessed=(world * b * h * s_loc * d * q.dtype.itemsize
+                            + 2 * world * b * hkv * s_loc * d
+                            * q.dtype.itemsize
+                            + 2 * world * b * h * s_loc * d * 4),
+            transcendentals=b * h * s_loc * world * s_loc,
+        ),
+        interpret=default_interpret(interpret),
+    )(qoff, base, q, k_shard, v_shard)
+    if return_lse:
+        return out, lse[..., 0]
+    return out
+
+
+def sp_ag_attention_2d(q, k_shard, v_shard, hctx, *,
+                       scale: Optional[float] = None,
+                       block_q: int = 128, block_k: int = 128,
+                       interpret: Optional[bool] = None):
+    """Two-level SP attention (reference:
+    `sp_ag_attention_inter_node.py:115,504`): KV shards cross DCN once
+    (XLA all_gather between slices), then each slice's worth of KV is
+    attended with the fused intra-slice ring kernel; the per-slice
+    partials merge by lse.  Sequence layout: global rank
+    g = dcn * ici_size + ici owns rows [g*S_loc, (g+1)*S_loc).
+
+    ``hctx``: `kernels.hierarchical.HierarchicalContext`.
+    """
+    dcn, ici = hctx.dcn_size, hctx.ici_size
+    my_d = jax.lax.axis_index(hctx.dcn_axis)
+    my_i = jax.lax.axis_index(hctx.ici_axis)
+    s_loc = q.shape[2]
+    q_off = (my_d * ici + my_i) * s_loc
+
+    kd = jax.lax.all_gather(k_shard, hctx.dcn_axis, tiled=False)
+    vd = jax.lax.all_gather(v_shard, hctx.dcn_axis, tiled=False)
+
+    out = lse = None
+    for s in range(dcn):
+        o_s, l_s = sp_ag_attention_fused(
+            q, kd[s], vd[s], hctx.ici_axis, scale=scale,
+            block_q=block_q, block_k=block_k,
+            q_offset=q_off, kv_base=s * ici * s_loc, return_lse=True,
+            collective_id=hctx.collective_id, interpret=interpret)
+        if out is None:
+            out, lse = o_s.astype(jnp.float32), l_s
+        else:
+            out, lse = _merge(out, lse, o_s, l_s)
     return out.astype(q.dtype)
 
 

@@ -145,7 +145,13 @@ def initialize_distributed(
     and ICI needs no handshake.
     """
     global _GLOBAL_CONTEXT
+    # Env plumbed by scripts/launch.py (the torchrun-equivalent);
+    # explicit args win, mirroring the reference's RANK/WORLD_SIZE.
     num_processes = num_processes or int(os.environ.get("TDT_NUM_PROCESSES", "1"))
+    if process_id is None and "TDT_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["TDT_PROCESS_ID"])
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("TDT_COORDINATOR")
     if num_processes > 1 or coordinator_address is not None:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
