@@ -1,13 +1,19 @@
-// tdt_aot_runtime implementation — see tdt_aot_runtime.h.
+// tdt_aot_runtime bundle loader — see tdt_aot_runtime.h.
 //
-// Bundle layout (written by tools/compile_aot.py):
-//   manifest.json   human-readable metadata
-//   index.bin       TLV index parsed here:
-//                     u32 magic 'TDTA', u32 version,
-//                     u32 n, then per variant:
-//                       u16 name_len, name bytes,
-//                       u16 file_len, file bytes
-//   *.jaxexp        serialized jax.export payloads
+// Bundle layout (written by tools/compile_aot.py +
+// tools/native.py:write_bundle_index):
+//   manifest.json        human-readable metadata
+//   compile_options.pb   serialized XLA CompileOptionsProto
+//   index.bin            TLV v2 index parsed here:
+//                          u32 magic 'TDTA', u32 version (2),
+//                          u32 n, then per variant:
+//                            pstr name, pstr jaxexp file, pstr mlir file,
+//                            sig args, sig outs
+//                          where pstr = u16 len + bytes and
+//                          sig = u16 count + per entry
+//                            (u8 dtype, u8 rank, i64 dims[rank])
+//   *.jaxexp             serialized jax.export payloads (Python path)
+//   *.mlirbc             StableHLO bytecode (native PJRT path)
 
 #include "tdt_aot_runtime.h"
 
@@ -16,28 +22,14 @@
 #include <string>
 #include <vector>
 
+#include "tdt_internal.h"
+
 namespace {
 
 constexpr uint32_t kMagic = 0x41544454;  // "TDTA" little-endian
-constexpr uint32_t kVersion = 1;
-
-struct Variant {
-  std::string name;
-  std::string file;
-};
+constexpr uint32_t kVersion = 2;
 
 }  // namespace
-
-struct tdt_bundle {
-  std::string path;
-  std::vector<Variant> variants;
-};
-
-struct tdt_executable {
-  std::vector<uint8_t> bytes;
-};
-
-static std::string g_pjrt_library;
 
 extern "C" {
 
@@ -53,9 +45,30 @@ tdt_status tdt_bundle_open(const char* path, tdt_bundle** out) {
   auto read_u16 = [&](uint16_t* v) {
     return std::fread(v, sizeof(uint16_t), 1, f) == 1;
   };
-  auto read_str = [&](std::string* s, uint16_t len) {
+  auto read_str = [&](std::string* s) {
+    uint16_t len = 0;
+    if (!read_u16(&len)) return false;
     s->resize(len);
     return len == 0 || std::fread(&(*s)[0], 1, len, f) == len;
+  };
+  auto read_sigs = [&](std::vector<tdt_sig>* sigs) {
+    uint16_t n = 0;
+    if (!read_u16(&n) || n > 256) return false;
+    sigs->resize(n);
+    for (auto& s : *sigs) {
+      uint8_t dt = 0, rank = 0;
+      if (std::fread(&dt, 1, 1, f) != 1 ||
+          std::fread(&rank, 1, 1, f) != 1 || rank > TDT_MAX_RANK)
+        return false;
+      s.dtype = dt;
+      s.rank = rank;
+      std::memset(s.dims, 0, sizeof(s.dims));
+      for (int i = 0; i < rank; ++i) {
+        if (std::fread(&s.dims[i], sizeof(int64_t), 1, f) != 1)
+          return false;
+      }
+    }
+    return true;
   };
 
   uint32_t magic = 0, version = 0, n = 0;
@@ -68,10 +81,10 @@ tdt_status tdt_bundle_open(const char* path, tdt_bundle** out) {
   auto* b = new tdt_bundle();
   b->path = path;
   for (uint32_t i = 0; i < n; ++i) {
-    uint16_t ln = 0, lf = 0;
-    Variant v;
-    if (!read_u16(&ln) || !read_str(&v.name, ln) || !read_u16(&lf) ||
-        !read_str(&v.file, lf)) {
+    TdtVariant v;
+    if (!read_str(&v.name) || !read_str(&v.file) ||
+        !read_str(&v.mlir_file) || !read_sigs(&v.args) ||
+        !read_sigs(&v.outs)) {
       delete b;
       std::fclose(f);
       return TDT_ERR_FORMAT;
@@ -95,37 +108,72 @@ const char* tdt_bundle_variant_name(const tdt_bundle* b, int i) {
   return b->variants[i].name.c_str();
 }
 
+const TdtVariant* tdt_find_variant(const tdt_bundle* b,
+                                   const char* variant) {
+  if (!b || !variant) return nullptr;
+  for (const auto& v : b->variants)
+    if (v.name == variant) return &v;
+  return nullptr;
+}
+
+int tdt_bundle_variant_arity(const tdt_bundle* b, const char* variant,
+                             int* nargs, int* nouts) {
+  const TdtVariant* v = tdt_find_variant(b, variant);
+  if (!v) return -1;
+  if (nargs) *nargs = static_cast<int>(v->args.size());
+  if (nouts) *nouts = static_cast<int>(v->outs.size());
+  return 0;
+}
+
+const tdt_sig* tdt_bundle_arg_sig(const tdt_bundle* b, const char* variant,
+                                  int i) {
+  const TdtVariant* v = tdt_find_variant(b, variant);
+  if (!v || i < 0 || i >= static_cast<int>(v->args.size())) return nullptr;
+  return &v->args[i];
+}
+
+const tdt_sig* tdt_bundle_out_sig(const tdt_bundle* b, const char* variant,
+                                  int i) {
+  const TdtVariant* v = tdt_find_variant(b, variant);
+  if (!v || i < 0 || i >= static_cast<int>(v->outs.size())) return nullptr;
+  return &v->outs[i];
+}
+
+bool tdt_read_file(const std::string& path, std::vector<uint8_t>* out) {
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  // Negative/absurd sizes (ftell failure, fopen of a directory) must
+  // surface as a clean false, not a resize() throw across the C ABI.
+  if (sz < 0 || sz > (1L << 33)) {
+    std::fclose(f);
+    return false;
+  }
+  std::fseek(f, 0, SEEK_SET);
+  out->resize(sz);
+  bool ok = sz == 0 || std::fread(out->data(), 1, sz, f) ==
+                           static_cast<size_t>(sz);
+  std::fclose(f);
+  return ok;
+}
+
+struct tdt_executable {
+  std::vector<uint8_t> bytes;
+};
+
 tdt_status tdt_bundle_load_variant(tdt_bundle* b, const char* variant,
                                    tdt_executable** out) {
-  if (!b || !variant || !out) return TDT_ERR_IO;
-  for (const auto& v : b->variants) {
-    if (v.name == variant) {
-      std::string fn = b->path + "/" + v.file;
-      FILE* f = std::fopen(fn.c_str(), "rb");
-      if (!f) return TDT_ERR_IO;
-      std::fseek(f, 0, SEEK_END);
-      long sz = std::ftell(f);
-      std::fseek(f, 0, SEEK_SET);
-      auto* e = new tdt_executable();
-      e->bytes.resize(sz);
-      if (sz > 0 &&
-          std::fread(e->bytes.data(), 1, sz, f) !=
-              static_cast<size_t>(sz)) {
-        delete e;
-        std::fclose(f);
-        return TDT_ERR_IO;
-      }
-      std::fclose(f);
-      // jax.export payloads are flatbuffers-framed; sanity check size.
-      if (e->bytes.size() < 16) {
-        delete e;
-        return TDT_ERR_FORMAT;
-      }
-      *out = e;
-      return TDT_OK;
-    }
+  const TdtVariant* v = tdt_find_variant(b, variant);
+  if (!v || !out) return v ? TDT_ERR_IO : TDT_ERR_NOT_FOUND;
+  auto* e = new tdt_executable();
+  if (!tdt_read_file(b->path + "/" + v->file, &e->bytes) ||
+      e->bytes.size() < 16) {
+    delete e;
+    return TDT_ERR_IO;
   }
-  return TDT_ERR_NOT_FOUND;
+  *out = e;
+  return TDT_OK;
 }
 
 void tdt_executable_free(tdt_executable* e) { delete e; }
@@ -138,24 +186,13 @@ size_t tdt_executable_size(const tdt_executable* e) {
   return e ? e->bytes.size() : 0;
 }
 
-tdt_status tdt_set_pjrt_library(const char* libtpu_path) {
-  if (!libtpu_path) return TDT_ERR_IO;
-  g_pjrt_library = libtpu_path;
-  return TDT_OK;
-}
-
-tdt_status tdt_executable_execute(tdt_executable* e, const void** args,
-                                  int nargs, void** outs, int nouts) {
-  (void)e;
-  (void)args;
-  (void)nargs;
-  (void)outs;
-  (void)nouts;
-  // Dispatch through the PJRT C API (dlopen(g_pjrt_library) →
-  // GetPjrtApi → compile+execute). Deferred until a PJRT SDK with
-  // stable headers is vendored; callers fall back to the Python
-  // executor (tools.compile_aot.load_bundle).
-  return TDT_ERR_NO_BACKEND;
+size_t tdt_sig_bytes(const tdt_sig* s) {
+  if (!s) return 0;
+  static const size_t kItem[] = {4, 2, 2, 4, 8, 1, 1, 1};
+  if (s->dtype >= sizeof(kItem) / sizeof(kItem[0])) return 0;
+  size_t n = kItem[s->dtype];
+  for (int i = 0; i < s->rank; ++i) n *= static_cast<size_t>(s->dims[i]);
+  return n;
 }
 
 const char* tdt_status_str(tdt_status s) {
@@ -165,6 +202,7 @@ const char* tdt_status_str(tdt_status s) {
     case TDT_ERR_FORMAT: return "bad bundle format";
     case TDT_ERR_NOT_FOUND: return "variant not found";
     case TDT_ERR_NO_BACKEND: return "no pjrt backend linked";
+    case TDT_ERR_PJRT: return "pjrt error (see tdt_last_error)";
   }
   return "unknown";
 }

@@ -1,0 +1,177 @@
+/* End-to-end native AOT test (reference analogue: a C consumer of
+ * triton_aot_runtime): load a bundle, create a PJRT client from a
+ * plugin .so, compile the variant's StableHLO, execute it on test
+ * vectors shipped in the bundle, and compare against the expected
+ * outputs — no Python anywhere in the process.
+ *
+ * Usage: aot_test <bundle_dir> <variant> <plugin.so>
+ * Client-create options come from TDT_PJRT_OPTIONS, a
+ * "key=value;key=value" string (values parsed as int64 when they look
+ * like integers — matching how JAX passes plugin options).
+ * Test vectors: <bundle>/test_arg<i>.bin, <bundle>/test_out<i>.bin
+ * (raw dense bytes in the signature's dtype).
+ */
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "tdt_aot_runtime.h"
+
+#define MAX_IO 16
+#define MAX_OPTS 32
+
+static void *read_file(const char *path, size_t expect) {
+  FILE *f = fopen(path, "rb");
+  if (!f) {
+    fprintf(stderr, "cannot open %s\n", path);
+    return NULL;
+  }
+  void *buf = malloc(expect);
+  size_t got = fread(buf, 1, expect, f);
+  fclose(f);
+  if (got != expect) {
+    fprintf(stderr, "%s: got %zu bytes, want %zu\n", path, got, expect);
+    free(buf);
+    return NULL;
+  }
+  return buf;
+}
+
+static int parse_options(char *spec, tdt_option *opts) {
+  int n = 0;
+  for (char *tok = strtok(spec, ";"); tok && n < MAX_OPTS;
+       tok = strtok(NULL, ";")) {
+    char *eq = strchr(tok, '=');
+    if (!eq) continue;
+    *eq = '\0';
+    opts[n].name = tok;
+    char *end = NULL;
+    long long v = strtoll(eq + 1, &end, 10);
+    if (end && *end == '\0' && end != eq + 1) {
+      opts[n].is_int = 1;
+      opts[n].int_value = v;
+      opts[n].str_value = NULL;
+    } else {
+      opts[n].is_int = 0;
+      opts[n].str_value = eq + 1;
+    }
+    ++n;
+  }
+  return n;
+}
+
+static float as_float(const unsigned char *p, int dtype, size_t i) {
+  if (dtype == TDT_F32) {
+    float v;
+    memcpy(&v, p + 4 * i, 4);
+    return v;
+  }
+  if (dtype == TDT_BF16) {
+    unsigned int bits = (unsigned int)(p[2 * i] | (p[2 * i + 1] << 8)) << 16;
+    float v;
+    memcpy(&v, &bits, 4);
+    return v;
+  }
+  if (dtype == TDT_I32) {
+    int v;
+    memcpy(&v, p + 4 * i, 4);
+    return (float)v;
+  }
+  return 0.0f;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <bundle_dir> <variant> <plugin.so>\n",
+            argv[0]);
+    return 2;
+  }
+  const char *bundle_dir = argv[1], *variant = argv[2], *plugin = argv[3];
+
+  tdt_bundle *bundle = NULL;
+  tdt_status rc = tdt_bundle_open(bundle_dir, &bundle);
+  if (rc != TDT_OK) {
+    fprintf(stderr, "bundle_open: %s\n", tdt_status_str(rc));
+    return 1;
+  }
+  int nargs = 0, nouts = 0;
+  if (tdt_bundle_variant_arity(bundle, variant, &nargs, &nouts) != 0 ||
+      nargs > MAX_IO || nouts > MAX_IO) {
+    fprintf(stderr, "bad variant %s\n", variant);
+    return 1;
+  }
+
+  tdt_option opts[MAX_OPTS];
+  int nopts = 0;
+  char *spec = getenv("TDT_PJRT_OPTIONS");
+  char spec_buf[2048];
+  if (spec) {
+    snprintf(spec_buf, sizeof(spec_buf), "%s", spec);
+    nopts = parse_options(spec_buf, opts);
+  }
+
+  tdt_client *client = NULL;
+  rc = tdt_client_create(plugin, opts, nopts, &client);
+  if (rc != TDT_OK) {
+    fprintf(stderr, "client_create: %s: %s\n", tdt_status_str(rc),
+            tdt_last_error());
+    return 1;
+  }
+  fprintf(stderr, "client created\n");
+
+  tdt_compiled *exe = NULL;
+  rc = tdt_client_compile(client, bundle, variant, &exe);
+  if (rc != TDT_OK) {
+    fprintf(stderr, "compile: %s: %s\n", tdt_status_str(rc),
+            tdt_last_error());
+    return 1;
+  }
+  fprintf(stderr, "compiled\n");
+
+  const void *args[MAX_IO] = {0};
+  void *outs[MAX_IO] = {0};
+  void *expected[MAX_IO] = {0};
+  char path[1024];
+  for (int i = 0; i < nargs; i++) {
+    const tdt_sig *s = tdt_bundle_arg_sig(bundle, variant, i);
+    snprintf(path, sizeof(path), "%s/test_arg%d.bin", bundle_dir, i);
+    if (!(args[i] = read_file(path, tdt_sig_bytes(s)))) return 1;
+  }
+  for (int i = 0; i < nouts; i++) {
+    const tdt_sig *s = tdt_bundle_out_sig(bundle, variant, i);
+    outs[i] = malloc(tdt_sig_bytes(s));
+    snprintf(path, sizeof(path), "%s/test_out%d.bin", bundle_dir, i);
+    if (!(expected[i] = read_file(path, tdt_sig_bytes(s)))) return 1;
+  }
+
+  rc = tdt_compiled_execute(exe, args, outs);
+  if (rc != TDT_OK) {
+    fprintf(stderr, "execute: %s: %s\n", tdt_status_str(rc),
+            tdt_last_error());
+    return 1;
+  }
+
+  double max_err = 0.0, max_ref = 1e-9;
+  for (int i = 0; i < nouts; i++) {
+    const tdt_sig *s = tdt_bundle_out_sig(bundle, variant, i);
+    size_t item = s->dtype == TDT_BF16 ? 2 : 4;
+    size_t n = tdt_sig_bytes(s) / item;
+    for (size_t j = 0; j < n; j++) {
+      double got = as_float((unsigned char *)outs[i], s->dtype, j);
+      double ref = as_float((unsigned char *)expected[i], s->dtype, j);
+      double err = fabs(got - ref);
+      if (err > max_err) max_err = err;
+      if (fabs(ref) > max_ref) max_ref = fabs(ref);
+    }
+  }
+  double rel = max_err / max_ref;
+  int ok = rel < 5e-2;
+  printf("AOT_NATIVE_%s maxrelerr=%g\n", ok ? "OK" : "FAIL", rel);
+
+  tdt_compiled_free(exe);
+  tdt_client_destroy(client);
+  tdt_bundle_close(bundle);
+  return ok ? 0 : 1;
+}

@@ -1,0 +1,322 @@
+// Native PJRT execution of AOT bundles — the half of the reference's
+// `tools/runtime/triton_aot_runtime.cc` that actually launches kernels
+// (there: cuModuleLoad + cuLaunchKernel against the CUDA driver; here:
+// PJRT_Client_Compile + PJRT_LoadedExecutable_Execute against any
+// PJRT C-API plugin .so).
+//
+// The public PJRT C API header (xla/pjrt/c/pjrt_c_api.h) is a
+// self-contained, versioned struct-size-negotiated C header — the
+// stable ABI XLA ships precisely for out-of-tree runtimes like this.
+
+#include <dlfcn.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "tdt_internal.h"
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+// Records err's message (and destroys it). Returns true if err != null.
+bool CheckFailed(const PJRT_Api* api, PJRT_Error* err) {
+  if (!err) return false;
+  PJRT_Error_Message_Args margs;
+  std::memset(&margs, 0, sizeof(margs));
+  margs.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  margs.error = err;
+  api->PJRT_Error_Message(&margs);
+  g_last_error.assign(margs.message, margs.message_size);
+  PJRT_Error_Destroy_Args dargs;
+  std::memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  dargs.error = err;
+  api->PJRT_Error_Destroy(&dargs);
+  return true;
+}
+
+bool AwaitEvent(const PJRT_Api* api, PJRT_Event* event) {
+  if (!event) return true;
+  PJRT_Event_Await_Args aargs;
+  std::memset(&aargs, 0, sizeof(aargs));
+  aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  aargs.event = event;
+  bool ok = !CheckFailed(api, api->PJRT_Event_Await(&aargs));
+  PJRT_Event_Destroy_Args dargs;
+  std::memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  dargs.event = event;
+  api->PJRT_Event_Destroy(&dargs);
+  return ok;
+}
+
+PJRT_Buffer_Type ToPjrtType(uint8_t dt) {
+  switch (dt) {
+    case TDT_F32: return PJRT_Buffer_Type_F32;
+    case TDT_BF16: return PJRT_Buffer_Type_BF16;
+    case TDT_F16: return PJRT_Buffer_Type_F16;
+    case TDT_I32: return PJRT_Buffer_Type_S32;
+    case TDT_I64: return PJRT_Buffer_Type_S64;
+    case TDT_U8: return PJRT_Buffer_Type_U8;
+    case TDT_I8: return PJRT_Buffer_Type_S8;
+    case TDT_BOOL: return PJRT_Buffer_Type_PRED;
+  }
+  return PJRT_Buffer_Type_INVALID;
+}
+
+}  // namespace
+
+struct tdt_client {
+  void* dl = nullptr;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+  PJRT_Device* device = nullptr;
+};
+
+struct tdt_compiled {
+  tdt_client* owner = nullptr;
+  PJRT_LoadedExecutable* exec = nullptr;
+  std::vector<tdt_sig> args;
+  std::vector<tdt_sig> outs;
+};
+
+extern "C" {
+
+const char* tdt_last_error(void) { return g_last_error.c_str(); }
+
+tdt_status tdt_client_create(const char* plugin_so, const tdt_option* opts,
+                             int nopts, tdt_client** out) {
+  if (!plugin_so || !out) return TDT_ERR_IO;
+  void* dl = dlopen(plugin_so, RTLD_NOW | RTLD_LOCAL);
+  if (!dl) {
+    g_last_error = dlerror();
+    return TDT_ERR_NO_BACKEND;
+  }
+  using GetPjrtApiFn = const PJRT_Api* (*)();
+  auto get_api =
+      reinterpret_cast<GetPjrtApiFn>(dlsym(dl, "GetPjrtApi"));
+  if (!get_api) {
+    g_last_error = "GetPjrtApi not exported by plugin";
+    dlclose(dl);
+    return TDT_ERR_NO_BACKEND;
+  }
+  const PJRT_Api* api = get_api();
+
+  // Past this point the plugin may have spawned threads / registered
+  // process state: never dlclose on failure (same invariant as
+  // tdt_client_destroy).
+  PJRT_Plugin_Initialize_Args init;
+  std::memset(&init, 0, sizeof(init));
+  init.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  if (CheckFailed(api, api->PJRT_Plugin_Initialize(&init)))
+    return TDT_ERR_PJRT;
+
+  std::vector<PJRT_NamedValue> values(nopts);
+  for (int i = 0; i < nopts; ++i) {
+    std::memset(&values[i], 0, sizeof(PJRT_NamedValue));
+    values[i].struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    values[i].name = opts[i].name;
+    values[i].name_size = std::strlen(opts[i].name);
+    if (opts[i].is_int) {
+      values[i].type = PJRT_NamedValue_kInt64;
+      values[i].int64_value = opts[i].int_value;
+      values[i].value_size = 1;
+    } else {
+      values[i].type = PJRT_NamedValue_kString;
+      values[i].string_value = opts[i].str_value;
+      values[i].value_size = std::strlen(opts[i].str_value);
+    }
+  }
+
+  PJRT_Client_Create_Args cargs;
+  std::memset(&cargs, 0, sizeof(cargs));
+  cargs.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  cargs.create_options = values.data();
+  cargs.num_options = values.size();
+  if (CheckFailed(api, api->PJRT_Client_Create(&cargs)))
+    return TDT_ERR_PJRT;
+
+  PJRT_Client_AddressableDevices_Args dargs;
+  std::memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  dargs.client = cargs.client;
+  if (CheckFailed(api, api->PJRT_Client_AddressableDevices(&dargs)) ||
+      dargs.num_addressable_devices == 0) {
+    if (g_last_error.empty()) g_last_error = "no addressable devices";
+    PJRT_Client_Destroy_Args cd;
+    std::memset(&cd, 0, sizeof(cd));
+    cd.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+    cd.client = cargs.client;
+    api->PJRT_Client_Destroy(&cd);
+    return TDT_ERR_PJRT;
+  }
+
+  auto* c = new tdt_client();
+  c->dl = dl;
+  c->api = api;
+  c->client = cargs.client;
+  c->device = dargs.addressable_devices[0];
+  *out = c;
+  return TDT_OK;
+}
+
+void tdt_client_destroy(tdt_client* c) {
+  if (!c) return;
+  if (c->client) {
+    PJRT_Client_Destroy_Args args;
+    std::memset(&args, 0, sizeof(args));
+    args.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+    args.client = c->client;
+    c->api->PJRT_Client_Destroy(&args);
+  }
+  // Leave the .so mapped: plugins commonly register atexit state.
+  delete c;
+}
+
+tdt_status tdt_client_compile(tdt_client* c, tdt_bundle* b,
+                              const char* variant, tdt_compiled** out) {
+  if (!c || !b || !out) return TDT_ERR_IO;
+  const TdtVariant* v = tdt_find_variant(b, variant);
+  if (!v) return TDT_ERR_NOT_FOUND;
+  if (v->mlir_file.empty()) return TDT_ERR_FORMAT;
+
+  std::vector<uint8_t> mlir, copts;
+  if (!tdt_read_file(b->path + "/" + v->mlir_file, &mlir) ||
+      !tdt_read_file(b->path + "/compile_options.pb", &copts))
+    return TDT_ERR_IO;
+
+  PJRT_Program program;
+  std::memset(&program, 0, sizeof(program));
+  program.struct_size = PJRT_Program_STRUCT_SIZE;
+  program.code = reinterpret_cast<char*>(mlir.data());
+  program.code_size = mlir.size();
+  program.format = "mlir";
+  program.format_size = 4;
+
+  PJRT_Client_Compile_Args cargs;
+  std::memset(&cargs, 0, sizeof(cargs));
+  cargs.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  cargs.client = c->client;
+  cargs.program = &program;
+  cargs.compile_options = reinterpret_cast<const char*>(copts.data());
+  cargs.compile_options_size = copts.size();
+  if (CheckFailed(c->api, c->api->PJRT_Client_Compile(&cargs)))
+    return TDT_ERR_PJRT;
+
+  auto* e = new tdt_compiled();
+  e->owner = c;
+  e->exec = cargs.executable;
+  e->args = v->args;
+  e->outs = v->outs;
+  *out = e;
+  return TDT_OK;
+}
+
+void tdt_compiled_free(tdt_compiled* e) {
+  if (!e) return;
+  if (e->exec) {
+    PJRT_LoadedExecutable_Destroy_Args args;
+    std::memset(&args, 0, sizeof(args));
+    args.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+    args.executable = e->exec;
+    e->owner->api->PJRT_LoadedExecutable_Destroy(&args);
+  }
+  delete e;
+}
+
+tdt_status tdt_compiled_execute(tdt_compiled* e, const void** args,
+                                void** outs) {
+  if (!e || (!args && !e->args.empty()) ||
+      (!outs && !e->outs.empty()))
+    return TDT_ERR_IO;
+  const PJRT_Api* api = e->owner->api;
+  const size_t nargs = e->args.size();
+  const size_t nouts = e->outs.size();
+
+  // Host → device.
+  std::vector<PJRT_Buffer*> in_bufs(nargs, nullptr);
+  tdt_status rc = TDT_OK;
+  for (size_t i = 0; i < nargs && rc == TDT_OK; ++i) {
+    PJRT_Client_BufferFromHostBuffer_Args h2d;
+    std::memset(&h2d, 0, sizeof(h2d));
+    h2d.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    h2d.client = e->owner->client;
+    h2d.data = args[i];
+    h2d.type = ToPjrtType(e->args[i].dtype);
+    h2d.dims = e->args[i].dims;
+    h2d.num_dims = e->args[i].rank;
+    h2d.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    h2d.device = e->owner->device;
+    if (CheckFailed(api, api->PJRT_Client_BufferFromHostBuffer(&h2d))) {
+      rc = TDT_ERR_PJRT;
+      break;
+    }
+    in_bufs[i] = h2d.buffer;
+    if (!AwaitEvent(api, h2d.done_with_host_buffer)) rc = TDT_ERR_PJRT;
+  }
+
+  // Execute.
+  std::vector<PJRT_Buffer*> out_bufs(nouts ? nouts : 1, nullptr);
+  if (rc == TDT_OK) {
+    PJRT_ExecuteOptions eopts;
+    std::memset(&eopts, 0, sizeof(eopts));
+    eopts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+    PJRT_Buffer* const* arg_list = in_bufs.data();
+    PJRT_Buffer** out_list = out_bufs.data();
+    PJRT_Event* done = nullptr;
+
+    PJRT_LoadedExecutable_Execute_Args ex;
+    std::memset(&ex, 0, sizeof(ex));
+    ex.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    ex.executable = e->exec;
+    ex.options = &eopts;
+    ex.argument_lists = &arg_list;
+    ex.num_devices = 1;
+    ex.num_args = nargs;
+    ex.output_lists = &out_list;
+    ex.device_complete_events = &done;
+    if (CheckFailed(api, api->PJRT_LoadedExecutable_Execute(&ex)))
+      rc = TDT_ERR_PJRT;
+    else if (!AwaitEvent(api, done))
+      rc = TDT_ERR_PJRT;
+  }
+
+  // Device → host.
+  for (size_t i = 0; i < nouts && rc == TDT_OK; ++i) {
+    PJRT_Buffer_ToHostBuffer_Args d2h;
+    std::memset(&d2h, 0, sizeof(d2h));
+    d2h.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    d2h.src = out_bufs[i];
+    d2h.dst = outs[i];
+    d2h.dst_size = tdt_sig_bytes(&e->outs[i]);
+    if (CheckFailed(api, api->PJRT_Buffer_ToHostBuffer(&d2h)))
+      rc = TDT_ERR_PJRT;
+    else if (!AwaitEvent(api, d2h.event))
+      rc = TDT_ERR_PJRT;
+  }
+
+  for (PJRT_Buffer* buf : in_bufs) {
+    if (!buf) continue;
+    PJRT_Buffer_Destroy_Args bd;
+    std::memset(&bd, 0, sizeof(bd));
+    bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    bd.buffer = buf;
+    api->PJRT_Buffer_Destroy(&bd);
+  }
+  for (PJRT_Buffer* buf : out_bufs) {
+    if (!buf) continue;
+    PJRT_Buffer_Destroy_Args bd;
+    std::memset(&bd, 0, sizeof(bd));
+    bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    bd.buffer = buf;
+    api->PJRT_Buffer_Destroy(&bd);
+  }
+  return rc;
+}
+
+}  // extern "C"

@@ -1,0 +1,29 @@
+// Shared internals between the bundle loader (aot_runtime.cc) and the
+// PJRT executor (pjrt_exec.cc).
+#ifndef TDT_INTERNAL_H_
+#define TDT_INTERNAL_H_
+
+#include <string>
+#include <vector>
+
+#include "tdt_aot_runtime.h"
+
+struct TdtVariant {
+  std::string name;
+  std::string file;       // .jaxexp (Python-side executor)
+  std::string mlir_file;  // .mlirbc (native PJRT path)
+  std::vector<tdt_sig> args;
+  std::vector<tdt_sig> outs;
+};
+
+struct tdt_bundle {
+  std::string path;
+  std::vector<TdtVariant> variants;
+};
+
+extern "C" const TdtVariant* tdt_find_variant(const tdt_bundle* b,
+                                              const char* variant);
+extern "C" bool tdt_read_file(const std::string& path,
+                              std::vector<uint8_t>* out);
+
+#endif  // TDT_INTERNAL_H_

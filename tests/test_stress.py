@@ -1,0 +1,117 @@
+"""Stress/correctness shakeout (reference:
+`test/stress/stress_test_ag_gemm.py:85-121` — randomized shapes per
+iteration + random straggler injection; `for_correctness` sleep knob
+`kernels/nvidia/allgather_gemm.py:506-508`).
+
+Each iteration draws a fresh shape (aligned / unaligned / decode
+regimes), a random method, and a random straggler rank with a real
+wall-clock delay — in the interpret harness the delay skews the
+simulated device's thread, so the cross-thread semaphore machinery
+sees genuinely late arrivals (the race class the entry barriers and
+per-chunk readiness flags exist for).
+"""
+
+import functools
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.kernels.allgather_gemm import (
+    AllGatherGEMMContext,
+    ag_gemm,
+)
+from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
+    GEMMReduceScatterContext,
+    gemm_rs,
+)
+from triton_distributed_tpu.kernels.low_latency_all_to_all import (
+    AllToAllContext,
+    fast_all_to_all,
+)
+from triton_distributed_tpu.kernels.matmul import MatmulConfig
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.testing import assert_allclose
+
+WORLD = 4
+DELAY = 30_000_000  # 30 ms wall-clock in the interpret harness
+
+
+def _rand_straggler(rng):
+    return (rng.randrange(WORLD), DELAY) if rng.random() < 0.7 else None
+
+
+def test_stress_ag_gemm(tp4_mesh):
+    rng = random.Random(0)
+    k, n_loc = 128, 128
+    for it in range(6):
+        m_loc = rng.choice([4, 8, 16, 24, 48])
+        method = rng.choice(["auto", "fused", "ll"])
+        ctx = AllGatherGEMMContext(
+            axis="tp", world_size=WORLD, method=method,
+            gemm=MatmulConfig(64, 128, 128),
+            straggler=_rand_straggler(rng),
+            for_correctness=rng.random() < 0.5)
+        a = jax.random.normal(jax.random.key(it), (WORLD * m_loc, k)) / 16
+        b = jax.random.normal(jax.random.key(100 + it),
+                              (k, WORLD * n_loc)) / 16
+        fn = shard_map_op(
+            functools.partial(ag_gemm, ctx=ctx),
+            tp4_mesh, in_specs=(P("tp", None), P(None, "tp")),
+            out_specs=P(None, "tp"))
+        out = jax.jit(fn)(a, b)
+        assert_allclose(out, a @ b, atol=2e-3, rtol=2e-3,
+                        name=f"stress-ag-{it}-m{m_loc}-{method}")
+
+
+def test_stress_gemm_rs(tp4_mesh):
+    rng = random.Random(1)
+    k_loc, n = 64, 128
+    for it in range(6):
+        mc = rng.choice([2, 8, 12, 16, 32])
+        method = rng.choice(["auto", "fused", "ll"])
+        mt = WORLD * mc
+        ctx = GEMMReduceScatterContext(
+            axis="tp", world_size=WORLD, method=method,
+            gemm=MatmulConfig(64, 128, 64),
+            straggler=_rand_straggler(rng),
+            for_correctness=rng.random() < 0.5)
+        a = jax.random.normal(jax.random.key(it), (mt, WORLD * k_loc)) / 16
+        b = jax.random.normal(jax.random.key(200 + it),
+                              (WORLD * k_loc, n)) / 16
+        fn = shard_map_op(
+            functools.partial(gemm_rs, ctx=ctx),
+            tp4_mesh, in_specs=(P(None, "tp"), P("tp", None)),
+            out_specs=P("tp", None))
+        out = jax.jit(fn)(a, b)
+        assert_allclose(out, a @ b, atol=2e-3, rtol=2e-3,
+                        name=f"stress-rs-{it}-mc{mc}-{method}")
+
+
+def test_stress_all_to_all(ep4_mesh):
+    rng = random.Random(2)
+    hidden = 64
+    for it in range(5):
+        cap = rng.choice([4, 8, 16])
+        ctx = AllToAllContext(
+            axis="ep", world_size=WORLD, max_tokens_per_rank=cap,
+            hidden=hidden, straggler=_rand_straggler(rng),
+            for_correctness=rng.random() < 0.5)
+        send = jax.random.normal(jax.random.key(it),
+                                 (WORLD, WORLD, cap, hidden))
+        counts = jax.random.randint(jax.random.key(300 + it),
+                                    (WORLD, WORLD, 1), 1,
+                                    cap + 1).astype(jnp.int32)
+        fn = shard_map_op(
+            lambda s, c: fast_all_to_all(s[0], c[0], ctx),
+            ep4_mesh,
+            in_specs=(P("ep", None, None, None), P("ep", None, None)),
+            out_specs=(P("ep", None, None), P("ep", None)))
+        recv, rcounts = jax.jit(fn)(send, counts)
+        assert_allclose(recv.reshape(WORLD, WORLD, cap, hidden),
+                        jnp.swapaxes(send, 0, 1), atol=0, rtol=0,
+                        name=f"stress-a2a-{it}-cap{cap}")
+        assert_allclose(rcounts.reshape(WORLD, WORLD, 1),
+                        jnp.swapaxes(counts, 0, 1), atol=0, rtol=0)

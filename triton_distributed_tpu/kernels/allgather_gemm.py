@@ -68,6 +68,12 @@ class AllGatherGEMMContext:
     gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
     method: str = "auto"
     collective_id: int = 1
+    # Fault injection (stress suite): (rank, cycles) delays that rank
+    # at kernel entry; for_correctness staggers every rank's comm
+    # phase to widen race windows (reference
+    # `allgather_gemm.py:506-508`, `stress_test_ag_gemm.py:119-121`).
+    straggler: Optional[Tuple[int, int]] = None
+    for_correctness: bool = False
     interpret: Optional[bool] = None
 
     #: "auto" switches to the one-shot low-latency path when the
@@ -100,10 +106,12 @@ def _ag_gemm_fused_kernel(ctx: AllGatherGEMMContext, m, n, k,
     my = jax.lax.axis_index(ctx.axis)
     right = jax.lax.rem(my + 1, world)
 
+    dl.maybe_straggle(ctx.axis, ctx.straggler)
     # Entry barrier with ring neighbors before they put into
     # gathered_ref (ADVICE r1: reused output buffers may alias the
     # previous program's live memory on a slow device).
     dl.entry_barrier(ctx.axis, world, neighbors_only=True)
+    dl.correctness_delay(ctx.axis, ctx.for_correctness)
     dl.local_copy(x_ref, gathered_ref.at[my], local_sem)
 
     # Python loop: `world` is static, so each step is unrolled and the
@@ -139,6 +147,8 @@ def _ag_gemm_ll_kernel(ctx: AllGatherGEMMContext, mp, n, k,
     single chunked matmul that streams B exactly once.  No per-chunk
     overlap: in this regime comm is microseconds while the GEMM is
     B-bandwidth-bound, so reading B once IS the optimisation."""
+    dl.maybe_straggle(ctx.axis, ctx.straggler)
+    dl.correctness_delay(ctx.axis, ctx.for_correctness)
     emit_push_allgather(ctx.axis, ctx.world_size, x_ref, gathered_ref,
                         local_sem, send_sem, recv_sems)
     emit_chunked_matmul(gathered_ref, b_ref, out_ref, chunks=ctx.world_size,

@@ -42,7 +42,6 @@ from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
     comm_compiler_params,
     default_interpret,
-    is_tpu,
 )
 
 
@@ -90,23 +89,11 @@ def create_allreduce_context(axis: str, world_size: int, **kw):
     return AllReduceContext(axis=axis, world_size=world_size, **kw)
 
 
-def _maybe_straggle(ctx):
-    if ctx.straggler is None:
-        return
-    rank, cycles = ctx.straggler
-    if not is_tpu():
-        return  # pl.delay is a no-op in interpret mode; keep sim fast
-
-    @pl.when(jax.lax.axis_index(ctx.axis) == rank)
-    def _():
-        pl.delay(cycles)
-
-
 def _one_shot_kernel(ctx, m, n, x_ref, o_ref, rbuf_ref, local_sem,
                      send_sem, recv_sems):
     world = ctx.world_size
     my = jax.lax.axis_index(ctx.axis)
-    _maybe_straggle(ctx)
+    dl.maybe_straggle(ctx.axis, ctx.straggler)
     dl.entry_barrier(ctx.axis, world)  # every peer puts into rbuf_ref
 
     dl.local_copy(x_ref, rbuf_ref.at[my], local_sem)
@@ -134,7 +121,7 @@ def _two_shot_kernel(ctx, mc, n, x_ref, o_ref, rbuf_ref, local_sem,
     chunk (into o_ref[my]); phase 2: broadcast reduced chunk to all."""
     world = ctx.world_size
     my = jax.lax.axis_index(ctx.axis)
-    _maybe_straggle(ctx)
+    dl.maybe_straggle(ctx.axis, ctx.straggler)
     dl.entry_barrier(ctx.axis, world)  # peers put into rbuf/o_ref
 
     # -- scatter partials --

@@ -54,6 +54,9 @@ class GEMMReduceScatterContext:
     gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
     method: str = "auto"          # auto | fused | ll | xla
     collective_id: int = 3
+    # Fault injection — see AllGatherGEMMContext.
+    straggler: Optional[tuple] = None
+    for_correctness: bool = False
     interpret: Optional[bool] = None
 
     #: "auto" switches to the one-shot low-latency path when the
@@ -83,7 +86,9 @@ def _gemm_rs_fused_kernel(ctx: GEMMReduceScatterContext, mc, n, k,
                           send_sems, recv_sems):
     world = ctx.world_size
     my = jax.lax.axis_index(ctx.axis)
+    dl.maybe_straggle(ctx.axis, ctx.straggler)
     dl.entry_barrier(ctx.axis, world)  # every peer puts into rbuf_ref
+    dl.correctness_delay(ctx.axis, ctx.for_correctness)
 
     # Per-slot send semaphores: a shared counter would let wait_send be
     # satisfied by the *other* in-flight transfer and free a staging
@@ -133,7 +138,9 @@ def _gemm_rs_ll_kernel(ctx: GEMMReduceScatterContext, mcp, n, k,
     `gemm_rs` — reference analogue: the low-latency RS composition
     rather than the persistent tile-scatter producer."""
     world = ctx.world_size
+    dl.maybe_straggle(ctx.axis, ctx.straggler)
     dl.entry_barrier(ctx.axis, world)  # every peer puts into rbuf_ref
+    dl.correctness_delay(ctx.axis, ctx.for_correctness)
     emit_chunked_matmul(a_ref, b_ref, cstage_ref, chunks=world,
                         mc=mcp, n=n, k=k, config=ctx.gemm)
     emit_scatter_reduce(ctx.axis, world, cstage_ref, out_ref, rbuf_ref,

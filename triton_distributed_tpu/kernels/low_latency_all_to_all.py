@@ -51,6 +51,9 @@ class AllToAllContext:
     max_tokens_per_rank: int
     hidden: int
     collective_id: int = 5
+    # Fault injection — see AllGatherGEMMContext.
+    straggler: Optional[tuple] = None
+    for_correctness: bool = False
     interpret: Optional[bool] = None
 
 
@@ -67,7 +70,9 @@ def _a2a_kernel(ctx: AllToAllContext, has_scale,
                 local_sem, send_sem, tok_sems, cnt_sems, scl_sems):
     world = ctx.world_size
     my = jax.lax.axis_index(ctx.axis)
+    dl.maybe_straggle(ctx.axis, ctx.straggler)
     dl.entry_barrier(ctx.axis, world)  # every peer puts into recv bufs
+    dl.correctness_delay(ctx.axis, ctx.for_correctness)
 
     # Local slice: my tokens destined to myself.
     dl.local_copy(send_ref.at[my], recv_ref.at[my], local_sem)

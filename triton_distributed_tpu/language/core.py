@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -231,6 +232,66 @@ def entry_barrier(axis: str, world: int, neighbors_only: bool = False):
         barrier_neighbors(axis)
     else:
         barrier_all(axis)
+
+
+# ---------------------------------------------------------------------------
+# Fault injection (straggler / race-widening delays)
+# ---------------------------------------------------------------------------
+
+def maybe_straggle(axis: str, straggler):
+    """Delay one rank before it communicates (reference
+    `_run_straggler`, `kernels/nvidia/allreduce.py:146`; stress use
+    `test/stress/stress_test_ag_gemm.py:119-121`).
+
+    ``straggler``: None or (rank, cycles).  On TPU the rank spins
+    ``cycles`` ns (`pl.delay`); in interpret mode it sleeps the
+    simulated device's host thread — a *real* wall-clock skew, so the
+    cross-thread semaphore machinery sees genuinely late arrivals.
+    """
+    if straggler is None:
+        return
+    rank, cycles = straggler
+    from triton_distributed_tpu.utils.platform import is_tpu
+
+    if is_tpu():
+        @pl.when(jax.lax.axis_index(axis) == rank)
+        def _():
+            pl.delay(cycles)
+    else:
+        _host_sleep(jax.lax.axis_index(axis) == rank, cycles)
+
+
+def correctness_delay(axis: str, enabled: bool, cycles: int = 100_000):
+    """Rank-staggered delay before communication on EVERY rank — the
+    reference's `for_correctness` knob (`allgather_gemm.py:506-508`):
+    widen race windows so ordering bugs surface deterministically
+    instead of once a week."""
+    if not enabled:
+        return
+    from triton_distributed_tpu.utils.platform import is_tpu
+
+    my = jax.lax.axis_index(axis)
+    if is_tpu():
+        pl.delay((my + 1) * cycles)
+    else:
+        _host_sleep(my >= 0, (my + 1) * cycles)
+
+
+def _host_sleep(cond, cycles):
+    """Interpret-mode delay: sleep this simulated device's thread
+    (ordered io_callback so it is neither elided nor reordered)."""
+    import numpy as np
+
+    from jax.experimental import io_callback
+
+    def _sleep(c, ns):
+        if bool(c):
+            import time
+            time.sleep(min(float(ns) / 1e9, 0.05))
+        return np.int32(0)
+
+    io_callback(_sleep, jax.ShapeDtypeStruct((), jnp.int32), cond,
+                jnp.asarray(cycles, jnp.int32), ordered=True)
 
 
 def barrier_neighbors(axis: str):

@@ -62,9 +62,17 @@ class AotBundle:
 
 def compile_aot(fn: Callable, name: str, variants: Sequence[AotVariant],
                 out_dir: str, platforms: Optional[Sequence[str]] = None):
-    """Export `fn` for each variant and write a bundle."""
+    """Export `fn` for each variant and write a bundle.
+
+    Each variant gets TWO artifacts: the hermetic `.jaxexp` payload
+    (Python-side executor, version-stamped) and the raw StableHLO
+    bytecode `.mlirbc` the *native* runtime compiles directly through
+    the PJRT C API (csrc/pjrt_exec.cc) — plus `compile_options.pb`,
+    the serialized XLA CompileOptionsProto PJRT_Client_Compile wants
+    (generated here so the C side never needs protobuf).
+    """
     os.makedirs(out_dir, exist_ok=True)
-    manifest = {"name": name, "format": "jax.export.v1", "variants": {}}
+    manifest = {"name": name, "format": "jax.export.v2", "variants": {}}
     jit_fn = fn if isinstance(fn, jax.stages.Wrapped) else jax.jit(fn)
     for v in variants:
         args = [jax.ShapeDtypeStruct(tuple(s), d)
@@ -73,16 +81,37 @@ def compile_aot(fn: Callable, name: str, variants: Sequence[AotVariant],
         fname = f"{name}__{v.name}.jaxexp"
         with open(os.path.join(out_dir, fname), "wb") as f:
             f.write(exp.serialize())
+        mname = f"{name}__{v.name}.mlirbc"
+        with open(os.path.join(out_dir, mname), "wb") as f:
+            f.write(exp.mlir_module_serialized)
         manifest["variants"][v.name] = {
             "file": fname,
+            "mlir_file": mname,
             "arg_shapes": [list(s) for s in v.arg_shapes],
             "arg_dtypes": list(v.arg_dtypes),
+            "out_shapes": [list(a.shape) for a in exp.out_avals],
+            "out_dtypes": [str(a.dtype) for a in exp.out_avals],
             "config": v.config,
         }
+    with open(os.path.join(out_dir, "compile_options.pb"), "wb") as f:
+        f.write(_compile_options_bytes())
     with open(os.path.join(out_dir, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=2)
     _write_c_header(name, manifest, out_dir)
+
+    from triton_distributed_tpu.tools.native import write_bundle_index
+    write_bundle_index(out_dir)
     return AotBundle(path=out_dir, manifest=manifest)
+
+
+def _compile_options_bytes() -> bytes:
+    """Serialized single-device XLA CompileOptionsProto."""
+    from jax._src.lib import xla_client
+
+    co = xla_client.CompileOptions()
+    co.num_replicas = 1
+    co.num_partitions = 1
+    return co.SerializeAsString()
 
 
 def load_bundle(path: str) -> AotBundle:

@@ -129,20 +129,45 @@ def swizzle_rs_order(world: int, rank: int) -> np.ndarray:
 # Native AOT bundle loader
 # ---------------------------------------------------------------------------
 
+# dtype codes shared with csrc/tdt_aot_runtime.h (tdt_dtype).
+_DTYPE_CODES = {"float32": 0, "bfloat16": 1, "float16": 2, "int32": 3,
+                "int64": 4, "uint8": 5, "int8": 6, "bool": 7}
+
+
 def write_bundle_index(bundle_dir: str) -> None:
-    """Emit index.bin for the C runtime from manifest.json."""
+    """Emit index.bin (v2 TLV) for the C runtime from manifest.json.
+
+    v2 layout per variant: name, jaxexp file, mlir file, then arg and
+    output signatures (dtype code u8, rank u8, dims i64[rank]) so the
+    native executor can build PJRT buffers without parsing JSON.
+    """
     import json
     import struct
 
     with open(os.path.join(bundle_dir, "manifest.json")) as f:
         manifest = json.load(f)
-    blob = struct.pack("<III", 0x41544454, 1,
-                       len(manifest["variants"]))
+
+    def pstr(s):
+        b = s.encode()
+        return struct.pack("<H", len(b)) + b
+
+    def psig(shapes, dtypes):
+        blob = struct.pack("<H", len(shapes))
+        for shape, dt in zip(shapes, dtypes):
+            # Unknown dtypes get code 255: the Python (.jaxexp) path
+            # still works; the C executor rejects that variant at
+            # execute time instead of this function raising.
+            blob += struct.pack("<BB", _DTYPE_CODES.get(dt, 255),
+                                len(shape))
+            for dim in shape:
+                blob += struct.pack("<q", dim)
+        return blob
+
+    blob = struct.pack("<III", 0x41544454, 2, len(manifest["variants"]))
     for name, v in manifest["variants"].items():
-        nb = name.encode()
-        fb = v["file"].encode()
-        blob += struct.pack("<H", len(nb)) + nb
-        blob += struct.pack("<H", len(fb)) + fb
+        blob += pstr(name) + pstr(v["file"]) + pstr(v.get("mlir_file", ""))
+        blob += psig(v["arg_shapes"], v["arg_dtypes"])
+        blob += psig(v.get("out_shapes", []), v.get("out_dtypes", []))
     with open(os.path.join(bundle_dir, "index.bin"), "wb") as f:
         f.write(blob)
 
