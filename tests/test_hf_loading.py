@@ -1,0 +1,71 @@
+"""HF weight-loading golden test (VERDICT r1 weak #9): build a tiny
+HuggingFace Qwen3 checkpoint locally, load it through
+`ModelConfig.from_hf` + `Qwen3.load_hf_weights`, and compare prefill
+logits against the HF (torch CPU) forward — the QKV/gate-up interleave
+logic is exactly the kind of code that's wrong until proven otherwise.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from triton_distributed_tpu.models import ModelConfig
+from triton_distributed_tpu.models.qwen import Qwen3
+from triton_distributed_tpu.utils.testing import assert_allclose
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_checkpoint(tmp_path_factory):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    cfg = transformers.Qwen3Config(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=8,
+        num_key_value_heads=4,
+        head_dim=16,
+        max_position_embeddings=256,
+        rope_theta=1e6,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        attention_bias=False,
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.Qwen3ForCausalLM(cfg)
+    hf_model.eval()
+    path = tmp_path_factory.mktemp("hf_qwen3")
+    hf_model.save_pretrained(path)
+    return str(path), hf_model
+
+
+def test_hf_weights_match_logits(tiny_hf_checkpoint, devices):
+    torch = pytest.importorskip("torch")
+    path, hf_model = tiny_hf_checkpoint
+
+    cfg = ModelConfig.from_hf(path)
+    assert cfg.num_heads == 8 and cfg.num_kv_heads == 4
+    assert cfg.head_dim == 16 and cfg.num_layers == 2
+    cfg.dtype = "float32"
+
+    mesh = Mesh(np.array(devices[:4]), ("tp",))
+    model = Qwen3(cfg, mesh, mode="xla")
+    params = model.load_hf_weights(path)
+
+    b, s = 2, 12
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, cfg.vocab_size, size=(b, s))
+
+    cache = model.create_cache(b, max_seq=32)
+    logits, _ = jax.jit(model.make_prefill_fn())(
+        params, jnp.asarray(ids, jnp.int32), cache)
+
+    with torch.no_grad():
+        hf_out = hf_model(torch.tensor(ids)).logits[:, -1].numpy()
+
+    assert_allclose(logits, hf_out, atol=2e-3, rtol=2e-3,
+                    name="hf-vs-tdt-logits")

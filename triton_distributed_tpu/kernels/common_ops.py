@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import default_interpret
 
@@ -33,7 +35,7 @@ def _barrier_kernel(axis, x_ref, o_ref, sem):
     cp.wait()
 
 
-def barrier_all_on_axis(x, axis: str, *, collective_id: int = 7,
+def barrier_all_on_axis(x, axis: str, *, collective_id: int = cids.BARRIER,
                         interpret: Optional[bool] = None):
     """Block every device on `axis` until all have arrived; returns `x`
     unchanged (the data dependency orders subsequent ops after the

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.utils.platform import default_interpret
 
 NEG_INF = -1e30
@@ -148,7 +150,7 @@ def combine_partials(outs, lses):
 
 def sp_flash_decode(q, k_shard, v_shard, kv_len_local, axis: str, *,
                     scale: Optional[float] = None, block_k: int = 256,
-                    collective_id: int = 9,
+                    collective_id: int = cids.FLASH_DECODE_AG,
                     interpret: Optional[bool] = None):
     """Sequence-parallel distributed flash-decode.  Call inside
     shard_map over `axis`; each rank holds a KV shard.

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.kernels.matmul import (
     MatmulConfig,
     emit_chunked_matmul,
@@ -53,7 +55,7 @@ class GEMMReduceScatterContext:
     world_size: int
     gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
     method: str = "auto"          # auto | fused | ll | xla
-    collective_id: int = 3
+    collective_id: int = cids.GEMM_RS
     # Fault injection — see AllGatherGEMMContext.
     straggler: Optional[tuple] = None
     for_correctness: bool = False

@@ -41,6 +41,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.kernels.allgather import (
     AllGatherContext,
     AllGatherMethod,
@@ -73,7 +75,7 @@ class HierarchicalContext:
     dcn_size: int
     ag_method: AllGatherMethod = AllGatherMethod.AUTO
     rs_method: ReduceScatterMethod = ReduceScatterMethod.AUTO
-    collective_id: int = 12
+    collective_id: int = cids.HIERARCHICAL
     interpret: Optional[bool] = None
 
     @property

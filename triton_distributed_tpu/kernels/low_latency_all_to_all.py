@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
     comm_compiler_params,
@@ -50,7 +52,7 @@ class AllToAllContext:
     world_size: int
     max_tokens_per_rank: int
     hidden: int
-    collective_id: int = 5
+    collective_id: int = cids.ALL_TO_ALL
     # Fault injection — see AllGatherGEMMContext.
     straggler: Optional[tuple] = None
     for_correctness: bool = False

@@ -30,6 +30,8 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.kernels.allgather import (
     AllGatherContext,
     AllGatherMethod,
@@ -38,7 +40,7 @@ from triton_distributed_tpu.kernels.allgather import (
 
 
 def create_fast_allgather_context(axis: str, world_size: int,
-                                  collective_id: int = 19,
+                                  collective_id: int = cids.LL_ALLGATHER,
                                   interpret: Optional[bool] = None):
     """Reference analogue: `FastAllGatherContext`
     (`low_latency_allgather.py:781`)."""

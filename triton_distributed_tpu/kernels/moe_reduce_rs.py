@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.kernels import moe_utils
 from triton_distributed_tpu.kernels.grouped_gemm import (
     emit_combine_matmul,
@@ -62,7 +64,7 @@ class MoEReduceRSContext:
     topk: int
     gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
     rs_method: ReduceScatterMethod = ReduceScatterMethod.AUTO
-    collective_id: int = 8
+    collective_id: int = cids.MOE_REDUCE_RS
     interpret: Optional[bool] = None
 
 

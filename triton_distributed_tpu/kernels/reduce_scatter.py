@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
     comm_compiler_params,
@@ -54,7 +56,7 @@ class ReduceScatterContext:
     axis: str
     world_size: int
     method: ReduceScatterMethod = ReduceScatterMethod.AUTO
-    collective_id: int = 2
+    collective_id: int = cids.REDUCE_SCATTER
     interpret: Optional[bool] = None
 
     def resolve_method(self, nbytes_per_chunk: int) -> ReduceScatterMethod:

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.kernels.flash_attention import flash_attention
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
@@ -277,7 +279,7 @@ def sp_ag_attention_fused(q, k_shard, v_shard, axis: str, *,
                           block_q: int = 128, block_k: int = 128,
                           q_offset=None, kv_base=0,
                           return_lse: bool = False,
-                          collective_id: int = 11,
+                          collective_id: int = cids.SP_AG_FUSED,
                           interpret: Optional[bool] = None):
     """Fully fused SP allgather-attention (causal prefill).  Call
     inside shard_map over `axis`.
@@ -399,7 +401,7 @@ def sp_ag_attention_2d(q, k_shard, v_shard, hctx, *,
 def sp_ag_attention_gather(q, k_shard, v_shard, axis: str, *,
                            scale: Optional[float] = None,
                            block_q: int = 128, block_k: int = 128,
-                           collective_id: int = 10,
+                           collective_id: int = cids.SP_AG_GATHER,
                            interpret: Optional[bool] = None):
     """Literal allgather-KV-then-attend (the reference's intra-node
     pipeline shape): gather the full KV with the overlap allgather

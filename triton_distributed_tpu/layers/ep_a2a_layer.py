@@ -22,6 +22,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.kernels import hierarchical, moe_utils
 from triton_distributed_tpu.kernels.low_latency_all_to_all import (
     AllToAllContext,
@@ -39,7 +41,7 @@ class EPAll2AllLayer:
     topk: int
     max_tokens_per_rank: int      # send capacity per (src, dst) pair
     hidden: int
-    collective_ids: tuple = (16, 17)
+    collective_ids: tuple = (cids.EP_DISPATCH, cids.EP_COMBINE)
     interpret: Optional[bool] = None
 
     @property

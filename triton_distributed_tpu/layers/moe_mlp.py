@@ -32,6 +32,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.kernels import moe_utils
 from triton_distributed_tpu.kernels.allgather_group_gemm import (
     AGGroupGEMMContext,
@@ -62,7 +64,7 @@ class MoEMLP:
     capacity_factor: float = 2.0   # per-chunk expert capacity headroom
     mode: str = "fused"            # xla | fused
     gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
-    collective_ids: tuple = (16, 17)
+    collective_ids: tuple = (cids.MOE_MLP_AG, cids.MOE_MLP_RS)
     interpret: Optional[bool] = None
 
     @property

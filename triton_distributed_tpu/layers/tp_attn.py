@@ -19,6 +19,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.kernels.allgather_gemm import (
     AllGatherGEMMContext,
     ag_gemm,
@@ -77,7 +79,7 @@ class TPAttention:
     qk_norm: bool = True          # Qwen3-style per-head q/k RMSNorm
     mode: str = "fused"           # xla | fused
     gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
-    collective_ids: tuple = (14, 15)
+    collective_ids: tuple = (cids.TP_ATTN_QKV, cids.TP_ATTN_OUT)
     interpret: Optional[bool] = None
 
     def __post_init__(self):

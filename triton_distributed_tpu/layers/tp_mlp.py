@@ -25,6 +25,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from triton_distributed_tpu import collective_ids as cids
+
 from triton_distributed_tpu.kernels.allgather_gemm import (
     AllGatherGEMMContext,
     ag_gemm,
@@ -51,7 +53,8 @@ class TPMLP:
     ffn: int
     mode: str = "fused"           # xla | fused | fused_ar
     gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
-    collective_ids: tuple = (11, 12, 13)
+    collective_ids: tuple = (cids.TP_MLP_AG, cids.TP_MLP_RS,
+                             cids.TP_MLP_AR)
     interpret: Optional[bool] = None
 
     @property
