@@ -1,0 +1,69 @@
+"""AllReduce method sweep vs `jax.lax.psum`.
+
+Emits one JSON line per (size, method).  Meaningful on >1 device; on a
+single chip it reports the degenerate world=1 paths for harness CI.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+import argparse
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_distributed_tpu.kernels.allreduce import (
+    AllReduceContext,
+    AllReduceMethod,
+    all_reduce,
+)
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.benchmarking import measure_ops
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, nargs="*",
+                    default=[8, 128, 2048, 16384])
+    ap.add_argument("--cols", type=int, default=1024)
+    ap.add_argument("--repeats", type=int, default=4)
+    args = ap.parse_args()
+
+    devices = jax.devices()
+    world = len(devices)
+    mesh = Mesh(np.array(devices), ("tp",))
+
+    def run(method):
+        ctx = AllReduceContext(axis="tp", world_size=world, method=method)
+        return jax.jit(shard_map_op(
+            functools.partial(all_reduce, ctx=ctx), mesh,
+            in_specs=P(None, None), out_specs=P(None, None)))
+
+    chain = lambda a, out: (out * jnp.bfloat16(1.0 / world),)
+
+    for rows in args.rows:
+        x = jax.random.normal(jax.random.key(0), (rows, args.cols)
+                              ).astype(jnp.bfloat16)
+        methods = [AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT,
+                   AllReduceMethod.RING, AllReduceMethod.XLA]
+        fs = [run(m) for m in methods]
+        times = measure_ops(fs, (x,), chain, repeats=args.repeats)
+        t_xla = times[-1]
+        nbytes = rows * args.cols * 2
+        for m, t in zip(methods, times):
+            print(json.dumps({
+                "bench": "allreduce", "world": world, "nbytes": nbytes,
+                "method": m.value, "us": round(t * 1e6, 1),
+                "vs_baseline": round(t_xla / t, 3),
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

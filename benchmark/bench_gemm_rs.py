@@ -1,0 +1,82 @@
+"""GEMM-ReduceScatter shape sweep vs the XLA baseline.
+
+Emits one JSON line per shape (see bench_ag_gemm.py).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+import argparse
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
+    GEMMReduceScatterContext,
+    gemm_rs,
+    gemm_rs_nonoverlap,
+)
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.benchmarking import (
+    feedback_mix,
+    measure_ops,
+)
+
+
+def chain_fn(k_total):
+    del k_total
+    mix = jax.jit(feedback_mix)
+    return lambda args, out: (mix(args[0], out), args[1])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=7168)
+    ap.add_argument("--n", type=int, default=7168)
+    ap.add_argument("--ms", type=int, nargs="*",
+                    default=[8, 64, 512, 1024, 4096])
+    ap.add_argument("--repeats", type=int, default=4)
+    args = ap.parse_args()
+
+    devices = jax.devices()
+    world = len(devices)
+    mesh = Mesh(np.array(devices), ("tp",))
+    specs = dict(in_specs=(P(None, "tp"), P("tp", None)),
+                 out_specs=P("tp", None))
+
+    for m_total in args.ms:
+        if m_total % world:
+            continue
+        a = jax.random.normal(jax.random.key(0), (m_total, args.k)
+                              ).astype(jnp.bfloat16)
+        b = jax.random.normal(jax.random.key(1), (args.k, args.n)
+                              ).astype(jnp.bfloat16)
+        ctx = GEMMReduceScatterContext(axis="tp", world_size=world)
+        method = ctx.resolve_method(m_total // world, jnp.bfloat16)
+        fused = jax.jit(shard_map_op(
+            functools.partial(gemm_rs, ctx=ctx), mesh, **specs))
+        base = jax.jit(shard_map_op(
+            functools.partial(gemm_rs_nonoverlap, axis="tp"), mesh,
+            **specs))
+        t_fused, t_base = measure_ops(
+            [fused, base], (a, b), chain_fn(args.k),
+            repeats=args.repeats)
+        flops = 2 * m_total * args.k * args.n
+        print(json.dumps({
+            "bench": "gemm_rs", "world": world, "M": m_total,
+            "K": args.k, "N": args.n, "method": method,
+            "us": round(t_fused * 1e6, 1),
+            "tflops": round(flops / t_fused / 1e12, 1),
+            "vs_baseline": round(t_base / t_fused, 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Full benchmark sweep (reference: benchmark/bench_allgather_gemm.py).
+# Each script emits JSON lines; meaningful comm numbers need >1 chip.
+# Run scripts individually for per-bench flags (--ms/--caps/--repeats).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python benchmark/bench_ag_gemm.py
+python benchmark/bench_gemm_rs.py
+python benchmark/bench_allreduce.py
+python benchmark/bench_all_to_all.py

@@ -139,9 +139,24 @@ def fast_all_to_all(send_tokens, send_counts, ctx: AllToAllContext,
     cap, hidden = send_tokens.shape[1], send_tokens.shape[2]
     has_scale = send_scales is not None
 
+    # Mosaic DMA slices need lane-dim (last-dim) alignment to 128;
+    # narrow payloads (counts (world, 1), scale slots) are padded here
+    # and sliced back below — interpret mode doesn't care, hardware
+    # does.
+    cnt_w = 128
+    send_counts = jnp.pad(send_counts.astype(jnp.int32),
+                          ((0, 0), (0, cnt_w - send_counts.shape[1])))
+    ns = ns_pad = 0
+    if has_scale:
+        ns = send_scales.shape[-1]
+        ns_pad = -ns % 128
+        if ns_pad:
+            send_scales = jnp.pad(send_scales,
+                                  ((0, 0), (0, 0), (0, ns_pad)))
+
     out_shapes = [
         jax.ShapeDtypeStruct((world, cap, hidden), send_tokens.dtype),
-        jax.ShapeDtypeStruct((world, 1), jnp.int32),
+        jax.ShapeDtypeStruct((world, cnt_w), jnp.int32),
     ]
     scratch = [
         pltpu.SemaphoreType.DMA(()),
@@ -180,9 +195,11 @@ def fast_all_to_all(send_tokens, send_counts, ctx: AllToAllContext,
         interpret=default_interpret(ctx.interpret),
     )(*operands)
 
+    rcounts = result[1][:, :1]
     if has_scale:
-        return result[0], result[1], result[2]
-    return result[0], result[1]
+        rscales = result[2][..., :ns] if ns_pad else result[2]
+        return result[0], rcounts, rscales
+    return result[0], rcounts
 
 
 def all_to_all_post_process(recv_tokens, recv_counts, cap: int):

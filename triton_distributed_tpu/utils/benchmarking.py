@@ -1,0 +1,83 @@
+"""Drift-robust device benchmarking (shared by bench.py and the
+benchmark/ sweep suite; reference analogue: `perf_func` +
+CUDA-event timing, `python/triton_dist/utils.py:277-291`).
+
+Tunneled-TPU methodology: every device→host fetch pays a large fixed
+round-trip (~100 ms, ±tens of ms) and `block_until_ready` does not
+block, so naive timing measures the tunnel.  Instead each sample
+dispatches N dependence-chained calls with ONE trailing fetch, and the
+per-call latency is the slope between adjacent (n1, n2) samples —
+median of per-repeat slopes, with competing ops interleaved in time so
+minutes-scale drift hits them equally.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from typing import Callable, Sequence
+
+import numpy as np
+
+
+def measure_ops(fs: Sequence[Callable], args: tuple,
+                chain: Callable, *, n1: int = 20, n2: int = None,
+                repeats: int = 6, min_window_s: float = 0.5) -> list:
+    """Per-call latency (seconds) of each `f(*args) -> out` in `fs`.
+
+    ``chain(args, out) -> new_args`` must make call i+1 data-dependent
+    on call i's output (so the device queue cannot collapse the chain)
+    while keeping shapes fixed.
+
+    ``n2`` auto-calibrates from a pilot so the slope window holds at
+    least ``min_window_s`` of device work — a fast op measured with a
+    small fixed window drowns in the fetch jitter and reads as ~0.
+    """
+
+    def total(f, n_calls):
+        t0 = time.perf_counter()
+        a = args
+        for _ in range(n_calls):
+            out = f(*a)
+            a = chain(a, out)
+        leaf = out[0] if isinstance(out, (tuple, list)) else out
+        # Fence: one-element fetch forces full queue drain (device-side
+        # slice first — fetching the whole array costs seconds at the
+        # big sweep shapes).
+        np.asarray(leaf.reshape(-1)[:1])
+        return time.perf_counter() - t0
+
+    for f in fs:
+        total(f, 2)  # warm every jit
+    if n2 is None:
+        # Grow the window until the measured (t2 - t1) dominates the
+        # fetch jitter for EVERY op — a pilot estimate would itself be
+        # jitter-dominated for fast ops, and calibrating on one op
+        # leaves faster competitors under-measured.
+        n2 = max(220, 4 * n1)
+        for f in fs:
+            while n2 < 8000:
+                if total(f, n2) - total(f, n1) >= min_window_s:
+                    break
+                n2 = min(8000, n2 * 4)
+    slopes = [[] for _ in fs]
+    for _ in range(repeats):
+        for sl, f in zip(slopes, fs):
+            t1 = total(f, n1)
+            t2 = total(f, n2)
+            sl.append(max((t2 - t1) / (n2 - n1), 1e-9))
+    return [statistics.median(sl) for sl in slopes]
+
+
+def feedback_mix(x, out):
+    """Shape-safe dependence edge: mix `out` (cropped/padded to x's
+    shape) into the next call's input.  Keeps magnitudes bounded so a
+    thousand-call chain cannot overflow."""
+    import jax.numpy as jnp
+
+    crop = out[tuple(slice(0, min(a, b))
+                     for a, b in zip(x.shape, out.shape))]
+    pad = [(0, xs - cs) for xs, cs in zip(x.shape, crop.shape)]
+    crop = jnp.pad(crop, pad)
+    return (x * 0.5 + crop.astype(jnp.float32).astype(x.dtype) * 1e-3
+            ).astype(x.dtype)
