@@ -1,0 +1,100 @@
+"""01 — notify / wait: the primitive everything else is built from.
+
+Reference: `tutorials/01-distributed-notify-wait.py`, where a producer
+rank writes data, `dl.notify`s a flag on the consumer, and the
+consumer `dl.wait`s the flag before reading.
+
+On TPU the same protocol is *one* operation: a remote DMA always
+increments the destination's receive semaphore when the bytes land, so
+`put == put-with-signal` and the consumer's wait is a semaphore wait.
+This example: every rank puts a message into its right neighbor's
+mailbox; the neighbor waits for delivery, then adds its rank to it.
+"""
+
+import functools
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+from examples._bootstrap import make_mesh  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from triton_distributed_tpu.language import core as dl  # noqa: E402
+from triton_distributed_tpu.ops import shard_map_op  # noqa: E402
+from triton_distributed_tpu.utils.platform import (  # noqa: E402
+    comm_compiler_params,
+    default_interpret,
+)
+
+
+def kernel(axis, world, x_ref, o_ref, mailbox_ref, local_sem, send_sem,
+           recv_sem):
+    my = dl.rank(axis)                       # == libshmem my_pe()
+    right = jax.lax.rem(my + 1, world)
+
+    # Peers will DMA into our mailbox: barrier so nobody writes into a
+    # buffer the previous program might still own (canonical pattern).
+    dl.entry_barrier(axis, world)
+
+    # One-sided put to the right neighbor. The returned descriptor's
+    # recv side IS the notify: no separate flag write needed.
+    dl.put_nbi(x_ref, mailbox_ref, send_sem, recv_sem,
+               dl.peer_id(axis, right))
+
+    # Consumer side: wait until the left neighbor's put landed
+    # (== dl.wait on the flag), then it is safe to read the mailbox.
+    dl.wait_recv(mailbox_ref, recv_sem)
+    dl.wait_send(x_ref, send_sem)
+
+    # HBM refs aren't directly addressable — stage through VMEM for
+    # the compute (+= my), exactly like real kernels pipeline HBM.
+    def finish(vscr):
+        dl.local_copy(mailbox_ref, vscr, local_sem)
+        vscr[...] = vscr[...] + my.astype(jnp.float32)
+        dl.local_copy(vscr, o_ref, local_sem)
+
+    pl.run_scoped(finish, pltpu.VMEM(x_ref.shape, jnp.float32))
+
+
+def main():
+    mesh = make_mesh()
+    world = mesh.shape["tp"]
+
+    def op(x):
+        return pl.pallas_call(
+            functools.partial(kernel, "tp", world),
+            out_shape=(
+                jax.ShapeDtypeStruct(x.shape, x.dtype),
+                jax.ShapeDtypeStruct(x.shape, x.dtype),  # mailbox
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 2,
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+            compiler_params=comm_compiler_params(63, world),
+            interpret=default_interpret(None),
+        )(x)[0]
+
+    fn = shard_map_op(op, mesh, in_specs=P("tp", None),
+                      out_specs=P("tp", None))
+    # Rank r sends a buffer full of r; rank r therefore receives r-1
+    # and adds its own rank: out[r] == (r - 1) % world + r.
+    x = jnp.repeat(jnp.arange(world, dtype=jnp.float32)[:, None],
+                   128, 1).repeat(8, 0)
+    out = jax.jit(fn)(x).reshape(world, 8, 128)
+    for r in range(world):
+        expect = (r - 1) % world + r
+        assert float(out[r, 0, 0]) == expect, (r, out[r, 0, 0])
+    print(f"01_notify_wait OK on {world} devices "
+          f"(rank r holds (r-1)%world + r)")
+
+
+if __name__ == "__main__":
+    main()

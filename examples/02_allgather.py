@@ -1,0 +1,53 @@
+"""02 — AllGather: ring vs one-shot push.
+
+Reference: `tutorials/02-intra-node-allgather.py` (copy-engine and
+NVSHMEM-put variants with per-rank readiness flags).
+
+Two schedules with opposite trade-offs:
+- RING: world-1 single-hop steps; every link carries each shard once —
+  bandwidth-optimal for big payloads.
+- PUSH_ALL: every rank pushes its shard to all peers at once; one hop
+  of latency — wins for small (decode-sized) payloads.
+`AllGatherContext.resolve_method` picks by an analytic ICI perf model.
+"""
+
+import functools
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+from examples._bootstrap import make_mesh  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from triton_distributed_tpu.kernels.allgather import (  # noqa: E402
+    AllGatherContext,
+    AllGatherMethod,
+    all_gather,
+)
+from triton_distributed_tpu.ops import shard_map_op  # noqa: E402
+
+
+def main():
+    mesh = make_mesh()
+    world = mesh.shape["tp"]
+    x = jax.random.normal(jax.random.key(0), (world * 16, 128))
+
+    for method in (AllGatherMethod.RING, AllGatherMethod.PUSH_ALL):
+        ctx = AllGatherContext(axis="tp", world_size=world, method=method)
+        fn = shard_map_op(functools.partial(all_gather, ctx=ctx), mesh,
+                          in_specs=P("tp", None), out_specs=P(None, None))
+        out = jax.jit(fn)(x)
+        assert jnp.array_equal(out, x), method
+        print(f"02_allgather {method.value:9s} OK "
+              f"({world} devices, {x.nbytes // world} B/shard)")
+
+    # The auto-select: tiny payloads go one-shot, big ones ring.
+    small = AllGatherContext(axis="tp", world_size=world)
+    print("auto @ 1 KiB   ->", small.resolve_method(1024).value)
+    print("auto @ 16 MiB  ->", small.resolve_method(16 << 20).value)
+
+
+if __name__ == "__main__":
+    main()

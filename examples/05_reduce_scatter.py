@@ -1,0 +1,52 @@
+"""05 — ReduceScatter: one-shot scatter-reduce vs flow-controlled ring.
+
+Reference: `tutorials/05-intra-node-reduce-scatter.py`
+(scatter-into-symmetric-buffers + ring reduce).
+
+- SCATTER_REDUCE: every rank puts partial chunk c straight to chunk
+  owner c (slot = sender's rank); owners sum `world` buffers with a
+  pipelined VPU reduction. One hop.
+- RING: running partial sums travel the ring; credit-based acks stop a
+  fast left neighbor from overrunning the 2-slot staging buffer — the
+  flow-control problem the reference solves with barrier arrays.
+"""
+
+import functools
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+from examples._bootstrap import make_mesh  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from triton_distributed_tpu.kernels.reduce_scatter import (  # noqa: E402
+    ReduceScatterContext,
+    ReduceScatterMethod,
+    reduce_scatter,
+)
+from triton_distributed_tpu.ops import shard_map_op  # noqa: E402
+
+
+def main():
+    mesh = make_mesh()
+    world = mesh.shape["tp"]
+    # Every rank holds partials of the FULL (world*m, n) array.
+    x = jax.random.normal(jax.random.key(0), (world, world * 8, 128))
+
+    for method in (ReduceScatterMethod.SCATTER_REDUCE,
+                   ReduceScatterMethod.RING):
+        ctx = ReduceScatterContext(axis="tp", world_size=world,
+                                   method=method)
+        fn = shard_map_op(
+            lambda xx, ctx=ctx: reduce_scatter(xx[0], ctx), mesh,
+            in_specs=P("tp", None, None), out_specs=P("tp", None))
+        out = jax.jit(fn)(x)
+        ref = x.sum(0)
+        assert float(jnp.abs(out - ref).max()) < 1e-4, method
+        print(f"05_reduce_scatter {method.value:14s} OK")
+
+
+if __name__ == "__main__":
+    main()

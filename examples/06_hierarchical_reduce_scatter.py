@@ -1,0 +1,44 @@
+"""06 — Two-level ReduceScatter (reduce inside the slice FIRST).
+
+Reference: `tutorials/06-inter-node-reduce-scatter.py` /
+`reduce_scatter_2d_op`: partials meet over NVLink before anything
+crosses IB, so the slow fabric carries 1/local_world of the bytes.
+
+Same economics here: the Pallas intra-slice RS runs first, then a DCN
+`psum_scatter` on the already-reduced 1/ici_size chunk.
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+from examples._bootstrap import make_mesh  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from triton_distributed_tpu.kernels.hierarchical import (  # noqa: E402
+    HierarchicalContext,
+    reduce_scatter_2d,
+)
+from triton_distributed_tpu.ops import shard_map_op  # noqa: E402
+
+
+def main():
+    mesh = make_mesh(("dcn", "ici"), (2, 4))
+    hctx = HierarchicalContext(ici_axis="ici", dcn_axis="dcn",
+                               ici_size=4, dcn_size=2)
+    world = 8
+    x = jax.random.normal(jax.random.key(0), (world, world * 8, 128))
+
+    fn = shard_map_op(
+        lambda xx: reduce_scatter_2d(xx[0], hctx), mesh,
+        in_specs=P(("dcn", "ici"), None, None),
+        out_specs=P(("dcn", "ici"), None))
+    out = jax.jit(fn)(x)
+    assert float(jnp.abs(out - x.sum(0)).max()) < 1e-4
+    print("06_hierarchical_reduce_scatter OK on a (2 x 4) mesh")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Barrier / broadcast building-block tests (reference:
+`test/nvidia/test_common_ops.py`)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu.kernels.common_ops import (
+    barrier_all_on_axis,
+    broadcast,
+)
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.testing import assert_allclose
+
+
+def test_barrier_all_on_axis(tp4_mesh):
+    x = jnp.arange(4 * 8 * 128, dtype=jnp.float32).reshape(32, 128)
+    fn = shard_map_op(
+        functools.partial(barrier_all_on_axis, axis="tp"),
+        tp4_mesh, in_specs=P("tp", None), out_specs=P("tp", None))
+    out = jax.jit(fn)(x)
+    assert_allclose(out, x, atol=0, rtol=0)
+
+
+@pytest.mark.parametrize("root", [0, 2])
+def test_broadcast(tp4_mesh, root):
+    world, m, n = 4, 8, 128
+    # Each rank holds a distinct shard; after broadcast all hold root's.
+    x = jax.random.normal(jax.random.key(root), (world * m, n))
+
+    fn = shard_map_op(
+        lambda xx: broadcast(xx, root, "tp", world),
+        tp4_mesh, in_specs=P("tp", None), out_specs=P("tp", None))
+    out = jax.jit(fn)(x).reshape(world, m, n)
+    ref = x.reshape(world, m, n)[root]
+    for r in range(world):
+        assert_allclose(out[r], ref, atol=0, rtol=0,
+                        name=f"broadcast-root{root}-rank{r}")

@@ -1,0 +1,26 @@
+"""Smoke-run the tutorial examples (reference: `tutorials/01-10` are
+runnable teaching scripts; ours must stay runnable too).  A fast
+subset runs in CI; all eight share the same bootstrap."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("script", [
+    "01_notify_wait.py",
+    "03_hierarchical_allgather.py",
+    "07_ag_gemm_overlap.py",
+])
+def test_example_runs(script):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script)],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "OK" in res.stdout, res.stdout

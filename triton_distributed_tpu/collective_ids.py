@@ -43,6 +43,7 @@ EP_DISPATCH = 20
 EP_COMBINE = 21
 MOE_MLP_AG = 22
 MOE_MLP_RS = 23
+BROADCAST = 24
 
 _FIRST_USER_ID = 64
 _user_ids = itertools.count(_FIRST_USER_ID)

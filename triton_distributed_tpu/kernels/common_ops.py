@@ -53,3 +53,36 @@ def barrier_all_on_axis(x, axis: str, *, collective_id: int = cids.BARRIER,
             has_side_effects=True, collective_id=collective_id),
         interpret=default_interpret(interpret),
     )(x)
+
+
+def _broadcast_kernel(axis, world, x_ref, root_ref, o_ref,
+                      local_sem, send_sem, recv_sem):
+    dl.entry_barrier(axis, world)
+    dl.emit_broadcast(axis, world, root_ref[0], x_ref, o_ref,
+                      local_sem, send_sem, recv_sem)
+
+
+def broadcast(x, root, axis: str, world_size: int, *,
+              collective_id: int = cids.BROADCAST,
+              interpret: Optional[bool] = None):
+    """Broadcast `x` from rank `root` to every device on `axis`
+    (reference: `libshmem_device.broadcast`; docs/device_language.md).
+    Call inside shard_map; `root` may be traced."""
+    if world_size <= 1:
+        return x
+    root_arr = jnp.asarray(root, jnp.int32).reshape(1)
+    return pl.pallas_call(
+        functools.partial(_broadcast_kernel, axis, world_size),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id),
+        interpret=default_interpret(interpret),
+    )(x, root_arr)

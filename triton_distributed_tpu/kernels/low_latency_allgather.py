@@ -25,6 +25,7 @@ NUMA-aware 2D split maps to ICI-slice × DCN).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -37,6 +38,7 @@ from triton_distributed_tpu.kernels.allgather import (
     AllGatherMethod,
     all_gather,
 )
+from triton_distributed_tpu.kernels.hierarchical import all_gather_2d
 
 
 def create_fast_allgather_context(axis: str, world_size: int,
@@ -54,6 +56,20 @@ def fast_allgather(x, ctx: AllGatherContext):
     """One-shot push allgather (latency-optimal).  Call inside
     shard_map.  x: (m, n) shard → (world*m, n)."""
     return all_gather(x, ctx)
+
+
+def fast_allgather_2d(x, hctx):
+    """Two-level low-latency allgather (reference:
+    `_forward_push_2d` / `_forward_push_numa_2d`,
+    `low_latency_allgather.py:74-400`): the shard crosses DCN once to
+    the same-position peer in every slice, then a one-shot ICI push
+    fans it out within each slice — both stages latency-first.
+
+    ``hctx``: `kernels.hierarchical.HierarchicalContext`; the ICI
+    stage is forced onto the one-shot PUSH_ALL method.
+    """
+    return all_gather_2d(
+        x, dataclasses.replace(hctx, ag_method=AllGatherMethod.PUSH_ALL))
 
 
 def fast_allgather_packed(tensors: Sequence[jnp.ndarray],

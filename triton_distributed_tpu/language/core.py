@@ -44,6 +44,12 @@ def num_ranks(axis: str) -> int:
     return jax.lax.axis_size(axis)
 
 
+# Team-API parity aliases: a mesh axis IS a team, so the team variants
+# are the same functions (docs/device_language.md).
+team_my_pe = rank
+team_n_pes = num_ranks
+
+
 def peer_id(axis: str, index):
     """Address of the device at ``index`` along ``axis``, keeping this
     device's coordinates on every other mesh axis.
@@ -210,6 +216,11 @@ def barrier_all(axis: str, sem=None):
     pltpu.semaphore_wait(bsem, n - 1)
 
 
+# NVSHMEM `sync_all` parity: barrier without a DMA-drain (quiet); see
+# docs/device_language.md for the barrier-vs-sync distinction.
+sync_all = barrier_all
+
+
 def entry_barrier(axis: str, world: int, neighbors_only: bool = False):
     """Barrier with the peers that will DMA into this device's output
     buffers, issued at kernel entry before the first remote put.
@@ -232,6 +243,46 @@ def entry_barrier(axis: str, world: int, neighbors_only: bool = False):
         barrier_neighbors(axis)
     else:
         barrier_all(axis)
+
+
+def emit_broadcast(axis: str, world: int, root, src_ref, dst_ref,
+                   local_sem, send_sem, recv_sem):
+    """Broadcast ``src_ref`` from ``root`` into every device's
+    ``dst_ref`` (reference: `libshmem_device.broadcast/broadcastmem`).
+
+    No ICI multicast exists (the NVLS path has no analogue), so the
+    root pushes explicitly to each peer — the same fan-out the
+    one-shot allgather uses, restricted to one source.  ``root`` may
+    be a traced scalar.  Callers barrier beforehand if dst_ref may
+    still be read by the previous program (see entry_barrier).
+    """
+    me = jax.lax.axis_index(axis)
+
+    @pl.when(me == root)
+    def _():
+        local_copy(src_ref, dst_ref, local_sem)
+
+        def send(i, _):
+            peer = jax.lax.rem(root + i, world)
+            pltpu.make_async_remote_copy(
+                src_ref=src_ref, dst_ref=dst_ref,
+                send_sem=send_sem, recv_sem=recv_sem,
+                device_id=peer_id(axis, peer),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            ).start()
+            return 0
+
+        jax.lax.fori_loop(1, world, send, 0, unroll=True)
+
+        def drain(i, _):
+            wait_send(src_ref, send_sem)
+            return 0
+
+        jax.lax.fori_loop(1, world, drain, 0, unroll=True)
+
+    @pl.when(me != root)
+    def _():
+        wait_recv(dst_ref, recv_sem)
 
 
 # ---------------------------------------------------------------------------
