@@ -92,3 +92,38 @@ def test_moe_reduce_rs(tp4_mesh):
                                   w_gate)
     assert out.shape == (n_tokens, n)
     assert_allclose(out, ref, atol=1e-3, rtol=1e-3)
+
+
+def test_ag_group_gemm_count_skipping(tp4_mesh):
+    """Empty-tile skipping (counts) must match full compute exactly:
+    padded bucket rows are zeros, so skipped tiles are zeros either
+    way — the count path just avoids the MXU work (the reference's
+    token-count-driven tile schedule, threadblock_swizzle_ag_moe)."""
+    world, e, cap, k, n = 4, 4, 16, 64, 128
+    key = jax.random.key(7)
+    # Sparse buckets: experts 2,3 empty on every rank; expert 1 partial.
+    counts_loc = jnp.array([cap, 4, 0, 0], jnp.int32)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (e, cap), 1)
+    mask = (rows < counts_loc[:, None])[..., None]
+    buckets = jnp.where(
+        mask, jax.random.normal(key, (world * e, cap, k)).reshape(
+            world, e, cap, k) / 8, 0.0).reshape(world * e, cap, k)
+    w = jax.random.normal(jax.random.fold_in(key, 1),
+                          (e, k, world * n)) / 8
+    counts_all = jnp.tile(counts_loc[None], (world, 1))
+
+    outs = {}
+    for use_counts in (False, True):
+        ctx = AGGroupGEMMContext(axis="tp", world_size=world,
+                                 num_experts=e,
+                                 gemm=MatmulConfig(8, 128, 64))
+        fn = shard_map_op(
+            lambda bb, ww, cc, ctx=ctx, u=use_counts: ag_group_gemm(
+                bb, ww, ctx, counts=cc if u else None),
+            tp4_mesh,
+            in_specs=(P("tp", None, None), P(None, None, "tp"),
+                      P(None, None)),
+            out_specs=P(None, None, None, "tp"))
+        outs[use_counts] = jax.jit(fn)(buckets, w, counts_all)
+    assert_allclose(outs[True], outs[False], atol=0, rtol=0,
+                    name="count-skip-vs-full")

@@ -94,3 +94,41 @@ def test_auto_llm(tiny_setup):
     mesh, cfg, model, params = tiny_setup
     m = AutoLLM(cfg, mesh, mode="xla")
     assert isinstance(m, Qwen3)
+
+
+def test_sample_token_top_p():
+    """Nucleus truncation: only the smallest prefix reaching top_p mass
+    can be sampled (reference `sample_token` top_p semantics)."""
+    from triton_distributed_tpu.models.utils import sample_token
+
+    # probs ~ [0.85, 0.12, 0.02, 0.01] -> top_p=0.9 keeps tokens {0, 1}
+    logits = jnp.log(jnp.array([[0.85, 0.12, 0.02, 0.01]]))
+    logits = jnp.tile(logits, (64, 1))
+    keys = jax.random.split(jax.random.key(0), 8)
+    seen = set()
+    for k in keys:
+        toks = sample_token(logits, k, temperature=1.0, top_p=0.9)
+        seen.update(int(t) for t in toks)
+    assert seen <= {0, 1}, seen
+    # top_p=1.0 eventually samples the tail too
+    seen_all = set()
+    for k in jax.random.split(jax.random.key(1), 32):
+        toks = sample_token(logits * 0 + logits / 10.0, k,
+                            temperature=1.0)
+        seen_all.update(int(t) for t in toks)
+    assert len(seen_all) > 2, seen_all
+
+
+def test_engine_top_p_and_step_profiling(tiny_setup, tmp_path,
+                                         monkeypatch):
+    monkeypatch.chdir(tmp_path)   # trace output goes to tmp, not repo
+    mesh, cfg, model, params = tiny_setup
+    model.set_mode("xla")
+    engine = Engine(model, temperature=0.8, top_k=8, top_p=0.9,
+                    scan_decode=True)
+    b, s = 4, 8
+    ids = jax.random.randint(jax.random.key(30), (b, s), 0,
+                             cfg.vocab_size)
+    out = engine.serve(params, ids, gen_len=6, profile_decode_steps=2)
+    assert out.shape == (b, 6)
+    assert bool((out >= 0).all()) and bool((out < cfg.vocab_size).all())

@@ -57,9 +57,15 @@ def create_ag_group_gemm_context(axis: str, world_size: int,
                               num_experts=num_experts, **kw)
 
 
-def _ag_group_gemm_kernel(ctx: AGGroupGEMMContext, cap, n, k,
-                          x_ref, b_ref, gathered_ref, out_ref,
-                          local_sem, send_sem, recv_sems):
+def _ag_group_gemm_kernel(ctx: AGGroupGEMMContext, cap, n, k, has_counts,
+                          *refs):
+    if has_counts:
+        (x_ref, b_ref, counts_ref, gathered_ref, out_ref,
+         local_sem, send_sem, recv_sems) = refs
+    else:
+        (x_ref, b_ref, gathered_ref, out_ref,
+         local_sem, send_sem, recv_sems) = refs
+        counts_ref = None
     world = ctx.world_size
     my = jax.lax.axis_index(ctx.axis)
     right = jax.lax.rem(my + 1, world)
@@ -80,17 +86,20 @@ def _ag_group_gemm_kernel(ctx: AGGroupGEMMContext, cap, n, k,
                 device_id_type=pltpu.DeviceIdType.MESH,
             )
             rdma.start()
-        emit_grouped_matmul(gathered_ref.at[chunk], b_ref,
-                            out_ref.at[chunk],
-                            num_experts=ctx.num_experts, m=cap, n=n, k=k,
-                            config=ctx.gemm)
+        emit_grouped_matmul(
+            gathered_ref.at[chunk], b_ref, out_ref.at[chunk],
+            num_experts=ctx.num_experts, m=cap, n=n, k=k,
+            config=ctx.gemm,
+            count_of=(None if counts_ref is None
+                      else lambda g, c=chunk: counts_ref[c, g]))
         if rdma is not None:
             exp = jax.lax.rem(my - s - 1 + 2 * world, world)
             dl.wait_recv(gathered_ref.at[exp], recv_sems.at[exp])
             rdma.wait_send()
 
 
-def ag_group_gemm(buckets, expert_weights, ctx: AGGroupGEMMContext):
+def ag_group_gemm(buckets, expert_weights, ctx: AGGroupGEMMContext,
+                  counts=None):
     """Overlapped allgather(buckets) × expert_weights.
 
     Call inside shard_map over `ctx.axis`.
@@ -99,6 +108,9 @@ def ag_group_gemm(buckets, expert_weights, ctx: AGGroupGEMMContext):
       (moe_utils.route_capacity + gather_tokens).
     expert_weights: (E, k, n_loc) — this rank's TP column shard of all
       expert weights.
+    counts: optional (world, E) int32 true bucket sizes (replicated) —
+      enables empty-tile skipping in the grouped GEMM (the reference's
+      token-count-driven tile schedule).
     Returns (world, E, cap_loc, n_loc): per source-rank expert outputs
     (chunk r = rank r's tokens), for downstream topk-combine.
     """
@@ -106,17 +118,22 @@ def ag_group_gemm(buckets, expert_weights, ctx: AGGroupGEMMContext):
     e, cap, k = buckets.shape
     e2, k2, n = expert_weights.shape
     assert e == e2 == ctx.num_experts and k == k2
+    has_counts = counts is not None
+
+    operands = [buckets, expert_weights]
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * 2
+    if has_counts:
+        operands.append(counts.astype(jnp.int32))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
 
     gathered, out = pl.pallas_call(
-        functools.partial(_ag_group_gemm_kernel, ctx, cap, n, k),
+        functools.partial(_ag_group_gemm_kernel, ctx, cap, n, k,
+                          has_counts),
         out_shape=(
             jax.ShapeDtypeStruct((world, e, cap, k), buckets.dtype),
             jax.ShapeDtypeStruct((world, e, cap, n), buckets.dtype),
         ),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
@@ -134,7 +151,7 @@ def ag_group_gemm(buckets, expert_weights, ctx: AGGroupGEMMContext):
             transcendentals=0,
         ),
         interpret=default_interpret(ctx.interpret),
-    )(buckets, expert_weights)
+    )(*operands)
     return out
 
 

@@ -86,7 +86,8 @@ def grouped_matmul(a, b, config: Optional[MatmulConfig] = None,
 
 
 def emit_grouped_matmul(a_ref, b_ref, o_ref, *, num_experts, m, n, k,
-                        config: Optional[MatmulConfig] = None):
+                        config: Optional[MatmulConfig] = None,
+                        count_of=None):
     """Grouped matmul over HBM refs inside a kernel body:
     a_ref (E, m, k), b_ref (E, k, n), o_ref (E, m, n).
 
@@ -94,25 +95,55 @@ def emit_grouped_matmul(a_ref, b_ref, o_ref, *, num_experts, m, n, k,
     dimension — a single software pipeline whose DMA prefetch crosses
     expert boundaries (the role of the reference's cross-expert tile
     scheduler `threadblock_swizzle_ag_moe.cu`), instead of E
-    independent pipelines each paying setup cost."""
+    independent pipelines each paying setup cost.
+
+    ``count_of`` (optional): callable ``g -> traced int`` giving the
+    true token count of expert g's bucket.  Row-blocks entirely past
+    the count skip the MXU work and write zeros — the token-count-
+    driven tile scheduling of the reference's dynamic swizzle, in the
+    form capacity padding admits (compute only non-empty tiles;
+    partially-filled blocks compute in full — their padded rows are
+    zeros).
+    """
     cfg = (config or MatmulConfig()).resolve(m, n, k)
     nk = pl.cdiv(k, cfg.block_k)
 
     def inner(a_blk, b_blk, o_blk, acc_ref):
+        g = pl.program_id(0)
+        i = pl.program_id(1)
         kk = pl.program_id(3)
+        valid = (count_of(g) > i * cfg.block_m if count_of is not None
+                 else None)
 
-        @pl.when(kk == 0)
-        def _():
-            acc_ref[:] = jnp.zeros_like(acc_ref)
+        def accumulate():
+            @pl.when(kk == 0)
+            def _():
+                acc_ref[:] = jnp.zeros_like(acc_ref)
 
-        acc_ref[:] += jax.lax.dot_general(
-            a_blk[0], b_blk[0],
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            acc_ref[:] += jax.lax.dot_general(
+                a_blk[0], b_blk[0],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        if valid is None:
+            accumulate()
+        else:
+            pl.when(valid)(accumulate)
 
         @pl.when(kk == nk - 1)
         def _():
-            o_blk[0] = acc_ref[:].astype(o_blk.dtype)
+            if valid is None:
+                o_blk[0] = acc_ref[:].astype(o_blk.dtype)
+            else:
+                @pl.when(valid)
+                def _():
+                    o_blk[0] = acc_ref[:].astype(o_blk.dtype)
+
+                # Empty tile: write zeros (never leave garbage — a NaN
+                # here would survive the 0-weighted combine).
+                @pl.when(jnp.logical_not(valid))
+                def _():
+                    o_blk[0] = jnp.zeros_like(o_blk[0])
 
     def run(acc_ref):
         pipeline = pltpu.emit_pipeline(

@@ -100,9 +100,16 @@ def moe_reduce_rs(buckets, expert_weights, expert_ids, slot_of_pair,
 
 
 def _moe_rs_fused_kernel(ctx: MoEReduceRSContext, e, cap, mc, n, k,
-                         buckets_ref, w_ref, cmat_ref,
-                         out_ref, rbuf_ref, gstage_ref, cstage_ref,
-                         send_sems, recv_sems):
+                         has_counts, *refs):
+    if has_counts:
+        (buckets_ref, w_ref, cmat_ref, counts_ref,
+         out_ref, rbuf_ref, gstage_ref, cstage_ref,
+         send_sems, recv_sems) = refs
+    else:
+        (buckets_ref, w_ref, cmat_ref,
+         out_ref, rbuf_ref, gstage_ref, cstage_ref,
+         send_sems, recv_sems) = refs
+        counts_ref = None
     world = ctx.world_size
     my = jax.lax.axis_index(ctx.axis)
     dl.entry_barrier(ctx.axis, world)  # every peer puts into rbuf_ref
@@ -114,7 +121,9 @@ def _moe_rs_fused_kernel(ctx: MoEReduceRSContext, e, cap, mc, n, k,
         chunk = jax.lax.rem(my + 1 + s, world)
         emit_grouped_matmul(buckets_ref.at[chunk], w_ref, gstage_ref,
                             num_experts=e, m=cap, n=n, k=k,
-                            config=ctx.gemm)
+                            config=ctx.gemm,
+                            count_of=(None if counts_ref is None else
+                                      lambda g, c=chunk: counts_ref[c, g]))
         if s == world - 1:
             # Own chunk: combine straight into our receive slot.
             emit_combine_matmul(cmat_ref.at[chunk], gstage_ref,
@@ -149,7 +158,7 @@ def _moe_rs_fused_kernel(ctx: MoEReduceRSContext, e, cap, mc, n, k,
 
 
 def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
-                        ctx: MoEReduceRSContext):
+                        ctx: MoEReduceRSContext, counts=None):
     """Single-kernel fused MoE epilogue (reference
     `moe_reduce_rs.py:380-486`: grouped-GEMM producer + topk-RS
     consumer).  Call inside shard_map over `ctx.axis`.
@@ -161,6 +170,8 @@ def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
     expert_weights: (E, k_loc, n) — down-projection TP K-shard.
     combine_mats:   (world, E, mc, cap) — per-chunk one-hot combine
                     weights (`moe_utils.plan_chunks`), replicated.
+    counts:         optional (world, E) int32 true bucket sizes
+                    (`plan.counts`) — empty-tile skipping.
     Returns (mc, n): this rank's reduced output chunk.
     """
     world, e, cap, k = buckets.shape
@@ -169,16 +180,24 @@ def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
     assert k == k2, (buckets.shape, expert_weights.shape)
     w2, e3, mc, cap2 = combine_mats.shape
     assert w2 == world and e3 == e and cap2 == cap, combine_mats.shape
+    has_counts = counts is not None
+
+    operands = [buckets, expert_weights, combine_mats]
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * 3
+    if has_counts:
+        operands.append(counts.astype(jnp.int32))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
 
     out, _, _, _ = pl.pallas_call(
-        functools.partial(_moe_rs_fused_kernel, ctx, e, cap, mc, n, k),
+        functools.partial(_moe_rs_fused_kernel, ctx, e, cap, mc, n, k,
+                          has_counts),
         out_shape=(
             jax.ShapeDtypeStruct((mc, n), buckets.dtype),
             jax.ShapeDtypeStruct((world, mc, n), buckets.dtype),  # rbuf
             jax.ShapeDtypeStruct((e, cap, n), buckets.dtype),     # gstage
             jax.ShapeDtypeStruct((2, mc, n), buckets.dtype),      # cstage
         ),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        in_specs=in_specs,
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 4,
         scratch_shapes=[
             pltpu.SemaphoreType.DMA((2,)),
@@ -192,5 +211,5 @@ def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
             transcendentals=0,
         ),
         interpret=default_interpret(ctx.interpret),
-    )(buckets, expert_weights, combine_mats)
+    )(*operands)
     return out

@@ -133,10 +133,15 @@ class ChunkPlan(NamedTuple):
       index per expert slot (sentinel mc = empty).
     combine_mats:   (world, E, mc, cap) — one-hot combine weights per
       chunk, laid out expert-major for `emit_combine_matmul`.
+    counts:         (world, E) int32 — true tokens per (chunk, expert)
+      bucket (≤ cap); drives empty-tile skipping in the grouped GEMMs
+      (the token-count-driven scheduling of the reference's
+      `threadblock_swizzle_ag_moe`).
     """
 
     dispatch_index: jnp.ndarray
     combine_mats: jnp.ndarray
+    counts: jnp.ndarray
 
 
 def plan_chunks(expert_ids, weights, world: int, num_experts: int,
@@ -155,10 +160,12 @@ def plan_chunks(expert_ids, weights, world: int, num_experts: int,
         r = route_capacity(ids, num_experts, capacity)
         cm = combine_matrix(ids, r.slot_of_pair, w, num_experts,
                             capacity, dtype)
-        return r.dispatch_index, cm.transpose(1, 0, 2)  # (E, mc, cap)
+        counts = jnp.minimum(r.counts, capacity).astype(jnp.int32)
+        return r.dispatch_index, cm.transpose(1, 0, 2), counts
 
-    dispatch, cmats = jax.vmap(per_chunk)(ids_c, w_c)
-    return ChunkPlan(dispatch_index=dispatch, combine_mats=cmats)
+    dispatch, cmats, counts = jax.vmap(per_chunk)(ids_c, w_c)
+    return ChunkPlan(dispatch_index=dispatch, combine_mats=cmats,
+                     counts=counts)
 
 
 def tokens_per_rank(expert_ids, num_experts: int, ep_size: int):

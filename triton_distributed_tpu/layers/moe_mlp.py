@@ -146,29 +146,36 @@ class MoEMLP:
         routing = moe_utils.route_capacity(ids_loc, self.num_experts, cap)
         buckets = moe_utils.gather_tokens(x, routing.dispatch_index)
 
+        # Routing metadata for every chunk (tiny id/weight allgather):
+        # plan.counts drives empty-tile skipping in BOTH grouped GEMMs
+        # (token-count-driven scheduling), the combine_mats the fused
+        # epilogue.  Chunk c's plan == rank c's own routing (same
+        # deterministic route_capacity on the same ids).
+        ids_all = jax.lax.all_gather(ids_loc, self.axis, tiled=True)
+        w_all = jax.lax.all_gather(w_loc, self.axis, tiled=True)
+        plan = self._chunk_plan(ids_all, w_all, cap)
+
         # 3. overlapped AG + gate/up grouped GEMM
         ag_ctx = AGGroupGEMMContext(
             axis=self.axis, world_size=world,
             num_experts=self.num_experts, gemm=self.gemm,
             collective_id=self.collective_ids[0],
             interpret=self.interpret)
-        inter = ag_group_gemm(buckets, params["gate_up"], ag_ctx)
+        inter = ag_group_gemm(buckets, params["gate_up"], ag_ctx,
+                              counts=plan.counts)
 
         # 4. activation (XLA elementwise, fused into the surroundings)
         act = gated_silu(inter)                      # (w, E, cap, f_loc)
 
-        # 5. routing metadata for every chunk (tiny allgather), then
-        #    the fused grouped-GEMM + combine + RS epilogue
-        ids_all = jax.lax.all_gather(ids_loc, self.axis, tiled=True)
-        w_all = jax.lax.all_gather(w_loc, self.axis, tiled=True)
-        plan = self._chunk_plan(ids_all, w_all, cap)
+        # 5. the fused grouped-GEMM + combine + RS epilogue
         rs_ctx = MoEReduceRSContext(
             axis=self.axis, world_size=world,
             num_experts=self.num_experts, topk=self.topk,
             gemm=self.gemm, collective_id=self.collective_ids[1],
             interpret=self.interpret)
         return moe_reduce_rs_fused(act, params["down"],
-                                   plan.combine_mats, rs_ctx)
+                                   plan.combine_mats, rs_ctx,
+                                   counts=plan.counts)
 
     def __call__(self, x, params):
         mc = x.shape[0]

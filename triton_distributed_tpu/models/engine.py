@@ -26,9 +26,12 @@ from triton_distributed_tpu.utils.profiling import group_profile
 
 class Engine:
     def __init__(self, model: Qwen3, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0,
                  scan_decode: bool = True):
         self.model = model
         self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
         self.scan_decode = scan_decode
         self._prefill = jax.jit(model.make_prefill_fn())
         decode_fn = model.make_decode_fn()
@@ -36,7 +39,8 @@ class Engine:
         def step(params, tokens, cache, key):
             logits, cache = decode_fn(params, tokens, cache)
             key, sub = jax.random.split(key)
-            nxt = sample_token(logits, sub, temperature)
+            nxt = sample_token(logits, sub, temperature, top_k=top_k,
+                               top_p=top_p)
             return nxt, cache, key
 
         # donate cache so XLA updates it in place across steps
@@ -59,26 +63,54 @@ class Engine:
         return self._prefill(params, input_ids, cache)
 
     def serve(self, params, input_ids, gen_len: int,
-              key: Optional[jax.Array] = None, profile: bool = False):
+              key: Optional[jax.Array] = None, profile: bool = False,
+              profile_decode_steps: int = 0):
         """input_ids: (B, S) — S and B must tile the tp axis (pad
-        upstream).  Returns generated tokens (B, gen_len)."""
+        upstream).  Returns generated tokens (B, gen_len).
+
+        ``profile_decode_steps``: trace only that many steady-state
+        decode steps (the reference Engine captures 64 decode steps to
+        `trace_static.json`, `models/engine.py:151-177`); implies the
+        per-step loop for the traced prefix.
+        """
         key = key if key is not None else jax.random.key(0)
         b, s = input_ids.shape
         cache = self.model.create_cache(b)
 
         with group_profile("engine_serve", do_prof=profile):
             logits, cache = self.prefill(params, input_ids, cache)
-            first = sample_token(logits, key, self.temperature)
-            if self.scan_decode:
-                toks, cache = self._rollout(params, first, cache, key,
-                                            gen_len - 1)
-                out = jnp.concatenate([first[:, None], toks], axis=1)
+            first = sample_token(logits, key, self.temperature,
+                                 top_k=self.top_k, top_p=self.top_p)
+            tokens = [first]
+            cur = first
+            # The warm-up step consumes a generation slot too.
+            n_prof = min(profile_decode_steps, max(gen_len - 2, 0))
+            if n_prof > 0:
+                # Warm the step jit before tracing, then capture only
+                # steady-state steps.  When an outer trace is already
+                # active (profile=True) don't start a nested one.
+                cur, cache, key = self._step(params, cur, cache, key)
+                tokens.append(cur)
+                with group_profile("engine_decode_steps",
+                                   do_prof=not profile):
+                    for _ in range(n_prof):
+                        cur, cache, key = self._step(params, cur, cache,
+                                                     key)
+                        tokens.append(cur)
+            remaining = gen_len - len(tokens)
+            if remaining > 0:
+                if self.scan_decode:
+                    toks, cache = self._rollout(params, cur, cache, key,
+                                                remaining)
+                    out = jnp.concatenate(
+                        [jnp.stack(tokens, axis=1), toks], axis=1)
+                else:
+                    for _ in range(remaining):
+                        cur, cache, key = self._step(params, cur, cache,
+                                                     key)
+                        tokens.append(cur)
+                    out = jnp.stack(tokens, axis=1)
             else:
-                tokens = [first]
-                cur = first
-                for _ in range(gen_len - 1):
-                    cur, cache, key = self._step(params, cur, cache, key)
-                    tokens.append(cur)
                 out = jnp.stack(tokens, axis=1)
         jax.block_until_ready(out)
         return out

@@ -11,12 +11,28 @@ from triton_distributed_tpu.utils.debug import logger  # noqa: F401
 
 
 def sample_token(logits, key=None, temperature: float = 0.0,
-                 top_k: int = 0):
-    """logits: (B, V) → (B,) int32.  temperature 0 = greedy."""
+                 top_k: int = 0, top_p: float = 1.0):
+    """logits: (B, V) → (B,) int32.  temperature 0 = greedy.
+
+    Reference `sample_token` semantics: temperature scaling, then
+    top-k truncation, then nucleus (top-p) truncation — the smallest
+    prefix of the sorted distribution whose mass reaches ``top_p`` is
+    kept (the first token is always kept)."""
     if temperature <= 0.0 or key is None:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -1e30, logits)
+    if 0.0 < top_p < 1.0:
+        desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(desc, axis=-1)
+        # Exclusive prefix mass: a sorted position is kept while the
+        # mass BEFORE it is < top_p (so the head token always stays).
+        excl = jnp.cumsum(probs, axis=-1) - probs
+        kept = excl < top_p
+        # Smallest kept logit per row = truncation threshold.
+        thresh = jnp.min(jnp.where(kept, desc, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < thresh, -1e30, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
