@@ -68,3 +68,67 @@ def test_assert_allclose_reports():
     b[1, 2] = 1.0
     with pytest.raises(AssertionError, match="mismatched"):
         assert_allclose(a, b, atol=1e-6, rtol=0)
+
+
+class _FakeDev:
+    """Stub with the TPU device attributes topology discovery reads."""
+
+    def __init__(self, coords, slice_index=0, kind="TPU v5p"):
+        self.coords = coords
+        self.slice_index = slice_index
+        self.device_kind = kind
+        self.platform = "tpu"
+
+
+def test_torus_discovery_v5p_wraparound():
+    # 4x4x4 v5p cube: every dimension wraps (>= 4 extents).
+    devs = [_FakeDev([x, y, z]) for x in range(4) for y in range(4)
+            for z in range(4)]
+    topo = node_topology(devs)
+    assert topo.torus_shape == (4, 4, 4)
+    assert topo.wraparound == (True, True, True)
+    assert topo.rings_closed is True
+
+
+def test_torus_discovery_v5e_open_mesh():
+    # 4x2 v5e slice: 2D mesh, no wraparound below the 16-chip edge.
+    devs = [_FakeDev([x, y, 0], kind="TPU v5 lite") for x in range(4)
+            for y in range(2)]
+    topo = node_topology(devs)
+    assert topo.torus_shape == (4, 2, 1)
+    assert topo.wraparound == (False, False, False)
+    assert topo.rings_closed is False
+
+
+def test_torus_discovery_multislice():
+    devs = ([_FakeDev([x, 0, 0], slice_index=0) for x in range(4)]
+            + [_FakeDev([x, 0, 0], slice_index=1) for x in range(4)])
+    topo = node_topology(devs)
+    assert topo.num_slices == 2 and topo.devices_per_slice == 4
+
+
+def test_make_hierarchical_mesh_fallback():
+    from triton_distributed_tpu.parallel.mesh import make_hierarchical_mesh
+    ctx = make_hierarchical_mesh()
+    # CPU harness: one "slice" of 8 simulated devices.
+    assert ctx.mesh.shape == {"dcn": 1, "ici": 8}
+
+
+def test_perf_model_open_vs_closed_ring():
+    from triton_distributed_tpu.kernels.comm_perf_model import (
+        estimate_all_gather_time_us, estimate_one_shot_time_us)
+    nb, w = 1 << 20, 8
+    assert (estimate_all_gather_time_us(nb, w, closed_ring=False)
+            > estimate_all_gather_time_us(nb, w, closed_ring=True))
+    assert (estimate_one_shot_time_us(nb, w, closed_ring=False)
+            > estimate_one_shot_time_us(nb, w, closed_ring=True))
+
+
+def test_torus_small_extents_ring_equivalent():
+    # 2x2x2 v5p: extent-2 dims have no wrap links but a 2-node "ring"
+    # is just the bidirectional link — closed for scheduling purposes.
+    devs = [_FakeDev([x, y, z]) for x in range(2) for y in range(2)
+            for z in range(2)]
+    topo = node_topology(devs)
+    assert topo.torus_shape == (2, 2, 2)
+    assert topo.rings_closed is True

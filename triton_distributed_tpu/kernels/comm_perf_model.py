@@ -45,14 +45,49 @@ def get_ici_spec(device=None) -> IciSpec:
     return _ICI_TABLE["v5e"]
 
 
+# Cache keyed on the visible device set: a process whose backend grows
+# (e.g. jax.distributed.initialize after a premature local query) gets
+# a fresh answer instead of a stale single-host sub-grid verdict.
+_topo_cache: dict = {}
+
+
+def rings_closed() -> bool:
+    """Whether the attached slice's torus dimensions wrap around (from
+    `parallel.mesh.node_topology` device-coords discovery).  On an
+    open mesh (no wraparound) the ring schedule's wrap edge shares
+    every link along the line, roughly doubling the busiest link's
+    load; unknown topologies (CPU simulation) assume closed."""
+    from triton_distributed_tpu.parallel.mesh import node_topology
+    try:
+        devices = jax.devices()
+        key = (len(devices),
+               getattr(devices[0], "device_kind", ""),
+               jax.process_count())
+    except Exception:
+        return True
+    if key not in _topo_cache:
+        try:
+            rc = node_topology(devices).rings_closed
+        except Exception:
+            rc = None
+        _topo_cache[key] = True if rc is None else rc
+    return _topo_cache[key]
+
+
 def estimate_all_gather_time_us(nbytes_per_shard: int, world: int,
-                                spec: IciSpec = None) -> float:
+                                spec: IciSpec = None,
+                                closed_ring: bool = None) -> float:
     """Ring AG: (world-1) steps, each shipping one shard one hop along
     the axis ring — every directed link carries each shard exactly
-    once, the bandwidth-optimal schedule."""
+    once, the bandwidth-optimal schedule.  On an open line (no
+    wraparound) the wrap hop routes through every link, ~doubling the
+    busiest link's traffic."""
     spec = spec or get_ici_spec()
+    closed = rings_closed() if closed_ring is None else closed_ring
     bw = spec.link_gbps * 1e9
-    return (world - 1) * (nbytes_per_shard / bw * 1e6 + spec.latency_us)
+    load = 1.0 if closed else 2.0
+    return (world - 1) * (load * nbytes_per_shard / bw * 1e6
+                          + spec.latency_us)
 
 
 def estimate_reduce_scatter_time_us(nbytes_per_shard: int, world: int,
@@ -67,23 +102,28 @@ def estimate_all_reduce_time_us(nbytes: int, world: int,
 
 
 def estimate_one_shot_time_us(nbytes: int, world: int,
-                              spec: IciSpec = None) -> float:
+                              spec: IciSpec = None,
+                              closed_ring: bool = None) -> float:
     """One-shot push: world-1 concurrent direct puts on the axis ring.
 
     Unlike a ring schedule (single-hop transfers only), a direct put
     to a peer at distance d occupies d links; summed over both ring
     directions the busiest directed link carries ~world²/8 payload
-    transits.  That link is the bottleneck, so one-shot loses to the
-    ring for large payloads at scale but wins the latency race
-    (1 hop vs world-1 serialized hops) for small ones — the same
+    transits (~world²/4 on an open line, where the far half cannot
+    route the short way).  That link is the bottleneck, so one-shot
+    loses to the ring for large payloads at scale but wins the latency
+    race (1 hop vs world-1 serialized hops) for small ones — the same
     topology-awareness as the reference's
     `get_auto_all_gather_method`."""
     spec = spec or get_ici_spec()
+    closed = rings_closed() if closed_ring is None else closed_ring
     bw = spec.link_gbps * 1e9
-    link_transits = max(1.0, world * world / 8.0)
-    # Farthest put crosses world/2 ring hops — the latency term is the
-    # longest path, not a single hop.
-    lat = max(1.0, world / 2.0) * spec.latency_us
+    denom = 8.0 if closed else 4.0
+    link_transits = max(1.0, world * world / denom)
+    # Farthest put crosses world/2 hops on a closed ring, world-1 on a
+    # line — the latency term is the longest path, not a single hop.
+    far = world / 2.0 if closed else float(world - 1)
+    lat = max(1.0, far) * spec.latency_us
     return link_transits * nbytes / bw * 1e6 + lat
 
 

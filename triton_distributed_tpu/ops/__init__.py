@@ -8,6 +8,12 @@ the reference's op entry points exported at
 """
 
 from triton_distributed_tpu.ops.api import (  # noqa: F401
+    ag_gemm,
     all_gather,
+    all_reduce,
+    all_to_all,
+    broadcast,
+    gemm_rs,
+    reduce_scatter,
     shard_map_op,
 )

@@ -1,4 +1,11 @@
-"""Mesh-level op wrappers."""
+"""Mesh-level op wrappers: the user-facing API over global arrays.
+
+Each wrapper builds the per-op context, shard_maps the kernel over the
+mesh, and maps global shardings — the role of the reference's
+top-level op entry points (`kernels/nvidia/__init__.py:25-42`) over
+torch tensors.  Power users drop to the `kernels.*` entry points
+inside their own shard_map.
+"""
 
 from __future__ import annotations
 
@@ -9,6 +16,12 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.kernels import allgather as ag_mod
+from triton_distributed_tpu.kernels import allgather_gemm as agg_mod
+from triton_distributed_tpu.kernels import allreduce as ar_mod
+from triton_distributed_tpu.kernels import common_ops as common_mod
+from triton_distributed_tpu.kernels import gemm_reduce_scatter as grs_mod
+from triton_distributed_tpu.kernels import low_latency_all_to_all as a2a_mod
+from triton_distributed_tpu.kernels import reduce_scatter as rs_mod
 
 
 def shard_map_op(fn, mesh: Mesh, in_specs, out_specs):
@@ -29,3 +42,90 @@ def all_gather(x, mesh: Mesh, axis: str = "tp",
         functools.partial(ag_mod.all_gather, ctx=ctx),
         mesh, in_specs=P(axis, None), out_specs=P(None, None))
     return fn(x)
+
+
+def reduce_scatter(x, mesh: Mesh, axis: str = "tp", **kw):
+    """Reduce replicated per-device partials (M, N) and scatter row
+    chunks: → (M, N) sharded on axis 0."""
+    ctx = rs_mod.create_reduce_scatter_context(
+        axis=axis, world_size=mesh.shape[axis], **kw)
+    fn = shard_map_op(
+        functools.partial(rs_mod.reduce_scatter, ctx=ctx),
+        mesh, in_specs=P(None, None), out_specs=P(axis, None))
+    return fn(x)
+
+
+def all_reduce(x, mesh: Mesh, axis: str = "tp", **kw):
+    """Sum per-device partials (M, N) → replicated (M, N)."""
+    ctx = ar_mod.create_allreduce_context(
+        axis=axis, world_size=mesh.shape[axis], **kw)
+    fn = shard_map_op(
+        functools.partial(ar_mod.all_reduce, ctx=ctx),
+        mesh, in_specs=P(None, None), out_specs=P(None, None))
+    return fn(x)
+
+
+def all_to_all(send, counts, mesh: Mesh, axis: str = "ep",
+               send_scales=None, **kw):
+    """Low-latency token exchange.  send: (world, world, cap, H)
+    global (row r = rank r's per-destination blocks); counts:
+    (world, world, 1).  Returns (recv, recv_counts[, recv_scales])
+    with the same global layout (row r = what rank r received)."""
+    world = mesh.shape[axis]
+    ctx = a2a_mod.create_all_to_all_context(
+        axis=axis, world_size=world, max_tokens_per_rank=send.shape[2],
+        hidden=send.shape[3], **kw)
+    has_scale = send_scales is not None
+
+    def op(s, c, *sc):
+        return a2a_mod.fast_all_to_all(
+            s[0], c[0], ctx, send_scales=sc[0][0] if sc else None)
+
+    in_specs = [P(axis, None, None, None), P(axis, None, None)]
+    out_specs = [P(axis, None, None), P(axis, None)]
+    operands = [send, counts]
+    if has_scale:
+        in_specs.append(P(axis, None, None, None))
+        out_specs.append(P(axis, None, None))
+        operands.append(send_scales)
+    fn = shard_map_op(op, mesh, in_specs=tuple(in_specs),
+                      out_specs=tuple(out_specs))
+    out = fn(*operands)
+    recv = out[0].reshape(send.shape)
+    rcounts = out[1].reshape(counts.shape)
+    if has_scale:
+        return recv, rcounts, out[2].reshape(send_scales.shape)
+    return recv, rcounts
+
+
+def broadcast(x, root: int, mesh: Mesh, axis: str = "tp", **kw):
+    """Broadcast rank `root`'s shard to every device: x (M, N) sharded
+    on axis 0 → replicated-content (M, N) in the same sharding."""
+    world = mesh.shape[axis]
+    fn = shard_map_op(
+        lambda xx: common_mod.broadcast(xx, root, axis, world, **kw),
+        mesh, in_specs=P(axis, None), out_specs=P(axis, None))
+    return fn(x)
+
+
+def ag_gemm(a, b, mesh: Mesh, axis: str = "tp", **kw):
+    """C = A @ B with A row-sharded and B column-sharded over `axis`,
+    communication overlapped (the flagship TP projection op).
+    Returns C column-sharded."""
+    ctx = agg_mod.create_ag_gemm_context(
+        axis=axis, world_size=mesh.shape[axis], **kw)
+    fn = shard_map_op(
+        functools.partial(agg_mod.ag_gemm, ctx=ctx), mesh,
+        in_specs=(P(axis, None), P(None, axis)), out_specs=P(None, axis))
+    return fn(a, b)
+
+
+def gemm_rs(a, b, mesh: Mesh, axis: str = "tp", **kw):
+    """C = reduce_scatter(A @ B) with A column(K)-sharded and B
+    row(K)-sharded over `axis`.  Returns C row-sharded."""
+    ctx = grs_mod.create_gemm_rs_context(
+        axis=axis, world_size=mesh.shape[axis], **kw)
+    fn = shard_map_op(
+        functools.partial(grs_mod.gemm_rs, ctx=ctx), mesh,
+        in_specs=(P(None, axis), P(axis, None)), out_specs=P(axis, None))
+    return fn(a, b)

@@ -34,6 +34,12 @@ DP_AXIS = "dp"   # data parallel (GSPMD gives this for free on TPU)
 PP_AXIS = "pp"   # pipeline parallel
 
 
+#: TPU generations whose slices are 3D tori (wraparound links appear
+#: per-dimension once the extent reaches 4); 2D-mesh generations
+#: (v5e/v6e) have no wraparound below a full pod.
+_TORUS_3D_PREFIXES = ("v4", "v5p", "tpu v4", "tpu v5p", "tpu v5 p")
+
+
 @dataclasses.dataclass(frozen=True)
 class NodeTopology:
     """ICI/DCN topology summary.
@@ -42,12 +48,20 @@ class NodeTopology:
     (`utils.py:595-871`, `kernels/nvidia/comm_perf_model.py:34-66`).
     On TPU: devices in the same slice share ICI (fast, one-sided DMA
     capable); distinct slices are connected by DCN (collectives only).
+
+    ``torus_shape``/``wraparound``: the slice's chip-grid extents and
+    whether each dimension closes into a ring, discovered from device
+    ``coords`` — the analogue of the reference's NVLink-fullmesh /
+    PCIe-switch probing.  None/empty when the backend exposes no
+    coordinates (CPU simulation).
     """
 
     num_devices: int
     num_slices: int
     devices_per_slice: int
     platform: str
+    torus_shape: Optional[Tuple[int, ...]] = None
+    wraparound: Tuple[bool, ...] = ()
 
     @property
     def has_ici_fullmesh(self) -> bool:
@@ -55,19 +69,53 @@ class NodeTopology:
         # one-sided remote DMA (the analogue of "full-mesh NVLink").
         return self.num_slices == 1
 
+    @property
+    def rings_closed(self) -> Optional[bool]:
+        """True when every torus dimension a ring could span runs
+        closed (single-hop steps).  Extent-2 dimensions are
+        ring-equivalent even without wrap links — the "wrap" hop is
+        the same bidirectional link in reverse — so only extents > 2
+        can open a ring.  None when the topology is unknown."""
+        if self.torus_shape is None:
+            return None
+        dims = [w for s, w in zip(self.torus_shape, self.wraparound)
+                if s > 2]
+        return all(dims) if dims else True
+
 
 def node_topology(devices: Optional[Sequence[jax.Device]] = None) -> NodeTopology:
-    """Discover slice structure of the given devices."""
+    """Discover slice + torus structure of the given devices."""
     devices = list(devices if devices is not None else jax.devices())
     slice_ids = []
     for d in devices:
         slice_ids.append(getattr(d, "slice_index", 0) or 0)
     num_slices = len(set(slice_ids)) or 1
+
+    torus_shape = None
+    wraparound: Tuple[bool, ...] = ()
+    first_slice = [d for d, s in zip(devices, slice_ids)
+                   if s == (slice_ids[0] if slice_ids else 0)]
+    coords = [getattr(d, "coords", None) for d in first_slice]
+    if coords and all(c is not None for c in coords):
+        arr = np.asarray(coords)
+        extents = tuple(int(e) for e in arr.max(0) - arr.min(0) + 1)
+        torus_shape = extents
+        kind = getattr(devices[0], "device_kind", "").lower()
+        is_3d_torus = any(kind.startswith(p) or p in kind
+                          for p in _TORUS_3D_PREFIXES)
+        # Published wraparound rule: 3D-torus generations close a
+        # dimension once its extent reaches 4; 2D-mesh generations
+        # (v5e/v6e) only at the full 16-chip pod edge.
+        wraparound = tuple(
+            (e >= 4) if is_3d_torus else (e >= 16) for e in extents)
+
     return NodeTopology(
         num_devices=len(devices),
         num_slices=num_slices,
         devices_per_slice=len(devices) // num_slices,
         platform=devices[0].platform if devices else "cpu",
+        torus_shape=torus_shape,
+        wraparound=wraparound,
     )
 
 
@@ -125,6 +173,33 @@ def make_mesh(
     dev_array = np.array(devices[:total]).reshape(sizes)
     mesh = Mesh(dev_array, tuple(axis_shapes.keys()))
     return MeshContext(mesh=mesh, topology=node_topology(devices[:total]))
+
+
+def make_hierarchical_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    *,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+) -> MeshContext:
+    """Build a two-level (slices × chips-per-slice) mesh with devices
+    grouped by ``slice_index`` on the DCN axis — the mesh the
+    hierarchical collectives (`kernels/hierarchical.py`) expect.
+    Falls back to a 1×N mesh on single-slice (or simulated) backends.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if not devices:
+        raise ValueError("make_hierarchical_mesh: no devices")
+    groups: dict = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", 0) or 0, []).append(d)
+    sizes = {s: len(g) for s, g in groups.items()}
+    if len(set(sizes.values())) != 1:
+        raise ValueError(
+            f"make_hierarchical_mesh: unequal slice sizes {sizes} — "
+            "pass an explicit uniform device subset")
+    dev_array = np.array([g for _, g in sorted(groups.items())])
+    mesh = Mesh(dev_array, (dcn_axis, ici_axis))
+    return MeshContext(mesh=mesh, topology=node_topology(devices))
 
 
 def initialize_distributed(
