@@ -132,3 +132,20 @@ def test_engine_top_p_and_step_profiling(tiny_setup, tmp_path,
     out = engine.serve(params, ids, gen_len=6, profile_decode_steps=2)
     assert out.shape == (b, 6)
     assert bool((out >= 0).all()) and bool((out < cfg.vocab_size).all())
+
+
+def test_engine_serve_fused_mode(tiny_setup):
+    """Engine end-to-end on the fused Pallas backend (prefill AG-GEMM/
+    GEMM-RS + ll decode), greedy — must match the xla backend's tokens
+    (same math, different kernels)."""
+    mesh, cfg, model, params = tiny_setup
+    b, s, gen = 4, 8, 4
+    ids = jax.random.randint(jax.random.key(40), (b, s), 0,
+                             cfg.vocab_size)
+    outs = {}
+    for mode in ("xla", "fused"):
+        model.set_mode(mode)
+        engine = Engine(model, temperature=0.0, scan_decode=True)
+        outs[mode] = engine.serve(params, ids, gen)
+    model.set_mode("xla")
+    assert (outs["fused"] == outs["xla"]).mean() > 0.9, outs

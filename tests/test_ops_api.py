@@ -17,16 +17,16 @@ def test_all_gather_api(tp4_mesh):
 
 
 def test_reduce_scatter_api(tp4_mesh):
-    x = jax.random.normal(jax.random.key(1), (32, 128))
+    # Row r = rank r's partial: distinct per device.
+    x = jax.random.normal(jax.random.key(1), (4, 32, 128))
     out = jax.jit(lambda a: ops.reduce_scatter(a, tp4_mesh))(x)
-    # every device held the same x → sum = world * x
-    assert_allclose(out, 4.0 * x, atol=1e-4, rtol=1e-4)
+    assert_allclose(out, x.sum(0), atol=1e-4, rtol=1e-4)
 
 
 def test_all_reduce_api(tp4_mesh):
-    x = jax.random.normal(jax.random.key(2), (16, 128))
+    x = jax.random.normal(jax.random.key(2), (4, 16, 128))
     out = jax.jit(lambda a: ops.all_reduce(a, tp4_mesh))(x)
-    assert_allclose(out, 4.0 * x, atol=1e-4, rtol=1e-4)
+    assert_allclose(out, x.sum(0), atol=1e-4, rtol=1e-4)
 
 
 def test_all_to_all_api(ep4_mesh):

@@ -213,3 +213,89 @@ def test_reduce_sum_pipeline():
     x = (jax.random.normal(jax.random.key(0), (world, m, n)) / 4
          ).astype(jnp.bfloat16)
     assert _rel_err(f(x), x.astype(jnp.float32).sum(0)) < 5e-3
+
+
+def test_grouped_matmul_count_skipping():
+    """Mosaic acceptance of the count-driven empty-tile skip path
+    (SMEM scalar reads + pl.when inside emit_pipeline) on hardware."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from triton_distributed_tpu.kernels.grouped_gemm import (
+        emit_grouped_matmul)
+
+    e, cap, k, n = 4, 64, 512, 512
+    counts = jnp.array([cap, 16, 0, 0], jnp.int32)
+
+    def body(a_ref, b_ref, c_ref, o_ref):
+        emit_grouped_matmul(a_ref, b_ref, o_ref, num_experts=e, m=cap,
+                            n=n, k=k,
+                            config=MatmulConfig(32, 512, 512),
+                            count_of=lambda g: c_ref[g])
+
+    @jax.jit
+    def f(a, b, c):
+        return pl.pallas_call(
+            body,
+            out_shape=jax.ShapeDtypeStruct((e, cap, n), a.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True,
+                vmem_limit_bytes=100 * 1024 * 1024),
+        )(a, b, c)
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (e, cap), 1)
+    mask = (rows < counts[:, None])[..., None]
+    a = jnp.where(mask, jax.random.normal(jax.random.key(0),
+                                          (e, cap, k)) / 16, 0.0
+                  ).astype(jnp.bfloat16)
+    b = (jax.random.normal(jax.random.key(1), (e, k, n)) / 16
+         ).astype(jnp.bfloat16)
+    out = f(a, b, counts)
+    ref = jnp.einsum("eck,ekn->ecn", a.astype(jnp.float32),
+                     b.astype(jnp.float32))
+    assert _rel_err(out, ref) < 5e-3
+
+
+def test_moe_fused_world1():
+    """MoE epilogue kernel class (grouped GEMM + combine matmul +
+    reduce) compiles and runs on hardware at world=1."""
+    from jax.sharding import Mesh
+
+    from triton_distributed_tpu.kernels import moe_utils
+    from triton_distributed_tpu.kernels.moe_reduce_rs import (
+        MoEReduceRSContext, moe_reduce_rs_fused)
+    from triton_distributed_tpu.ops import shard_map_op
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    world, e, cap, mc, k, n = 1, 4, 32, 64, 256, 256
+    key = jax.random.key(2)
+    buckets = (jax.random.normal(key, (world, e, cap, k)) / 16
+               ).astype(jnp.bfloat16)
+    wdown = (jax.random.normal(jax.random.fold_in(key, 1), (e, k, n))
+             / 16).astype(jnp.bfloat16)
+    ids = jax.random.randint(jax.random.fold_in(key, 2),
+                             (world * mc, 2), 0, e)
+    w = jax.nn.softmax(jax.random.normal(jax.random.fold_in(key, 3),
+                                         (world * mc, 2)))
+    plan = moe_utils.plan_chunks(ids, w, world, e, cap)
+
+    ctx = MoEReduceRSContext(axis="tp", world_size=world, num_experts=e,
+                             topk=2, gemm=MatmulConfig(32, 256, 256))
+    fn = jax.jit(shard_map_op(
+        lambda bb, ww, cc, nn: moe_reduce_rs_fused(bb, ww, cc, ctx,
+                                                   counts=nn),
+        mesh,
+        in_specs=(P(None, None, None, None), P(None, None, None),
+                  P(None, None, None, None), P(None, None)),
+        out_specs=P(None, None)))
+    out = fn(buckets, wdown, plan.combine_mats, plan.counts)
+
+    partial = jnp.einsum("weck,ekn->wecn", buckets.astype(jnp.float32),
+                         wdown.astype(jnp.float32))
+    ref = jnp.einsum("wemc,wecn->wmn", plan.combine_mats,
+                     partial).reshape(world * mc, n)
+    assert _rel_err(out, ref) < 2e-2

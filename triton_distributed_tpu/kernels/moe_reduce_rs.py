@@ -182,6 +182,19 @@ def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
     assert w2 == world and e3 == e and cap2 == cap, combine_mats.shape
     has_counts = counts is not None
 
+    # Mosaic lane tiling: the combine matmul slices cmat along its
+    # last (cap) dim, which must be a 128 multiple on hardware.  Pad
+    # cap with zero coefficients and zero token rows — the padded
+    # stage rows are *computed* zeros (zero inputs), never garbage,
+    # and count-skipping elides their MXU work anyway.
+    cap_p = -cap % 128
+    if cap_p:
+        combine_mats = jnp.pad(
+            combine_mats, ((0, 0), (0, 0), (0, 0), (0, cap_p)))
+        buckets = jnp.pad(
+            buckets, ((0, 0), (0, 0), (0, cap_p), (0, 0)))
+        cap += cap_p
+
     operands = [buckets, expert_weights, combine_mats]
     in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * 3
     if has_counts:

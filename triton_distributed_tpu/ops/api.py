@@ -45,23 +45,29 @@ def all_gather(x, mesh: Mesh, axis: str = "tp",
 
 
 def reduce_scatter(x, mesh: Mesh, axis: str = "tp", **kw):
-    """Reduce replicated per-device partials (M, N) and scatter row
-    chunks: → (M, N) sharded on axis 0."""
+    """Sum per-device partials and scatter row chunks.
+
+    x: (world, M, N) global — row r holds rank r's partial of the full
+    (M, N) array (the leading world dim carries per-rank data, like
+    `all_to_all`).  Returns (M, N) row-sharded over `axis`."""
     ctx = rs_mod.create_reduce_scatter_context(
         axis=axis, world_size=mesh.shape[axis], **kw)
     fn = shard_map_op(
-        functools.partial(rs_mod.reduce_scatter, ctx=ctx),
-        mesh, in_specs=P(None, None), out_specs=P(axis, None))
+        lambda xx: rs_mod.reduce_scatter(xx[0], ctx),
+        mesh, in_specs=P(axis, None, None), out_specs=P(axis, None))
     return fn(x)
 
 
 def all_reduce(x, mesh: Mesh, axis: str = "tp", **kw):
-    """Sum per-device partials (M, N) → replicated (M, N)."""
+    """Sum per-device partials → replicated sum.
+
+    x: (world, M, N) global — row r holds rank r's partial.
+    Returns (M, N), the full sum on every device."""
     ctx = ar_mod.create_allreduce_context(
         axis=axis, world_size=mesh.shape[axis], **kw)
     fn = shard_map_op(
-        functools.partial(ar_mod.all_reduce, ctx=ctx),
-        mesh, in_specs=P(None, None), out_specs=P(None, None))
+        lambda xx: ar_mod.all_reduce(xx[0], ctx),
+        mesh, in_specs=P(axis, None, None), out_specs=P(None, None))
     return fn(x)
 
 
