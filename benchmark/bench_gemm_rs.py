@@ -59,7 +59,8 @@ def main():
         b = jax.random.normal(jax.random.key(1), (args.k, args.n)
                               ).astype(jnp.bfloat16)
         ctx = GEMMReduceScatterContext(axis="tp", world_size=world)
-        method = ctx.resolve_method(m_total // world, jnp.bfloat16)
+        method = ctx.resolve_method(m_total // world, jnp.bfloat16,
+                                    k=args.k, n=args.n)
         fused = jax.jit(shard_map_op(
             functools.partial(gemm_rs, ctx=ctx), mesh, **specs))
         base = jax.jit(shard_map_op(

@@ -78,23 +78,29 @@ class AllGatherGEMMContext:
     for_correctness: bool = False
     interpret: Optional[bool] = None
 
-    #: "auto" switches to the one-shot low-latency path when the
-    #: gathered matrix has at most this many (padded) rows — the
-    #: decode regime.  Mid-size prefill stays on the ring kernel the
-    #: real-TPU autotune validated (vs_baseline 1.0-1.15); the ll
-    #: crossover above this has not been measured on hardware.
+    #: Shape-only fallback for "auto" when K/N are unknown: one-shot
+    #: ll below this many (padded) gathered rows — the decode regime.
     LL_MAX_GATHERED_ROWS = 256
 
-    def resolve_method(self, m: int, dtype) -> str:
+    def resolve_method(self, m: int, dtype, k: Optional[int] = None,
+                       n: Optional[int] = None) -> str:
+        """Pick xla / ll / fused.  With K and N known, the choice is
+        model-driven with hysteresis (`choose_ll_or_fused`); otherwise
+        the shape-only decode threshold decides."""
         assert self.method in ("auto", "fused", "ll", "xla"), self.method
         if self.method != "auto":
             return self.method
-        if self.world_size <= 1:
+        world = self.world_size
+        if world <= 1:
             return "xla"
         mp = round_up_rows(m, dtype)
-        if self.world_size * mp <= self.LL_MAX_GATHERED_ROWS:
-            return "ll"
-        return "fused"
+        if k is None or n is None:
+            return ("ll" if world * mp <= self.LL_MAX_GATHERED_ROWS
+                    else "fused")
+        from triton_distributed_tpu.kernels.comm_perf_model import (
+            choose_ll_or_fused)
+        return choose_ll_or_fused(mp * k * jnp.dtype(dtype).itemsize,
+                                  mp, n, k, world, dtype)
 
 
 def create_ag_gemm_context(axis: str, world_size: int, **kw) -> AllGatherGEMMContext:
@@ -175,7 +181,7 @@ def ag_gemm(a_shard, b, ctx: AllGatherGEMMContext,
     k2, n = b.shape
     assert k == k2, (a_shard.shape, b.shape)
 
-    method = ctx.resolve_method(m, a_shard.dtype)
+    method = ctx.resolve_method(m, a_shard.dtype, k=k, n=n)
     if method == "xla" and world > 1:
         a_full = jax.lax.all_gather(a_shard, ctx.axis, tiled=True)
         out = jnp.dot(a_full, b, preferred_element_type=jnp.float32

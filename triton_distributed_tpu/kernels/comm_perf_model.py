@@ -142,3 +142,29 @@ def one_shot_beats_ring(nbytes: int, world: int,
     all collectives agree on the same perf-model comparison."""
     return (estimate_one_shot_time_us(nbytes, world, spec)
             <= estimate_all_gather_time_us(nbytes, world, spec))
+
+
+def choose_ll_or_fused(chunk_bytes: int, m_rows: int, n: int, k: int,
+                       world: int, dtype,
+                       margin: float = 0.7) -> str:
+    """Shared fused-ring vs one-shot-ll chooser for the overlap GEMMs
+    (ag_gemm / gemm_rs): the ring wins when each chunk's matmul hides
+    its DMA; ll wins when the GEMM is B-streaming-bound (a per-chunk
+    matmul loop re-reads B `world` times).
+
+    ``margin`` is hysteresis protecting the hardware-validated regime:
+    the fused ring (real-TPU autotuned, vs_baseline 1.0-1.15) is only
+    abandoned when the analytic model predicts a DECISIVE ll win
+    (t_ll < margin * t_fused) — published-peak tables with a fixed
+    efficiency derate cannot be trusted to call a 1% margin.
+    """
+    from triton_distributed_tpu.kernels.gemm_perf_model import (
+        estimate_gemm_time_us)
+
+    step_comm = (estimate_all_gather_time_us(chunk_bytes, world)
+                 / max(world - 1, 1))
+    t_fused = world * max(
+        estimate_gemm_time_us(m_rows, n, k, dtype), step_comm)
+    t_ll = (estimate_one_shot_time_us(chunk_bytes, world)
+            + estimate_gemm_time_us(world * m_rows, n, k, dtype))
+    return "ll" if t_ll < margin * t_fused else "fused"

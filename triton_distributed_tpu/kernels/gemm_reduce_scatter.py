@@ -61,22 +61,27 @@ class GEMMReduceScatterContext:
     for_correctness: bool = False
     interpret: Optional[bool] = None
 
-    #: "auto" switches to the one-shot low-latency path when the
-    #: partial matrix has at most this many (padded) rows — the decode
-    #: regime; the crossover above this is unmeasured on hardware, so
-    #: mid-size M stays on the validated ring/swizzle kernel.
+    #: Shape-only fallback for "auto" when K/N are unknown.
     LL_MAX_ROWS = 256
 
-    def resolve_method(self, mc: int, dtype) -> str:
+    def resolve_method(self, mc: int, dtype, k: Optional[int] = None,
+                       n: Optional[int] = None) -> str:
+        """Model-driven fused/ll choice when K/N are known (shared
+        `choose_ll_or_fused` with hysteresis); shape-only decode
+        threshold otherwise."""
         assert self.method in ("auto", "fused", "ll", "xla"), self.method
         if self.method != "auto":
             return self.method
-        if self.world_size <= 1:
+        world = self.world_size
+        if world <= 1:
             return "xla"
         mcp = round_up_rows(mc, dtype)
-        if self.world_size * mcp <= self.LL_MAX_ROWS:
-            return "ll"
-        return "fused"
+        if k is None or n is None:
+            return "ll" if world * mcp <= self.LL_MAX_ROWS else "fused"
+        from triton_distributed_tpu.kernels.comm_perf_model import (
+            choose_ll_or_fused)
+        return choose_ll_or_fused(mcp * n * jnp.dtype(dtype).itemsize,
+                                  mcp, n, k, world, dtype)
 
 
 def create_gemm_rs_context(axis: str, world_size: int, **kw):
@@ -168,7 +173,7 @@ def gemm_rs(a, b, ctx: GEMMReduceScatterContext):
     assert k == k2 and mt % world == 0, (a.shape, b.shape, world)
     mc = mt // world
 
-    method = ctx.resolve_method(mc, a.dtype)
+    method = ctx.resolve_method(mc, a.dtype, k=k, n=n)
     if method == "xla" or world <= 1:
         return gemm_rs_nonoverlap(a, b, ctx.axis)
 
