@@ -1,0 +1,75 @@
+"""Flash attention (causal prefill) vs the XLA attention baseline
+(`jax.nn.dot_product_attention`).
+
+Emits one JSON line per sequence length.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+import argparse
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels.flash_attention import flash_attention
+from triton_distributed_tpu.utils.benchmarking import measure_ops
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", type=int, nargs="*",
+                    default=[1024, 4096, 8192])
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--repeats", type=int, default=4)
+    args = ap.parse_args()
+
+    b, h, d = 1, args.heads, args.head_dim
+    for s in args.seqs:
+        q = (jax.random.normal(jax.random.key(0), (b, h, s, d)) / 4
+             ).astype(jnp.bfloat16)
+        k = (jax.random.normal(jax.random.key(1), (b, h, s, d)) / 4
+             ).astype(jnp.bfloat16)
+        v = (jax.random.normal(jax.random.key(2), (b, h, s, d)) / 4
+             ).astype(jnp.bfloat16)
+
+        flash = jax.jit(functools.partial(flash_attention, causal=True))
+
+        def xla_attn(q_, k_, v_):
+            # XLA's fused attention path (cuDNN/Mosaic-flash when
+            # available, else the composable reference).
+            qt = jnp.swapaxes(q_, 1, 2)
+            out = jax.nn.dot_product_attention(
+                qt, jnp.swapaxes(k_, 1, 2), jnp.swapaxes(v_, 1, 2),
+                is_causal=True)
+            return jnp.swapaxes(out, 1, 2)
+
+        base = jax.jit(xla_attn)
+
+        # Chain through q (same shape as out).  The chain MUST be
+        # jitted: eager ops cost ~5 ms each through the tunnel and
+        # would swamp the op being measured.
+        mix = jax.jit(lambda x, out: (
+            x * jnp.bfloat16(0.5)
+            + out * jnp.bfloat16(1e-3)).astype(jnp.bfloat16))
+        chain = lambda a, out: (mix(a[0], out), a[1], a[2])
+        t_flash, t_base = measure_ops([flash, base], (q, k, v), chain,
+                                      repeats=args.repeats)
+        # Causal: ~half the full QK^T + PV FLOPs.
+        flops = 4 * b * h * s * s * d / 2
+        print(json.dumps({
+            "bench": "flash_attention", "S": s, "H": h, "D": d,
+            "us": round(t_flash * 1e6, 1),
+            "tflops": round(flops / t_flash / 1e12, 1),
+            "vs_baseline": round(t_base / t_flash, 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

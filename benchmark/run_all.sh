@@ -8,3 +8,4 @@ python benchmark/bench_ag_gemm.py
 python benchmark/bench_gemm_rs.py
 python benchmark/bench_allreduce.py
 python benchmark/bench_all_to_all.py
+python benchmark/bench_attention.py

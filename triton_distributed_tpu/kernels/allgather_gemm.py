@@ -182,16 +182,27 @@ def ag_gemm(a_shard, b, ctx: AllGatherGEMMContext,
     assert k == k2, (a_shard.shape, b.shape)
 
     method = ctx.resolve_method(m, a_shard.dtype, k=k, n=n)
+
+    def xla_dot(a_full):
+        return jnp.dot(a_full, b, preferred_element_type=jnp.float32
+                       ).astype(a_shard.dtype)
+
     if method == "xla" and world > 1:
         a_full = jax.lax.all_gather(a_shard, ctx.axis, tiled=True)
-        out = jnp.dot(a_full, b, preferred_element_type=jnp.float32
-                      ).astype(a_shard.dtype)
+        out = xla_dot(a_full)
         return (out, a_full) if return_gathered else out
 
     if world <= 1:
-        # Single device: no comm — run the tuned MXU pipeline directly.
-        from triton_distributed_tpu.kernels.matmul import matmul
-        out = matmul(a_shard, b, config=ctx.gemm, interpret=ctx.interpret)
+        # Single device: no comm.  `method` is "xla" here unless a
+        # fused path was requested explicitly (e.g. by the autotuner
+        # with a tuned config) — the XLA dot needs no tuning to be
+        # fast.
+        if method in ("fused", "ll"):
+            from triton_distributed_tpu.kernels.matmul import matmul
+            out = matmul(a_shard, b, config=ctx.gemm,
+                         interpret=ctx.interpret)
+        else:
+            out = xla_dot(a_shard)
         return (out, a_shard) if return_gathered else out
 
     # Pad rows to the Mosaic sublane multiple (sliced back below).

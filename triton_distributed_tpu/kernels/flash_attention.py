@@ -38,40 +38,58 @@ def _flash_kernel(nk: int, sk: int, scale: float, causal: bool,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0, 0]                       # (bq, D)
-    k = k_ref[0, 0]                       # (bk, D)
-    v = v_ref[0, 0]
+    def attend_block():
+        q = q_ref[0, 0]                   # (bq, D)
+        k = k_ref[0, 0]                   # (bk, D)
+        v = v_ref[0, 0]
 
-    s = jax.lax.dot_general(
-        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
 
-    k_pos = (ki * block_k
-             + jax.lax.broadcasted_iota(jnp.int32,
-                                        (block_q, block_k), 1))
-    if sk % block_k != 0:
-        # KV-length bound mask: the last block's padded columns must
-        # not reach the softmax (they'd contribute garbage whenever
-        # causal=False or kv_offset > 0 lets them through).
-        s = jnp.where(k_pos < sk, s, NEG_INF)
-    if causal:
-        q_pos = (qi * block_q
+        k_pos = (ki * block_k
                  + jax.lax.broadcasted_iota(jnp.int32,
-                                            (block_q, block_k), 0)
-                 + off_ref[0])
-        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+                                            (block_q, block_k), 1))
+        if sk % block_k != 0:
+            # KV-length bound mask: the last block's padded columns
+            # must not reach the softmax (they'd contribute garbage
+            # whenever causal=False or kv_offset > 0 lets them
+            # through).
+            s = jnp.where(k_pos < sk, s, NEG_INF)
+        if causal:
+            q_pos = (qi * block_q
+                     + jax.lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 0)
+                     + off_ref[0])
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
 
-    m_prev = m_scr[:]                     # (bq, 1)
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)                # (bq, bk)
-    l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-        p.astype(v.dtype), v, dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_scr[:] = m_new
-    l_scr[:] = l_new
+        m_prev = m_scr[:]                 # (bq, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)            # (bq, bk)
+        l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    if causal:
+        # Skip blocks entirely above the causal diagonal (their every
+        # score is masked): ~2× for the triangular schedule.  NOTE on
+        # fully-masked ROWS: their lse is ≈ -inf either way (so
+        # lse-weighted combines drop them), but the raw out is exactly
+        # 0 only when all the row's blocks were skipped — a masked row
+        # inside a visible block produces the classic p = exp(0)
+        # uniform average instead.  Callers that can present
+        # fully-masked rows must consume lse.
+        visible = ki * block_k <= (qi * block_q + block_q - 1
+                                   + off_ref[0])
+        pl.when(visible)(attend_block)
+    else:
+        attend_block()
 
     @pl.when(ki == nk - 1)
     def _():
@@ -84,16 +102,18 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
                     kv_offset=0,
                     return_lse: bool = False,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 1024,
                     interpret: Optional[bool] = None):
     """q: (B, H, Sq, D); k, v: (B, Hkv, Sk, D) → (B, H, Sq, D)
     [, lse (B, H, Sq)].
 
     `kv_offset` (python int or traced scalar) shifts the causal
     diagonal: query row i attends kv cols <= i + kv_offset (used by SP
-    attention where local queries sit at a global offset).  If all
-    positions of a row are masked the row output is 0 with lse ≈ -inf,
-    which drops out of an LSE-weighted combine.
+    attention where local queries sit at a global offset).  Fully
+    masked rows have lse ≈ -inf and drop out of an LSE-weighted
+    combine; their raw `out` values are unspecified (callers that can
+    present fully-masked rows must consume lse — see the note at the
+    skip logic in `_flash_kernel`).
     """
     b, h, sq, d = q.shape
     _, hkv, sk, _ = k.shape

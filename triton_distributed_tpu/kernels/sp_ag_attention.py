@@ -136,32 +136,41 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
             l_scr[:] = jnp.zeros_like(l_scr)
             acc_scr[:] = jnp.zeros_like(acc_scr)
 
-        q = q_blk[0, 0]
-        k = k_blk[0, 0]
-        v = v_blk[0, 0]
-        s = jax.lax.dot_general(
-            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+        def attend_block():
+            q = q_blk[0, 0]
+            k = k_blk[0, 0]
+            v = v_blk[0, 0]
+            s = jax.lax.dot_general(
+                q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
 
-        k_pos = (ki * bk
-                 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
-        if sk % bk != 0:
-            s = jnp.where(k_pos < sk, s, NEG_INF)
-        q_pos = (qi * bq
-                 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-                 + off)
-        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            k_pos = (ki * bk
+                     + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
+            if sk % bk != 0:
+                s = jnp.where(k_pos < sk, s, NEG_INF)
+            q_pos = (qi * bq
+                     + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                     + off)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
 
-        m_prev = m_scr[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[:] = m_new
+            m_prev = m_scr[:]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(s, axis=1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_scr[:] = (alpha * l_scr[:]
+                        + jnp.sum(p, axis=1, keepdims=True))
+            acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+                p.astype(v.dtype), v,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[:] = m_new
+
+        # Skip blocks entirely above the causal diagonal (the
+        # within-chunk triangle; whole future chunks are skipped one
+        # level up in the ring loop).
+        visible = ki * bk <= (qi * bq + bq - 1 + off)
+        pl.when(visible)(attend_block)
 
         @pl.when(ki == nk - 1)
         def _():

@@ -50,22 +50,27 @@ def measure_ops(fs: Sequence[Callable], args: tuple,
     for f in fs:
         total(f, 2)  # warm every jit
     if n2 is None:
-        # Grow the window until the measured (t2 - t1) dominates the
-        # fetch jitter for EVERY op — a pilot estimate would itself be
-        # jitter-dominated for fast ops, and calibrating on one op
-        # leaves faster competitors under-measured.
-        n2 = max(220, 4 * n1)
+        # Grow each op's window until its measured (t2 - t1) dominates
+        # the fetch jitter — a pilot estimate would itself be
+        # jitter-dominated for fast ops.  Per-op windows: sizing by
+        # the fastest op would charge its large call count to a slow
+        # competitor (minutes per sample).
+        n2s = []
         for f in fs:
-            while n2 < 8000:
-                if total(f, n2) - total(f, n1) >= min_window_s:
+            n = max(3 * n1, n1 + 40)
+            while n < 8000:
+                if total(f, n) - total(f, n1) >= min_window_s:
                     break
-                n2 = min(8000, n2 * 4)
+                n = min(8000, n * 4)
+            n2s.append(n)
+    else:
+        n2s = [n2] * len(fs)
     slopes = [[] for _ in fs]
     for _ in range(repeats):
-        for sl, f in zip(slopes, fs):
+        for sl, f, n in zip(slopes, fs, n2s):
             t1 = total(f, n1)
-            t2 = total(f, n2)
-            sl.append(max((t2 - t1) / (n2 - n1), 1e-9))
+            t2 = total(f, n)
+            sl.append(max((t2 - t1) / (n - n1), 1e-9))
     return [statistics.median(sl) for sl in slopes]
 
 
