@@ -18,7 +18,10 @@ import jax
 import jax.numpy as jnp
 
 from triton_distributed_tpu.kernels.flash_attention import flash_attention
-from triton_distributed_tpu.utils.benchmarking import measure_ops
+from triton_distributed_tpu.utils.benchmarking import (
+    feedback_mix,
+    measure_ops,
+)
 
 
 def main():
@@ -55,9 +58,7 @@ def main():
         # Chain through q (same shape as out).  The chain MUST be
         # jitted: eager ops cost ~5 ms each through the tunnel and
         # would swamp the op being measured.
-        mix = jax.jit(lambda x, out: (
-            x * jnp.bfloat16(0.5)
-            + out * jnp.bfloat16(1e-3)).astype(jnp.bfloat16))
+        mix = jax.jit(feedback_mix)
         chain = lambda a, out: (mix(a[0], out), a[1], a[2])
         t_flash, t_base = measure_ops([flash, base], (q, k, v), chain,
                                       repeats=args.repeats)

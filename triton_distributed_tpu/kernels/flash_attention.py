@@ -163,10 +163,11 @@ def flash_attention(q, k, v, *, causal: bool = True,
             ],
         ),
         cost_estimate=pl.CostEstimate(
-            flops=4 * b * h * sq * sk * d,
+            # Causal block-skipping executes ~half the (qi, ki) grid.
+            flops=4 * b * h * sq * sk * d // (2 if causal else 1),
             bytes_accessed=(b * h * sq * d * 2
                             + b * hkv * sk * d * 2) * q.dtype.itemsize,
-            transcendentals=b * h * sq * sk,
+            transcendentals=b * h * sq * sk // (2 if causal else 1),
         ),
         interpret=default_interpret(interpret),
     )(off, q, k, v)

@@ -80,7 +80,7 @@ def _decode_kernel(nk: int, scale: float, block_k: int,
 
 
 def flash_decode(q, k_cache, v_cache, kv_len, *,
-                 scale: Optional[float] = None, block_k: int = 256,
+                 scale: Optional[float] = None, block_k: int = 2048,
                  interpret: Optional[bool] = None):
     """Single-position GQA decode.
 
@@ -149,7 +149,7 @@ def combine_partials(outs, lses):
 
 
 def sp_flash_decode(q, k_shard, v_shard, kv_len_local, axis: str, *,
-                    scale: Optional[float] = None, block_k: int = 256,
+                    scale: Optional[float] = None, block_k: int = 2048,
                     collective_id: int = cids.FLASH_DECODE_AG,
                     interpret: Optional[bool] = None):
     """Sequence-parallel distributed flash-decode.  Call inside
