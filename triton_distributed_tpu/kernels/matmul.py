@@ -75,6 +75,8 @@ def matmul_config_space(m: int, n: int, k: int):
         MatmulConfig(1024, 2048, 1024),
         MatmulConfig(1024, 2048, 512),
         MatmulConfig(2048, 1024, 1024),
+        MatmulConfig(1024, 3584, 1024),
+        MatmulConfig(2048, 3584, 512),
         MatmulConfig(1024, 1024, 512),
         MatmulConfig(512, 1024, 512),
         MatmulConfig(512, 512, 1024),
