@@ -1,0 +1,60 @@
+"""Grouped (per-expert) GEMM vs the XLA einsum baseline — the MoE
+compute core (`kernels/grouped_gemm.py`).
+
+Emits one JSON line per (E, cap, k, n) shape.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+import argparse
+import json
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels.grouped_gemm import grouped_matmul
+from triton_distributed_tpu.kernels.matmul import MatmulConfig
+from triton_distributed_tpu.utils.benchmarking import (
+    feedback_mix,
+    measure_ops,
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shapes", nargs="*", default=[
+        "8,512,2048,1408", "64,128,2048,1408", "8,1024,7168,2048"])
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    for spec in args.shapes:
+        e, cap, k, n = (int(x) for x in spec.split(","))
+        a = (jax.random.normal(jax.random.key(0), (e, cap, k)) / 16
+             ).astype(jnp.bfloat16)
+        b = (jax.random.normal(jax.random.key(1), (e, k, n)) / 16
+             ).astype(jnp.bfloat16)
+
+        grouped = jax.jit(grouped_matmul)
+        base = jax.jit(lambda x, y: jnp.einsum(
+            "eck,ekn->ecn", x, y,
+            preferred_element_type=jnp.float32).astype(x.dtype))
+
+        mix = jax.jit(feedback_mix)
+        chain = lambda ar, out: (mix(ar[0], out), ar[1])
+        t_g, t_b = measure_ops([grouped, base], (a, b), chain,
+                               repeats=args.repeats)
+        flops = 2 * e * cap * k * n
+        print(json.dumps({
+            "bench": "grouped_gemm", "E": e, "cap": cap, "K": k, "N": n,
+            "us": round(t_g * 1e6, 1),
+            "tflops": round(flops / t_g / 1e12, 1),
+            "vs_baseline": round(t_b / t_g, 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,7 +23,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu.kernels.matmul import MatmulConfig, emit_matmul
-from triton_distributed_tpu.utils.platform import default_interpret
+from triton_distributed_tpu.utils.platform import (
+    SCOPED_VMEM_LIMIT,
+    default_interpret,
+)
 
 
 def _grouped_kernel(nk: int, a_ref, b_ref, o_ref, acc_ref):
@@ -74,6 +77,11 @@ def grouped_matmul(a, b, config: Optional[MatmulConfig] = None,
                 pltpu.VMEM((min(cfg.block_m, m), min(cfg.block_n, n)),
                            jnp.float32)
             ],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+            vmem_limit_bytes=SCOPED_VMEM_LIMIT,
         ),
         cost_estimate=pl.CostEstimate(
             flops=2 * e * m * n * k,
