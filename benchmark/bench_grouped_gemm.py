@@ -17,7 +17,6 @@ import jax
 import jax.numpy as jnp
 
 from triton_distributed_tpu.kernels.grouped_gemm import grouped_matmul
-from triton_distributed_tpu.kernels.matmul import MatmulConfig
 from triton_distributed_tpu.utils.benchmarking import (
     feedback_mix,
     measure_ops,

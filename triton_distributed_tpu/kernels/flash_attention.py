@@ -19,7 +19,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from triton_distributed_tpu.utils.platform import default_interpret
+from triton_distributed_tpu.utils.platform import (
+    SCOPED_VMEM_LIMIT as VMEM_LIMIT,
+    default_interpret,
+)
 
 NEG_INF = -1e30
 
@@ -161,6 +164,9 @@ def flash_attention(q, k, v, *, causal: bool = True,
                 pltpu.VMEM((bq, 1), jnp.float32),
                 pltpu.VMEM((bq, d), jnp.float32),
             ],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=VMEM_LIMIT,
         ),
         cost_estimate=pl.CostEstimate(
             # Causal block-skipping executes ~half the (qi, ki) grid.

@@ -60,7 +60,7 @@ def _merge(out_a, lse_a, out_b, lse_b):
 
 def sp_ring_attention(q, k_shard, v_shard, axis: str, *,
                       scale: Optional[float] = None,
-                      block_q: int = 128, block_k: int = 128,
+                      block_q: int = 512, block_k: int = 1024,
                       interpret: Optional[bool] = None):
     """Causal ring attention.  Call inside shard_map over `axis`.
 
@@ -340,7 +340,7 @@ def _sp_ag_attn_fused_kernel(axis, world, scale, block_q, block_k, group,
 
 def sp_ag_attention_fused(q, k_shard, v_shard, axis: str, *,
                           scale: Optional[float] = None,
-                          block_q: int = 128, block_k: int = 128,
+                          block_q: int = 512, block_k: int = 1024,
                           q_offset=None, kv_base=0,
                           return_lse: bool = False,
                           collective_id: int = cids.SP_AG_FUSED,
@@ -428,7 +428,7 @@ def sp_ag_attention_fused(q, k_shard, v_shard, axis: str, *,
 
 def sp_ag_attention_2d(q, k_shard, v_shard, hctx, *,
                        scale: Optional[float] = None,
-                       block_q: int = 128, block_k: int = 128,
+                       block_q: int = 512, block_k: int = 1024,
                        interpret: Optional[bool] = None):
     """Two-level SP attention (reference:
     `sp_ag_attention_inter_node.py:115,504`): KV shards cross DCN once
@@ -507,7 +507,7 @@ def zigzag_unshard(x, world: int, axis_dim: int = 2):
 
 def sp_ring_attention_zigzag(q, k_shard, v_shard, axis: str, *,
                              scale: Optional[float] = None,
-                             block_q: int = 128, block_k: int = 128,
+                             block_q: int = 512, block_k: int = 1024,
                              interpret: Optional[bool] = None):
     """Load-balanced causal ring attention over zigzag-sharded inputs.
 
@@ -566,7 +566,7 @@ def sp_ring_attention_zigzag(q, k_shard, v_shard, axis: str, *,
 
 def sp_ag_attention_gather(q, k_shard, v_shard, axis: str, *,
                            scale: Optional[float] = None,
-                           block_q: int = 128, block_k: int = 128,
+                           block_q: int = 512, block_k: int = 1024,
                            collective_id: int = cids.SP_AG_GATHER,
                            interpret: Optional[bool] = None):
     """Literal allgather-KV-then-attend (the reference's intra-node
