@@ -25,13 +25,24 @@ from triton_distributed_tpu.utils.platform import (
 )
 
 NEG_INF = -1e30
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
 
 
-def _flash_kernel(nk: int, sk: int, scale: float, causal: bool,
+def _flash_kernel(nk: int, sk: int, causal: bool,
                   block_q: int, block_k: int,
                   off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                   m_scr, l_scr, acc_scr):
-    """Grid: (B, H, nq, nk); blocks: q (1,1,bq,D), k/v (1,1,bk,D)."""
+    """Grid: (B, H, nq, nk); blocks: q (1,1,bq,D), k/v (1,1,bk,D).
+
+    `q` arrives pre-scaled by `scale * log2(e)` (done once in XLA by
+    the host wrapper), so the online softmax runs in the exp2 domain —
+    no per-block full-tile scale multiply, and `exp2` saves `exp`'s
+    internal log2(e) multiply.  Only `m_scr` is in log2 units;
+    `l_scr` is a natural-domain weight sum (exp2 of log2-differences
+    equals the natural softmax weights), so the epilogue's lse is
+    `m * ln2 + log(l)` — do NOT also convert `log(l)`.
+    """
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -42,13 +53,26 @@ def _flash_kernel(nk: int, sk: int, scale: float, causal: bool,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def attend_block():
-        q = q_ref[0, 0]                   # (bq, D)
+        q = q_ref[0, 0]                   # (bq, D), pre-scaled
         k = k_ref[0, 0]                   # (bk, D)
         v = v_ref[0, 0]
+        if sk % block_k != 0:
+            # The ragged last block's out-of-bounds V rows are
+            # uninitialized on hardware (interpret mode zero-fills,
+            # hiding this).  The bound mask below makes their p
+            # exactly 0, but the PV matmul still computes 0 × garbage
+            # — NaN whenever the debris decodes as NaN/Inf — so zero
+            # the rows.  (K needs no cleanup: garbage scores are
+            # *selected away* by the mask, not multiplied.)  For
+            # non-last blocks every row passes, so this is one cheap
+            # (bk, D) select with no branch.
+            v_row = (ki * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0))
+            v = jnp.where(v_row < sk, v, 0)
 
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+            preferred_element_type=jnp.float32)           # (bq, bk)
 
         k_pos = (ki * block_k
                  + jax.lax.broadcasted_iota(jnp.int32,
@@ -66,11 +90,11 @@ def _flash_kernel(nk: int, sk: int, scale: float, causal: bool,
                      + off_ref[0])
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
 
-        m_prev = m_scr[:]                 # (bq, 1)
+        m_prev = m_scr[:]                 # (bq, 1), log2 domain
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)            # (bq, bk)
+        alpha = jnp.exp2(m_prev - m_new)
+        p = jnp.exp2(s - m_new)           # (bq, bk)
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v,
@@ -98,14 +122,15 @@ def _flash_kernel(nk: int, sk: int, scale: float, causal: bool,
     def _():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_scr[:] + jnp.log(l)   # (bq, 1)
+        # m is log2-domain; lse stays natural-log at the API boundary.
+        lse_ref[0, 0] = m_scr[:] * LN2 + jnp.log(l)   # (bq, 1)
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
                     kv_offset=0,
                     return_lse: bool = False,
-                    block_q: int = 512, block_k: int = 1024,
+                    block_q: int = 1024, block_k: int = 1024,
                     interpret: Optional[bool] = None):
     """q: (B, H, Sq, D); k, v: (B, Hkv, Sk, D) → (B, H, Sq, D)
     [, lse (B, H, Sq)].
@@ -129,8 +154,13 @@ def flash_attention(q, k, v, *, causal: bool = True,
     nk = pl.cdiv(sk, bk)
     off = jnp.asarray(kv_offset, jnp.int32).reshape(1)
 
+    # Fold the softmax scale and exp→exp2 conversion into q once (XLA
+    # fuses this into the producer); saves a full-tile multiply per
+    # (bq, bk) block inside the kernel.
+    q = (q * jnp.asarray(scale * LOG2E, jnp.float32)).astype(q.dtype)
+
     out, lse = pl.pallas_call(
-        functools.partial(_flash_kernel, nk, sk, scale, causal, bq, bk),
+        functools.partial(_flash_kernel, nk, sk, causal, bq, bk),
         out_shape=(
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),

@@ -34,7 +34,7 @@ from triton_distributed_tpu.utils.platform import default_interpret
 NEG_INF = -1e30
 
 
-def _decode_kernel(nk: int, scale: float, block_k: int,
+def _decode_kernel(nk: int, s_cache: int, scale: float, block_k: int,
                    kvlen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                    m_scr, l_scr, acc_scr):
     """Grid: (B, Hkv, nk).  Blocks: q (1, 1, G, D) — all grouped query
@@ -51,6 +51,16 @@ def _decode_kernel(nk: int, scale: float, block_k: int,
     q = q_ref[0, 0]                        # (G, D)
     k = k_ref[0, 0]                        # (bk, D)
     v = v_ref[0, 0]
+    if s_cache % block_k != 0:
+        # Ragged cache tail: the last block's rows past the cache end
+        # are uninitialized on hardware.  The kv_len mask makes their
+        # p exactly 0, but the PV matmul still computes 0 × garbage —
+        # NaN when the debris decodes as NaN/Inf — so zero the rows.
+        # (Rows in [kv_len, s_cache) are real allocated cache: finite,
+        # already handled by the mask alone.)
+        v_row = (ki * block_k
+                 + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0))
+        v = jnp.where(v_row < s_cache, v, 0)
 
     s = jax.lax.dot_general(
         q, k, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -97,7 +107,7 @@ def flash_decode(q, k_cache, v_cache, kv_len, *,
 
     qg = q.reshape(b, hkv, g, d)
     out, lse = pl.pallas_call(
-        functools.partial(_decode_kernel, nk, scale, bk),
+        functools.partial(_decode_kernel, nk, s, scale, bk),
         out_shape=(
             jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
             jax.ShapeDtypeStruct((b, hkv, g, 1), jnp.float32),
@@ -144,6 +154,12 @@ def combine_partials(outs, lses):
     m = jnp.max(lses, axis=0, keepdims=True)          # (1, B, H)
     w = jnp.exp(lses - m)                             # (R, B, H)
     denom = jnp.sum(w, axis=0)                        # (B, H)
+    # An empty shard (lse = -inf, w = 0) may carry garbage partials —
+    # e.g. a kv_len=0 rank whose kernel averaged uninitialized rows;
+    # 0 × NaN would poison the sum.  Gate on the weight (NOT on
+    # finiteness: a live shard's genuine NaN/Inf must still propagate
+    # rather than be silently replaced by a finite wrong answer).
+    outs = jnp.where(w[..., None] > 0, outs, 0)
     num = jnp.einsum("rbh,rbhd->bhd", w, outs.astype(jnp.float32))
     return (num / jnp.maximum(denom, 1e-30)[..., None]).astype(outs.dtype)
 

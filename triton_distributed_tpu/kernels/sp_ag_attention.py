@@ -60,7 +60,7 @@ def _merge(out_a, lse_a, out_b, lse_b):
 
 def sp_ring_attention(q, k_shard, v_shard, axis: str, *,
                       scale: Optional[float] = None,
-                      block_q: int = 512, block_k: int = 1024,
+                      block_q: int = 1024, block_k: int = 1024,
                       interpret: Optional[bool] = None):
     """Causal ring attention.  Call inside shard_map over `axis`.
 
@@ -140,6 +140,16 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
             q = q_blk[0, 0]
             k = k_blk[0, 0]
             v = v_blk[0, 0]
+            if sk % bk != 0:
+                # Ragged last KV tile: its out-of-bounds VMEM rows are
+                # stale/uninitialized on hardware; the bound mask below
+                # makes their p exactly 0 but the PV matmul would still
+                # compute 0 × garbage (NaN if the debris decodes as
+                # NaN/Inf) — zero the rows (see `flash_attention`).
+                v_row = (ki * bk
+                         + jax.lax.broadcasted_iota(jnp.int32,
+                                                    v.shape, 0))
+                v = jnp.where(v_row < sk, v, 0)
             s = jax.lax.dot_general(
                 q, k, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
@@ -340,7 +350,7 @@ def _sp_ag_attn_fused_kernel(axis, world, scale, block_q, block_k, group,
 
 def sp_ag_attention_fused(q, k_shard, v_shard, axis: str, *,
                           scale: Optional[float] = None,
-                          block_q: int = 512, block_k: int = 1024,
+                          block_q: int = 1024, block_k: int = 1024,
                           q_offset=None, kv_base=0,
                           return_lse: bool = False,
                           collective_id: int = cids.SP_AG_FUSED,
@@ -428,7 +438,7 @@ def sp_ag_attention_fused(q, k_shard, v_shard, axis: str, *,
 
 def sp_ag_attention_2d(q, k_shard, v_shard, hctx, *,
                        scale: Optional[float] = None,
-                       block_q: int = 512, block_k: int = 1024,
+                       block_q: int = 1024, block_k: int = 1024,
                        interpret: Optional[bool] = None):
     """Two-level SP attention (reference:
     `sp_ag_attention_inter_node.py:115,504`): KV shards cross DCN once
@@ -507,7 +517,7 @@ def zigzag_unshard(x, world: int, axis_dim: int = 2):
 
 def sp_ring_attention_zigzag(q, k_shard, v_shard, axis: str, *,
                              scale: Optional[float] = None,
-                             block_q: int = 512, block_k: int = 1024,
+                             block_q: int = 1024, block_k: int = 1024,
                              interpret: Optional[bool] = None):
     """Load-balanced causal ring attention over zigzag-sharded inputs.
 
@@ -566,7 +576,7 @@ def sp_ring_attention_zigzag(q, k_shard, v_shard, axis: str, *,
 
 def sp_ag_attention_gather(q, k_shard, v_shard, axis: str, *,
                            scale: Optional[float] = None,
-                           block_q: int = 512, block_k: int = 1024,
+                           block_q: int = 1024, block_k: int = 1024,
                            collective_id: int = cids.SP_AG_GATHER,
                            interpret: Optional[bool] = None):
     """Literal allgather-KV-then-attend (the reference's intra-node
