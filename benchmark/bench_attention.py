@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from triton_distributed_tpu.kernels.flash_attention import flash_attention
 from triton_distributed_tpu.utils.benchmarking import (
     feedback_mix,
-    measure_ops,
+    measure_ops_scanned,
 )
 
 
@@ -42,7 +42,7 @@ def main():
         v = (jax.random.normal(jax.random.key(2), (b, h, s, d)) / 4
              ).astype(jnp.bfloat16)
 
-        flash = jax.jit(functools.partial(flash_attention, causal=True))
+        flash = functools.partial(flash_attention, causal=True)
 
         def xla_attn(q_, k_, v_):
             # XLA's fused attention path (cuDNN/Mosaic-flash when
@@ -53,15 +53,16 @@ def main():
                 is_causal=True)
             return jnp.swapaxes(out, 1, 2)
 
-        base = jax.jit(xla_attn)
+        base = xla_attn
 
-        # Chain through q (same shape as out).  The chain MUST be
-        # jitted: eager ops cost ~5 ms each through the tunnel and
-        # would swamp the op being measured.
-        mix = jax.jit(feedback_mix)
-        chain = lambda a, out: (mix(a[0], out), a[1], a[2])
-        t_flash, t_base = measure_ops([flash, base], (q, k, v), chain,
-                                      repeats=args.repeats)
+        # Chain through q (same shape as out), n_inner iterations per
+        # dispatch inside one jitted scan — one-dispatch-per-call
+        # timing bottoms out at the tunnel's dispatch floor for the
+        # short sequences.
+        mix = lambda a, out: (feedback_mix(a[0], out), a[1], a[2])
+        t_flash, t_base = measure_ops_scanned(
+            [flash, base], (q, k, v), mix, n_inner=8,
+            repeats=args.repeats)
         # Causal: ~half the full QK^T + PV FLOPs.
         flops = 4 * b * h * s * s * d / 2
         print(json.dumps({

@@ -9,4 +9,5 @@ python benchmark/bench_gemm_rs.py
 python benchmark/bench_allreduce.py
 python benchmark/bench_all_to_all.py
 python benchmark/bench_attention.py
+python benchmark/bench_flash_decode.py
 python benchmark/bench_grouped_gemm.py

@@ -29,6 +29,25 @@ LOG2E = 1.4426950408889634
 LN2 = 0.6931471805599453
 
 
+def zero_oob_rows(v, block_idx, block_rows: int, bound: int):
+    """Zero the rows of tile ``v`` whose global row index
+    (``block_idx * block_rows + local_row``) is past ``bound``.
+
+    Ragged-tail guard shared by every attention kernel: the last KV
+    block's out-of-bounds rows are uninitialized on hardware
+    (interpret mode zero-fills, hiding it).  The score masks make
+    those rows' p exactly 0, but the PV matmul still computes
+    0 × garbage — NaN whenever the debris decodes as NaN/Inf — so the
+    V rows themselves must be zeroed.  (K needs no cleanup: garbage
+    scores are *selected away* by the mask, not multiplied.)  For
+    non-last blocks every row passes: one cheap (rows, D) select, no
+    branch.
+    """
+    row = (block_idx * block_rows
+           + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0))
+    return jnp.where(row < bound, v, 0)
+
+
 def _flash_kernel(nk: int, sk: int, causal: bool,
                   block_q: int, block_k: int,
                   off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -57,18 +76,7 @@ def _flash_kernel(nk: int, sk: int, causal: bool,
         k = k_ref[0, 0]                   # (bk, D)
         v = v_ref[0, 0]
         if sk % block_k != 0:
-            # The ragged last block's out-of-bounds V rows are
-            # uninitialized on hardware (interpret mode zero-fills,
-            # hiding this).  The bound mask below makes their p
-            # exactly 0, but the PV matmul still computes 0 × garbage
-            # — NaN whenever the debris decodes as NaN/Inf — so zero
-            # the rows.  (K needs no cleanup: garbage scores are
-            # *selected away* by the mask, not multiplied.)  For
-            # non-last blocks every row passes, so this is one cheap
-            # (bk, D) select with no branch.
-            v_row = (ki * block_k
-                     + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0))
-            v = jnp.where(v_row < sk, v, 0)
+            v = zero_oob_rows(v, ki, block_k, sk)
 
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),

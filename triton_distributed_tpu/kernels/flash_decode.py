@@ -29,6 +29,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu import collective_ids as cids
 
+from triton_distributed_tpu.kernels.flash_attention import zero_oob_rows
 from triton_distributed_tpu.utils.platform import default_interpret
 
 NEG_INF = -1e30
@@ -52,15 +53,10 @@ def _decode_kernel(nk: int, s_cache: int, scale: float, block_k: int,
     k = k_ref[0, 0]                        # (bk, D)
     v = v_ref[0, 0]
     if s_cache % block_k != 0:
-        # Ragged cache tail: the last block's rows past the cache end
-        # are uninitialized on hardware.  The kv_len mask makes their
-        # p exactly 0, but the PV matmul still computes 0 × garbage —
-        # NaN when the debris decodes as NaN/Inf — so zero the rows.
-        # (Rows in [kv_len, s_cache) are real allocated cache: finite,
-        # already handled by the mask alone.)
-        v_row = (ki * block_k
-                 + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0))
-        v = jnp.where(v_row < s_cache, v, 0)
+        # Rows in [kv_len, s_cache) are real allocated cache (finite,
+        # handled by the mask alone); only rows past the cache end are
+        # uninitialized and need the shared ragged-tail guard.
+        v = zero_oob_rows(v, ki, block_k, s_cache)
 
     s = jax.lax.dot_general(
         q, k, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -90,7 +86,7 @@ def _decode_kernel(nk: int, s_cache: int, scale: float, block_k: int,
 
 
 def flash_decode(q, k_cache, v_cache, kv_len, *,
-                 scale: Optional[float] = None, block_k: int = 2048,
+                 scale: Optional[float] = None, block_k: int = 4096,
                  interpret: Optional[bool] = None):
     """Single-position GQA decode.
 
@@ -140,6 +136,15 @@ def flash_decode(q, k_cache, v_cache, kv_len, *,
                 pltpu.VMEM((g, d), jnp.float32),
             ],
         ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            # KV streaming dominates; flops are negligible at M=G.
+            flops=4 * b * h * s * d,
+            bytes_accessed=2 * b * hkv * s * d * k_cache.dtype.itemsize,
+            transcendentals=b * h * s,
+        ),
         interpret=default_interpret(interpret),
     )(kv_len.astype(jnp.int32), qg, k_cache, v_cache)
     return out.reshape(b, h, d), lse.reshape(b, h)
@@ -154,18 +159,20 @@ def combine_partials(outs, lses):
     m = jnp.max(lses, axis=0, keepdims=True)          # (1, B, H)
     w = jnp.exp(lses - m)                             # (R, B, H)
     denom = jnp.sum(w, axis=0)                        # (B, H)
-    # An empty shard (lse = -inf, w = 0) may carry garbage partials —
-    # e.g. a kv_len=0 rank whose kernel averaged uninitialized rows;
-    # 0 × NaN would poison the sum.  Gate on the weight (NOT on
-    # finiteness: a live shard's genuine NaN/Inf must still propagate
-    # rather than be silently replaced by a finite wrong answer).
-    outs = jnp.where(w[..., None] > 0, outs, 0)
+    # An empty shard (lse ≈ -inf) may carry garbage partials — e.g. a
+    # kv_len=0 rank whose kernel averaged uninitialized rows; 0 × NaN
+    # would poison the sum.  Gate on the shard's own lse (NOT on the
+    # relative weight w: when ALL shards are empty every w is exp(0)=1
+    # and garbage would pass; NOT on finiteness: a live shard's
+    # genuine NaN/Inf must still propagate rather than be silently
+    # replaced by a finite wrong answer).
+    outs = jnp.where((lses > NEG_INF / 2)[..., None], outs, 0)
     num = jnp.einsum("rbh,rbhd->bhd", w, outs.astype(jnp.float32))
     return (num / jnp.maximum(denom, 1e-30)[..., None]).astype(outs.dtype)
 
 
 def sp_flash_decode(q, k_shard, v_shard, kv_len_local, axis: str, *,
-                    scale: Optional[float] = None, block_k: int = 2048,
+                    scale: Optional[float] = None, block_k: int = 4096,
                     collective_id: int = cids.FLASH_DECODE_AG,
                     interpret: Optional[bool] = None):
     """Sequence-parallel distributed flash-decode.  Call inside

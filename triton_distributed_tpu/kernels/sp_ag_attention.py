@@ -34,7 +34,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu import collective_ids as cids
 
-from triton_distributed_tpu.kernels.flash_attention import flash_attention
+from triton_distributed_tpu.kernels.flash_attention import (
+    flash_attention,
+    zero_oob_rows,
+)
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
     comm_compiler_params,
@@ -141,15 +144,7 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
             k = k_blk[0, 0]
             v = v_blk[0, 0]
             if sk % bk != 0:
-                # Ragged last KV tile: its out-of-bounds VMEM rows are
-                # stale/uninitialized on hardware; the bound mask below
-                # makes their p exactly 0 but the PV matmul would still
-                # compute 0 × garbage (NaN if the debris decodes as
-                # NaN/Inf) — zero the rows (see `flash_attention`).
-                v_row = (ki * bk
-                         + jax.lax.broadcasted_iota(jnp.int32,
-                                                    v.shape, 0))
-                v = jnp.where(v_row < sk, v, 0)
+                v = zero_oob_rows(v, ki, bk, sk)
             s = jax.lax.dot_general(
                 q, k, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale

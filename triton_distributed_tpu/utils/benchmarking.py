@@ -74,6 +74,41 @@ def measure_ops(fs: Sequence[Callable], args: tuple,
     return [statistics.median(sl) for sl in slopes]
 
 
+def measure_ops_scanned(fs: Sequence[Callable], args: tuple,
+                        mix: Callable, *, n_inner: int = 16,
+                        n1: int = 4, repeats: int = 6,
+                        min_window_s: float = 0.5) -> list:
+    """Per-call latency for SUB-MILLISECOND ops.
+
+    One-dispatch-per-call measurement (``measure_ops``) bottoms out at
+    the tunnel's dispatch-rate floor (~0.3-1 ms, drifting), so ops
+    faster than that read as the floor, with ±40% run-to-run noise.
+    Here each dispatch runs ``n_inner`` data-chained iterations of the
+    op inside ONE jitted `lax.scan`, so per-dispatch device work is
+    n_inner× the op and the floor amortizes away.
+
+    ``mix(args, out) -> new_args`` chains iteration i+1 on iteration
+    i's output *inside* the scan (shapes must be preserved; it is
+    traced, so no jit wrapper is needed).
+    """
+    import jax
+
+    def scanned(f):
+        def body(a, _):
+            return mix(a, f(*a)), None
+
+        def g(*a):
+            final, _ = jax.lax.scan(body, a, None, length=n_inner)
+            return final
+
+        return jax.jit(g)
+
+    ts = measure_ops([scanned(f) for f in fs], args,
+                     lambda a, out: out, n1=n1, repeats=repeats,
+                     min_window_s=min_window_s)
+    return [t / n_inner for t in ts]
+
+
 def feedback_mix(x, out):
     """Shape-safe dependence edge: mix `out` (cropped/padded to x's
     shape) into the next call's input.  Keeps magnitudes bounded so a
