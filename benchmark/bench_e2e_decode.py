@@ -1,0 +1,92 @@
+"""End-to-end decode throughput: Qwen3-0.6B-shaped model, full
+serving stack (Engine scan rollout: fused-Pallas layers, donated KV
+cache, fused sampling) on the available chip(s).
+
+Timing: the scan rollout is ONE dispatch for all gen_len steps, so the
+per-token latency is the slope between two gen_len values — prefill,
+cache allocation, dispatch and fetch costs cancel exactly.
+
+Emits one JSON line per mode (fused vs plain-XLA layers).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+import argparse
+import json
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from triton_distributed_tpu.models import ModelConfig
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.models.qwen import Qwen3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prefill", type=int, default=128)
+    ap.add_argument("--g1", type=int, default=32)
+    ap.add_argument("--g2", type=int, default=160)
+    ap.add_argument("--repeats", type=int, default=4)
+    ap.add_argument("--layers", type=int, default=0,
+                    help="override layer count (0 = config default)")
+    args = ap.parse_args()
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("tp",))
+    cfg = ModelConfig.qwen3_0_6b()
+    if args.layers:
+        cfg.num_layers = args.layers
+    cfg.max_seq_len = args.prefill + args.g2 + 8
+
+    b = args.batch
+    ids = jax.random.randint(jax.random.key(0), (b, args.prefill), 0,
+                             cfg.vocab_size)
+
+    results = {}
+    for mode in ("fused", "xla"):
+        model = Qwen3(cfg, mesh, mode=mode)
+        params = model.init_params(jax.random.key(1))
+        eng = Engine(model)
+
+        def run(gen_len):
+            cache = model.create_cache(b)
+            logits, cache = eng.prefill(params, ids, cache)
+            first = jnp.argmax(logits, -1).astype(jnp.int32)
+            t0 = time.perf_counter()
+            toks, _ = eng._rollout(params, first, cache,
+                                   jax.random.key(2), gen_len)
+            np.asarray(toks[0, 0])          # fence: full queue drain
+            return time.perf_counter() - t0
+
+        run(args.g1)  # warm both jits (prefill warmed inside)
+        run(args.g2)
+        slopes = []
+        for _ in range(args.repeats):
+            t1 = run(args.g1)
+            t2 = run(args.g2)
+            slopes.append((t2 - t1) / (args.g2 - args.g1))
+        per_step = statistics.median(slopes)
+        results[mode] = per_step
+        print(json.dumps({
+            "bench": "e2e_decode", "mode": mode, "B": b,
+            "layers": cfg.num_layers,
+            "ms_per_step": round(per_step * 1e3, 3),
+            "tokens_per_s": round(b / per_step, 1),
+            **({"vs_baseline":
+                round(results["xla"] / results["fused"], 3)}
+               if "xla" in results and "fused" in results else {}),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

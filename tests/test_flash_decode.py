@@ -111,3 +111,34 @@ def test_sp_flash_decode_ragged(sp4_mesh):
     total = int(fill.sum())
     ref = _decode_ref(q, kcat, vcat, jnp.array([total], jnp.int32))
     assert_allclose(out, ref, atol=3e-3, rtol=3e-3, name="sp_decode_ragged")
+
+
+def test_combine_partials_all_empty_shards():
+    """All-empty shards (every lse = -inf) must combine to 0, not NaN:
+    the relative weight w is exp(0) = 1 for every shard in that case,
+    so the garbage gate must key on each shard's own lse."""
+    outs = jnp.full((3, 2, 4, 8), jnp.nan, jnp.float32)
+    lses = jnp.full((3, 2, 4), -1e30, jnp.float32)
+    c = np.asarray(combine_partials(outs, lses))
+    assert (c == 0).all(), c
+
+
+def test_combine_partials_live_nan_propagates():
+    """A live shard's genuine NaN must NOT be silently sanitized."""
+    outs = jnp.stack([jnp.full((1, 2, 4), jnp.nan, jnp.float32),
+                      jnp.ones((1, 2, 4), jnp.float32)])
+    lses = jnp.stack([jnp.zeros((1, 2), jnp.float32),
+                      jnp.zeros((1, 2), jnp.float32)])
+    c = np.asarray(combine_partials(outs, lses))
+    assert np.isnan(c).all(), c
+
+
+def test_zero_oob_rows():
+    from triton_distributed_tpu.kernels.flash_attention import (
+        zero_oob_rows,
+    )
+
+    v = jnp.ones((8, 4))
+    # block 2 of 8-row blocks, bound 19: rows 16..18 valid, 19+ zeroed.
+    out = np.asarray(zero_oob_rows(v, 2, 8, 19))
+    assert (out[:3] == 1).all() and (out[3:] == 0).all(), out

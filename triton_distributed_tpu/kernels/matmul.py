@@ -34,6 +34,10 @@ def _pick_block(dim: int, preferred: int, align: int) -> int:
     when possible."""
     if dim <= preferred:
         return dim
+    # Mosaic requires sublane/lane blocks to be align-multiples (or the
+    # whole dim); a misaligned `preferred` would make every candidate
+    # below misaligned too, so round it down first.
+    preferred = max(align, preferred // align * align)
     for b in range(preferred, align - 1, -align):
         if dim % b == 0:
             return b
