@@ -114,3 +114,56 @@ def test_fast_allgather_packed(tp4_mesh):
     ga, gb = jax.jit(fn)(a, b)
     assert_allclose(ga, a, atol=0, rtol=0)
     assert_allclose(gb, b, atol=0, rtol=0)
+
+
+def test_autotuner_disk_cache(tmp_path):
+    """Persisted winners are reloaded (no re-timing) and invalidated
+    when the candidate list changes."""
+    import jax.numpy as jnp
+
+    calls = []
+
+    def op(a, *, config):
+        calls.append(config)
+        return a * config
+
+    path = str(tmp_path / "cache.json")
+    a = jnp.ones((8, 128))
+    t1 = ContextualAutotuner(op, [2.0, 3.0], iters=1, warmup=1,
+                             cache_path=path)
+    t1(a)
+    assert len(calls) > 2  # tuning ran both configs
+    best = t1.cache[next(iter(t1.cache))].config
+
+    calls.clear()
+    t2 = ContextualAutotuner(op, [2.0, 3.0], iters=1, warmup=1,
+                             cache_path=path)
+    t2(a)
+    assert calls == [best]  # disk hit: exactly one production call
+
+    calls.clear()
+    t3 = ContextualAutotuner(op, [5.0, 7.0], iters=1, warmup=1,
+                             cache_path=path)  # candidates changed
+    t3(a)
+    assert len(calls) > 2  # stale entry ignored, re-tuned
+
+    # GROWING the space must also invalidate (a new candidate would
+    # otherwise silently never be benchmarked).
+    calls.clear()
+    t4 = ContextualAutotuner(op, [2.0, 3.0, 4.0], iters=1, warmup=1,
+                             cache_path=path)
+    t4(a)
+    assert len(set(calls)) == 3  # every candidate timed
+
+    # Merge-on-save: a second instance writing a different key must not
+    # clobber the first instance's entry.
+    b = jnp.ones((16, 128))
+    t5 = ContextualAutotuner(op, [2.0, 3.0, 4.0], iters=1, warmup=1,
+                             cache_path=path)
+    t5(b)  # different shape key, saves after t4
+    calls.clear()
+    t6 = ContextualAutotuner(op, [2.0, 3.0, 4.0], iters=1, warmup=1,
+                             cache_path=path)
+    t6(a)
+    t6(b)
+    assert len(calls) == 2  # both keys hit the disk cache

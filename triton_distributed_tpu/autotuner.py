@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import json
 import os
 import time
 from typing import Any, Callable, Iterable, Optional, Sequence
@@ -42,7 +43,8 @@ class ContextualAutotuner:
                  key_fn: Optional[Callable] = None,
                  iters: int = 5, warmup: int = 2,
                  log_dir: str = ".autotune_logs",
-                 chain: Optional[Callable] = None):
+                 chain: Optional[Callable] = None,
+                 cache_path: Optional[str] = None):
         self.fn = fn
         self.configs = list(configs)
         self.key_fn = key_fn or self._default_key
@@ -56,6 +58,59 @@ class ContextualAutotuner:
         #: ``iters`` modest.
         self.chain = chain
         self.cache = {}
+        #: Optional JSON file persisting winners across processes (the
+        #: role of Triton's on-disk autotune cache).  Entries are keyed
+        #: by device kind + world size + the call key, and configs are
+        #: matched back by repr — a candidate list change invalidates
+        #: stale entries naturally (no repr match → re-tune).
+        self.cache_path = cache_path
+        self._disk = self._load_disk() if cache_path else {}
+
+    def _device_key(self) -> str:
+        d = jax.devices()[0]
+        return f"{d.device_kind}/w{jax.device_count()}"
+
+    def _load_disk(self) -> dict:
+        try:
+            with open(self.cache_path) as f:
+                return json.load(f)
+        except Exception:
+            return {}
+
+    def _save_disk(self):
+        try:
+            # Merge-on-save: another instance/process sharing this path
+            # may have written since our load; a blind dump of our
+            # in-memory copy would clobber its entries.
+            merged = self._load_disk()
+            merged.update(self._disk)
+            self._disk = merged
+            tmp = self.cache_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._disk, f, indent=1)
+            os.replace(tmp, self.cache_path)
+        except Exception as e:
+            logger.warning("autotune cache write failed: %s", e)
+
+    def _candidates_repr(self) -> list:
+        return sorted(repr(c) for c in self.configs)
+
+    def _disk_lookup(self, key):
+        """Rebuild an _Entry from the persisted ranking.  The entry is
+        valid only for the EXACT candidate list it was tuned over —
+        a grown space would otherwise silently never benchmark the new
+        candidates, and a shrunk one could resurrect a removed best."""
+        rec = self._disk.get(f"{self._device_key()}|{key}")
+        if not rec:
+            return None
+        if rec.get("candidates") != self._candidates_repr():
+            return None  # candidate list changed: stale entry
+        by_repr = {repr(c): c for c in self.configs}
+        ranking = [(t, by_repr[r]) for t, r in rec.get("ranking", [])
+                   if r in by_repr]
+        if not ranking or rec.get("best") not in by_repr:
+            return None
+        return _Entry(by_repr[rec["best"]], ranking[0][0], ranking)
 
     @staticmethod
     def _default_key(*args, **kwargs):
@@ -132,6 +187,12 @@ class ContextualAutotuner:
 
     def __call__(self, *args, **kwargs):
         key = self.key_fn(*args, **kwargs)
+        if key not in self.cache and self.cache_path:
+            hit = self._disk_lookup(key)
+            if hit is not None:
+                self.cache[key] = hit
+                logger.info("autotune %s: disk cache hit, best=%s",
+                            key, hit.config)
         if key not in self.cache:
             results = []
             for i, cfg in enumerate(self.configs):
@@ -151,6 +212,13 @@ class ContextualAutotuner:
                                      ranking)
             logger.info("autotune %s: best=%s (%.3f ms)", key,
                         self.configs[best_idx], results[0][0] * 1e3)
+            if self.cache_path:
+                self._disk[f"{self._device_key()}|{key}"] = {
+                    "best": repr(self.configs[best_idx]),
+                    "ranking": [[t, repr(c)] for t, c in ranking],
+                    "candidates": self._candidates_repr(),
+                }
+                self._save_disk()
         return self.fn(*args, config=self.cache[key].config, **kwargs)
 
 

@@ -35,6 +35,8 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_distributed_tpu import collective_ids as cids
 
 from triton_distributed_tpu.kernels.flash_attention import (
+    LN2,
+    LOG2E,
     flash_attention,
     zero_oob_rows,
 )
@@ -140,14 +142,20 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
             acc_scr[:] = jnp.zeros_like(acc_scr)
 
         def attend_block():
+            # exp2-domain online softmax (see `_flash_kernel`): scale
+            # by scale*log2(e) on the (bq, D) q block — 1/nk-th the
+            # work of scaling the (bq, bk) score tile — and use exp2.
+            # m_scr is log2-domain; l_scr stays a natural weight sum.
             q = q_blk[0, 0]
+            q = (q * jnp.asarray(scale * LOG2E, jnp.float32)
+                 ).astype(q.dtype)
             k = k_blk[0, 0]
             v = v_blk[0, 0]
             if sk % bk != 0:
                 v = zero_oob_rows(v, ki, bk, sk)
             s = jax.lax.dot_general(
                 q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
+                preferred_element_type=jnp.float32)
 
             k_pos = (ki * bk
                      + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
@@ -161,8 +169,8 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
             m_prev = m_scr[:]
             m_new = jnp.maximum(m_prev,
                                 jnp.max(s, axis=1, keepdims=True))
-            alpha = jnp.exp(m_prev - m_new)
-            p = jnp.exp(s - m_new)
+            alpha = jnp.exp2(m_prev - m_new)
+            p = jnp.exp2(s - m_new)
             l_scr[:] = (alpha * l_scr[:]
                         + jnp.sum(p, axis=1, keepdims=True))
             acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
@@ -181,7 +189,9 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
         def _():
             l = jnp.maximum(l_scr[:], 1e-30)
             o_c = acc_scr[:] / l
-            l_c = m_scr[:] + jnp.log(l)
+            # m_scr is log2-domain; the running state's lse stays
+            # natural-log (the prev-merge below depends on it).
+            l_c = m_scr[:] * LN2 + jnp.log(l)
             if prev is not None:
                 la = pl_blk[0, 0]
                 m = jnp.maximum(jnp.maximum(la, l_c), NEG_INF / 2)
