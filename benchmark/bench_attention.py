@@ -55,21 +55,30 @@ def main():
 
         base = xla_attn
 
+        # The XLA baseline materializes the (B, H, S, S) f32 score
+        # tensor; S=16384 (8 GiB scores) still fits the 16 GiB chip
+        # (measured ~7× slower than ours), S=32768 (34 GiB) OOMs —
+        # skip the baseline when it cannot fit.
+        score_bytes = 4 * b * h * s * s
+        run_base = score_bytes < 10 << 30
+
         # Chain through q (same shape as out), n_inner iterations per
         # dispatch inside one jitted scan — one-dispatch-per-call
         # timing bottoms out at the tunnel's dispatch floor for the
         # short sequences.
         mix = lambda a, out: (feedback_mix(a[0], out), a[1], a[2])
-        t_flash, t_base = measure_ops_scanned(
-            [flash, base], (q, k, v), mix, n_inner=8,
-            repeats=args.repeats)
+        ts = measure_ops_scanned(
+            [flash] + ([base] if run_base else []), (q, k, v), mix,
+            n_inner=8, repeats=args.repeats)
+        t_flash = ts[0]
         # Causal: ~half the full QK^T + PV FLOPs.
         flops = 4 * b * h * s * s * d / 2
         print(json.dumps({
             "bench": "flash_attention", "S": s, "H": h, "D": d,
             "us": round(t_flash * 1e6, 1),
             "tflops": round(flops / t_flash / 1e12, 1),
-            "vs_baseline": round(t_base / t_flash, 3),
+            "vs_baseline": (round(ts[1] / t_flash, 3) if run_base
+                            else None),
         }), flush=True)
 
 

@@ -1,0 +1,71 @@
+"""W8A8 int8 matmul vs the XLA int8 dot and the bf16 MXU peak.
+
+On v5e the int8 MXU path doubles peak throughput (394 TOPS vs
+197 TFLOP/s bf16).  Emits one JSON line per shape; `tops` counts the
+int multiply-accumulates (the dequant epilogue is O(m·n) extra).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+import argparse
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels.quantized import matmul_w8a8
+from triton_distributed_tpu.utils.benchmarking import measure_ops_scanned
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shapes", nargs="*",
+                    default=["4096,4096,4096", "4096,7168,7168"])
+    ap.add_argument("--repeats", type=int, default=4)
+    args = ap.parse_args()
+
+    for spec in args.shapes:
+        m, k, n = (int(x) for x in spec.split(","))
+        a = jax.random.randint(jax.random.key(0), (m, k), -127, 127,
+                               jnp.int8)
+        b = jax.random.randint(jax.random.key(1), (k, n), -127, 127,
+                               jnp.int8)
+        sa = jnp.full((m,), 1e-2, jnp.float32)
+        sb = jnp.full((n,), 1e-2, jnp.float32)
+
+        ours = functools.partial(matmul_w8a8, out_dtype=jnp.bfloat16)
+
+        def xla_int8(a_, b_, sa_, sb_):
+            acc = jax.lax.dot_general(
+                a_, b_, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            return (acc.astype(jnp.float32) * sa_[:, None] * sb_[None, :]
+                    ).astype(jnp.bfloat16)
+
+        # Chain: fold the bf16 output back into the int8 activations
+        # (crop/pad so any M, K, N relation works).
+        def mix(ar, out):
+            crop = out[:, :min(k, n)].astype(jnp.int32) * 8
+            crop = jnp.pad(crop, ((0, 0), (0, k - crop.shape[1])))
+            nxt = ar[0].astype(jnp.int32) + crop
+            return (jnp.clip(nxt, -127, 127).astype(jnp.int8),) + ar[1:]
+
+        t_ours, t_base = measure_ops_scanned(
+            [ours, xla_int8], (a, b, sa, sb), mix, n_inner=8,
+            repeats=args.repeats)
+        ops = 2 * m * k * n
+        print(json.dumps({
+            "bench": "int8_gemm", "M": m, "K": k, "N": n,
+            "us": round(t_ours * 1e6, 1),
+            "tops": round(ops / t_ours / 1e12, 1),
+            "vs_baseline": round(t_base / t_ours, 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -12,3 +12,4 @@ python benchmark/bench_attention.py
 python benchmark/bench_flash_decode.py
 python benchmark/bench_grouped_gemm.py
 python benchmark/bench_e2e_decode.py
+python benchmark/bench_int8_gemm.py

@@ -299,3 +299,21 @@ def test_moe_fused_world1():
     ref = jnp.einsum("wemc,wecn->wmn", plan.combine_mats,
                      partial).reshape(world * mc, n)
     assert _rel_err(out, ref) < 2e-2
+
+
+def test_w8a8_matmul_hardware():
+    """Int8 MXU path compiles and matches exact int32 accumulation."""
+    import jax.numpy as jnp
+    from triton_distributed_tpu.kernels.quantized import (
+        Int8MatmulConfig, matmul_w8a8)
+
+    ka = jax.random.randint(jax.random.key(1), (256, 1024), -127, 127,
+                            jnp.int8)
+    kb = jax.random.randint(jax.random.key(2), (1024, 512), -127, 127,
+                            jnp.int8)
+    out = jax.jit(functools.partial(
+        matmul_w8a8, out_dtype=jnp.float32,
+        config=Int8MatmulConfig(128, 512, 1024)))(
+        ka, kb, jnp.ones((256,), jnp.float32), jnp.ones((512,), jnp.float32))
+    ref = jnp.dot(ka.astype(jnp.int32), kb.astype(jnp.int32))
+    assert np.array_equal(np.asarray(out), np.asarray(ref, dtype=np.float32))

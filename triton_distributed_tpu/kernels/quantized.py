@@ -1,0 +1,153 @@
+"""Int8 (W8A8) quantized matmul — beyond-parity capability.
+
+The reference is bf16/fp16-only for GEMMs (fp8 appears only as an
+AllToAll payload format, `kernels/nvidia/low_latency_all_to_all.py`).
+On TPU v5e the MXU's int8 path doubles peak throughput (394 TOPS vs
+197 TFLOP/s bf16), so a quantized-inference path is a genuine win:
+the kernel below measures 326 TOPS at 4096³ (83% of int8 peak,
+1.66× the bf16 peak; see docs/performance.md) with the
+(512, 1024, 4096) default blocks — int8 tiles are half the bytes, so
+the winning configs run K-deep.
+
+Symmetric per-channel quantization: a row-scale for activations
+(per-token) and a column-scale for weights (per-output-channel); the
+int32 accumulator is dequantized in the epilogue with one rank-1
+scaling, so the extra work over a plain int8 matmul is O(m·n).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.kernels.matmul import _pick_block
+from triton_distributed_tpu.utils.platform import (
+    SCOPED_VMEM_LIMIT,
+    default_interpret,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Int8MatmulConfig:
+    """Defaults tuned on v5e at 4096³ (299 TOPS); K-deep blocks win
+    because int8 K tiles are half the bytes of bf16."""
+
+    block_m: int = 512
+    block_n: int = 1024
+    block_k: int = 4096
+
+    def resolve(self, m: int, n: int, k: int) -> "Int8MatmulConfig":
+        return Int8MatmulConfig(
+            block_m=_pick_block(m, self.block_m, 8),
+            block_n=_pick_block(n, self.block_n, 128),
+            block_k=_pick_block(k, self.block_k, 128),
+        )
+
+
+def quantize_sym(x, axis: int):
+    """Symmetric int8 quantization along ``axis`` (the contraction
+    axis): returns (q int8, scale f32) with x ≈ q * scale, where
+    ``scale`` has ``axis`` reduced away."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis)
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(x / jnp.expand_dims(scale, axis)),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _w8a8_kernel(nk: int, a_ref, b_ref, sa_ref, sb_ref, o_ref, acc_ref):
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jax.lax.dot_general(
+        a_ref[:], b_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+    @pl.when(kk == nk - 1)
+    def _():
+        # Rank-1 dequant: out = acc * (sa ⊗ sb).
+        o_ref[:] = (acc_ref[:].astype(jnp.float32)
+                    * sa_ref[:] * sb_ref[:]).astype(o_ref.dtype)
+
+
+def matmul_w8a8(a_q, b_q, scale_a, scale_b,
+                config: Optional[Int8MatmulConfig] = None,
+                out_dtype=jnp.bfloat16,
+                interpret: Optional[bool] = None):
+    """C[m,n] ≈ (a_q·scale_a[:,None]) @ (b_q·scale_b[None,:]).
+
+    a_q: (m, k) int8; b_q: (k, n) int8; scale_a: (m,) f32 per-row
+    (per-token); scale_b: (n,) f32 per-column (per-channel).
+    The matmul runs on the MXU's int8 path with an int32 accumulator;
+    dequantization is a rank-1 epilogue.
+    """
+    m, k = a_q.shape
+    k2, n = b_q.shape
+    assert k == k2, (a_q.shape, b_q.shape)
+    assert a_q.dtype == jnp.int8 and b_q.dtype == jnp.int8
+    cfg = (config or Int8MatmulConfig()).resolve(m, n, k)
+    nk = pl.cdiv(k, cfg.block_k)
+    grid = (pl.cdiv(m, cfg.block_m), pl.cdiv(n, cfg.block_n), nk)
+    sa = scale_a.astype(jnp.float32).reshape(m, 1)
+    sb = scale_b.astype(jnp.float32).reshape(1, n)
+    return pl.pallas_call(
+        functools.partial(_w8a8_kernel, nk),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((cfg.block_m, cfg.block_k),
+                             lambda i, j, kk: (i, kk),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((cfg.block_k, cfg.block_n),
+                             lambda i, j, kk: (kk, j),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((cfg.block_m, 1),
+                             lambda i, j, kk: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, cfg.block_n),
+                             lambda i, j, kk: (0, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((cfg.block_m, cfg.block_n),
+                                   lambda i, j, kk: (i, j),
+                                   memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((min(cfg.block_m, m), min(cfg.block_n, n)),
+                           jnp.int32)
+            ],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=SCOPED_VMEM_LIMIT,
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * n * k,
+            bytes_accessed=(m * k + k * n)
+            + m * n * jnp.dtype(out_dtype).itemsize,
+            transcendentals=0,
+        ),
+        interpret=default_interpret(interpret),
+    )(a_q, b_q, sa, sb)
+
+
+def matmul_quantized(a, b, config: Optional[Int8MatmulConfig] = None,
+                     interpret: Optional[bool] = None):
+    """Convenience wrapper: quantize float inputs on the fly (per-row
+    activations, per-column weights) and run the W8A8 kernel.  For
+    inference, quantize the weights once ahead of time with
+    `quantize_sym(w, axis=0)` and call `matmul_w8a8` directly."""
+    a_q, sa = quantize_sym(a, axis=1)
+    b_q, sb = quantize_sym(b, axis=0)
+    return matmul_w8a8(a_q, b_q, sa, sb, config=config,
+                       out_dtype=a.dtype, interpret=interpret)
