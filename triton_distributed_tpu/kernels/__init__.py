@@ -14,3 +14,9 @@ from triton_distributed_tpu.kernels.allgather import (  # noqa: F401
 from triton_distributed_tpu.kernels.common_ops import (  # noqa: F401
     barrier_all_on_axis,
 )
+from triton_distributed_tpu.kernels.quantized import (  # noqa: F401
+    Int8MatmulConfig,
+    matmul_quantized,
+    matmul_w8a8,
+    quantize_sym,
+)

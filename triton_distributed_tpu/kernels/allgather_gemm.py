@@ -107,9 +107,12 @@ def create_ag_gemm_context(axis: str, world_size: int, **kw) -> AllGatherGEMMCon
     return AllGatherGEMMContext(axis=axis, world_size=world_size, **kw)
 
 
-def _ag_gemm_fused_kernel(ctx: AllGatherGEMMContext, m, n, k,
-                          x_ref, b_ref, gathered_ref, out_ref,
-                          local_sem, send_sem, recv_sems):
+def _emit_ag_ring(ctx: AllGatherGEMMContext, emit_chunk,
+                  x_ref, gathered_ref, local_sem, send_sem, recv_sems):
+    """The fused-AG ring schedule, shared by every consumer variant
+    (bf16 matmul, int8 W8A8): forward the freshest chunk to the right
+    neighbor while ``emit_chunk(chunk)`` does this step's MXU work on
+    the chunk already held."""
     world = ctx.world_size
     my = jax.lax.axis_index(ctx.axis)
     right = jax.lax.rem(my + 1, world)
@@ -139,12 +142,22 @@ def _ag_gemm_fused_kernel(ctx: AllGatherGEMMContext, m, n, k,
             )
             rdma.start()
         # MXU work for the chunk we already hold overlaps the DMA.
-        emit_matmul(gathered_ref.at[chunk], b_ref, out_ref.at[chunk],
-                    m=m, n=n, k=k, config=ctx.gemm)
+        emit_chunk(chunk)
         if rdma is not None:
             exp = jax.lax.rem(my - s - 1 + 2 * world, world)
             dl.wait_recv(gathered_ref.at[exp], recv_sems.at[exp])
             rdma.wait_send()
+
+
+def _ag_gemm_fused_kernel(ctx: AllGatherGEMMContext, m, n, k,
+                          x_ref, b_ref, gathered_ref, out_ref,
+                          local_sem, send_sem, recv_sems):
+    def emit_chunk(chunk):
+        emit_matmul(gathered_ref.at[chunk], b_ref, out_ref.at[chunk],
+                    m=m, n=n, k=k, config=ctx.gemm)
+
+    _emit_ag_ring(ctx, emit_chunk, x_ref, gathered_ref, local_sem,
+                  send_sem, recv_sems)
 
 
 def _ag_gemm_ll_kernel(ctx: AllGatherGEMMContext, mp, n, k,
@@ -248,6 +261,109 @@ def ag_gemm(a_shard, b, ctx: AllGatherGEMMContext,
         g = gathered[:, :m] if mp != m else gathered
         return out, g.reshape(world * m, k)
     return out
+
+
+def _ag_gemm_w8a8_kernel(ctx: AllGatherGEMMContext, cfg, m, n, k,
+                         x_ref, b_ref, sa_ref, sb_ref,
+                         gathered_ref, out_ref,
+                         local_sem, send_sem, recv_sems):
+    """Fused ring AG-GEMM over int8 activations: the same ring
+    schedule (`_emit_ag_ring`), but each forwarded chunk is int8 —
+    HALF the ICI bytes of the bf16 ring — and each held chunk feeds
+    the MXU's int8 path (2× bf16 peak) with a rank-1 dequant epilogue.
+    Per-row activation scales ride outside the kernel (one tiny XLA
+    all_gather); per-channel weight scales are resident."""
+    from triton_distributed_tpu.kernels.quantized import emit_matmul_w8a8
+
+    def emit_chunk(chunk):
+        emit_matmul_w8a8(gathered_ref.at[chunk], b_ref,
+                         sa_ref.at[chunk], sb_ref,
+                         out_ref.at[chunk], m=m, n=n, k=k, config=cfg)
+
+    _emit_ag_ring(ctx, emit_chunk, x_ref, gathered_ref, local_sem,
+                  send_sem, recv_sems)
+
+
+def ag_gemm_w8a8(a_shard, b_q, scale_b, ctx: AllGatherGEMMContext,
+                 config=None):
+    """Quantized fused AG-GEMM: C ≈ all_gather(a) @ (b_q·scale_b).
+
+    a_shard: (m_local, k) float — quantized per-row on the fly;
+    b_q: (k, n_local) int8 weights (quantize once ahead of time with
+    `quantize_sym(w, axis=0)`); scale_b: (n_local,) f32.
+    Returns (world*m_local, n_local) in a_shard's dtype.
+
+    Beyond-parity: the reference's AG-GEMM family is half-precision
+    only.  Int8 both halves the ring's ICI traffic and doubles the
+    MXU ceiling, so the overlap balance point shifts — comm shrinks
+    2× while compute speeds up ~1.7×.
+    """
+    from triton_distributed_tpu.kernels.quantized import (
+        Int8MatmulConfig, matmul_w8a8, quantize_sym)
+
+    world = ctx.world_size
+    m, k = a_shard.shape
+    k2, n = b_q.shape
+    assert k == k2, (a_shard.shape, b_q.shape)
+    assert b_q.dtype == jnp.int8
+    # No xla/ll variants for the quantized path (yet): refuse a ctx
+    # that asks for one rather than silently running the fused ring.
+    assert ctx.method in ("auto", "fused"), (
+        f"ag_gemm_w8a8 implements the fused ring only, got method="
+        f"{ctx.method!r}")
+
+    a_q, sa = quantize_sym(a_shard, axis=1)          # (m, k) i8, (m,)
+
+    if world <= 1:
+        return matmul_w8a8(a_q, b_q, sa, scale_b, config=config,
+                           out_dtype=a_shard.dtype,
+                           interpret=ctx.interpret)
+
+    mp = round_up_rows(m, jnp.int8)
+    if mp != m:
+        a_q = jnp.pad(a_q, ((0, mp - m), (0, 0)))
+        sa = jnp.pad(sa, (0, mp - m))
+
+    # Scales are tiny (world*mp f32): one XLA all_gather, not worth a
+    # ring slot.
+    sa_all = jax.lax.all_gather(sa, ctx.axis)        # (world, mp)
+    cfg = (config or Int8MatmulConfig()).resolve(mp, n, k)
+
+    gathered, out = pl.pallas_call(
+        functools.partial(_ag_gemm_w8a8_kernel, ctx, cfg, mp, n, k),
+        out_shape=(
+            jax.ShapeDtypeStruct((world, mp, k), jnp.int8),
+            jax.ShapeDtypeStruct((world, mp, n), a_shard.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((world,)),
+        ],
+        compiler_params=comm_compiler_params(ctx.collective_id, world),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * world * mp * n * k,
+            bytes_accessed=world * mp * k + k * n
+            + world * mp * n * a_shard.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=default_interpret(ctx.interpret),
+    )(a_q, b_q, sa_all.reshape(world, mp, 1),
+      scale_b.astype(jnp.float32).reshape(1, n))
+
+    if mp != m:
+        out = out[:, :m]
+    return out.reshape(world * m, n)
 
 
 def ag_gemm_nonoverlap(a_shard, b, axis: str):

@@ -141,6 +141,46 @@ def matmul_w8a8(a_q, b_q, scale_a, scale_b,
     )(a_q, b_q, sa, sb)
 
 
+def emit_matmul_w8a8(a_ref, b_ref, sa_ref, sb_ref, o_ref, *, m, n, k,
+                     config: Optional[Int8MatmulConfig] = None):
+    """W8A8 matmul over HBM refs from inside a kernel body (the int8
+    counterpart of `matmul.emit_matmul`, for fused comm kernels).
+
+    ``a_ref``: (m, k) int8; ``b_ref``: (k, n) int8; ``sa_ref``: (m, 1)
+    f32; ``sb_ref``: (1, n) f32; ``o_ref``: (m, n) output.
+    """
+    cfg = (config or Int8MatmulConfig()).resolve(m, n, k)
+    nk = pl.cdiv(k, cfg.block_k)
+
+    def run(acc_ref):
+        # Same body as the standalone pallas_call path — one
+        # accumulate/dequant implementation, two launch forms.
+        pipeline = pltpu.emit_pipeline(
+            lambda a, b, sa, sb, o: _w8a8_kernel(nk, a, b, sa, sb, o,
+                                                 acc_ref),
+            grid=(pl.cdiv(m, cfg.block_m), pl.cdiv(n, cfg.block_n), nk),
+            in_specs=[
+                pl.BlockSpec((cfg.block_m, cfg.block_k),
+                             lambda i, j, kk: (i, kk)),
+                pl.BlockSpec((cfg.block_k, cfg.block_n),
+                             lambda i, j, kk: (kk, j)),
+                pl.BlockSpec((cfg.block_m, 1), lambda i, j, kk: (i, 0)),
+                pl.BlockSpec((1, cfg.block_n), lambda i, j, kk: (0, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((cfg.block_m, cfg.block_n),
+                             lambda i, j, kk: (i, j)),
+            ],
+        )
+        pipeline(a_ref, b_ref, sa_ref, sb_ref, o_ref)
+
+    pl.run_scoped(
+        run,
+        acc_ref=pltpu.VMEM((min(cfg.block_m, m), min(cfg.block_n, n)),
+                           jnp.int32),
+    )
+
+
 def matmul_quantized(a, b, config: Optional[Int8MatmulConfig] = None,
                      interpret: Optional[bool] = None):
     """Convenience wrapper: quantize float inputs on the fly (per-row
