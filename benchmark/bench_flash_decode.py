@@ -18,7 +18,10 @@ import json
 import jax
 import jax.numpy as jnp
 
-from triton_distributed_tpu.kernels.flash_decode import flash_decode
+from triton_distributed_tpu.kernels.flash_decode import (
+    flash_decode,
+    quantize_kv,
+)
 from triton_distributed_tpu.utils.benchmarking import measure_ops_scanned
 
 
@@ -43,9 +46,16 @@ def main():
               ).astype(jnp.bfloat16)
         kv_len = jnp.full((b,), s, jnp.int32)
 
-        ours = lambda *a: flash_decode(*a)[0]
+        k_q, v_q, ks, vs = quantize_kv(kc, vc)
 
-        def xla_decode(q_, kc_, vc_, kv_len_):
+        def ours(q_, kc_, vc_, kv_len_, *_):
+            return flash_decode(q_, kc_, vc_, kv_len_)[0]
+
+        def ours_int8(q_, kc_, vc_, kv_len_, k_q_, v_q_, ks_, vs_):
+            return flash_decode(q_, k_q_, v_q_, kv_len_,
+                                k_scale=ks_, v_scale=vs_)[0]
+
+        def xla_decode(q_, kc_, vc_, kv_len_, *_):
             # Dense GQA decode in plain XLA (what a naive port runs).
             g = h // hkv
             qg = q_.reshape(b, hkv, g, d).astype(jnp.float32)
@@ -68,8 +78,9 @@ def main():
             return ((a[0] + out * jnp.bfloat16(1e-3)
                      ).astype(jnp.bfloat16),) + a[1:]
 
-        t_ours, t_base = measure_ops_scanned(
-            [ours, base], (q, kc, vc, kv_len), mix,
+        t_ours, t_int8, t_base = measure_ops_scanned(
+            [ours, ours_int8, base],
+            (q, kc, vc, kv_len, k_q, v_q, ks, vs), mix,
             repeats=args.repeats)
         kv_bytes = 2 * b * hkv * s * d * kc.dtype.itemsize
         print(json.dumps({
@@ -77,6 +88,8 @@ def main():
             "S": s, "D": d,
             "us": round(t_ours * 1e6, 1),
             "kv_gbps": round(kv_bytes / t_ours / 1e9, 1),
+            "int8_us": round(t_int8 * 1e6, 1),
+            "int8_speedup": round(t_ours / t_int8, 3),
             "vs_baseline": round(t_base / t_ours, 3),
         }), flush=True)
 

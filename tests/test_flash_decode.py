@@ -142,3 +142,61 @@ def test_zero_oob_rows():
     # block 2 of 8-row blocks, bound 19: rows 16..18 valid, 19+ zeroed.
     out = np.asarray(zero_oob_rows(v, 2, 8, 19))
     assert (out[:3] == 1).all() and (out[3:] == 0).all(), out
+
+
+@pytest.mark.parametrize("ragged", [False, True])
+def test_flash_decode_int8_kv(ragged):
+    """int8 KV-cache decode matches the dequantized float golden
+    within quantization error (incl. the ragged cache tail)."""
+    from triton_distributed_tpu.kernels.flash_decode import quantize_kv
+
+    b, h, hkv, s, d = 2, 8, 4, 96 if ragged else 128, 32
+    q = jax.random.normal(jax.random.key(0), (b, h, d), jnp.float32) / 4
+    k = jax.random.normal(jax.random.key(1), (b, hkv, s, d),
+                          jnp.float32) / 4
+    v = jax.random.normal(jax.random.key(2), (b, hkv, s, d),
+                          jnp.float32) / 4
+    kv_len = jnp.array([s, s // 2], jnp.int32)
+
+    k_q, v_q, ks, vs = quantize_kv(k, v)
+    out, lse = flash_decode(q, k_q, v_q, kv_len, k_scale=ks, v_scale=vs,
+                            block_k=64)
+
+    # golden on the dequantized cache (so only kernel error remains)
+    k_dq = k_q.astype(jnp.float32) * ks[..., None]
+    v_dq = v_q.astype(jnp.float32) * vs[..., None]
+    ref = _decode_ref(q, k_dq, v_dq, kv_len)
+    assert_allclose(out, ref, atol=3e-3, rtol=3e-3,
+                    name=f"decode_int8_ragged={ragged}")
+
+
+def test_sp_flash_decode_int8(sp4_mesh):
+    """SP decode over int8 KV shards matches the dequantized golden."""
+    from triton_distributed_tpu.kernels.flash_decode import quantize_kv
+
+    world, b, h, hkv, s_loc, d = 4, 2, 8, 4, 32, 32
+    q = jax.random.normal(jax.random.key(0), (b, h, d), jnp.float32) / 4
+    k = jax.random.normal(jax.random.key(1), (b, hkv, world * s_loc, d),
+                          jnp.float32) / 4
+    v = jax.random.normal(jax.random.key(2), (b, hkv, world * s_loc, d),
+                          jnp.float32) / 4
+    k_q, v_q, ks, vs = quantize_kv(k, v)
+    kv_lens = jnp.broadcast_to(
+        jnp.array([s_loc], jnp.int32), (world, b))
+
+    fn = shard_map_op(
+        lambda qq, kk, vv, kss, vss, ll: sp_flash_decode(
+            qq, kk, vv, ll[0], axis="sp", k_scale=kss, v_scale=vss,
+            block_k=16),
+        sp4_mesh,
+        in_specs=(P(None, None, None), P(None, None, "sp", None),
+                  P(None, None, "sp", None), P(None, None, "sp"),
+                  P(None, None, "sp"), P("sp", None)),
+        out_specs=P(None, None, None))
+    out = jax.jit(fn)(q, k_q, v_q, ks, vs, kv_lens)
+
+    k_dq = k_q.astype(jnp.float32) * ks[..., None]
+    v_dq = v_q.astype(jnp.float32) * vs[..., None]
+    ref = _decode_ref(q, k_dq, v_dq,
+                      jnp.full((b,), world * s_loc, jnp.int32))
+    assert_allclose(out, ref, atol=3e-3, rtol=3e-3, name="sp_decode_int8")

@@ -36,10 +36,21 @@ NEG_INF = -1e30
 
 
 def _decode_kernel(nk: int, s_cache: int, scale: float, block_k: int,
-                   kvlen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                   m_scr, l_scr, acc_scr):
+                   quantized: bool, compute_dtype,
+                   kvlen_ref, q_ref, k_ref, v_ref, *rest):
     """Grid: (B, Hkv, nk).  Blocks: q (1, 1, G, D) — all grouped query
-    heads of one kv head; k/v (1, 1, bk, D)."""
+    heads of one kv head; k/v (1, 1, bk, D).
+
+    With ``quantized`` the caches are int8 with per-token f32 scales
+    (blocks (1, 1, bk)); both dequant multiplies are folded into the
+    tiny (G, bk) tiles — the K scale onto the scores, the V scale onto
+    p — so int8 halves the KV bandwidth (the decode bottleneck) at
+    ~zero extra VPU cost on the big (bk, D) tiles."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+        ks_ref = vs_ref = None
     ki = pl.program_id(2)
     bb = pl.program_id(0)
 
@@ -52,6 +63,10 @@ def _decode_kernel(nk: int, s_cache: int, scale: float, block_k: int,
     q = q_ref[0, 0]                        # (G, D)
     k = k_ref[0, 0]                        # (bk, D)
     v = v_ref[0, 0]
+    if quantized:
+        # int8 → compute dtype is exact; the scales follow below.
+        k = k.astype(compute_dtype)
+        v = v.astype(compute_dtype)
     if s_cache % block_k != 0:
         # Rows in [kv_len, s_cache) are real allocated cache (finite,
         # handled by the mask alone); only rows past the cache end are
@@ -61,6 +76,10 @@ def _decode_kernel(nk: int, s_cache: int, scale: float, block_k: int,
     s = jax.lax.dot_general(
         q, k, dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale     # (G, bk)
+    if quantized:
+        # Dequant K on the (G, bk) scores: one row-broadcast multiply
+        # (the scale block is laid out (1, bk) — lane-aligned).
+        s = s * ks_ref[0, 0]
 
     kv_len = kvlen_ref[bb]
     k_pos = ki * block_k + jax.lax.broadcasted_iota(
@@ -72,6 +91,16 @@ def _decode_kernel(nk: int, s_cache: int, scale: float, block_k: int,
     alpha = jnp.exp(m_prev - m_new)
     p = jnp.exp(s - m_new)
     l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+    if quantized:
+        # Dequant V on p (masked cols have p = 0, so a garbage scale
+        # in the ragged tail must be zeroed or 0 × NaN poisons p).
+        # The l sum above uses the unscaled softmax weights.
+        vs = vs_ref[0, 0]                               # (1, bk)
+        if s_cache % block_k != 0:
+            col = (ki * block_k
+                   + jax.lax.broadcasted_iota(jnp.int32, vs.shape, 1))
+            vs = jnp.where(col < s_cache, vs, 0)
+        p = p * vs
     acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
         p.astype(v.dtype), v, dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -85,25 +114,70 @@ def _decode_kernel(nk: int, s_cache: int, scale: float, block_k: int,
         lse_ref[0, 0] = m_scr[:] + jnp.log(l)
 
 
+def quantize_kv(k, v):
+    """Per-token symmetric int8 quantization of a KV cache (amax over
+    D): returns (k_q, v_q int8, k_scale, v_scale f32 (B, Hkv, S)).
+    Halves decode's KV bandwidth — the decode bottleneck — and the
+    cache's HBM footprint."""
+    from triton_distributed_tpu.kernels.quantized import quantize_sym
+
+    k_q, ks = quantize_sym(k, axis=3)
+    v_q, vs = quantize_sym(v, axis=3)
+    return k_q, v_q, ks, vs
+
+
 def flash_decode(q, k_cache, v_cache, kv_len, *,
+                 k_scale=None, v_scale=None,
                  scale: Optional[float] = None, block_k: int = 4096,
                  interpret: Optional[bool] = None):
     """Single-position GQA decode.
 
     q: (B, H, D); k_cache/v_cache: (B, Hkv, S, D); kv_len: (B,) int32
     (true filled length, ≤ S).  Returns (out (B, H, D), lse (B, H)).
+
+    With ``k_scale``/``v_scale`` ((B, Hkv, S) f32, from `quantize_kv`)
+    the caches are int8: half the KV streaming bytes, dequantized
+    in-kernel on the tiny (G, bk) tiles.
     """
     b, h, d = q.shape
     _, hkv, s, _ = k_cache.shape
     assert h % hkv == 0
     g = h // hkv
+    quantized = k_scale is not None
+    assert quantized == (v_scale is not None)
+    if quantized:
+        assert k_cache.dtype == jnp.int8 and v_cache.dtype == jnp.int8
     scale = scale if scale is not None else d ** -0.5
     bk = min(block_k, s)
     nk = pl.cdiv(s, bk)
 
-    qg = q.reshape(b, hkv, g, d)
+    def kv_spec():
+        return pl.BlockSpec((1, 1, bk, d),
+                            lambda bb, hh, ki, *pre: (bb, hh, ki, 0),
+                            memory_space=pltpu.VMEM)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d),
+                     lambda bb, hh, ki, *pre: (bb, hh, 0, 0),
+                     memory_space=pltpu.VMEM),
+        kv_spec(),
+        kv_spec(),
+    ]
+    operands = [q.reshape(b, hkv, g, d), k_cache, v_cache]
+    if quantized:
+        # (B, Hkv, 1, S) layout: the (1, 1, 1, bk) block's trailing
+        # (1, bk) shape is Mosaic-legal AND already the broadcast
+        # shape the kernel multiplies against the (G, bk) tiles.
+        sspec = pl.BlockSpec((1, 1, 1, bk),
+                             lambda bb, hh, ki, *pre: (bb, hh, 0, ki),
+                             memory_space=pltpu.VMEM)
+        in_specs += [sspec, sspec]
+        operands += [k_scale.astype(jnp.float32).reshape(b, hkv, 1, s),
+                     v_scale.astype(jnp.float32).reshape(b, hkv, 1, s)]
+
     out, lse = pl.pallas_call(
-        functools.partial(_decode_kernel, nk, s, scale, bk),
+        functools.partial(_decode_kernel, nk, s, scale, bk, quantized,
+                          q.dtype),
         out_shape=(
             jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
             jax.ShapeDtypeStruct((b, hkv, g, 1), jnp.float32),
@@ -111,17 +185,7 @@ def flash_decode(q, k_cache, v_cache, kv_len, *,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, hkv, nk),
-            in_specs=[
-                pl.BlockSpec((1, 1, g, d),
-                             lambda bb, hh, ki, *pre: (bb, hh, 0, 0),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, 1, bk, d),
-                             lambda bb, hh, ki, *pre: (bb, hh, ki, 0),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, 1, bk, d),
-                             lambda bb, hh, ki, *pre: (bb, hh, ki, 0),
-                             memory_space=pltpu.VMEM),
-            ],
+            in_specs=in_specs,
             out_specs=(
                 pl.BlockSpec((1, 1, g, d),
                              lambda bb, hh, ki, *pre: (bb, hh, 0, 0),
@@ -146,7 +210,7 @@ def flash_decode(q, k_cache, v_cache, kv_len, *,
             transcendentals=b * h * s,
         ),
         interpret=default_interpret(interpret),
-    )(kv_len.astype(jnp.int32), qg, k_cache, v_cache)
+    )(kv_len.astype(jnp.int32), *operands)
     return out.reshape(b, h, d), lse.reshape(b, h)
 
 
@@ -172,6 +236,7 @@ def combine_partials(outs, lses):
 
 
 def sp_flash_decode(q, k_shard, v_shard, kv_len_local, axis: str, *,
+                    k_scale=None, v_scale=None,
                     scale: Optional[float] = None, block_k: int = 4096,
                     collective_id: int = cids.FLASH_DECODE_AG,
                     interpret: Optional[bool] = None):
@@ -191,6 +256,7 @@ def sp_flash_decode(q, k_shard, v_shard, kv_len_local, axis: str, *,
     world = jax.lax.axis_size(axis)
     b, h, d = q.shape
     out, lse = flash_decode(q, k_shard, v_shard, kv_len_local,
+                            k_scale=k_scale, v_scale=v_scale,
                             scale=scale, block_k=block_k,
                             interpret=interpret)
     # Empty shards (kv_len 0) have lse = -inf ⇒ zero weight.

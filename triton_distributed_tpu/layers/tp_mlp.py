@@ -90,6 +90,15 @@ class TPMLP:
 
     # -- forward modes (all run per-device inside shard_map) --
 
+    def _psum_scatter_rows(self, partial, out_dtype):
+        """Row-chunked reduce-scatter of f32 partials (shared by the
+        xla and w8a8 epilogues — one place owns the convention)."""
+        world = self.world_size
+        m = partial.shape[0]
+        return jax.lax.psum_scatter(
+            partial.reshape(world, m // world, -1), self.axis,
+            scatter_dimension=0, tiled=False).astype(out_dtype)
+
     def _fwd_xla(self, x, params):
         full = jax.lax.all_gather(x, self.axis, tiled=True)
         h = jnp.dot(full, params["gate_up"],
@@ -97,11 +106,7 @@ class TPMLP:
         h = gated_silu(h)
         partial = jnp.dot(h, params["down"],
                           preferred_element_type=jnp.float32)
-        world = self.world_size
-        m = partial.shape[0]
-        return jax.lax.psum_scatter(
-            partial.reshape(world, m // world, -1), self.axis,
-            scatter_dimension=0, tiled=False).astype(x.dtype)
+        return self._psum_scatter_rows(partial, x.dtype)
 
     def _fwd_fused(self, x, params):
         ag_ctx = AllGatherGEMMContext(
@@ -147,11 +152,7 @@ class TPMLP:
                               config=self.int8_gemm,
                               out_dtype=jnp.float32,
                               interpret=self.interpret)
-        world = self.world_size
-        m = partial.shape[0]
-        return jax.lax.psum_scatter(
-            partial.reshape(world, m // world, -1), self.axis,
-            scatter_dimension=0, tiled=False).astype(x.dtype)
+        return self._psum_scatter_rows(partial, x.dtype)
 
     def _fwd_fused_ar(self, x, params):
         # x replicated (M, hidden)
