@@ -223,7 +223,7 @@ def test_tp_attn_decode(tp4_mesh, mode):
     offset = jnp.array([5, 3, 7, 0], jnp.int32)
 
     def step(xx, wq, w_o, kc, vc):
-        out, (nk, nv) = attn.decode(
+        out, (nk, nv), _ = attn.decode(
             xx, {"wqkv": wq, "wo": w_o}, (kc, vc), offset)
         return out, nk, nv
 

@@ -149,3 +149,40 @@ def test_engine_serve_fused_mode(tiny_setup):
         outs[mode] = engine.serve(params, ids, gen)
     model.set_mode("xla")
     assert (outs["fused"] == outs["xla"]).mean() > 0.9, outs
+
+
+def test_quantized_kv_cache_e2e(tiny_setup):
+    """Int8 KV cache (quantize_kv_cache=True): prefill + decode logits
+    track the float-cache model within quantization tolerance."""
+    import dataclasses
+
+    mesh, cfg, model, params = tiny_setup
+    model.set_mode("xla")
+    b, s = 4, 8
+    ids = jax.random.randint(jax.random.key(3), (b, s), 0, cfg.vocab_size)
+
+    cache_f = model.create_cache(b, max_seq=64)
+    logits_f, cache_f = jax.jit(model.make_prefill_fn())(
+        params, ids, cache_f)
+
+    cfg_q = dataclasses.replace(cfg, quantize_kv_cache=True)
+    model_q = Qwen3(cfg_q, mesh, mode="xla")
+    cache_q = model_q.create_cache(b, max_seq=64)
+    assert cache_q.quantized and cache_q.ks[0].dtype == jnp.int8
+    logits_q, cache_q = jax.jit(model_q.make_prefill_fn())(
+        params, ids, cache_q)
+
+    # prefill logits don't read the cache: identical paths
+    assert_allclose(logits_q, logits_f, atol=1e-4, rtol=1e-4,
+                    name="prefill int8-cache")
+
+    toks = jnp.argmax(logits_f, -1).astype(jnp.int32)
+    decode_f = jax.jit(model.make_decode_fn())
+    decode_q = jax.jit(model_q.make_decode_fn())
+    for step in range(3):
+        lf, cache_f = decode_f(params, toks, cache_f)
+        lq, cache_q = decode_q(params, toks, cache_q)
+        tol = 0.03 * float(jnp.abs(lf).max())
+        assert_allclose(lq, lf, atol=tol, rtol=0.05,
+                        name=f"decode int8-cache step{step}")
+        toks = jnp.argmax(lf, -1).astype(jnp.int32)

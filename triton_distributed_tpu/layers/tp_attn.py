@@ -190,7 +190,7 @@ class TPAttention:
         out = self._out_proj(attn, x.dtype, params)
         return out, (k, v)
 
-    def decode(self, x, params, kv_cache, offset):
+    def decode(self, x, params, kv_cache, offset, kv_scales=None):
         """x: (B/world... ) decode step with B*1 tokens: x is
         (B/world rows? ) — following the reference, decode activations
         are M=B-sharded; B must divide world or be replicated.
@@ -198,8 +198,10 @@ class TPAttention:
         Here: x (B_loc, hidden) with B_loc = B/world when B >= world,
         else x replicated (B, hidden) and mode falls back to gather.
         kv_cache: (k, v) each (B, Hkv_loc, S_max, D); offset: (B,) int32
-        current lengths (same on all ranks).
-        Returns (out like x, updated cache)."""
+        current lengths (same on all ranks).  With ``kv_scales``
+        ((k_scale, v_scale), each (B, Hkv_loc, S_max) f32) the cache is
+        int8 and the new token is quantized on write.
+        Returns (out like x, updated cache, updated scales or None)."""
         k_cache, v_cache = kv_cache
         b = k_cache.shape[0]
         qkv = self._project_qkv(x, params)          # (B, qkv_cols)
@@ -220,16 +222,36 @@ class TPAttention:
         q = rope1(q)
         k = rope1(k)
 
-        # scatter new kv at offset
+        # scatter new kv at offset (quantizing first for int8 caches)
+        assert (kv_scales is not None) == (k_cache.dtype == jnp.int8), (
+            "int8 caches require kv_scales (and float caches must not "
+            "pass them)")
+        k_sc = v_sc = None
+        if kv_scales is not None:
+            from triton_distributed_tpu.kernels.flash_decode import (
+                quantize_kv)
+
+            k_sc, v_sc = kv_scales
+            # Same scheme as the prefill write path (quantize_kv).
+            k, v, kscale_new, vscale_new = quantize_kv(k, v)
+            k_sc = jax.vmap(
+                lambda c, u, o: jax.lax.dynamic_update_slice(
+                    c, u, (0, o)))(k_sc, kscale_new, offset)
+            v_sc = jax.vmap(
+                lambda c, u, o: jax.lax.dynamic_update_slice(
+                    c, u, (0, o)))(v_sc, vscale_new, offset)
         k_cache = jax.vmap(
             lambda c, u, o: jax.lax.dynamic_update_slice(
-                c, u, (0, o, 0)))(k_cache, k, offset)
+                c, u, (0, o, 0)))(k_cache, k.astype(k_cache.dtype), offset)
         v_cache = jax.vmap(
             lambda c, u, o: jax.lax.dynamic_update_slice(
-                c, u, (0, o, 0)))(v_cache, v, offset)
+                c, u, (0, o, 0)))(v_cache, v.astype(v_cache.dtype), offset)
 
         out, _ = flash_decode(q.reshape(b, self.h_loc, self.head_dim),
                               k_cache, v_cache, offset + 1,
+                              k_scale=k_sc, v_scale=v_sc,
                               interpret=self.interpret)
         attn = out.reshape(b, self.h_loc * self.head_dim)
-        return self._out_proj(attn, x.dtype, params), (k_cache, v_cache)
+        out_x = self._out_proj(attn, x.dtype, params)
+        scales = (k_sc, v_sc) if kv_scales is not None else None
+        return out_x, (k_cache, v_cache), scales

@@ -29,6 +29,9 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     moe_intermediate_size: Optional[int] = None   # per-expert ffn
     moe_capacity_factor: float = 2.0
+    #: Int8-quantize the KV cache (per-token scales): halves the cache
+    #: footprint and decode's KV bandwidth (kernels/flash_decode.py).
+    quantize_kv_cache: bool = False
 
     @property
     def is_moe(self) -> bool:

@@ -12,7 +12,7 @@ place — the role CUDA graphs + in-place writes play in the reference.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -24,33 +24,76 @@ class KVCache:
     ks: List[jnp.ndarray]          # per layer: (B, Hkv_loc, S_max, D)
     vs: List[jnp.ndarray]
     offset: jnp.ndarray            # (B,) int32 — filled length
+    #: Per-token dequant scales (B, Hkv_loc, S_max) f32 per layer when
+    #: the cache is int8-quantized (see `kernels.flash_decode`:
+    #: quantize_kv / flash_decode's k_scale/v_scale); None = float
+    #: cache.  Int8 halves both the cache footprint and decode's KV
+    #: streaming bytes (measured 1.6–1.66× faster decode).
+    kss: Optional[List[jnp.ndarray]] = None
+    vss: Optional[List[jnp.ndarray]] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.kss is not None
 
     @classmethod
     def create(cls, num_layers: int, batch: int, num_kv_heads: int,
-               max_seq: int, head_dim: int, dtype=jnp.bfloat16):
+               max_seq: int, head_dim: int, dtype=jnp.bfloat16,
+               quantized: bool = False):
         shape = (batch, num_kv_heads, max_seq, head_dim)
+        if quantized:
+            dtype = jnp.int8
         return cls(
             ks=[jnp.zeros(shape, dtype) for _ in range(num_layers)],
             vs=[jnp.zeros(shape, dtype) for _ in range(num_layers)],
             offset=jnp.zeros((batch,), jnp.int32),
+            kss=([jnp.zeros(shape[:3], jnp.float32)
+                  for _ in range(num_layers)] if quantized else None),
+            vss=([jnp.zeros(shape[:3], jnp.float32)
+                  for _ in range(num_layers)] if quantized else None),
         )
 
     def write_prefill(self, layer: int, k, v):
-        """k/v: (B, Hkv, S, D) — fill from position 0."""
+        """k/v: (B, Hkv, S, D) float — fill from position 0
+        (quantizing on write when the cache is int8)."""
         ks = list(self.ks)
         vs = list(self.vs)
+        if self.quantized:
+            from triton_distributed_tpu.kernels.flash_decode import (
+                quantize_kv)
+
+            k_q, v_q, kscale, vscale = quantize_kv(k, v)
+            kss = list(self.kss)
+            vss = list(self.vss)
+            ks[layer] = jax.lax.dynamic_update_slice(
+                self.ks[layer], k_q, (0, 0, 0, 0))
+            vs[layer] = jax.lax.dynamic_update_slice(
+                self.vs[layer], v_q, (0, 0, 0, 0))
+            kss[layer] = jax.lax.dynamic_update_slice(
+                self.kss[layer], kscale, (0, 0, 0))
+            vss[layer] = jax.lax.dynamic_update_slice(
+                self.vss[layer], vscale, (0, 0, 0))
+            return dataclasses.replace(self, ks=ks, vs=vs, kss=kss,
+                                       vss=vss)
         ks[layer] = jax.lax.dynamic_update_slice(
             self.ks[layer], k.astype(self.ks[layer].dtype), (0, 0, 0, 0))
         vs[layer] = jax.lax.dynamic_update_slice(
             self.vs[layer], v.astype(self.vs[layer].dtype), (0, 0, 0, 0))
         return dataclasses.replace(self, ks=ks, vs=vs)
 
-    def set_layer(self, layer: int, k, v):
+    def set_layer(self, layer: int, k, v, kscale=None, vscale=None):
         ks = list(self.ks)
         vs = list(self.vs)
         ks[layer] = k
         vs[layer] = v
-        return dataclasses.replace(self, ks=ks, vs=vs)
+        rep = dict(ks=ks, vs=vs)
+        if kscale is not None:
+            kss = list(self.kss)
+            vss = list(self.vss)
+            kss[layer] = kscale
+            vss[layer] = vscale
+            rep.update(kss=kss, vss=vss)
+        return dataclasses.replace(self, **rep)
 
     def inc_offset(self, n: int = 1):
         return dataclasses.replace(self, offset=self.offset + n)

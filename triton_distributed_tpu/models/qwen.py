@@ -278,9 +278,13 @@ class Qwen3:
         for li, lp in enumerate(params["layers"]):
             res = x
             h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps)
-            h, (nk, nv) = self.attn.decode(
-                h, lp["attn"], (cache.ks[li], cache.vs[li]), offset)
-            cache = cache.set_layer(li, nk, nv)
+            scales = ((cache.kss[li], cache.vss[li])
+                      if cache.quantized else None)
+            h, (nk, nv), nscales = self.attn.decode(
+                h, lp["attn"], (cache.ks[li], cache.vs[li]), offset,
+                kv_scales=scales)
+            cache = cache.set_layer(li, nk, nv,
+                                    *(nscales or (None, None)))
             x = res + h
             res = x
             h = rms_norm(x, lp["ln2"], cfg.rms_norm_eps)
@@ -299,10 +303,13 @@ class Qwen3:
 
     def _cache_specs(self, cache):
         n = self.config.num_layers
+        q = self.config.quantize_kv_cache
         return KVCache(
             ks=[P(None, self.axis, None, None)] * n,
             vs=[P(None, self.axis, None, None)] * n,
             offset=P(None),
+            kss=[P(None, self.axis, None)] * n if q else None,
+            vss=[P(None, self.axis, None)] * n if q else None,
         )
 
     def make_prefill_fn(self):
@@ -334,7 +341,8 @@ class Qwen3:
         # global cache: kv heads sharded over tp
         return KVCache.create(
             cfg.num_layers, batch, cfg.num_kv_heads,
-            max_seq or cfg.max_seq_len, cfg.head_dim, self.dtype)
+            max_seq or cfg.max_seq_len, cfg.head_dim, self.dtype,
+            quantized=cfg.quantize_kv_cache)
 
 
 def _interleave_gate_up(gate, up, world: int):
