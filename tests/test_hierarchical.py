@@ -122,6 +122,66 @@ def test_hierarchical_all_to_all(dcn2_ici4_mesh, with_scales):
                     name="a2a2d counts")
 
 
+@pytest.mark.parametrize("kw", [
+    dict(),                                   # auto (fused at this shape)
+    dict(gemm_method="ll"),                   # low-latency ICI stage
+    dict(straggler=(2, 50), for_correctness=True),  # fault injection
+])
+def test_ag_gemm_2d(dcn2_ici4_mesh, kw):
+    """Two-level fused AG-GEMM == XLA golden on the (2, 4) mesh
+    (reference: internode AG-GEMM, allgather_gemm.py:430-481)."""
+    from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm
+
+    m, k, n = 8, 64, 256
+    a = jax.random.normal(jax.random.key(10), (WORLD * m, k), jnp.float32)
+    b = jax.random.normal(jax.random.key(11), (k, WORLD * n), jnp.float32)
+    fn = shard_map_op(
+        lambda aa, bb: ag_gemm(aa, bb, _hctx(**kw)),
+        dcn2_ici4_mesh,
+        in_specs=(P(("dcn", "ici"), None), P(None, ("dcn", "ici"))),
+        out_specs=P(None, ("dcn", "ici")))
+    out = jax.jit(fn)(a, b)
+    assert_allclose(out, a @ b, atol=2e-3, rtol=2e-3, name="ag_gemm_2d")
+
+
+def test_ag_gemm_2d_return_gathered(dcn2_ici4_mesh):
+    from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm
+
+    m, k, n = 8, 64, 128
+    a = jax.random.normal(jax.random.key(12), (WORLD * m, k), jnp.float32)
+    b = jax.random.normal(jax.random.key(13), (k, WORLD * n), jnp.float32)
+    fn = shard_map_op(
+        lambda aa, bb: ag_gemm(aa, bb, _hctx(), return_gathered=True),
+        dcn2_ici4_mesh,
+        in_specs=(P(("dcn", "ici"), None), P(None, ("dcn", "ici"))),
+        out_specs=(P(None, ("dcn", "ici")), P(None, None)))
+    out, gathered = jax.jit(fn)(a, b)
+    assert_allclose(gathered, a, atol=0, rtol=0, name="ag_gemm_2d gather")
+    assert_allclose(out, a @ b, atol=2e-3, rtol=2e-3, name="ag_gemm_2d out")
+
+
+@pytest.mark.parametrize("kw", [
+    dict(),
+    dict(gemm_method="ll"),
+    dict(straggler=(3, 50), for_correctness=True),
+])
+def test_gemm_rs_2d(dcn2_ici4_mesh, kw):
+    """Two-level fused GEMM-RS == XLA golden (reference: 2D GEMM-RS,
+    gemm_reduce_scatter.py:515-576)."""
+    from triton_distributed_tpu.kernels.gemm_reduce_scatter import gemm_rs
+
+    mt, k, n = WORLD * 8, WORLD * 16, 128
+    a = jax.random.normal(jax.random.key(14), (mt, k), jnp.float32)
+    b = jax.random.normal(jax.random.key(15), (k, n), jnp.float32)
+    fn = shard_map_op(
+        lambda aa, bb: gemm_rs(aa, bb, _hctx(**kw)),
+        dcn2_ici4_mesh,
+        in_specs=(P(None, ("dcn", "ici")), P(("dcn", "ici"), None)),
+        out_specs=P(("dcn", "ici"), None))
+    out = jax.jit(fn)(a, b)
+    assert_allclose(out, a @ b, atol=5e-3, rtol=5e-3, name="gemm_rs_2d")
+
+
 def test_hierarchical_ep_layer_matches_flat(devices):
     """Slice-proxy dispatch/combine must be bit-identical to the flat
     single-level EP layer on the same 8-rank problem."""

@@ -317,3 +317,24 @@ def test_w8a8_matmul_hardware():
         ka, kb, jnp.ones((256,), jnp.float32), jnp.ones((512,), jnp.float32))
     ref = jnp.dot(ka.astype(jnp.int32), kb.astype(jnp.int32))
     assert np.array_equal(np.asarray(out), np.asarray(ref, dtype=np.float32))
+
+
+@pytest.mark.parametrize("m", [16, 48])
+def test_w8a8_ragged_small_m_hardware(m):
+    """Ragged / sub-32-row int8 shapes (the fused ring's per-rank
+    shards at decode sizes) must compile on hardware with the int8
+    (32, 128) native tiling — ADVICE r2: these ran only in interpret
+    mode before."""
+    import jax.numpy as jnp
+    from triton_distributed_tpu.kernels.quantized import (
+        matmul_w8a8, quantize_sym)
+
+    k, n = 1024, 512
+    a = jax.random.normal(jax.random.key(3), (m, k)).astype(jnp.bfloat16)
+    b = jax.random.normal(jax.random.key(4), (k, n)).astype(jnp.bfloat16)
+    aq, sa = quantize_sym(a, axis=1)
+    bq, sb = quantize_sym(b, axis=0)
+    out = jax.jit(matmul_w8a8)(aq, bq, sa, sb)
+    ref = ((aq.astype(jnp.float32) * sa[:, None])
+           @ (bq.astype(jnp.float32) * sb[None, :]))
+    assert _rel_err(out, ref) < 2e-2

@@ -26,6 +26,11 @@ from typing import Any, Callable, Iterable, Optional, Sequence
 
 import jax
 
+try:
+    import fcntl
+except ImportError:  # non-POSIX platform: fall back to lockless saves
+    fcntl = None
+
 from triton_distributed_tpu.utils.debug import logger
 
 
@@ -68,7 +73,11 @@ class ContextualAutotuner:
 
     def _device_key(self) -> str:
         d = jax.devices()[0]
-        return f"{d.device_kind}/w{jax.device_count()}"
+        # Include the tuned function's identity: two tuners for
+        # different ops sharing one cache_path (same arg shapes, same
+        # candidate reprs) must not reuse each other's winners.
+        fn_id = getattr(self.fn, "__qualname__", None) or repr(self.fn)
+        return f"{d.device_kind}/w{jax.device_count()}/{fn_id}"
 
     def _load_disk(self) -> dict:
         try:
@@ -79,18 +88,27 @@ class ContextualAutotuner:
 
     def _save_disk(self):
         try:
-            # Merge-on-save: another instance/process sharing this path
-            # may have written since our load; a blind dump of our
-            # in-memory copy would clobber its entries.
-            merged = self._load_disk()
-            merged.update(self._disk)
-            self._disk = merged
-            tmp = self.cache_path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(self._disk, f, indent=1)
-            os.replace(tmp, self.cache_path)
+            # Locked merge-on-save: two processes saving concurrently
+            # between each other's load and os.replace would otherwise
+            # drop the other's freshly-tuned entries on shared-FS
+            # multi-rank runs.  No fcntl (non-POSIX): lockless merge.
+            if fcntl is not None:
+                with open(self.cache_path + ".lock", "w") as lock:
+                    fcntl.flock(lock, fcntl.LOCK_EX)
+                    self._merge_save()
+            else:
+                self._merge_save()
         except Exception as e:
             logger.warning("autotune cache write failed: %s", e)
+
+    def _merge_save(self):
+        merged = self._load_disk()
+        merged.update(self._disk)
+        self._disk = merged
+        tmp = self.cache_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._disk, f, indent=1)
+        os.replace(tmp, self.cache_path)
 
     def _candidates_repr(self) -> list:
         return sorted(repr(c) for c in self.configs)
@@ -185,10 +203,37 @@ class ContextualAutotuner:
         return int(multihost_utils.broadcast_one_to_all(
             np.int32(choice_idx)))
 
+    def _collective_disk_hit(self, hit):
+        """Make the disk hit/miss decision collective.  Under
+        multi-process JAX a per-host cache file may exist on some hosts
+        and not others; if hitting ranks skipped the benchmark while
+        missing ranks ran it and called `broadcast_one_to_all`, the
+        collective participation mismatch would hang (and even absent a
+        hang, ranks could run different configs).  Rank 0's lookup is
+        authoritative: if it hit, every rank adopts its winner by
+        config index (candidate lists are identical across ranks — the
+        module's identical-programs invariant); if it missed, every
+        rank re-tunes, including local hitters."""
+        if jax.process_count() <= 1:
+            return hit
+        from jax.experimental import multihost_utils
+        import numpy as np
+        reprs = [repr(c) for c in self.configs]
+        idx = -1
+        if hit is not None and repr(hit.config) in reprs:
+            idx = reprs.index(repr(hit.config))
+        idx = int(multihost_utils.broadcast_one_to_all(np.int32(idx)))
+        if idx < 0:
+            return None
+        cfg = self.configs[idx]
+        if hit is not None and repr(hit.config) == reprs[idx]:
+            return hit  # local entry agrees: keep its timing/ranking
+        return _Entry(cfg, 0.0, [(0.0, cfg)])
+
     def __call__(self, *args, **kwargs):
         key = self.key_fn(*args, **kwargs)
         if key not in self.cache and self.cache_path:
-            hit = self._disk_lookup(key)
+            hit = self._collective_disk_hit(self._disk_lookup(key))
             if hit is not None:
                 self.cache[key] = hit
                 logger.info("autotune %s: disk cache hit, best=%s",

@@ -176,8 +176,68 @@ def _ag_gemm_ll_kernel(ctx: AllGatherGEMMContext, mp, n, k,
                         mc=mp, n=n, k=k, config=ctx.gemm)
 
 
-def ag_gemm(a_shard, b, ctx: AllGatherGEMMContext,
-            return_gathered: bool = False):
+def _ag_gemm_2d(a_shard, b, hctx, return_gathered: bool):
+    """Two-level (dcn × ici) fused AG-GEMM: DCN slice-chunks are
+    pipelined through the fused ICI ring kernel.
+
+    Reference: the internode AG-GEMM path — rank-swizzled tile order
+    for nnodes > 1 (`allgather_gemm.py:211-216`), a dedicated
+    internode AG stream feeding the persistent GEMM
+    (`allgather_gemm.py:430,471-481`,
+    `cp_engine_producer_all_gather_inter_node`, `allgather.py:293-472`).
+
+    TPU re-design: Pallas cannot issue one-sided DMA across DCN, so
+    the DCN stage is a host-composed ring of `lax.ppermute` steps —
+    XLA's latency-hiding scheduler runs the (slow) DCN transfer of
+    slice-chunk s+1 concurrently with the Pallas kernel (ICI ring +
+    MXU) consuming slice-chunk s.  Each DCN hop carries only this
+    device's (m, k) rows, the per-slice minimum, and the ICI ring
+    starts on the *local* slice's rows at step 0 — no wait on any DCN
+    traffic to begin computing, the same "start from own rank" swizzle
+    as the single-axis ring, lifted one level up.
+    """
+    dcn = hctx.dcn_size
+    ici_ctx = hctx._ag_gemm_ctx()
+    if dcn <= 1:
+        return ag_gemm(a_shard, b, ici_ctx, return_gathered)
+
+    m, k = a_shard.shape
+    n = b.shape[1]
+    mi = hctx.ici_size * m          # rows per slice after the ICI AG
+    my_d = jax.lax.axis_index(hctx.dcn_axis)
+    perm = [(i, (i + 1) % dcn) for i in range(dcn)]
+
+    cur = a_shard
+    blocks = []
+    for s in range(dcn):
+        # Start the DCN hop BEFORE the Pallas call so the scheduler
+        # can overlap the collective-permute with the fused kernel.
+        nxt = (jax.lax.ppermute(cur, hctx.dcn_axis, perm)
+               if s < dcn - 1 else None)
+        blocks.append(ag_gemm(cur, b, ici_ctx, return_gathered))
+        cur = nxt
+
+    # Step s held slice (my_d - s): place each block at its global
+    # slot (global rank g = dcn_index * ici_size + ici_index).
+    out_full = jnp.zeros((dcn, mi, n), blocks[0][0].dtype
+                         if return_gathered else blocks[0].dtype)
+    g_full = jnp.zeros((dcn, mi, k), a_shard.dtype) if return_gathered \
+        else None
+    for s, res in enumerate(blocks):
+        src = jax.lax.rem(my_d - s + dcn, dcn)
+        o, g = res if return_gathered else (res, None)
+        out_full = jax.lax.dynamic_update_slice(
+            out_full, o[None], (src, 0, 0))
+        if return_gathered:
+            g_full = jax.lax.dynamic_update_slice(
+                g_full, g[None], (src, 0, 0))
+    out = out_full.reshape(dcn * mi, n)
+    if return_gathered:
+        return out, g_full.reshape(dcn * mi, k)
+    return out
+
+
+def ag_gemm(a_shard, b, ctx, return_gathered: bool = False):
     """C = all_gather(a, axis) @ b, overlapped.  Call inside shard_map.
 
     a_shard: (m_local, k) — row shard of A over `ctx.axis`.
@@ -188,7 +248,16 @@ def ag_gemm(a_shard, b, ctx: AllGatherGEMMContext,
     Any m is supported on the fused paths: rows are padded to the
     Mosaic sublane multiple inside the op and sliced back out — decode
     shapes (m = 1..8) run the Pallas "ll" path, not an XLA fallback.
+
+    ``ctx`` may be an `AllGatherGEMMContext` (single axis) or a
+    `HierarchicalContext` (two-level dcn × ici — the reference's
+    internode AG-GEMM, `allgather_gemm.py:430-481`).
     """
+    from triton_distributed_tpu.kernels.hierarchical import (
+        HierarchicalContext)
+    if isinstance(ctx, HierarchicalContext):
+        return _ag_gemm_2d(a_shard, b, ctx, return_gathered)
+
     world = ctx.world_size
     m, k = a_shard.shape
     k2, n = b.shape

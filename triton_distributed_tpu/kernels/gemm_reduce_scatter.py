@@ -155,7 +155,56 @@ def _gemm_rs_ll_kernel(ctx: GEMMReduceScatterContext, mcp, n, k,
                         barrier=False)
 
 
-def gemm_rs(a, b, ctx: GEMMReduceScatterContext):
+def _gemm_rs_2d(a, b, hctx):
+    """Two-level (dcn × ici) fused GEMM-RS: a DCN ring of partial sums
+    wrapped around the fused ICI kernel.
+
+    Reference: the 2D GEMM-RS composition — persistent GEMM feeding
+    the 2D reduce-scatter (`gemm_reduce_scatter.py:515-576` →
+    `reduce_scatter.py:844-873`, inter-node p2p at `:518`).
+
+    TPU re-design: at DCN step s each device runs the fused ICI
+    GEMM-RS (compute + intra-slice reduce-scatter, one Pallas kernel)
+    on the rows destined for slice (my_d + dcn - 1 - s), and adds the
+    result into an accumulator travelling a DCN ring — after dcn-1
+    hops each accumulated chunk lands on its owner slice.  The DCN
+    hops carry only the already-slice-reduced (M/world, n) chunk (the
+    scarce-resource minimum, like the reference's 1/LOCAL_WORLD_SIZE
+    IB traffic), and XLA overlaps each hop with the next step's Pallas
+    kernel.  Cross-slice accumulation rides in f32 — dcn-1 sequential
+    adds of bf16 partials would otherwise lose the golden's precision.
+    """
+    dcn = hctx.dcn_size
+    ici_ctx = hctx._gemm_rs_ctx()
+    if dcn <= 1:
+        return gemm_rs(a, b, ici_ctx)
+
+    mt, k = a.shape
+    world = dcn * hctx.ici_size
+    assert mt % world == 0, (a.shape, world)
+    mi = mt // dcn                   # rows destined per slice
+    ar = a.reshape(dcn, mi, k)
+    my_d = jax.lax.axis_index(hctx.dcn_axis)
+    perm = [(i, (i + 1) % dcn) for i in range(dcn)]
+
+    def part(c):
+        """Slice-level partial for destination slice ``c``: fused ICI
+        GEMM-RS over this slice's K-shards → (M/world, n)."""
+        rows = jax.lax.dynamic_index_in_dim(ar, c, axis=0,
+                                            keepdims=False)
+        return gemm_rs(rows, b, ici_ctx).astype(jnp.float32)
+
+    # Same ring walk as `gemm_rs_ppermute`, lifted to the DCN level:
+    # step s computes the chunk owned by slice (my_d + dcn - 1 - s);
+    # the travelling accumulator reaches its owner at the last step.
+    acc = part(jax.lax.rem(my_d + dcn - 1, dcn))
+    for s in range(1, dcn):
+        acc = jax.lax.ppermute(acc, hctx.dcn_axis, perm)
+        acc = acc + part(jax.lax.rem(my_d + 2 * dcn - 1 - s, dcn))
+    return acc.astype(a.dtype)
+
+
+def gemm_rs(a, b, ctx):
     """reduce_scatter(a @ b) over `ctx.axis`, overlapped.
     Call inside shard_map.
 
@@ -166,7 +215,16 @@ def gemm_rs(a, b, ctx: GEMMReduceScatterContext):
     Any chunk size is supported on the fused paths: chunks are padded
     to the Mosaic sublane multiple inside the op and sliced back —
     decode shapes run the Pallas "ll" path, not an XLA fallback.
+
+    ``ctx`` may be a `GEMMReduceScatterContext` (single axis) or a
+    `HierarchicalContext` (two-level dcn × ici — the reference's 2D
+    GEMM-RS, `gemm_reduce_scatter.py:515-576`).
     """
+    from triton_distributed_tpu.kernels.hierarchical import (
+        HierarchicalContext)
+    if isinstance(ctx, HierarchicalContext):
+        return _gemm_rs_2d(a, b, ctx)
+
     world = ctx.world_size
     mt, k = a.shape
     k2, n = b.shape

@@ -52,6 +52,7 @@ from triton_distributed_tpu.kernels.low_latency_all_to_all import (
     AllToAllContext,
     fast_all_to_all,
 )
+from triton_distributed_tpu.kernels.matmul import MatmulConfig
 from triton_distributed_tpu.kernels.reduce_scatter import (
     ReduceScatterContext,
     ReduceScatterMethod,
@@ -77,6 +78,16 @@ class HierarchicalContext:
     rs_method: ReduceScatterMethod = ReduceScatterMethod.AUTO
     collective_id: int = cids.HIERARCHICAL
     interpret: Optional[bool] = None
+    #: Settings for the 2-level fused GEMM-overlap ops (`ag_gemm` /
+    #: `gemm_rs` accept a HierarchicalContext and pipeline DCN
+    #: slice-chunks through the fused ICI kernels — reference:
+    #: internode AG-GEMM `allgather_gemm.py:430-481`, 2D GEMM-RS
+    #: `gemm_reduce_scatter.py:515-576`).
+    gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
+    gemm_method: str = "auto"      # auto | fused | ll | xla (ICI stage)
+    #: Fault injection, forwarded into every ICI-stage kernel launch.
+    straggler: Optional[tuple] = None
+    for_correctness: bool = False
 
     @property
     def world_size(self) -> int:
@@ -92,6 +103,30 @@ class HierarchicalContext:
         return ReduceScatterContext(
             axis=self.ici_axis, world_size=self.ici_size,
             method=self.rs_method, collective_id=self.collective_id,
+            interpret=self.interpret)
+
+    def _ag_gemm_ctx(self):
+        """ICI-stage context for the 2-level fused AG-GEMM."""
+        from triton_distributed_tpu.kernels.allgather_gemm import (
+            AllGatherGEMMContext)
+        return AllGatherGEMMContext(
+            axis=self.ici_axis, world_size=self.ici_size,
+            gemm=self.gemm, method=self.gemm_method,
+            collective_id=self.collective_id,
+            straggler=self.straggler,
+            for_correctness=self.for_correctness,
+            interpret=self.interpret)
+
+    def _gemm_rs_ctx(self):
+        """ICI-stage context for the 2-level fused GEMM-RS."""
+        from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
+            GEMMReduceScatterContext)
+        return GEMMReduceScatterContext(
+            axis=self.ici_axis, world_size=self.ici_size,
+            gemm=self.gemm, method=self.gemm_method,
+            collective_id=self.collective_id,
+            straggler=self.straggler,
+            for_correctness=self.for_correctness,
             interpret=self.interpret)
 
 

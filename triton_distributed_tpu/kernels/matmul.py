@@ -260,6 +260,12 @@ def emit_chunked_matmul(a_ref, b_ref, o_ref, *, chunks, mc, n, k,
 
 
 def round_up_rows(m: int, dtype) -> int:
-    """Pad row counts to the Mosaic sublane multiple for the dtype."""
-    min_rows = 16 if jnp.dtype(dtype).itemsize < 4 else 8
+    """Pad row counts to the Mosaic sublane multiple for the dtype.
+
+    Native tiling is (8, 128) for 4-byte, (16, 128) for 2-byte and
+    (32, 128) for 1-byte elements — int8 rows must pad to 32 or the
+    ring kernels' small-m shards force relayouts (or fail to compile)
+    on hardware."""
+    itemsize = jnp.dtype(dtype).itemsize
+    min_rows = {1: 32, 2: 16}.get(itemsize, 8)
     return (m + min_rows - 1) // min_rows * min_rows

@@ -43,8 +43,10 @@ class Int8MatmulConfig:
     block_k: int = 4096
 
     def resolve(self, m: int, n: int, k: int) -> "Int8MatmulConfig":
+        # int8 Mosaic native tiling is (32, 128): align block_m to 32
+        # (bf16's 8-row alignment would force relayouts on hardware).
         return Int8MatmulConfig(
-            block_m=_pick_block(m, self.block_m, 8),
+            block_m=_pick_block(m, self.block_m, 32),
             block_n=_pick_block(n, self.block_n, 128),
             block_k=_pick_block(k, self.block_k, 128),
         )
