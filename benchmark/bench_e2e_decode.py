@@ -52,13 +52,19 @@ def main():
     ids = jax.random.randint(jax.random.key(0), (b, args.prefill), 0,
                              cfg.vocab_size)
 
-    results = {}
+    # Build BOTH modes up front and interleave their measurements in
+    # ABBA order: the tunneled chip shows minutes-scale drift, and a
+    # sequential per-mode sweep folds that drift into the ratio (round
+    # 2 reported fused 0.96x from exactly this artifact; interleaved,
+    # the two modes tie at world=1 — their decode graphs are
+    # equivalent there).
+    runners = {}
     for mode in ("fused", "xla"):
         model = Qwen3(cfg, mesh, mode=mode)
         params = model.init_params(jax.random.key(1))
         eng = Engine(model)
 
-        def run(gen_len):
+        def run(gen_len, model=model, params=params, eng=eng):
             cache = model.create_cache(b)
             logits, cache = eng.prefill(params, ids, cache)
             first = jnp.argmax(logits, -1).astype(jnp.int32)
@@ -70,21 +76,35 @@ def main():
 
         run(args.g1)  # warm both jits (prefill warmed inside)
         run(args.g2)
-        slopes = []
-        for _ in range(args.repeats):
-            t1 = run(args.g1)
-            t2 = run(args.g2)
-            slopes.append((t2 - t1) / (args.g2 - args.g1))
-        per_step = statistics.median(slopes)
-        results[mode] = per_step
+        runners[mode] = run
+
+    slopes = {m: [] for m in runners}
+    for _ in range(args.repeats):
+        for m in ("fused", "xla", "xla", "fused"):   # ABBA
+            t1 = runners[m](args.g1)
+            t2 = runners[m](args.g2)
+            slopes[m].append((t2 - t1) / (args.g2 - args.g1))
+
+    results = {m: statistics.median(s) for m, s in slopes.items()}
+    # Paired per-round ratios expose the noise band the medians hide:
+    # at world=1 the two modes' decode graphs are equivalent (the only
+    # HLO diff is two world-1 no-op all_gathers), so any deviation of
+    # the ratio from 1.0 here bounds the harness noise, not a real
+    # fused overhead.
+    pair_ratios = sorted(x / f for x, f in zip(slopes["xla"],
+                                               slopes["fused"]))
+    for mode in ("fused", "xla"):
+        per_step = results[mode]
         print(json.dumps({
             "bench": "e2e_decode", "mode": mode, "B": b,
             "layers": cfg.num_layers,
             "ms_per_step": round(per_step * 1e3, 3),
             "tokens_per_s": round(b / per_step, 1),
             **({"vs_baseline":
-                round(results["xla"] / results["fused"], 3)}
-               if "xla" in results and "fused" in results else {}),
+                round(results["xla"] / results["fused"], 3),
+                "ratio_range": [round(pair_ratios[0], 3),
+                                round(pair_ratios[-1], 3)]}
+               if mode == "xla" else {}),
         }), flush=True)
 
 

@@ -29,6 +29,7 @@ def _run_ar(mesh, x_per_rank, method, axis="tp"):
     AllReduceMethod.ONE_SHOT,
     AllReduceMethod.TWO_SHOT,
     AllReduceMethod.RING,
+    AllReduceMethod.CHAIN,
     AllReduceMethod.XLA,
 ])
 @pytest.mark.parametrize("world,mesh_name", [(4, "tp4_mesh"), (8, "tp8_mesh")])
@@ -54,6 +55,43 @@ def test_auto_select():
     assert get_auto_allreduce_method(1024, 8) == AllReduceMethod.ONE_SHOT
     assert get_auto_allreduce_method(1 << 20, 8) == AllReduceMethod.TWO_SHOT
     assert get_auto_allreduce_method(64 << 20, 8) == AllReduceMethod.RING
+
+
+def test_auto_select_open_topology_prefers_chain():
+    """On an open (non-wraparound) mesh the ring pays ~2x the busiest
+    link for its wrap hop; the wrap-free CHAIN fills the double-tree
+    slot (`kernels/nvidia/allreduce.py:418`) at mid/large sizes."""
+    assert (get_auto_allreduce_method(16 << 20, 8, closed_ring=False)
+            == AllReduceMethod.CHAIN)
+    # Tiny payloads stay latency-bound one-shot even on open meshes.
+    assert (get_auto_allreduce_method(1024, 8, closed_ring=False)
+            == AllReduceMethod.ONE_SHOT)
+    # On a closed torus the validated ring keeps the slot.
+    assert (get_auto_allreduce_method(64 << 20, 8, closed_ring=True)
+            == AllReduceMethod.RING)
+
+
+def test_chain_straggler(tp8_mesh):
+    """CHAIN correctness with a mid-chain straggler (the pipelined
+    line must tolerate a slow interior rank)."""
+    world, m, n = 8, 16, 128
+    xs = jax.random.normal(jax.random.key(3), (world, m, n), jnp.float32)
+    ctx = AllReduceContext(axis="tp", world_size=world,
+                           method=AllReduceMethod.CHAIN,
+                           straggler=(3, 10_000_000))
+    fn = shard_map_op(lambda x: all_reduce(x[0], ctx), tp8_mesh,
+                      in_specs=P("tp", None, None), out_specs=P(None, None))
+    out = jax.jit(fn)(xs)
+    assert_allclose(out, xs.sum(axis=0), atol=1e-4, rtol=1e-4)
+
+
+def test_chain_odd_rows(tp4_mesh):
+    """Rows that don't tile into the preferred pipeline depth fall
+    back to coarser chunking (P=2 / P=1) and stay correct."""
+    world, m, n = 4, 6, 128     # 6 % 8 != 0, 6 % 4 != 0, 6 % 2 == 0
+    xs = jax.random.normal(jax.random.key(4), (world, m, n), jnp.float32)
+    out = _run_ar(tp4_mesh, xs, AllReduceMethod.CHAIN)
+    assert_allclose(out, xs.sum(axis=0), atol=1e-4, rtol=1e-4)
 
 
 def test_straggler_injection(tp4_mesh):

@@ -1,0 +1,539 @@
+"""Device-language conformance suite: one test per row (group) of
+`docs/device_language.md`.
+
+Reference analogues: `test/nvidia/test_nvshmem_api.py` (every nvshmem
+device op × scope × comparison, 980 LoC) and
+`test_distributed_wait.py` (624 LoC).  The mapping table is a
+contract; this file pins each row's behavior, including the
+TPU-specific hazards the table documents:
+
+- **consuming waits** (`signal_wait_until` DECREMENTS, NVSHMEM's
+  CMP_GE does not): a deliberate-violation test demonstrates the
+  stale-read hazard when the re-arm convention is broken.
+- **put == put-with-signal** (every remote DMA signals the
+  destination recv semaphore).
+- **no device-initiated get** (reads are flipped puts).
+- entry barriers under stragglers.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_distributed_tpu import collective_ids as cids
+from triton_distributed_tpu.language import core as dl
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.platform import (
+    comm_compiler_params,
+    default_interpret,
+)
+from triton_distributed_tpu.utils.testing import assert_allclose
+
+
+WORLD = 8
+SHAPE = (8, 128)
+
+
+def _run(kernel, mesh, x, n_out=1, scratch=None, out_shape=None,
+         extra_inputs=(), collective_id=cids.ALLGATHER):
+    """Launch a conformance kernel over the tp axis: input x sharded by
+    rows, `n_out` HBM outputs of the shard's shape (first is returned
+    sharded back)."""
+    shard_shape = (x.shape[0] // WORLD,) + x.shape[1:]
+    out_shape = out_shape or (jax.ShapeDtypeStruct(shard_shape, x.dtype),
+                              ) * n_out
+
+    def op(xs, *extra):
+        return pl.pallas_call(
+            kernel,
+            out_shape=out_shape,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)]
+            + [pl.BlockSpec(memory_space=pltpu.SMEM)] * len(extra),
+            out_specs=tuple(
+                pl.BlockSpec(memory_space=pl.ANY) for _ in out_shape),
+            scratch_shapes=scratch or [],
+            compiler_params=comm_compiler_params(collective_id, WORLD),
+            interpret=default_interpret(None),
+        )(xs, *extra)
+
+    in_specs = (P("tp", None),) + tuple(
+        P(*(None,) * np.ndim(e)) for e in extra_inputs)
+    fn = shard_map_op(op, mesh, in_specs=in_specs,
+                      out_specs=tuple(P("tp", None)
+                                      for _ in out_shape))
+    outs = jax.jit(fn)(x, *extra_inputs)
+    return outs[0] if len(out_shape) == 1 else outs
+
+
+# ---------------------------------------------------------------------------
+# Identity rows: my_pe / n_pes / team aliases / peer_id
+# ---------------------------------------------------------------------------
+
+def test_my_pe_n_pes(tp8_mesh):
+    """Rows `my_pe` / `n_pes`: dl.rank / dl.num_ranks."""
+    def kernel(x_ref, o_ref, sem):
+        def body(v):
+            v[...] = (jnp.zeros_like(v)
+                      + dl.rank("tp").astype(jnp.float32)
+                      + 100.0 * dl.num_ranks("tp"))
+            dl.local_copy(v, o_ref, sem)
+        pl.run_scoped(body, pltpu.VMEM(o_ref.shape, jnp.float32))
+
+    x = jnp.zeros((WORLD * 8, 128), jnp.float32)
+    out = _run(kernel, tp8_mesh, x,
+               scratch=[pltpu.SemaphoreType.DMA(())])
+    expect = np.repeat(np.arange(WORLD), 8)[:, None] + 800.0
+    assert_allclose(out, np.broadcast_to(expect, out.shape),
+                    atol=0, rtol=0, name="my_pe")
+
+
+def test_team_aliases():
+    """Rows `team_my_pe` / `team_n_pes`: a mesh axis IS the team, so
+    the team entry points are the same functions."""
+    assert dl.team_my_pe is dl.rank
+    assert dl.team_n_pes is dl.num_ranks
+
+
+def test_signal_aliases():
+    """Rows `signal_op(SIGNAL_ADD)` / remote signal: aliases of
+    notify (SIGNAL_SET documented N/A — semaphores are counters)."""
+    assert dl.signal_op is dl.notify
+    assert dl.remote_sem_signal is dl.notify
+    assert dl.sync_all is dl.barrier_all
+
+
+def test_peer_id_shape():
+    """Row `remote_ptr`: addressing is (axis-coordinate dict, ref) —
+    never a raw pointer; other axes' coordinates are preserved."""
+    assert dl.peer_id("tp", 3) == {"tp": 3}
+    assert dl.peer_id("ici", 0) == {"ici": 0}
+
+
+def test_docs_cover_public_surface():
+    """Every public symbol in language.core appears in the mapping
+    table (the table is the contract this suite pins)."""
+    import triton_distributed_tpu.language.core as core
+
+    doc = open("docs/device_language.md").read()
+    public = [n for n in dir(core)
+              if not n.startswith("_")
+              and callable(getattr(core, n))
+              and getattr(getattr(core, n), "__module__", "").endswith(
+                  "language.core")]
+    missing = [n for n in public if n not in doc]
+    assert not missing, f"undocumented device-language ops: {missing}"
+
+
+# ---------------------------------------------------------------------------
+# Data movement rows: put / put_nbi / get-as-flipped-put / local_copy
+# ---------------------------------------------------------------------------
+
+def test_put_blocking_source_reuse(tp8_mesh):
+    """Row `putmem`: dl.put returns after LOCAL completion — the
+    source is immediately reusable without corrupting the payload
+    (SHMEM blocking-put semantics)."""
+    def kernel(x_ref, o_ref, scratch_ref, local_sem, send_sem, recv_sem):
+        my = dl.rank("tp")
+        right = jax.lax.rem(my + 1, WORLD)
+        dl.entry_barrier("tp", WORLD)
+        # Stage the payload in a scratch HBM buffer we then clobber.
+        dl.local_copy(x_ref, scratch_ref, local_sem)
+        dl.put(scratch_ref, o_ref, send_sem, recv_sem,
+               dl.peer_id("tp", right))
+
+        # Blocking put returned → source reusable: poison it.
+        def poison(v):
+            v[...] = jnp.full(v.shape, -1.0, jnp.float32)
+            dl.local_copy(v, scratch_ref, local_sem)
+        pl.run_scoped(poison, pltpu.VMEM(x_ref.shape, jnp.float32))
+        dl.wait_recv(o_ref, recv_sem)
+
+    x = jax.random.normal(jax.random.key(0), (WORLD * 8, 128))
+    out = _run(kernel, tp8_mesh, x, n_out=2,
+               scratch=[pltpu.SemaphoreType.DMA(())] * 3)[0]
+    # Device r receives from its LEFT neighbor (r-1).
+    expect = np.roll(np.asarray(x).reshape(WORLD, 8, 128), 1, axis=0)
+    assert_allclose(out, expect.reshape(WORLD * 8, 128), atol=0, rtol=0,
+                    name="put")
+
+
+def test_put_nbi_descriptor(tp8_mesh):
+    """Rows `putmem_nbi` / `putmem_signal(_nbi)`: the descriptor's
+    wait_send is `quiet`; the destination semaphore fires on delivery
+    (every put IS put-with-signal — no separate flag write exists or
+    is needed)."""
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        my = dl.rank("tp")
+        right = jax.lax.rem(my + 1, WORLD)
+        dl.entry_barrier("tp", WORLD)
+        rdma = dl.put_nbi(x_ref, o_ref, send_sem, recv_sem,
+                          dl.peer_id("tp", right))
+        dl.wait_recv(o_ref, recv_sem)   # delivery signal == the data
+        rdma.wait_send()                # quiet
+
+    x = jax.random.normal(jax.random.key(1), (WORLD * 8, 128))
+    out = _run(kernel, tp8_mesh, x,
+               scratch=[pltpu.SemaphoreType.DMA(())] * 2)
+    expect = np.roll(np.asarray(x).reshape(WORLD, 8, 128), 1, axis=0)
+    assert_allclose(out, expect.reshape(WORLD * 8, 128), atol=0, rtol=0,
+                    name="put_nbi")
+
+
+def test_get_as_flipped_put(tp8_mesh):
+    """Row `getmem`: no device-initiated read on ICI — a get from the
+    LEFT neighbor is expressed as the left neighbor pushing to us.
+    Same data flow, owner-push discipline."""
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        my = dl.rank("tp")
+        # "get from left" == left's shard arrives here; implemented as
+        # every device pushing to its right.
+        right = jax.lax.rem(my + 1, WORLD)
+        dl.entry_barrier("tp", WORLD)
+        dl.put(x_ref, o_ref, send_sem, recv_sem,
+               dl.peer_id("tp", right))
+        dl.wait_recv(o_ref, recv_sem)
+
+    x = jax.random.normal(jax.random.key(2), (WORLD * 8, 128))
+    out = _run(kernel, tp8_mesh, x,
+               scratch=[pltpu.SemaphoreType.DMA(())] * 2)
+    expect = np.roll(np.asarray(x).reshape(WORLD, 8, 128), 1, axis=0)
+    assert_allclose(out, expect.reshape(WORLD * 8, 128), atol=0, rtol=0,
+                    name="get")
+
+
+def test_fence_ordering_two_puts(tp8_mesh):
+    """Row `fence`: puts issued in program order to the same peer land
+    without interleaving corruption — waiting for both arrivals
+    observes both payloads (Mosaic orders DMA issue; per-transfer
+    semaphores order the visibility)."""
+    def kernel(x_ref, o1_ref, o2_ref, send_sem, recv_sems):
+        my = dl.rank("tp")
+        right = jax.lax.rem(my + 1, WORLD)
+        dl.entry_barrier("tp", WORLD)
+        r1 = dl.put_nbi(x_ref, o1_ref, send_sem, recv_sems.at[0],
+                        dl.peer_id("tp", right))
+        r2 = dl.put_nbi(x_ref, o2_ref, send_sem, recv_sems.at[1],
+                        dl.peer_id("tp", right))
+        dl.wait_recv(o1_ref, recv_sems.at[0])
+        dl.wait_recv(o2_ref, recv_sems.at[1])
+        r1.wait_send()
+        r2.wait_send()
+
+    x = jax.random.normal(jax.random.key(3), (WORLD * 8, 128))
+    o1, o2 = _run(kernel, tp8_mesh, x, n_out=2,
+                  scratch=[pltpu.SemaphoreType.DMA(()),
+                           pltpu.SemaphoreType.DMA((2,))])
+    expect = np.roll(np.asarray(x).reshape(WORLD, 8, 128), 1, axis=0
+                     ).reshape(WORLD * 8, 128)
+    assert_allclose(o1, expect, atol=0, rtol=0, name="fence o1")
+    assert_allclose(o2, expect, atol=0, rtol=0, name="fence o2")
+
+
+# ---------------------------------------------------------------------------
+# Signal rows: notify / int_p / signal_wait_until + the consuming-wait
+# hazard
+# ---------------------------------------------------------------------------
+
+def test_int_p_notify_remote(tp8_mesh):
+    """Rows `int_p` / `signal_op`: the idiomatic single-word remote
+    message is a semaphore signal; receiver waits for exactly the
+    count sent."""
+    def kernel(x_ref, o_ref, local_sem, sig):
+        my = dl.rank("tp")
+        right = jax.lax.rem(my + 1, WORLD)
+        # Send "3" to the right neighbor as 3 signal increments.
+        dl.notify(sig, device_id=dl.peer_id("tp", right), inc=3)
+        dl.signal_wait_until(sig, 3)
+        dl.local_copy(x_ref, o_ref, local_sem)
+
+    x = jax.random.normal(jax.random.key(4), (WORLD * 8, 128))
+    out = _run(kernel, tp8_mesh, x,
+               scratch=[pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.REGULAR])
+    assert_allclose(out, x, atol=0, rtol=0, name="int_p")
+
+
+def test_consuming_wait_re_arm(tp8_mesh):
+    """Row `signal_wait_until` (positive): waits CONSUME — two rounds
+    of signal(k)/wait(k) on one semaphore balance exactly; fresh data
+    is observed each round."""
+    def kernel(x_ref, o_ref, scratch_ref, local_sem, send_sem,
+               recv_sem, sig):
+        my = dl.rank("tp")
+        right = jax.lax.rem(my + 1, WORLD)
+        dl.entry_barrier("tp", WORLD)
+        # Round 1: put + notify 2; wait 2 (consume all).
+        dl.put(x_ref, scratch_ref, send_sem, recv_sem,
+               dl.peer_id("tp", right))
+        dl.notify(sig, device_id=dl.peer_id("tp", right), inc=2)
+        dl.signal_wait_until(sig, 2)
+        dl.wait_recv(scratch_ref, recv_sem)
+        # Round 2 re-arms cleanly: signal 1 / wait 1.
+        dl.put(scratch_ref, o_ref, send_sem, recv_sem,
+               dl.peer_id("tp", right))
+        dl.notify(sig, device_id=dl.peer_id("tp", right), inc=1)
+        dl.signal_wait_until(sig, 1)
+        dl.wait_recv(o_ref, recv_sem)
+
+    x = jax.random.normal(jax.random.key(5), (WORLD * 8, 128))
+    out = _run(kernel, tp8_mesh, x, n_out=2,
+               scratch=[pltpu.SemaphoreType.DMA(())] * 3
+               + [pltpu.SemaphoreType.REGULAR])[0]
+    # Two hops right = roll by 2.
+    expect = np.roll(np.asarray(x).reshape(WORLD, 8, 128), 2, axis=0)
+    assert_allclose(out, expect.reshape(WORLD * 8, 128), atol=0, rtol=0,
+                    name="re-arm")
+
+
+def test_consuming_wait_violation_hazard(tp8_mesh):
+    """Row `signal_wait_until` (DELIBERATE VIOLATION): NVSHMEM's
+    CMP_GE wait does not consume, so NVSHMEM-style code that
+    over-signals (2) and under-waits (1) leaves residue.  On TPU the
+    residue satisfies the NEXT round's wait instantly — before the
+    producer has written — and the consumer reads STALE round-1 data.
+    This test makes the race deterministic (the producer straggles in
+    round 2) and asserts the stale read HAPPENS, proving the hazard
+    the mapping table documents."""
+    def kernel(x_ref, o_ref, stale_ref, buf_ref, local_sem, send_sem,
+               recv_sem, sig):
+        my = dl.rank("tp")
+        right = jax.lax.rem(my + 1, WORLD)
+        dl.entry_barrier("tp", WORLD)
+
+        # Round 1: producer puts x and OVER-signals (2); consumer
+        # under-waits (1) — NVSHMEM CMP_GE style.  Residue: 1.
+        dl.put(x_ref, buf_ref, send_sem, recv_sem,
+               dl.peer_id("tp", right))
+        dl.wait_recv(buf_ref, recv_sem)
+        dl.notify(sig, device_id=dl.peer_id("tp", right), inc=2)
+        dl.signal_wait_until(sig, 1)          # leaves residue 1
+        dl.local_copy(buf_ref, o_ref, local_sem)     # round-1 value
+
+        # Round 2: producer STRAGGLES, then sends fresh data (2x).
+        # Consumer's wait(1) passes INSTANTLY on the residue; the
+        # snapshot it takes is stale.
+        dl.signal_wait_until(sig, 1)          # satisfied by residue!
+        dl.local_copy(buf_ref, stale_ref, local_sem)  # STALE snapshot
+        dl.correctness_delay("tp", True, cycles=30_000_000)
+
+        def fresh(v):
+            dl.local_copy(x_ref, v, local_sem)
+            v[...] = v[...] * 2.0
+            dl.local_copy(v, buf_ref, local_sem)
+        pl.run_scoped(fresh, pltpu.VMEM(x_ref.shape, jnp.float32))
+        dl.put(buf_ref, buf_ref, send_sem, recv_sem,
+               dl.peer_id("tp", right))
+        dl.wait_recv(buf_ref, recv_sem)
+        dl.notify(sig, device_id=dl.peer_id("tp", right), inc=1)
+        dl.signal_wait_until(sig, 1)          # drain the real signal
+
+    x = jax.random.normal(jax.random.key(6), (WORLD * 8, 128))
+    out = _run(kernel, tp8_mesh, x, n_out=3,
+               scratch=[pltpu.SemaphoreType.DMA(())] * 3
+               + [pltpu.SemaphoreType.REGULAR])
+    round1, stale = out[0], out[1]
+    # The violation's "round 2" snapshot equals round 1's data — the
+    # consumer observed the PAST.  (With correct re-arm it would be
+    # 2*x from the left neighbor.)
+    assert_allclose(stale, round1, atol=0, rtol=0, name="stale read")
+
+
+def test_consume_token_dataflow():
+    """Row `consume_token`: ties a value to a completed wait via an
+    optimization barrier (pure dataflow edge, value-preserving)."""
+    tok = dl.wait.__doc__  # doc exists
+    x = jnp.arange(8.0)
+    y = dl.consume_token(x, ())
+    assert_allclose(y, x, atol=0, rtol=0, name="consume_token")
+
+
+# ---------------------------------------------------------------------------
+# Barrier rows: barrier_all / sync_all / neighbors / entry barrier under
+# stragglers
+# ---------------------------------------------------------------------------
+
+def test_barrier_all_orders_one_shot_writes(tp8_mesh):
+    """Rows `barrier` / `barrier_all`: after the barrier, every peer's
+    pre-barrier put is visible (all-to-all one-shot exchange)."""
+    def kernel(x_ref, o_ref, send_sem, recv_sems):
+        my = dl.rank("tp")
+        dl.entry_barrier("tp", WORLD)
+        for i in range(1, WORLD):
+            peer = jax.lax.rem(my + i, WORLD)
+            dl.put_nbi(x_ref, o_ref.at[my], send_sem, recv_sems.at[my],
+                       dl.peer_id("tp", peer))
+        dl.local_copy(x_ref, o_ref.at[my], send_sem)
+        for i in range(1, WORLD):
+            peer = jax.lax.rem(my + i, WORLD)
+            dl.wait_recv(o_ref.at[peer], recv_sems.at[peer])
+        for _ in range(1, WORLD):
+            dl.wait_send(x_ref, send_sem)
+        dl.barrier_all("tp")
+
+    x = jax.random.normal(jax.random.key(7), (WORLD * 8, 128))
+    out = _run(kernel, tp8_mesh, x,
+               out_shape=(jax.ShapeDtypeStruct((WORLD, 8, 128),
+                                               jnp.float32),),
+               scratch=[pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA((WORLD,))])
+    # Every device holds the full gathered array (out stacks the
+    # per-device copies along tp).
+    out = np.asarray(out).reshape(WORLD, WORLD, 8, 128)
+    for d in range(WORLD):
+        assert_allclose(out[d].reshape(WORLD * 8, 128), x, atol=0,
+                        rtol=0, name=f"barrier fcollect dev{d}")
+
+
+def test_barrier_neighbors(tp8_mesh):
+    """Row `barrier_neighbors`: ring-neighbor barrier suffices to
+    order a neighbor-only exchange."""
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        my = dl.rank("tp")
+        right = jax.lax.rem(my + 1, WORLD)
+        dl.barrier_neighbors("tp")
+        dl.put(x_ref, o_ref, send_sem, recv_sem,
+               dl.peer_id("tp", right))
+        dl.wait_recv(o_ref, recv_sem)
+
+    x = jax.random.normal(jax.random.key(8), (WORLD * 8, 128))
+    out = _run(kernel, tp8_mesh, x,
+               scratch=[pltpu.SemaphoreType.DMA(())] * 2)
+    expect = np.roll(np.asarray(x).reshape(WORLD, 8, 128), 1, axis=0)
+    assert_allclose(out, expect.reshape(WORLD * 8, 128), atol=0, rtol=0,
+                    name="barrier_neighbors")
+
+
+@pytest.mark.parametrize("straggler_rank", [0, 5])
+def test_entry_barrier_under_straggler(tp8_mesh, straggler_rank):
+    """Entry barrier + straggler injection: a late rank must not let
+    fast peers' puts corrupt its previous-program state, and the
+    exchange must still complete correctly (the reference's
+    `for_correctness` + straggler stress discipline)."""
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        my = dl.rank("tp")
+        right = jax.lax.rem(my + 1, WORLD)
+        dl.maybe_straggle("tp", (straggler_rank, 20_000_000))
+        dl.entry_barrier("tp", WORLD)
+        dl.correctness_delay("tp", True, cycles=3_000_000)
+        dl.put(x_ref, o_ref, send_sem, recv_sem,
+               dl.peer_id("tp", right))
+        dl.wait_recv(o_ref, recv_sem)
+
+    x = jax.random.normal(jax.random.key(9), (WORLD * 8, 128))
+    out = _run(kernel, tp8_mesh, x,
+               scratch=[pltpu.SemaphoreType.DMA(())] * 2)
+    expect = np.roll(np.asarray(x).reshape(WORLD, 8, 128), 1, axis=0)
+    assert_allclose(out, expect.reshape(WORLD * 8, 128), atol=0, rtol=0,
+                    name="straggler barrier")
+
+
+# ---------------------------------------------------------------------------
+# Collective rows: broadcast (traced root) / fcollect
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("root", [0, 3])
+def test_broadcast_from_traced_root(tp8_mesh, root):
+    """Row `broadcast`: `emit_broadcast` with the root passed as a
+    TRACED scalar (not a Python int) — the `pl.when(me == root)`
+    branch must resolve dynamically."""
+    def kernel(x_ref, root_ref, o_ref, local_sem, send_sem, recv_sem):
+        r = root_ref[0]
+        dl.entry_barrier("tp", WORLD)
+        dl.emit_broadcast("tp", WORLD, r, x_ref, o_ref, local_sem,
+                          send_sem, recv_sem)
+
+    x = jax.random.normal(jax.random.key(10), (WORLD * 8, 128))
+    out = _run(kernel, tp8_mesh, x,
+               extra_inputs=(jnp.array([root], jnp.int32),),
+               scratch=[pltpu.SemaphoreType.DMA(())] * 3)
+    expect = np.broadcast_to(
+        np.asarray(x).reshape(WORLD, 8, 128)[root], (WORLD, 8, 128))
+    assert_allclose(out, expect.reshape(WORLD * 8, 128), atol=0, rtol=0,
+                    name="broadcast")
+
+
+def test_fcollect_push_allgather(tp8_mesh):
+    """Row `fcollect`: emit_push_allgather from inside a kernel is the
+    in-kernel allgather (one-shot push)."""
+    from triton_distributed_tpu.kernels.allgather import (
+        emit_push_allgather)
+
+    def kernel(x_ref, o_ref, local_sem, send_sem, recv_sems):
+        emit_push_allgather("tp", WORLD, x_ref, o_ref, local_sem,
+                            send_sem, recv_sems)
+
+    x = jax.random.normal(jax.random.key(11), (WORLD * 8, 128))
+    out = _run(kernel, tp8_mesh, x,
+               out_shape=(jax.ShapeDtypeStruct((WORLD, 8, 128),
+                                               jnp.float32),),
+               scratch=[pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA((WORLD,))])
+    out = np.asarray(out).reshape(WORLD, WORLD, 8, 128)
+    for d in range(WORLD):
+        assert_allclose(out[d].reshape(WORLD * 8, 128), x, atol=0,
+                        rtol=0, name=f"fcollect dev{d}")
+
+
+def test_packed_multi_tensor_put(tp8_mesh):
+    """LL-protocol row: TPU needs no flag-in-data because DMA delivery
+    signals the semaphore — but the PACKING trick (multiple tensors in
+    one put, one flag for all) is still useful and must round-trip."""
+    def kernel(a_ref, b_ref, o_ref, pack_ref, local_sem, send_sem,
+               recv_sem):
+        my = dl.rank("tp")
+        right = jax.lax.rem(my + 1, WORLD)
+        dl.entry_barrier("tp", WORLD)
+        # Pack a and b into one buffer, one put, one delivery signal.
+        dl.local_copy(a_ref, pack_ref.at[0], local_sem)
+        dl.local_copy(b_ref, pack_ref.at[1], local_sem)
+        dl.put(pack_ref, o_ref, send_sem, recv_sem,
+               dl.peer_id("tp", right))
+        dl.wait_recv(o_ref, recv_sem)
+
+    m, n = 8, 128
+    a = jax.random.normal(jax.random.key(12), (WORLD * m, n))
+    b = jax.random.normal(jax.random.key(13), (WORLD * m, n))
+
+    def op(a_s, b_s):
+        return pl.pallas_call(
+            kernel,
+            out_shape=(jax.ShapeDtypeStruct((2, m, n), jnp.float32),
+                       jax.ShapeDtypeStruct((2, m, n), jnp.float32)),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 2,
+            scratch_shapes=[pltpu.SemaphoreType.DMA(())] * 3,
+            compiler_params=comm_compiler_params(cids.ALLGATHER, WORLD),
+            interpret=default_interpret(None),
+        )(a_s, b_s)
+
+    fn = shard_map_op(op, tp8_mesh,
+                      in_specs=(P("tp", None), P("tp", None)),
+                      out_specs=(P("tp", None, None),) * 2)
+    out = jax.jit(fn)(a, b)[0]       # (WORLD*2, m, n)
+    out = np.asarray(out).reshape(WORLD, 2, m, n)
+    ar = np.roll(np.asarray(a).reshape(WORLD, m, n), 1, axis=0)
+    br = np.roll(np.asarray(b).reshape(WORLD, m, n), 1, axis=0)
+    assert_allclose(out[:, 0], ar, atol=0, rtol=0, name="packed a")
+    assert_allclose(out[:, 1], br, atol=0, rtol=0, name="packed b")
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection rows
+# ---------------------------------------------------------------------------
+
+def test_maybe_straggle_none_is_noop(tp8_mesh):
+    def kernel(x_ref, o_ref, sem):
+        dl.maybe_straggle("tp", None)
+        dl.local_copy(x_ref, o_ref, sem)
+
+    x = jax.random.normal(jax.random.key(14), (WORLD * 8, 128))
+    out = _run(kernel, tp8_mesh, x,
+               scratch=[pltpu.SemaphoreType.DMA(())])
+    assert_allclose(out, x, atol=0, rtol=0, name="no straggler")

@@ -319,6 +319,38 @@ def test_w8a8_matmul_hardware():
     assert np.array_equal(np.asarray(out), np.asarray(ref, dtype=np.float32))
 
 
+def test_strided_slab_dma_hardware():
+    """Mosaic acceptance of the torus kernels' phase-2 slab refs:
+    a DMA whose source is `ref.at[:, j, q]` — full leading slice,
+    DYNAMIC middle index, static trailing index — must compile and
+    copy correctly (kernels/torus.py `_quarter_slab_ref`).  Local DMA
+    exercises the same descriptor generation as the remote one."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    wx, wy, nq, mq, n = 2, 4, 4, 8, 128
+
+    def kernel(j_ref, x_ref, o_ref, sem):
+        j = j_ref[0]
+        for q in range(nq):
+            cp = pltpu.make_async_copy(
+                x_ref.at[:, j, q], o_ref.at[:, 0, q], sem)
+            cp.start()
+            cp.wait()
+
+    x = jax.random.normal(jax.random.key(7), (wx, wy, nq, mq, n),
+                          jnp.float32)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((wx, 1, nq, mq, n), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+    )(jnp.array([2], jnp.int32), x)
+    assert np.array_equal(np.asarray(out[:, 0]), np.asarray(x[:, 2]))
+
+
 @pytest.mark.parametrize("m", [16, 48])
 def test_w8a8_ragged_small_m_hardware(m):
     """Ragged / sub-32-row int8 shapes (the fused ring's per-rank

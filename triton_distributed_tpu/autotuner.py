@@ -76,7 +76,14 @@ class ContextualAutotuner:
         # Include the tuned function's identity: two tuners for
         # different ops sharing one cache_path (same arg shapes, same
         # candidate reprs) must not reuse each other's winners.
-        fn_id = getattr(self.fn, "__qualname__", None) or repr(self.fn)
+        # Module-qualified (bare __qualname__ like "main.<locals>.op"
+        # collides across scripts), with a STABLE fallback for
+        # partials/callables — repr() would embed a memory address and
+        # the key would never hit across processes.
+        mod = getattr(self.fn, "__module__", None)
+        qual = getattr(self.fn, "__qualname__", None)
+        fn_id = (f"{mod}.{qual}" if mod and qual
+                 else type(self.fn).__name__)
         return f"{d.device_kind}/w{jax.device_count()}/{fn_id}"
 
     def _load_disk(self) -> dict:

@@ -249,14 +249,19 @@ def ag_gemm(a_shard, b, ctx, return_gathered: bool = False):
     Mosaic sublane multiple inside the op and sliced back out — decode
     shapes (m = 1..8) run the Pallas "ll" path, not an XLA fallback.
 
-    ``ctx`` may be an `AllGatherGEMMContext` (single axis) or a
+    ``ctx`` may be an `AllGatherGEMMContext` (single axis), a
     `HierarchicalContext` (two-level dcn × ici — the reference's
-    internode AG-GEMM, `allgather_gemm.py:430-481`).
+    internode AG-GEMM, `allgather_gemm.py:430-481`), or a
+    `TorusContext` (both ICI torus axes at once, `kernels/torus.py`).
     """
     from triton_distributed_tpu.kernels.hierarchical import (
         HierarchicalContext)
+    from triton_distributed_tpu.kernels.torus import (
+        TorusContext, ag_gemm_torus)
     if isinstance(ctx, HierarchicalContext):
         return _ag_gemm_2d(a_shard, b, ctx, return_gathered)
+    if isinstance(ctx, TorusContext):
+        return ag_gemm_torus(a_shard, b, ctx, return_gathered)
 
     world = ctx.world_size
     m, k = a_shard.shape

@@ -52,27 +52,40 @@ class AllReduceMethod(enum.Enum):
     ONE_SHOT = "one_shot"
     TWO_SHOT = "two_shot"
     RING = "ring"
+    CHAIN = "chain"
     XLA = "xla"
 
 
-def get_auto_allreduce_method(nbytes: int, world_size: int) -> AllReduceMethod:
+def get_auto_allreduce_method(nbytes: int, world_size: int,
+                              closed_ring: bool = None) -> AllReduceMethod:
     """Perf-model-driven selection (reference
     `get_auto_allreduce_method`, `allreduce.py:1039`): compare the
     predicted cost of each method on this chip generation's ICI —
     tiny payloads are latency-bound → one-shot (1 hop), medium →
-    two-shot (scatter + broadcast), large → bandwidth-optimal ring."""
+    two-shot (scatter + broadcast), large → bandwidth-optimal ring.
+    On OPEN topologies (no wraparound — `rings_closed()` False) the
+    ring's wrap hop routes through every link (~2× busiest-link load);
+    the CHAIN method needs no wrap, filling the slot the reference's
+    double-tree fills (`allreduce.py:418`)."""
     from triton_distributed_tpu.kernels.comm_perf_model import (
-        estimate_all_reduce_time_us, estimate_one_shot_time_us,
-        estimate_two_shot_time_us)
+        estimate_all_reduce_time_us, estimate_chain_allreduce_time_us,
+        estimate_one_shot_time_us, estimate_two_shot_time_us,
+        rings_closed)
     w = world_size
-    t_one = estimate_one_shot_time_us(nbytes, w)
+    closed = rings_closed() if closed_ring is None else closed_ring
+    t_one = estimate_one_shot_time_us(nbytes, w, closed_ring=closed)
     t_two = estimate_two_shot_time_us(nbytes, w)
-    t_ring = estimate_all_reduce_time_us(nbytes, w)
-    best = min((t_one, AllReduceMethod.ONE_SHOT),
-               (t_two, AllReduceMethod.TWO_SHOT),
-               (t_ring, AllReduceMethod.RING),
-               key=lambda p: p[0])
-    return best[1]
+    t_ring = estimate_all_reduce_time_us(nbytes, w, closed_ring=closed)
+    candidates = [(t_one, AllReduceMethod.ONE_SHOT),
+                  (t_two, AllReduceMethod.TWO_SHOT),
+                  (t_ring, AllReduceMethod.RING)]
+    if not closed:
+        # Wrap-free chain fills the open-topology slot the reference's
+        # double-tree fills; on closed rings the hardware-validated
+        # ring stays the bandwidth choice.
+        candidates.append((estimate_chain_allreduce_time_us(nbytes, w),
+                           AllReduceMethod.CHAIN))
+    return min(candidates, key=lambda p: p[0])[1]
 
 
 @dataclasses.dataclass
@@ -165,6 +178,77 @@ def _two_shot_kernel(ctx, mc, n, x_ref, o_ref, rbuf_ref, local_sem,
         dl.wait_send(o_ref.at[my], bcast_send_sem)
 
 
+def _chain_kernel(ctx, P, mc, n, x_ref, o_ref, staging_ref,
+                  local_sem, send_sem, red_sems, bcast_sems):
+    """Pipelined line AllReduce (no wrap hop — the open-topology
+    method; reference slot: double-tree, `allreduce.py:418`).
+
+    Reduce: running partial sums stream chunk-by-chunk toward rank 0
+    on the leftward links; broadcast: the reduced chunks stream back
+    on the rightward links.  The two phases ride OPPOSITE link
+    directions, so once the pipe fills they overlap fully; per
+    directed link ~nbytes total, independent of world size.
+    """
+    world = ctx.world_size
+    my = jax.lax.axis_index(ctx.axis)
+    dl.maybe_straggle(ctx.axis, ctx.straggler)
+    # Neighbors DMA into our staging (right) and o_ref (left).
+    dl.entry_barrier(ctx.axis, world, neighbors_only=True)
+
+    def add_into(dst, a_ref, b_ref):
+        from triton_distributed_tpu.kernels.reduce_scatter import (
+            emit_add_into)
+        emit_add_into(dst, a_ref, b_ref, (mc, n))
+
+    left = dl.peer_id(ctx.axis, jax.lax.max(my - 1, 0))
+    right = dl.peer_id(ctx.axis,
+                       jax.lax.min(my + 1, world - 1))
+
+    # ---- reduce phase: partials flow left --------------------------
+    for c in range(P):
+        @pl.when(my == world - 1)
+        def _(c=c):
+            dl.put(x_ref.at[c], staging_ref.at[c], send_sem,
+                   red_sems.at[c], left)
+
+        @pl.when(jnp.logical_and(my > 0, my < world - 1))
+        def _(c=c):
+            dl.wait_recv(staging_ref.at[c], red_sems.at[c])
+            add_into(staging_ref.at[c], staging_ref.at[c], x_ref.at[c])
+            dl.put(staging_ref.at[c], staging_ref.at[c], send_sem,
+                   red_sems.at[c], left)
+
+        @pl.when(my == 0)
+        def _(c=c):
+            dl.wait_recv(staging_ref.at[c], red_sems.at[c])
+            add_into(o_ref.at[c], staging_ref.at[c], x_ref.at[c])
+            # Broadcast starts immediately — rides the rightward links
+            # while later chunks are still reducing leftward.
+            dl.put(o_ref.at[c], o_ref.at[c], send_sem,
+                   bcast_sems.at[c], right)
+
+    # ---- broadcast phase: reduced chunks flow right ----------------
+    for c in range(P):
+        @pl.when(jnp.logical_and(my > 0, my < world - 1))
+        def _(c=c):
+            dl.wait_recv(o_ref.at[c], bcast_sems.at[c])
+            dl.put(o_ref.at[c], o_ref.at[c], send_sem,
+                   bcast_sems.at[c], right)
+
+        @pl.when(my == world - 1)
+        def _(c=c):
+            dl.wait_recv(o_ref.at[c], bcast_sems.at[c])
+
+
+def _chain_chunks(m: int) -> int:
+    """Pipeline depth: more chunks = earlier pipe fill, but each chunk
+    must still be a reasonable DMA."""
+    for p in (8, 4, 2):
+        if m % p == 0:
+            return p
+    return 1
+
+
 def all_reduce(x, ctx: AllReduceContext):
     """Sum `x` across `ctx.axis`; returns the full reduced array on
     every device.  Call inside shard_map.  x: (m, n)."""
@@ -210,6 +294,30 @@ def all_reduce(x, ctx: AllReduceContext):
 
     interpret = default_interpret(ctx.interpret)
     cparams = comm_compiler_params(ctx.collective_id, world)
+
+    if method == AllReduceMethod.CHAIN:
+        if world <= 1:
+            return x     # rank 0 would wait on a put that never comes
+        P = _chain_chunks(m)
+        mc = m // P
+        out, _ = pl.pallas_call(
+            functools.partial(_chain_kernel, ctx, P, mc, n),
+            out_shape=(
+                jax.ShapeDtypeStruct((P, mc, n), x.dtype),
+                jax.ShapeDtypeStruct((P, mc, n), x.dtype),  # staging
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 2,
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA((P,)),
+                pltpu.SemaphoreType.DMA((P,)),
+            ],
+            compiler_params=cparams,
+            interpret=interpret,
+        )(x.reshape(P, mc, n))
+        return out.reshape(m, n)
 
     # NOTE: HBM communication buffers are extra *outputs* (discarded),
     # not scratch — Mosaic only allows vmem/smem/semaphore scratch.

@@ -96,9 +96,28 @@ def estimate_reduce_scatter_time_us(nbytes_per_shard: int, world: int,
 
 
 def estimate_all_reduce_time_us(nbytes: int, world: int,
-                                spec: IciSpec = None) -> float:
+                                spec: IciSpec = None,
+                                closed_ring: bool = None) -> float:
     """ring AR = RS + AG over chunks of nbytes/world."""
-    return 2 * estimate_all_gather_time_us(nbytes // world, world, spec)
+    return 2 * estimate_all_gather_time_us(nbytes // world, world, spec,
+                                           closed_ring=closed_ring)
+
+
+def estimate_chain_allreduce_time_us(nbytes: int, world: int,
+                                     spec: IciSpec = None) -> float:
+    """Pipelined line (chain) AllReduce: partials flow toward rank 0
+    on one link direction while the broadcast streams back on the
+    other — per directed link ~nbytes once, NO wrap hop, so the open-
+    topology penalty never applies.  Latency: the first chunk crosses
+    the line twice (2(w-1) hops); bandwidth: reduce and broadcast ride
+    opposite directions and overlap, so ~nbytes/bw once the pipe
+    fills.  The TPU analogue of the reference's double-tree
+    (`kernels/nvidia/allreduce.py:418`) — latency-optimal at mid
+    sizes, open topologies, where one-shot's fan-out congests and the
+    ring pays the wrap."""
+    spec = spec or get_ici_spec()
+    bw = spec.link_gbps * 1e9
+    return (nbytes / bw * 1e6 + 2 * (world - 1) * spec.latency_us)
 
 
 def estimate_one_shot_time_us(nbytes: int, world: int,
@@ -125,6 +144,45 @@ def estimate_one_shot_time_us(nbytes: int, world: int,
     far = world / 2.0 if closed else float(world - 1)
     lat = max(1.0, far) * spec.latency_us
     return link_transits * nbytes / bw * 1e6 + lat
+
+
+def estimate_torus_ag_time_us(nbytes_per_shard: int, wx: int, wy: int,
+                              spec: IciSpec = None,
+                              closed_ring: bool = None) -> float:
+    """4-quarter 2-axis torus AG (`kernels/torus.py`): each directed
+    link carries one quarter's phase-1 chunks plus another quarter's
+    phase-2 slabs.  Per x-link traffic: (wx-1)(wy+1)·nbytes/4; per
+    y-link: (wy-1)(wx+1)·nbytes/4 — the busiest link decides.  For
+    wx = wy = w that is (w²-1)·nbytes/4, i.e. HALF a bidirectional
+    single-axis ring's load and a QUARTER of a unidirectional one."""
+    spec = spec or get_ici_spec()
+    closed = rings_closed() if closed_ring is None else closed_ring
+    bw = spec.link_gbps * 1e9
+    load = 1.0 if closed else 2.0
+    per_x = (wx - 1) * (wy + 1) * nbytes_per_shard / 4.0
+    per_y = (wy - 1) * (wx + 1) * nbytes_per_shard / 4.0
+    hops = (wx - 1) + (wy - 1)      # serialized phase-1 + phase-2 steps
+    return (load * max(per_x, per_y) / bw * 1e6
+            + hops * spec.latency_us)
+
+
+def torus_beats_single_axis(nbytes_per_shard: int, wx: int, wy: int,
+                            spec: IciSpec = None,
+                            margin: float = 0.7) -> bool:
+    """Crossover for the 2-axis torus schedule vs the best single-axis
+    method over the flattened world: the torus wins on bandwidth
+    (~2× a bidir ring) once payloads amortize its extra latency (two
+    serialized ring phases + 4-way chunk split).  ``margin`` is the
+    same hysteresis convention as `choose_ll_or_fused`: the torus
+    kernel's un-modeled fixed costs (two-axis entry barrier, 4×
+    strided-DMA issue) mean a marginal modeled win is not a real one,
+    so the simple path is kept unless the win is decisive."""
+    world = wx * wy
+    t_torus = estimate_torus_ag_time_us(nbytes_per_shard, wx, wy, spec)
+    t_1axis = min(
+        estimate_all_gather_time_us(nbytes_per_shard, world, spec),
+        estimate_one_shot_time_us(nbytes_per_shard, world, spec))
+    return t_torus < margin * t_1axis
 
 
 def estimate_two_shot_time_us(nbytes: int, world: int,

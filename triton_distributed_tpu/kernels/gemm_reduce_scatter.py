@@ -216,14 +216,19 @@ def gemm_rs(a, b, ctx):
     to the Mosaic sublane multiple inside the op and sliced back —
     decode shapes run the Pallas "ll" path, not an XLA fallback.
 
-    ``ctx`` may be a `GEMMReduceScatterContext` (single axis) or a
+    ``ctx`` may be a `GEMMReduceScatterContext` (single axis), a
     `HierarchicalContext` (two-level dcn × ici — the reference's 2D
-    GEMM-RS, `gemm_reduce_scatter.py:515-576`).
+    GEMM-RS, `gemm_reduce_scatter.py:515-576`), or a `TorusContext`
+    (both ICI torus axes at once, `kernels/torus.py`).
     """
     from triton_distributed_tpu.kernels.hierarchical import (
         HierarchicalContext)
+    from triton_distributed_tpu.kernels.torus import (
+        TorusContext, gemm_rs_torus)
     if isinstance(ctx, HierarchicalContext):
         return _gemm_rs_2d(a, b, ctx)
+    if isinstance(ctx, TorusContext):
+        return gemm_rs_torus(a, b, ctx)
 
     world = ctx.world_size
     mt, k = a.shape

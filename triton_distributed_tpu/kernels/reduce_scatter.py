@@ -106,6 +106,30 @@ def _emit_reduce_sum(src_ref, out_ref, *, world, m, n, block_m=256,
     pipeline(*[src_ref.at[w] for w in range(world)], out_ref)
 
 
+def emit_add_into(dst, a_ref, b_ref, shape):
+    """dst = a + b (f32 accumulate), pipelined through VMEM; handles
+    2D (rows, n) chunk refs and 3D (w, rows, n) slab refs.  Shared by
+    the ring/chain/torus reduce kernels — one place owns the blocking
+    and the cast dance.  ``dst`` may alias ``a_ref``."""
+    def inner(a_blk, b_blk, o_blk):
+        o_blk[:] = (a_blk[:].astype(jnp.float32)
+                    + b_blk[:].astype(jnp.float32)).astype(o_blk.dtype)
+
+    if len(shape) == 3:
+        w, rows, n = shape
+        bm = min(256, rows)
+        grid = (w, pl.cdiv(rows, bm))
+        spec = pl.BlockSpec((1, bm, n), lambda i, j: (i, j, 0))
+    else:
+        rows, n = shape
+        bm = min(256, rows)
+        grid = (pl.cdiv(rows, bm),)
+        spec = pl.BlockSpec((bm, n), lambda i: (i, 0))
+    pltpu.emit_pipeline(
+        inner, grid=grid, in_specs=[spec] * 2, out_specs=[spec],
+    )(a_ref, b_ref, dst)
+
+
 # ---------------------------------------------------------------------------
 # One-shot scatter + local reduce
 # ---------------------------------------------------------------------------

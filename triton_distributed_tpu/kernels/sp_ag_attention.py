@@ -446,11 +446,20 @@ def sp_ag_attention_2d(q, k_shard, v_shard, hctx, *,
                        block_q: int = 1024, block_k: int = 1024,
                        interpret: Optional[bool] = None):
     """Two-level SP attention (reference:
-    `sp_ag_attention_inter_node.py:115,504`): KV shards cross DCN once
-    (XLA all_gather between slices), then each slice's worth of KV is
-    attended with the fused intra-slice ring kernel; the per-slice
-    partials merge by lse.  Sequence layout: global rank
+    `sp_ag_attention_inter_node.py:115,504`): slice KV chunks STREAM
+    across DCN one slice at a time (a `ppermute` ring between
+    same-ICI-position devices, which XLA overlaps with the fused
+    intra-slice ring kernel attending the chunk already held); the
+    per-slice partials merge by lse, which is order-invariant, so
+    arrival order needs no re-sorting.  Sequence layout: global rank
     g = dcn * ici_size + ici owns rows [g*S_loc, (g+1)*S_loc).
+
+    Peak KV memory is BOUNDED INDEPENDENT OF dcn_size: 2 slice-shards
+    (held + in-flight) + the fused kernel's intra-slice gather buffer
+    (ici * S_loc) — the reference's inter-node path streams chunks for
+    the same reason (`sp_ag_attention_inter_node.py:115`).  A DCN-wide
+    `all_gather` here would instead grow per-device KV linearly with
+    the number of slices.
 
     ``hctx``: `kernels.hierarchical.HierarchicalContext`.
     """
@@ -459,21 +468,27 @@ def sp_ag_attention_2d(q, k_shard, v_shard, hctx, *,
     my_i = jax.lax.axis_index(hctx.ici_axis)
     s_loc = q.shape[2]
     q_off = (my_d * ici + my_i) * s_loc
+    perm = [(i, (i + 1) % dcn) for i in range(dcn)]
 
-    kd = jax.lax.all_gather(k_shard, hctx.dcn_axis, tiled=False)
-    vd = jax.lax.all_gather(v_shard, hctx.dcn_axis, tiled=False)
-
+    cur_k, cur_v = k_shard, v_shard
     out = lse = None
     for s in range(dcn):
+        # Start the DCN hop before the Pallas call so the scheduler
+        # overlaps the transfer with the fused ring + flash consumer.
+        nxt = (tuple(jax.lax.ppermute(t, hctx.dcn_axis, perm)
+                     for t in (cur_k, cur_v))
+               if s < dcn - 1 else (None, None))
+        src = jax.lax.rem(my_d - s + dcn, dcn)   # slice we now hold
         o_s, l_s = sp_ag_attention_fused(
-            q, kd[s], vd[s], hctx.ici_axis, scale=scale,
+            q, cur_k, cur_v, hctx.ici_axis, scale=scale,
             block_q=block_q, block_k=block_k,
-            q_offset=q_off, kv_base=s * ici * s_loc, return_lse=True,
+            q_offset=q_off, kv_base=src * ici * s_loc, return_lse=True,
             collective_id=hctx.collective_id, interpret=interpret)
         if out is None:
             out, lse = o_s.astype(jnp.float32), l_s
         else:
             out, lse = _merge(out, lse, o_s, l_s)
+        cur_k, cur_v = nxt
     return out.astype(q.dtype)
 
 

@@ -1,0 +1,629 @@
+"""Multi-axis torus collectives: drive BOTH torus dimensions at once.
+
+Reference: the NUMA-aware / multi-dimensional intra-node variants —
+2D ring AllGather (`python/triton_dist/kernels/nvidia/allgather.py:
+196-293`), low-latency push-2d/3d (`low_latency_allgather.py:345-400`).
+Those exploit NVLink topology hierarchy; the TPU analogue exploits the
+ICI torus: a v5e chip has 4 ICI links (x±, y±), but a single-axis ring
+only ever drives one axis — at most 2 of the 4 links.
+
+Design — the 4-quarter bucket schedule: split the local shard into 4
+row-quarters and run 4 CONCURRENT 2-phase rings, one per (axis-order,
+direction) combination:
+
+  q0: +x then +y        q1: -x then -y
+  q2: +y then +x        q3: -y then -x
+
+Phase 1 rings gather each quarter within its first axis (per-chunk
+sends); phase 2 rings forward whole first-axis slabs along the second
+axis.  At every step the four quarters' DMAs ride four DIFFERENT
+directed links (x+, x-, y+, y-), so the torus runs at ~2x the
+bandwidth of a bidirectional single-axis ring and ~4x a unidirectional
+one.  Per-(quarter, position) recv semaphores are the readiness flags,
+exactly like the 1D kernels in `allgather.py`.
+
+ReduceScatter reverses the schedule: phase 1 ring-reduces slabs along
+the SECOND axis (running partial sums with ack flow control, like
+`reduce_scatter._ring_rs_kernel`), phase 2 ring-reduces per-position
+chunks along the first axis.  The heavy slab traffic of phase 1 again
+spreads over all four links.
+
+Layout: global rank g = x_index * wy + y_index (x-major), matching
+``Mesh(devs.reshape(wx, wy), ("x", "y"))`` with ``P(("x", "y"))``.
+The gathered output (wx, wy, 4, mq, n) reshapes straight to
+(world * m, n) with each device block being its 4 quarters in order —
+no transpose, no extra HBM pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu import collective_ids as cids
+
+from triton_distributed_tpu.kernels.matmul import (
+    MatmulConfig,
+    emit_matmul,
+    round_up_rows,
+)
+from triton_distributed_tpu.kernels.reduce_scatter import (
+    emit_add_into as _add_into,
+)
+from triton_distributed_tpu.language import core as dl
+from triton_distributed_tpu.utils.platform import (
+    comm_compiler_params,
+    default_interpret,
+)
+
+
+@dataclasses.dataclass
+class TorusContext:
+    """Two concurrent mesh axes of one ICI torus (both Pallas-DMA
+    addressable — unlike `HierarchicalContext`, where the outer axis is
+    DCN and only XLA collectives can cross it)."""
+
+    axes: Tuple[str, str]          # (x_axis, y_axis)
+    sizes: Tuple[int, int]         # (wx, wy)
+    method: str = "auto"           # auto | torus | xla
+    collective_id: int = cids.ALLGATHER
+    interpret: Optional[bool] = None
+    #: MXU config for the fused torus GEMM ops (`ag_gemm` / `gemm_rs`
+    #: accept a TorusContext and consume quarters in arrival order).
+    gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
+
+    @property
+    def world_size(self) -> int:
+        return self.sizes[0] * self.sizes[1]
+
+    def resolve_method(self, nbytes_per_shard: int) -> str:
+        """Perf-model crossover: the 4-quarter torus schedule wins on
+        bandwidth (~2× a bidir single-axis ring) but pays two
+        serialized ring phases of latency; below the crossover fall
+        back to the XLA collective over both axes."""
+        if self.method != "auto":
+            return self.method
+        wx, wy = self.sizes
+        if min(wx, wy) == 1:
+            return "torus"   # degenerates to the single-axis auto path
+        from triton_distributed_tpu.kernels.comm_perf_model import (
+            torus_beats_single_axis)
+        return ("torus" if torus_beats_single_axis(
+            nbytes_per_shard, wx, wy) else "xla")
+
+
+def create_torus_context(axes, sizes, **kw) -> TorusContext:
+    return TorusContext(axes=tuple(axes), sizes=tuple(sizes), **kw)
+
+
+#: Quarter schedules: (first_axis_idx, first_dir, second_axis_idx,
+#: second_dir).  Axis idx 0 = x, 1 = y.  At any step the 4 quarters'
+#: sends use the 4 distinct directed links (x+, x-, y+, y-).
+_QUARTERS = (
+    (0, +1, 1, +1),   # q0: +x then +y
+    (0, -1, 1, -1),   # q1: -x then -y
+    (1, +1, 0, +1),   # q2: +y then +x
+    (1, -1, 0, -1),   # q3: -y then -x
+)
+
+
+def _neighbor(ctx: TorusContext, axis_idx: int, direction: int):
+    """peer_id of the ring neighbor `direction` along axes[axis_idx],
+    holding the other axis fixed."""
+    ax = ctx.axes[axis_idx]
+    w = ctx.sizes[axis_idx]
+    p = jax.lax.axis_index(ax)
+    tgt = jax.lax.rem(p + direction + w, w)
+    return dl.peer_id(ax, tgt)
+
+
+def _quarter_slab_ref(o_ref, axis_idx: int, pos, q: int):
+    """Phase-2 slab ref: all first-axis positions of quarter ``q`` at
+    second-... — for an x-first quarter the slab is o[:, pos, q]
+    (every x of one y row); for a y-first quarter o[pos, :, q]."""
+    if axis_idx == 0:          # first axis is x → slab indexed by y pos
+        return o_ref.at[:, pos, q]
+    return o_ref.at[pos, :, q]
+
+
+# ---------------------------------------------------------------------------
+# AllGather over a 2-axis torus
+# ---------------------------------------------------------------------------
+
+def _emit_torus_ag(ctx: TorusContext, x_ref, o_ref,
+                   local_sems, send_sems, p1_sems, p2_sems,
+                   consume_local=None, consume_chunk=None,
+                   consume_slab=None):
+    """The 4-quarter 2-phase torus AG schedule, with optional
+    arrival-order consumption hooks (the torus analogue of
+    `allgather_gemm._emit_ag_ring`'s consume-while-the-next-chunk-
+    flies pattern):
+
+    - ``consume_local()`` fires once the 4 local quarters are placed
+      (and step-0 sends started), overlapping the first chunk flights;
+    - ``consume_chunk(q, fa, cpos)`` fires when phase-1 chunk
+      ``cpos`` of quarter q has landed and the NEXT step's sends are
+      in flight;
+    - ``consume_slab(q, fa, spos)`` likewise for phase-2 slabs.
+
+    Every gathered row is announced to exactly one hook.
+    """
+    wx, wy = ctx.sizes
+    px = jax.lax.axis_index(ctx.axes[0])
+    py = jax.lax.axis_index(ctx.axes[1])
+    pos = (px, py)
+    w = (wx, wy)
+
+    # Both axis neighborhoods put into our o_ref: barrier with each.
+    dl.entry_barrier(ctx.axes[0], wx, neighbors_only=True)
+    dl.entry_barrier(ctx.axes[1], wy, neighbors_only=True)
+
+    # Place the 4 local quarters.
+    for q in range(4):
+        dl.local_copy(x_ref.at[q], o_ref.at[px, py, q], local_sems.at[q])
+
+    def chunk_ref(q, first_axis, cpos):
+        """Phase-1 chunk slot: position `cpos` along the quarter's
+        first axis, own position along the other."""
+        if first_axis == 0:
+            return o_ref.at[cpos, py, q]
+        return o_ref.at[px, cpos, q]
+
+    # ---- phase 1: per-quarter ring along the FIRST axis -------------
+    steps1 = max(wx, wy) - 1
+    arrived = []                     # chunks waited on, pending consume
+    for s in range(steps1):
+        started = []
+        for q, (fa, fd, sa, sd) in enumerate(_QUARTERS):
+            if s >= w[fa] - 1:
+                continue
+            p = pos[fa]
+            src = jax.lax.rem(p - s * fd + 2 * s * w[fa] + w[fa], w[fa])
+            pltpu.make_async_remote_copy(
+                src_ref=chunk_ref(q, fa, src),
+                dst_ref=chunk_ref(q, fa, src),
+                send_sem=send_sems.at[q],
+                recv_sem=p1_sems.at[q, src],
+                device_id=_neighbor(ctx, fa, fd),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            ).start()
+            exp = jax.lax.rem(p - (s + 1) * fd + 2 * (s + 1) * w[fa]
+                              + w[fa], w[fa])
+            started.append((q, fa, exp))
+        # MXU work on data already held overlaps the in-flight DMAs.
+        if s == 0:
+            if consume_local is not None:
+                consume_local()
+        elif consume_chunk is not None:
+            for q, fa, cpos in arrived:
+                consume_chunk(q, fa, cpos)
+        arrived = started
+        for q, fa, exp in started:
+            dl.wait_recv(chunk_ref(q, fa, exp), p1_sems.at[q, exp])
+            dl.wait_send(chunk_ref(q, fa, exp), send_sems.at[q])
+    if consume_chunk is not None:
+        for q, fa, cpos in arrived:
+            consume_chunk(q, fa, cpos)
+
+    # ---- phase 2: per-quarter ring of first-axis SLABS along the
+    # SECOND axis ------------------------------------------------------
+    steps2 = max(wx, wy) - 1
+    arrived = []
+    for s in range(steps2):
+        started = []
+        for q, (fa, fd, sa, sd) in enumerate(_QUARTERS):
+            if s >= w[sa] - 1:
+                continue
+            p = pos[sa]
+            src = jax.lax.rem(p - s * sd + 2 * s * w[sa] + w[sa], w[sa])
+            slab = _quarter_slab_ref(o_ref, fa, src, q)
+            pltpu.make_async_remote_copy(
+                src_ref=slab,
+                dst_ref=slab,
+                send_sem=send_sems.at[q],
+                recv_sem=p2_sems.at[q, src],
+                device_id=_neighbor(ctx, sa, sd),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            ).start()
+            exp = jax.lax.rem(p - (s + 1) * sd + 2 * (s + 1) * w[sa]
+                              + w[sa], w[sa])
+            started.append((q, fa, exp))
+        if s > 0 and consume_slab is not None:
+            for q, fa, spos in arrived:
+                consume_slab(q, fa, spos)
+        arrived = started
+        for q, fa, exp in started:
+            dl.wait_recv(_quarter_slab_ref(o_ref, fa, exp, q),
+                         p2_sems.at[q, exp])
+            dl.wait_send(_quarter_slab_ref(o_ref, fa, exp, q),
+                         send_sems.at[q])
+    if consume_slab is not None:
+        for q, fa, spos in arrived:
+            consume_slab(q, fa, spos)
+
+
+def _torus_ag_kernel(ctx: TorusContext, x_ref, o_ref,
+                     local_sems, send_sems, p1_sems, p2_sems):
+    _emit_torus_ag(ctx, x_ref, o_ref, local_sems, send_sems, p1_sems,
+                   p2_sems)
+
+
+def all_gather_torus(x, ctx: TorusContext):
+    """Gather row shards over BOTH torus axes concurrently.
+
+    Input (inside shard_map over both axes): this device's (m, n)
+    shard of a (world * m, n) array ordered x-major
+    (g = x_index * wy + y_index).  Output: the full array, replicated.
+    """
+    wx, wy = ctx.sizes
+    world = ctx.world_size
+    if world <= 1:
+        return x
+    if ctx.resolve_method(x.size * x.dtype.itemsize) == "xla":
+        return jax.lax.all_gather(x, ctx.axes, tiled=True)
+    if min(wx, wy) == 1:
+        # Degenerate torus: a single-axis ring is the right algorithm.
+        from triton_distributed_tpu.kernels.allgather import (
+            AllGatherContext, all_gather)
+        ax = ctx.axes[0] if wx > 1 else ctx.axes[1]
+        return all_gather(x, AllGatherContext(
+            axis=ax, world_size=world, collective_id=ctx.collective_id,
+            interpret=ctx.interpret))
+
+    m, n = x.shape
+    pad = (-m) % 4
+    xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+    mq = (m + pad) // 4
+    maxw = max(wx, wy)
+
+    out = pl.pallas_call(
+        functools.partial(_torus_ag_kernel, ctx),
+        out_shape=jax.ShapeDtypeStruct((wx, wy, 4, mq, n), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((4,)),        # local copies
+            pltpu.SemaphoreType.DMA((4,)),        # per-quarter send
+            pltpu.SemaphoreType.DMA((4, maxw)),   # phase-1 arrivals
+            pltpu.SemaphoreType.DMA((4, maxw)),   # phase-2 arrivals
+        ],
+        compiler_params=comm_compiler_params(ctx.collective_id, world),
+        interpret=default_interpret(ctx.interpret),
+    )(xp.reshape(4, mq, n))
+    out = out.reshape(world, 4 * mq, n)
+    if pad:
+        out = out[:, :m]
+    return out.reshape(world * m, n)
+
+
+# ---------------------------------------------------------------------------
+# ReduceScatter over a 2-axis torus
+# ---------------------------------------------------------------------------
+
+
+
+class _ReduceLane:
+    """One ring-reduce lane (running partial sums + 2-slot staging with
+    ack credit flow, the `reduce_scatter._ring_rs_kernel` pattern),
+    split into per-step start/finish halves so FOUR lanes — one per
+    directed torus link — can be interleaved step-by-step."""
+
+    def __init__(self, ctx, axis_idx, direction, take_chunk, out_ref,
+                 staging_slot, accum_slot, send_sem, recv_sems, ack_sem,
+                 chunk_shape):
+        self.wsz = ctx.sizes[axis_idx]
+        self.nsteps = self.wsz - 1
+        self.p = jax.lax.axis_index(ctx.axes[axis_idx])
+        self.fwd = _neighbor(ctx, axis_idx, direction)
+        self.bwd = _neighbor(ctx, axis_idx, -direction)
+        self.direction = direction
+        self.take_chunk = take_chunk
+        self.out_ref = out_ref
+        self.staging_slot = staging_slot    # slot -> ref
+        self.accum_slot = accum_slot        # slot -> ref
+        self.send_sem = send_sem
+        self.recv_sems = recv_sems          # (2,) per-slot arrivals
+        self.ack_sem = ack_sem
+        self.chunk_shape = chunk_shape
+
+    def start(self, s):
+        slot = s % 2
+        if s >= 2:
+            # The slot we are about to overwrite on the right neighbor
+            # must have been consumed there.
+            pltpu.semaphore_wait(self.ack_sem, 1)
+        send_chunk = jax.lax.rem(
+            self.p - (1 + s) * self.direction + (1 + s) * self.wsz,
+            self.wsz)
+        src = (self.take_chunk(send_chunk) if s == 0
+               else self.accum_slot(slot))
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=src,
+            dst_ref=self.staging_slot(slot),
+            send_sem=self.send_sem,
+            recv_sem=self.recv_sems.at[slot],
+            device_id=self.fwd,
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        rdma.start()
+        return rdma
+
+    def finish(self, s, rdma):
+        slot = s % 2
+        recv_chunk = jax.lax.rem(
+            self.p - (2 + s) * self.direction + (2 + s) * self.wsz,
+            self.wsz)
+        dl.wait_recv(self.staging_slot(slot), self.recv_sems.at[slot])
+        dst = (self.accum_slot((s + 1) % 2) if s < self.nsteps - 1
+               else self.out_ref)
+        _add_into(dst, self.staging_slot(slot),
+                  self.take_chunk(recv_chunk), self.chunk_shape)
+        pltpu.semaphore_signal(self.ack_sem, inc=1, device_id=self.bwd,
+                               device_id_type=pltpu.DeviceIdType.MESH)
+        rdma.wait_send()
+
+    def drain(self):
+        pltpu.semaphore_wait(self.ack_sem, min(2, self.nsteps))
+
+
+def _run_lanes(lanes):
+    """Interleave lanes step-by-step: all four sends of step s are in
+    flight (on four different directed links) before any finish."""
+    for s in range(max(l.nsteps for l in lanes)):
+        pending = [(l, l.start(s)) for l in lanes if s < l.nsteps]
+        for l, rdma in pending:
+            l.finish(s, rdma)
+    for l in lanes:
+        l.drain()
+
+
+def _torus_rs_kernel(ctx: TorusContext, mq, n,
+                     x_ref, out_ref, s1_ref, a1_ref, mid_ref,
+                     s2_ref, a2_ref,
+                     send_sems, p1_sems, p2_sems, ack_sems):
+    """x_ref: (wx, wy, 4, mq, n) partials; out_ref: (4, mq, n).
+
+    Per quarter q (reversing its AG schedule): phase 1 ring-reduces
+    SECOND-axis slabs (each slab = all first-axis positions of one
+    second-axis row), landing the fully-second-axis-reduced slab of our
+    own position in ``mid_ref[q]``; phase 2 ring-reduces its per-
+    first-axis-position chunks, landing our own chunk in ``out_ref[q]``.
+    The four quarters' lanes interleave so the heavy phase-1 slab
+    traffic rides all four directed links concurrently.
+    """
+    wx, wy = ctx.sizes
+    w = (wx, wy)
+
+    dl.entry_barrier(ctx.axes[0], wx)
+    dl.entry_barrier(ctx.axes[1], wy)
+
+    def take_slab(c, q, fa):
+        # All first-axis positions of second-axis position c.
+        return x_ref.at[:, c, q] if fa == 0 else x_ref.at[c, :, q]
+
+    lanes1 = []
+    for q, (fa, fd, sa, sd) in enumerate(_QUARTERS):
+        wf = w[fa]
+        lanes1.append(_ReduceLane(
+            ctx, sa, sd,
+            functools.partial(take_slab, q=q, fa=fa),
+            mid_ref.at[q, 0:wf],
+            lambda slot, q=q, wf=wf: s1_ref.at[q, slot, 0:wf],
+            lambda slot, q=q, wf=wf: a1_ref.at[q, slot, 0:wf],
+            send_sems.at[q], p1_sems.at[q], ack_sems.at[q],
+            chunk_shape=(wf, mq, n)))
+    _run_lanes(lanes1)
+
+    lanes2 = []
+    for q, (fa, fd, sa, sd) in enumerate(_QUARTERS):
+        lanes2.append(_ReduceLane(
+            ctx, fa, fd,
+            lambda c, q=q: mid_ref.at[q, c],
+            out_ref.at[q],
+            lambda slot, q=q: s2_ref.at[q, slot],
+            lambda slot, q=q: a2_ref.at[q, slot],
+            send_sems.at[q], p2_sems.at[q], ack_sems.at[4 + q],
+            chunk_shape=(mq, n)))
+    _run_lanes(lanes2)
+
+
+def reduce_scatter_torus(x, ctx: TorusContext):
+    """Reduce per-device partials of the full array over BOTH torus
+    axes concurrently and keep this device's chunk.
+
+    Input: (world * m, n) partials, x-major device order; output:
+    this device's reduced (m, n) chunk.
+    """
+    wx, wy = ctx.sizes
+    world = ctx.world_size
+    if world <= 1:
+        return x
+    mt0 = x.shape[0]
+    if ctx.resolve_method(mt0 // world * x.shape[1]
+                          * x.dtype.itemsize) == "xla":
+        return jax.lax.psum_scatter(
+            x.reshape(world, mt0 // world, -1), ctx.axes,
+            scatter_dimension=0, tiled=False)
+    if min(wx, wy) == 1:
+        from triton_distributed_tpu.kernels.reduce_scatter import (
+            ReduceScatterContext, reduce_scatter)
+        ax = ctx.axes[0] if wx > 1 else ctx.axes[1]
+        return reduce_scatter(x, ReduceScatterContext(
+            axis=ax, world_size=world, collective_id=ctx.collective_id,
+            interpret=ctx.interpret))
+
+    mt, n = x.shape
+    assert mt % world == 0, (x.shape, world)
+    m = mt // world
+    pad = (-m) % 4
+    if pad:
+        xr = x.reshape(world, m, n)
+        xr = jnp.pad(xr, ((0, 0), (0, pad), (0, 0)))
+    else:
+        xr = x.reshape(world, m, n)
+    mq = (m + pad) // 4
+    maxw = max(wx, wy)
+
+    out, *_ = pl.pallas_call(
+        functools.partial(_torus_rs_kernel, ctx, mq, n),
+        out_shape=(
+            jax.ShapeDtypeStruct((4, mq, n), x.dtype),
+            jax.ShapeDtypeStruct((4, 2, maxw, mq, n), x.dtype),   # s1
+            jax.ShapeDtypeStruct((4, 2, maxw, mq, n), x.dtype),   # a1
+            jax.ShapeDtypeStruct((4, maxw, mq, n), x.dtype),      # mid
+            jax.ShapeDtypeStruct((4, 2, mq, n), x.dtype),         # s2
+            jax.ShapeDtypeStruct((4, 2, mq, n), x.dtype),         # a2
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 6,
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((4,)),       # per-quarter send
+            pltpu.SemaphoreType.DMA((4, 2)),     # phase-1 staging slots
+            pltpu.SemaphoreType.DMA((4, 2)),     # phase-2 staging slots
+            pltpu.SemaphoreType.REGULAR((8,)),   # acks: [0:4] p1, [4:8] p2
+        ],
+        compiler_params=comm_compiler_params(ctx.collective_id, world),
+        interpret=default_interpret(ctx.interpret),
+    )(xr.reshape(wx, wy, 4, mq, n))
+    out = out.reshape(4 * mq, n)
+    return out[:m] if pad else out
+
+
+# ---------------------------------------------------------------------------
+# Fused torus AG-GEMM / GEMM-RS (both torus axes drive the overlap)
+# ---------------------------------------------------------------------------
+
+def _ag_gemm_torus_kernel(ctx: TorusContext, mq, n, k,
+                          x_ref, b_ref, g_ref, out_ref,
+                          local_sems, send_sems, p1_sems, p2_sems):
+    """Arrival-order consumer over the 4-quarter torus AG: every piece
+    (local quarters, phase-1 chunks, phase-2 slabs) is matmul'ed
+    against the resident B shard as soon as its semaphore fires, while
+    the next pieces ride all four ICI links — the 2-axis analogue of
+    `allgather_gemm._ag_gemm_fused_kernel`."""
+    wx, wy = ctx.sizes
+    w = (wx, wy)
+    px = jax.lax.axis_index(ctx.axes[0])
+    py = jax.lax.axis_index(ctx.axes[1])
+
+    def mm(i, j, q):
+        emit_matmul(g_ref.at[i, j, q], b_ref, out_ref.at[i, j, q],
+                    m=mq, n=n, k=k, config=ctx.gemm)
+
+    def consume_local():
+        for q in range(4):
+            mm(px, py, q)
+
+    def consume_chunk(q, fa, cpos):
+        if fa == 0:
+            mm(cpos, py, q)
+        else:
+            mm(px, cpos, q)
+
+    def consume_slab(q, fa, spos):
+        for i in range(w[fa]):
+            if fa == 0:
+                mm(i, spos, q)
+            else:
+                mm(spos, i, q)
+
+    _emit_torus_ag(ctx, x_ref, g_ref, local_sems, send_sems, p1_sems,
+                   p2_sems, consume_local=consume_local,
+                   consume_chunk=consume_chunk,
+                   consume_slab=consume_slab)
+
+
+def ag_gemm_torus(a_shard, b, ctx: TorusContext,
+                  return_gathered: bool = False):
+    """C = all_gather_torus(a) @ b with the gather and the GEMM fused
+    in one kernel: quarters are consumed in arrival order while later
+    quarters ride all four ICI links (reference: the consumer-side
+    swizzle of `allgather_gemm.py:211-216`, lifted to a 2D torus the
+    way `allgather.py:196-293` lifts the copy engine)."""
+    wx, wy = ctx.sizes
+    world = ctx.world_size
+    m, k = a_shard.shape
+    k2, n = b.shape
+    assert k == k2, (a_shard.shape, b.shape)
+
+    if world <= 1 or min(wx, wy) == 1:
+        # Degenerate torus: the single-axis fused ring is the right
+        # algorithm (and handles world == 1 itself).
+        from triton_distributed_tpu.kernels.allgather_gemm import (
+            AllGatherGEMMContext, ag_gemm)
+        ax = ctx.axes[0] if wx > 1 else ctx.axes[1]
+        return ag_gemm(a_shard, b, AllGatherGEMMContext(
+            axis=ax, world_size=world, gemm=ctx.gemm,
+            collective_id=ctx.collective_id, interpret=ctx.interpret),
+            return_gathered)
+
+    # Pad to 4 sublane-aligned quarters (sliced back below).
+    mq = round_up_rows(pl.cdiv(m, 4), a_shard.dtype)
+    m4 = 4 * mq
+    a_p = (a_shard if m4 == m
+           else jnp.pad(a_shard, ((0, m4 - m), (0, 0))))
+    maxw = max(wx, wy)
+
+    gathered, out = pl.pallas_call(
+        functools.partial(_ag_gemm_torus_kernel, ctx, mq, n, k),
+        out_shape=(
+            jax.ShapeDtypeStruct((wx, wy, 4, mq, k), a_shard.dtype),
+            jax.ShapeDtypeStruct((wx, wy, 4, mq, n), a_shard.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 2,
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((4,)),        # local copies
+            pltpu.SemaphoreType.DMA((4,)),        # per-quarter send
+            pltpu.SemaphoreType.DMA((4, maxw)),   # phase-1 arrivals
+            pltpu.SemaphoreType.DMA((4, maxw)),   # phase-2 arrivals
+        ],
+        compiler_params=comm_compiler_params(ctx.collective_id, world),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * world * m4 * n * k,
+            bytes_accessed=(world * m4 * k + k * n
+                            + world * m4 * n) * a_shard.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=default_interpret(ctx.interpret),
+    )(a_p.reshape(4, mq, k), b)
+
+    out = out.reshape(world, m4, n)
+    if m4 != m:
+        out = out[:, :m]
+    out = out.reshape(world * m, n)
+    if return_gathered:
+        g = gathered.reshape(world, m4, k)
+        if m4 != m:
+            g = g[:, :m]
+        return out, g.reshape(world * m, k)
+    return out
+
+
+def gemm_rs_torus(a, b, ctx: TorusContext):
+    """reduce_scatter_torus(a @ b): the partial GEMM (B streamed once)
+    composed with the 4-lane torus reduce-scatter.  XLA overlaps the
+    matmul's tail with the kernel's entry; the RS itself drives all
+    four ICI links."""
+    from triton_distributed_tpu.kernels.matmul import matmul
+
+    wx, wy = ctx.sizes
+    world = ctx.world_size
+    if world <= 1 or min(wx, wy) == 1:
+        from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
+            GEMMReduceScatterContext, gemm_rs)
+        ax = ctx.axes[0] if wx > 1 else ctx.axes[1]
+        return gemm_rs(a, b, GEMMReduceScatterContext(
+            axis=ax, world_size=world, gemm=ctx.gemm,
+            collective_id=ctx.collective_id, interpret=ctx.interpret))
+    partial = matmul(a, b, config=ctx.gemm, interpret=ctx.interpret)
+    return reduce_scatter_torus(partial, ctx)
