@@ -87,7 +87,8 @@ def ratio_vs_last(per_repeat):
             for sl in per_repeat[:-1]]
 
 
-def main():
+def _regime_prefill(mesh, world):
+    """Autotuned fused AG-GEMM at the reference's headline shape."""
     from triton_distributed_tpu.autotuner import ContextualAutotuner
     from triton_distributed_tpu.kernels.allgather_gemm import (
         AllGatherGEMMContext,
@@ -100,18 +101,12 @@ def main():
     )
     from triton_distributed_tpu.ops import shard_map_op
 
-    devices = jax.devices()
-    world = len(devices)
-    mesh = Mesh(np.array(devices), ("tp",))
     m_loc = M_TOTAL // world
     n_loc = N_TOTAL // world
-
     a = jax.random.normal(jax.random.key(0), (M_TOTAL, K)).astype(jnp.bfloat16)
     b = jax.random.normal(jax.random.key(1), (K, N_TOTAL)).astype(jnp.bfloat16)
-
     specs = dict(in_specs=(P("tp", None), P(None, "tp")),
                  out_specs=P(None, "tp"))
-
     jit_cache = {}
 
     def fused_for(config):
@@ -162,16 +157,96 @@ def main():
     ratios = ratio_vs_last(per_repeat)
     t_fused, ratio, best = max(
         zip(times[:-1], ratios, finalists), key=lambda p: p[1])
-
     flops = 2 * M_TOTAL * K * N_TOTAL
+    detail = (f"autotuned {best[1].block_m}x{best[1].block_n}x"
+              f"{best[1].block_k}, {flops / t_fused / 1e12:.1f} TFLOP/s")
+    return t_fused, ratio, detail
+
+
+def _regime_decode_ll(mesh, world, m=16):
+    """The serving hot path at decode rows: low-latency ag_gemm (one
+    Pallas kernel, B streamed once) vs the XLA composition."""
+    from triton_distributed_tpu.kernels.allgather_gemm import (
+        AllGatherGEMMContext,
+        ag_gemm,
+        ag_gemm_nonoverlap,
+    )
+    from triton_distributed_tpu.ops import shard_map_op
+
+    a = jax.random.normal(jax.random.key(2), (m, K)).astype(jnp.bfloat16)
+    b = jax.random.normal(jax.random.key(3), (K, N_TOTAL)).astype(jnp.bfloat16)
+    specs = dict(in_specs=(P("tp", None), P(None, "tp")),
+                 out_specs=P(None, "tp"))
+    ctx = AllGatherGEMMContext(axis="tp", world_size=world, method="ll")
+    ll = jax.jit(shard_map_op(
+        functools.partial(ag_gemm, ctx=ctx), mesh, **specs))
+    baseline = jax.jit(shard_map_op(
+        functools.partial(ag_gemm_nonoverlap, axis="tp"), mesh, **specs))
+    times, per_repeat = measure_pair([ll, baseline], a, b, K,
+                                     n1=40, n2=440)
+    ratio = ratio_vs_last(per_repeat)[0]
+    return times[0], ratio, f"M={m} ll path"
+
+
+def _regime_w8a8(mesh, world):
+    """Quantized inference (beyond-reference capability): int8 fused
+    AG-GEMM vs the bf16 XLA composition a user would otherwise run."""
+    from triton_distributed_tpu.kernels.allgather_gemm import (
+        AllGatherGEMMContext,
+        ag_gemm_nonoverlap,
+        ag_gemm_w8a8,
+    )
+    from triton_distributed_tpu.kernels.quantized import quantize_sym
+    from triton_distributed_tpu.ops import shard_map_op
+
+    a = jax.random.normal(jax.random.key(4), (M_TOTAL, K)).astype(jnp.bfloat16)
+    b = jax.random.normal(jax.random.key(5), (K, N_TOTAL)).astype(jnp.bfloat16)
+    b_q, b_s = quantize_sym(b, axis=0)
+    ctx = AllGatherGEMMContext(axis="tp", world_size=world)
+
+    q_op = jax.jit(shard_map_op(
+        lambda aa, bq, bs: ag_gemm_w8a8(aa, bq, bs, ctx), mesh,
+        in_specs=(P("tp", None), P(None, "tp"), P("tp")),
+        out_specs=P(None, "tp")))
+    baseline = jax.jit(shard_map_op(
+        functools.partial(ag_gemm_nonoverlap, axis="tp"), mesh,
+        in_specs=(P("tp", None), P(None, "tp")), out_specs=P(None, "tp")))
+
+    # The quantized weights ride as RUNTIME ARGUMENTS of the jitted
+    # q_op (the outer adapter is plain Python): a jitted closure over
+    # b_q would embed ~50 MB as compile-time constants.
+    times, per_repeat = measure_pair(
+        [lambda x, w: q_op(x, b_q, b_s), baseline], a, b, K)
+    ratio = ratio_vs_last(per_repeat)[0]
+    tops = 2 * M_TOTAL * K * N_TOTAL / times[0] / 1e12
+    return times[0], ratio, f"{tops:.0f} TOPS int8 vs bf16 XLA"
+
+
+def main():
+    devices = jax.devices()
+    world = len(devices)
+    mesh = Mesh(np.array(devices), ("tp",))
+
+    # Three regimes (VERDICT r2 #8): the headline is the MINIMUM
+    # vs_baseline across them, so a lucky draw in one regime can't
+    # carry the round.
+    regimes = {
+        "prefill_fused": _regime_prefill(mesh, world),
+        "decode_ll": _regime_decode_ll(mesh, world),
+        "w8a8": _regime_w8a8(mesh, world),
+    }
+    worst = min(regimes, key=lambda r: regimes[r][1])
+    t_worst, r_worst, _ = regimes[worst]
+    detail = "; ".join(f"{name}={r:.3f} ({d})"
+                       for name, (t, r, d) in regimes.items())
     print(json.dumps({
-        "metric": f"ag_gemm latency M={M_TOTAL} K={K} N={N_TOTAL} bf16 "
-                  f"({world} chip{'s' if world > 1 else ''}, autotuned "
-                  f"{best[1].block_m}x{best[1].block_n}x{best[1].block_k}); "
-                  f"{flops / t_fused / 1e12:.1f} TFLOP/s",
-        "value": round(t_fused * 1e6, 1),
+        "metric": f"min vs_baseline over regimes [{detail}] "
+                  f"(M={M_TOTAL} K={K} N={N_TOTAL}, "
+                  f"{world} chip{'s' if world > 1 else ''}); "
+                  f"worst={worst}",
+        "value": round(t_worst * 1e6, 1),
         "unit": "us",
-        "vs_baseline": round(ratio, 3),
+        "vs_baseline": round(r_worst, 3),
     }))
 
 

@@ -83,16 +83,24 @@ def main():
         for m in ("fused", "xla", "xla", "fused"):   # ABBA
             t1 = runners[m](args.g1)
             t2 = runners[m](args.g2)
-            slopes[m].append((t2 - t1) / (args.g2 - args.g1))
+            # A tunnel-fetch glitch can make t2 < t1; a non-positive
+            # slope is always measurement garbage — DISCARD the
+            # sample (clamping would leak an absurd sentinel into the
+            # paired ratios and the median).
+            sl = (t2 - t1) / (args.g2 - args.g1)
+            slopes[m].append(sl if sl > 0 else None)
 
-    results = {m: statistics.median(s) for m, s in slopes.items()}
+    results = {m: statistics.median([s for s in sl if s is not None])
+               for m, sl in slopes.items()}
     # Paired per-round ratios expose the noise band the medians hide:
     # at world=1 the two modes' decode graphs are equivalent (the only
     # HLO diff is two world-1 no-op all_gathers), so any deviation of
     # the ratio from 1.0 here bounds the harness noise, not a real
-    # fused overhead.
+    # fused overhead.  Pairs with a discarded sample drop out.
     pair_ratios = sorted(x / f for x, f in zip(slopes["xla"],
-                                               slopes["fused"]))
+                                               slopes["fused"])
+                         if x is not None and f is not None)
+    pair_ratios = pair_ratios or [float("nan")]
     for mode in ("fused", "xla"):
         per_step = results[mode]
         print(json.dumps({

@@ -80,19 +80,23 @@ def main():
         from jax.experimental.pallas.ops.tpu.paged_attention import (
             paged_attention)
 
-        # Largest power-of-2 page size <= 256 that tiles s (arbitrary
-        # --seqs values must not crash the whole sweep).
+        # Largest power-of-2 page size <= 256 that tiles s; when none
+        # fits, SKIP the paged baseline for this s (arbitrary --seqs
+        # values must not crash the whole sweep).
         page_size = next((p for p in (256, 128, 64, 32, 16)
                           if s % p == 0), None)
-        assert page_size is not None, (
-            f"--seqs {s} not divisible by any supported page size")
-        pages_per_seq = s // page_size
-        k_pages = kc.transpose(1, 0, 2, 3).reshape(
-            hkv, b * pages_per_seq, page_size, d)
-        v_pages = vc.transpose(1, 0, 2, 3).reshape(
-            hkv, b * pages_per_seq, page_size, d)
-        page_indices = jnp.arange(b * pages_per_seq, dtype=jnp.int32
-                                  ).reshape(b, pages_per_seq)
+        run_paged = page_size is not None
+        if run_paged:
+            pages_per_seq = s // page_size
+            k_pages = kc.transpose(1, 0, 2, 3).reshape(
+                hkv, b * pages_per_seq, page_size, d)
+            v_pages = vc.transpose(1, 0, 2, 3).reshape(
+                hkv, b * pages_per_seq, page_size, d)
+            page_indices = jnp.arange(b * pages_per_seq, dtype=jnp.int32
+                                      ).reshape(b, pages_per_seq)
+        else:
+            k_pages = v_pages = page_indices = jnp.zeros(
+                (1,), jnp.int32)      # placeholder args-tuple slots
         scale = d ** -0.5
 
         def paged(q_, kc_, vc_, kv_len_, k_q_, v_q_, ks_, vs_,
@@ -109,11 +113,15 @@ def main():
             return ((a[0] + out * jnp.bfloat16(1e-3)
                      ).astype(jnp.bfloat16),) + a[1:]
 
-        t_ours, t_int8, t_paged, t_base = measure_ops_scanned(
-            [ours, ours_int8, paged, base],
+        ops = [ours, ours_int8] + ([paged] if run_paged else []) + [base]
+        ts = measure_ops_scanned(
+            ops,
             (q, kc, vc, kv_len, k_q, v_q, ks, vs,
              k_pages, v_pages, page_indices), mix,
             repeats=args.repeats)
+        t_ours, t_int8 = ts[0], ts[1]
+        t_paged = ts[2] if run_paged else None
+        t_base = ts[-1]
         kv_bytes = 2 * b * hkv * s * d * kc.dtype.itemsize
         print(json.dumps({
             "bench": "flash_decode", "B": b, "H": h, "Hkv": hkv,
@@ -122,7 +130,8 @@ def main():
             "kv_gbps": round(kv_bytes / t_ours / 1e9, 1),
             "int8_us": round(t_int8 * 1e6, 1),
             "int8_speedup": round(t_ours / t_int8, 3),
-            "vs_paged": round(t_paged / t_ours, 3),
+            "vs_paged": (round(t_paged / t_ours, 3) if run_paged
+                         else None),
             "vs_baseline": round(t_base / t_ours, 3),
         }), flush=True)
 

@@ -2,14 +2,13 @@
 # Full benchmark sweep (reference: benchmark/bench_allgather_gemm.py).
 # Each script emits JSON lines; meaningful comm numbers need >1 chip.
 # Run scripts individually for per-bench flags (--ms/--caps/--repeats).
+#
+# Every script's JSON lines are also captured under benchmark/results/
+# so hardware-measured claims are diffable in-repo (VERDICT r2 #8).
 set -euo pipefail
 cd "$(dirname "$0")/.."
-python benchmark/bench_ag_gemm.py
-python benchmark/bench_gemm_rs.py
-python benchmark/bench_allreduce.py
-python benchmark/bench_all_to_all.py
-python benchmark/bench_attention.py
-python benchmark/bench_flash_decode.py
-python benchmark/bench_grouped_gemm.py
-python benchmark/bench_e2e_decode.py
-python benchmark/bench_int8_gemm.py
+mkdir -p benchmark/results
+for b in ag_gemm gemm_rs allreduce all_to_all attention flash_decode \
+         grouped_gemm e2e_decode int8_gemm; do
+  python "benchmark/bench_${b}.py" "$@" | tee "benchmark/results/${b}.json"
+done

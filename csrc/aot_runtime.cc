@@ -132,6 +132,29 @@ const tdt_sig* tdt_bundle_arg_sig(const tdt_bundle* b, const char* variant,
   return &v->args[i];
 }
 
+const char* tdt_bundle_select_variant(const tdt_bundle* b, int nargs,
+                                      const tdt_sig* sigs) {
+  // Runtime variant selection by call-site signature (the role of the
+  // reference's per-signature generated dispatchers,
+  // compile_aot.py:61-183): first variant whose declared argument
+  // signatures match exactly wins.  Bundles for a kernel family (e.g.
+  // flash_decode over several S) declare one variant per tuned shape.
+  if (!b || (nargs > 0 && !sigs)) return nullptr;
+  for (const auto& v : b->variants) {
+    if (static_cast<int>(v.args.size()) != nargs) continue;
+    bool ok = true;
+    for (int i = 0; ok && i < nargs; ++i) {
+      const tdt_sig& a = v.args[i];
+      const tdt_sig& s = sigs[i];
+      if (a.dtype != s.dtype || a.rank != s.rank) ok = false;
+      for (int r = 0; ok && r < a.rank; ++r)
+        if (a.dims[r] != s.dims[r]) ok = false;
+    }
+    if (ok) return v.name.c_str();
+  }
+  return nullptr;
+}
+
 const tdt_sig* tdt_bundle_out_sig(const tdt_bundle* b, const char* variant,
                                   int i) {
   const TdtVariant* v = tdt_find_variant(b, variant);

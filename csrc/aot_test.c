@@ -5,6 +5,11 @@
  * outputs — no Python anywhere in the process.
  *
  * Usage: aot_test <bundle_dir> <variant> <plugin.so>
+ * With <variant> == "auto", the variant is SELECTED AT RUNTIME from
+ * the call-site signature in <bundle>/test_sigs.txt (one line per
+ * argument: "<dtype> <rank> <d0> <d1> ...") via
+ * tdt_bundle_select_variant — the deployment dispatch path for
+ * kernel-family bundles (several tuned shapes of flash_decode etc.).
  * Client-create options come from TDT_PJRT_OPTIONS, a
  * "key=value;key=value" string (values parsed as int64 when they look
  * like integers — matching how JAX passes plugin options).
@@ -96,6 +101,52 @@ int main(int argc, char **argv) {
     fprintf(stderr, "bundle_open: %s\n", tdt_status_str(rc));
     return 1;
   }
+
+  if (strcmp(variant, "auto") == 0) {
+    /* Runtime shape-keyed dispatch: parse the call-site signature and
+     * let the bundle pick the matching tuned variant. */
+    char path0[1024];
+    snprintf(path0, sizeof(path0), "%s/test_sigs.txt", bundle_dir);
+    FILE *f = fopen(path0, "r");
+    if (!f) {
+      fprintf(stderr, "auto: cannot open %s\n", path0);
+      return 1;
+    }
+    tdt_sig sigs[MAX_IO];
+    int nsigs = 0;
+    while (nsigs < MAX_IO) {
+      int dt = 0, rank = 0;
+      if (fscanf(f, "%d %d", &dt, &rank) != 2) break;
+      if (rank < 0 || rank > TDT_MAX_RANK) {
+        fclose(f);
+        fprintf(stderr, "auto: sig %d rank %d out of range\n", nsigs,
+                rank);
+        return 1;
+      }
+      sigs[nsigs].dtype = (uint8_t)dt;
+      sigs[nsigs].rank = (uint8_t)rank;
+      memset(sigs[nsigs].dims, 0, sizeof(sigs[nsigs].dims));
+      for (int r = 0; r < rank; r++) {
+        long long d = 0;
+        if (fscanf(f, "%lld", &d) != 1) {
+          fclose(f);
+          fprintf(stderr, "auto: bad sig line %d\n", nsigs);
+          return 1;
+        }
+        sigs[nsigs].dims[r] = d;
+      }
+      nsigs++;
+    }
+    fclose(f);
+    variant = tdt_bundle_select_variant(bundle, nsigs, sigs);
+    if (!variant) {
+      fprintf(stderr, "auto: no variant matches the %d-arg signature\n",
+              nsigs);
+      return 1;
+    }
+    printf("SELECTED %s\n", variant);
+  }
+
   int nargs = 0, nouts = 0;
   if (tdt_bundle_variant_arity(bundle, variant, &nargs, &nouts) != 0 ||
       nargs > MAX_IO || nouts > MAX_IO) {

@@ -70,6 +70,13 @@ const tdt_sig* tdt_bundle_arg_sig(const tdt_bundle* b, const char* variant,
 const tdt_sig* tdt_bundle_out_sig(const tdt_bundle* b, const char* variant,
                                   int i);
 
+/* Runtime variant selection: return the name of the first variant
+ * whose argument signatures match (dtype, rank, dims) exactly, or
+ * NULL.  The C-side analogue of shape-keyed kernel dispatch for
+ * bundles that declare one variant per tuned shape. */
+const char* tdt_bundle_select_variant(const tdt_bundle* b, int nargs,
+                                      const tdt_sig* sigs);
+
 /* Load one variant's serialized jax.export payload into memory. */
 tdt_status tdt_bundle_load_variant(tdt_bundle* b, const char* variant,
                                    tdt_executable** out);

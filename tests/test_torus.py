@@ -113,11 +113,14 @@ def test_torus_auto_crossover():
     assert t_torus < 0.35 * t_ring, (t_torus, t_ring)
 
 
-def test_xla_fallback_matches(dcn2_ici4_mesh=None, devices=None):
-    """method='xla' path returns the same result as the torus path."""
-    if devices is None:
-        devices = jax.devices()
-    mesh = Mesh(np.array(devices).reshape(2, 4), ("x", "y"))
+def test_xla_fallback_matches(torus_mesh):
+    """method='xla' path returns the same result as the torus path —
+    for the collectives AND the fused GEMM ops (which must honor an
+    explicit method override)."""
+    from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm
+    from triton_distributed_tpu.kernels.gemm_reduce_scatter import gemm_rs
+
+    mesh = torus_mesh
     m, n = 8, 128
     x = jax.random.normal(jax.random.key(5), (WORLD * m, n), jnp.float32)
     fn = shard_map_op(
@@ -133,6 +136,27 @@ def test_xla_fallback_matches(dcn2_ici4_mesh=None, devices=None):
         out_specs=P(("x", "y"), None))
     assert_allclose(jax.jit(fn2)(xr), xr.sum(axis=0), atol=1e-4,
                     rtol=1e-4, name="rs_xla2d")
+
+    k = 64
+    a = jax.random.normal(jax.random.key(7), (WORLD * m, k), jnp.float32)
+    b = jax.random.normal(jax.random.key(8), (k, WORLD * n), jnp.float32)
+    fn3 = shard_map_op(
+        lambda aa, bb: ag_gemm(aa, bb, _ctx(mesh, method="xla")),
+        mesh, in_specs=(P(("x", "y"), None), P(None, ("x", "y"))),
+        out_specs=P(None, ("x", "y")))
+    assert_allclose(jax.jit(fn3)(a, b), a @ b, atol=2e-3, rtol=2e-3,
+                    name="agg_xla2d")
+
+    a2 = jax.random.normal(jax.random.key(9), (WORLD * m, WORLD * 16),
+                           jnp.float32)
+    b2 = jax.random.normal(jax.random.key(10), (WORLD * 16, n),
+                           jnp.float32)
+    fn4 = shard_map_op(
+        lambda aa, bb: gemm_rs(aa, bb, _ctx(mesh, method="xla")),
+        mesh, in_specs=(P(None, ("x", "y")), P(("x", "y"), None)),
+        out_specs=P(("x", "y"), None))
+    assert_allclose(jax.jit(fn4)(a2, b2), a2 @ b2, atol=5e-3, rtol=5e-3,
+                    name="grs_xla2d")
 
 
 @pytest.mark.parametrize("m", [8, 6])   # 6: pad branch (mq rounds up)

@@ -29,6 +29,19 @@ def _plugin_path():
         return None
 
 
+def _client_env():
+    env = dict(os.environ)
+    env.setdefault("AXON_COMPAT_VERSION", "49")
+    env.setdefault("AXON_POOL_SVC_OVERRIDE", "127.0.0.1")
+    env.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    env["TDT_PJRT_OPTIONS"] = (
+        f"topology={gen}:1x1x1;session_id={uuid.uuid4()};"
+        "remote_compile=1;local_only=0;n_slices=1;priority=0;"
+        "rank=4294967295")
+    return env
+
+
 def test_native_aot_execute(tmp_path):
     plugin = _plugin_path()
     if plugin is None:
@@ -60,20 +73,62 @@ def test_native_aot_execute(tmp_path):
     (a @ b).astype(np.float32).tofile(
         os.path.join(out_dir, "test_out0.bin"))
 
-    env = dict(os.environ)
     # The C process runs no sitecustomize: supply the plugin options
     # and relay env that axon's register() would have set.
-    env.setdefault("AXON_COMPAT_VERSION", "49")
-    env.setdefault("AXON_POOL_SVC_OVERRIDE", "127.0.0.1")
-    env.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-    env["TDT_PJRT_OPTIONS"] = (
-        f"topology={gen}:1x1x1;session_id={uuid.uuid4()};"
-        "remote_compile=1;local_only=0;n_slices=1;priority=0;"
-        "rank=4294967295")
-
     res = subprocess.run([AOT_TEST, out_dir, "m256", plugin],
-                         env=env, capture_output=True, text=True,
-                         timeout=300)
+                         env=_client_env(), capture_output=True,
+                         text=True, timeout=300)
     assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "AOT_NATIVE_OK" in res.stdout, (res.stdout, res.stderr)
+
+
+def test_native_aot_decode_family_shape_select(tmp_path):
+    """Deployment dispatch for the decode family (VERDICT r2 #6): one
+    bundle, TWO flash_decode variants (different KV lengths); the C
+    executor selects the variant FROM THE CALL-SITE SHAPES
+    (tdt_bundle_select_variant), compiles its Pallas StableHLO and
+    executes it on the chip.  Reference:
+    `tools/compile_aot.py:61-183` + `scripts/aot_kernels.txt`."""
+    import jax.numpy as jnp
+
+    plugin = _plugin_path()
+    if plugin is None:
+        pytest.skip("no PJRT plugin .so available")
+
+    subprocess.run(["make", "-C", os.path.join(REPO, "csrc")],
+                   check=True, capture_output=True, timeout=300)
+
+    from triton_distributed_tpu.kernels.flash_decode import flash_decode
+    from triton_distributed_tpu.tools.aot_kernels import (
+        build_flash_decode_bundle, write_call_site_sigs)
+
+    b, h, hkv, d = 2, 8, 2, 128
+    seqs = (512, 1024)
+    out_dir = str(tmp_path / "decode_bundle")
+    build_flash_decode_bundle(out_dir, batch=b, heads=h, kv_heads=hkv,
+                              head_dim=d, seqs=seqs, dtype="bfloat16")
+
+    # Call site: the LONGER variant's shapes — selection must pick
+    # "s1024", not the first variant in the bundle.
+    s = 1024
+    q = (jax.random.normal(jax.random.key(0), (b, h, d)) / 4
+         ).astype(jnp.bfloat16)
+    kc = (jax.random.normal(jax.random.key(1), (b, hkv, s, d)) / 4
+          ).astype(jnp.bfloat16)
+    vc = (jax.random.normal(jax.random.key(2), (b, hkv, s, d)) / 4
+          ).astype(jnp.bfloat16)
+    kv_len = jnp.full((b,), s, jnp.int32)
+
+    args = [q, kc, vc, kv_len]
+    write_call_site_sigs(os.path.join(out_dir, "test_sigs.txt"), args)
+    for i, a in enumerate(args):
+        np.asarray(a).tofile(os.path.join(out_dir, f"test_arg{i}.bin"))
+    ref = flash_decode(q, kc, vc, kv_len)[0]
+    np.asarray(ref).tofile(os.path.join(out_dir, "test_out0.bin"))
+
+    res = subprocess.run([AOT_TEST, out_dir, "auto", plugin],
+                         env=_client_env(), capture_output=True,
+                         text=True, timeout=300)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "SELECTED s1024" in res.stdout, (res.stdout, res.stderr)
     assert "AOT_NATIVE_OK" in res.stdout, (res.stdout, res.stderr)

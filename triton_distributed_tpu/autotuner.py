@@ -78,12 +78,17 @@ class ContextualAutotuner:
         # candidate reprs) must not reuse each other's winners.
         # Module-qualified (bare __qualname__ like "main.<locals>.op"
         # collides across scripts), with a STABLE fallback for
-        # partials/callables — repr() would embed a memory address and
-        # the key would never hit across processes.
-        mod = getattr(self.fn, "__module__", None)
-        qual = getattr(self.fn, "__qualname__", None)
+        # callables — repr() would embed a memory address and the key
+        # would never hit across processes.  functools.partial has no
+        # __qualname__: unwrap to the underlying function so two
+        # partials of DIFFERENT ops don't collapse to one key.
+        fn = self.fn
+        while isinstance(fn, functools.partial):
+            fn = fn.func
+        mod = getattr(fn, "__module__", None)
+        qual = getattr(fn, "__qualname__", None)
         fn_id = (f"{mod}.{qual}" if mod and qual
-                 else type(self.fn).__name__)
+                 else type(fn).__name__)
         return f"{d.device_kind}/w{jax.device_count()}/{fn_id}"
 
     def _load_disk(self) -> dict:

@@ -179,7 +179,7 @@ def _two_shot_kernel(ctx, mc, n, x_ref, o_ref, rbuf_ref, local_sem,
 
 
 def _chain_kernel(ctx, P, mc, n, x_ref, o_ref, staging_ref,
-                  local_sem, send_sem, red_sems, bcast_sems):
+                  send_sem, red_sems, bcast_sems):
     """Pipelined line AllReduce (no wrap hop — the open-topology
     method; reference slot: double-tree, `allreduce.py:418`).
 
@@ -309,10 +309,9 @@ def all_reduce(x, ctx: AllReduceContext):
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 2,
             scratch_shapes=[
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA((P,)),
-                pltpu.SemaphoreType.DMA((P,)),
+                pltpu.SemaphoreType.DMA(()),      # send
+                pltpu.SemaphoreType.DMA((P,)),    # reduce arrivals
+                pltpu.SemaphoreType.DMA((P,)),    # broadcast arrivals
             ],
             compiler_params=cparams,
             interpret=interpret,

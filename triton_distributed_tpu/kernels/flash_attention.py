@@ -71,32 +71,39 @@ def _flash_kernel(nk: int, sk: int, causal: bool,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    def attend_block():
+    ragged = sk % block_k != 0
+
+    def attend_block(masked: bool):
         q = q_ref[0, 0]                   # (bq, D), pre-scaled
         k = k_ref[0, 0]                   # (bk, D)
         v = v_ref[0, 0]
-        if sk % block_k != 0:
+        if ragged:
             v = zero_oob_rows(v, ki, block_k, sk)
 
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # (bq, bk)
 
-        k_pos = (ki * block_k
-                 + jax.lax.broadcasted_iota(jnp.int32,
-                                            (block_q, block_k), 1))
-        if sk % block_k != 0:
-            # KV-length bound mask: the last block's padded columns
-            # must not reach the softmax (they'd contribute garbage
-            # whenever causal=False or kv_offset > 0 lets them
-            # through).
-            s = jnp.where(k_pos < sk, s, NEG_INF)
-        if causal:
-            q_pos = (qi * block_q
+        # Mask arithmetic (2 iotas + compares + selects over the full
+        # (bq, bk) tile) runs ONLY on blocks that need it — the
+        # diagonal and the ragged tail.  Interior blocks (the bulk of
+        # the triangular schedule) take the unmasked path.
+        if masked:
+            k_pos = (ki * block_k
                      + jax.lax.broadcasted_iota(jnp.int32,
-                                                (block_q, block_k), 0)
-                     + off_ref[0])
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+                                                (block_q, block_k), 1))
+            if ragged:
+                # KV-length bound mask: the last block's padded
+                # columns must not reach the softmax (they'd
+                # contribute garbage whenever causal=False or
+                # kv_offset > 0 lets them through).
+                s = jnp.where(k_pos < sk, s, NEG_INF)
+            if causal:
+                q_pos = (qi * block_q
+                         + jax.lax.broadcasted_iota(
+                             jnp.int32, (block_q, block_k), 0)
+                         + off_ref[0])
+                s = jnp.where(k_pos <= q_pos, s, NEG_INF)
 
         m_prev = m_scr[:]                 # (bq, 1), log2 domain
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -122,9 +129,21 @@ def _flash_kernel(nk: int, sk: int, causal: bool,
         # fully-masked rows must consume lse.
         visible = ki * block_k <= (qi * block_q + block_q - 1
                                    + off_ref[0])
-        pl.when(visible)(attend_block)
+        # Fully-visible blocks (last k column <= the block's FIRST
+        # query's limit) need no causal mask.
+        fully = (ki * block_k + block_k - 1
+                 <= qi * block_q + off_ref[0])
+        if ragged:
+            fully = jnp.logical_and(fully, ki != nk - 1)
+        pl.when(jnp.logical_and(visible, fully))(
+            lambda: attend_block(False))
+        pl.when(jnp.logical_and(visible, jnp.logical_not(fully)))(
+            lambda: attend_block(True))
+    elif ragged:
+        pl.when(ki != nk - 1)(lambda: attend_block(False))
+        pl.when(ki == nk - 1)(lambda: attend_block(True))
     else:
-        attend_block()
+        attend_block(False)
 
     @pl.when(ki == nk - 1)
     def _():
@@ -167,6 +186,19 @@ def flash_attention(q, k, v, *, causal: bool = True,
     # (bq, bk) block inside the kernel.
     q = (q * jnp.asarray(scale * LOG2E, jnp.float32)).astype(q.dtype)
 
+    def kv_index(bb, hh, qi, ki, off, g=group):
+        # Causal: blocks above the diagonal are skipped by pl.when in
+        # the kernel body — but the PIPELINE would still DMA their KV
+        # blocks (index maps run for every grid step).  Skipped steps
+        # instead PREFETCH block 0 — the first block of the NEXT query
+        # row — so the triangular schedule neither pays the skipped
+        # blocks' HBM traffic nor stalls on a cold fetch when the next
+        # row starts (the jax flash kernel's `next_kv_index` trick).
+        if causal:
+            visible = ki * bk <= qi * bq + bq - 1 + off[0]
+            ki = jax.lax.select(visible, ki, 0)
+        return (bb, hh // g, ki, 0)
+
     out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, nk, sk, causal, bq, bk),
         out_shape=(
@@ -180,13 +212,9 @@ def flash_attention(q, k, v, *, causal: bool = True,
                 pl.BlockSpec((1, 1, bq, d),
                              lambda bb, hh, qi, ki, *pre: (bb, hh, qi, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, 1, bk, d),
-                             lambda bb, hh, qi, ki, *pre, g=group:
-                                 (bb, hh // g, ki, 0),
+                pl.BlockSpec((1, 1, bk, d), kv_index,
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, 1, bk, d),
-                             lambda bb, hh, qi, ki, *pre, g=group:
-                                 (bb, hh // g, ki, 0),
+                pl.BlockSpec((1, 1, bk, d), kv_index,
                              memory_space=pltpu.VMEM),
             ],
             out_specs=(
@@ -204,6 +232,8 @@ def flash_attention(q, k, v, *, causal: bool = True,
             ],
         ),
         compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
             vmem_limit_bytes=VMEM_LIMIT,
         ),
         cost_estimate=pl.CostEstimate(

@@ -403,16 +403,14 @@ def _torus_rs_kernel(ctx: TorusContext, mq, n,
     dl.entry_barrier(ctx.axes[0], wx)
     dl.entry_barrier(ctx.axes[1], wy)
 
-    def take_slab(c, q, fa):
-        # All first-axis positions of second-axis position c.
-        return x_ref.at[:, c, q] if fa == 0 else x_ref.at[c, :, q]
-
     lanes1 = []
     for q, (fa, fd, sa, sd) in enumerate(_QUARTERS):
         wf = w[fa]
         lanes1.append(_ReduceLane(
             ctx, sa, sd,
-            functools.partial(take_slab, q=q, fa=fa),
+            # Local partials slab for second-axis position c (same
+            # addressing convention as the AG's phase-2 slabs).
+            lambda c, q=q, fa=fa: _quarter_slab_ref(x_ref, fa, c, q),
             mid_ref.at[q, 0:wf],
             lambda slot, q=q, wf=wf: s1_ref.at[q, slot, 0:wf],
             lambda slot, q=q, wf=wf: a1_ref.at[q, slot, 0:wf],
@@ -563,6 +561,15 @@ def ag_gemm_torus(a_shard, b, ctx: TorusContext,
             collective_id=ctx.collective_id, interpret=ctx.interpret),
             return_gathered)
 
+    # Honor ctx.method (explicit "xla", or the auto crossover on the
+    # gathered payload): below the crossover — or when the user forces
+    # the fallback — run the XLA composition.
+    if ctx.resolve_method(m * k * a_shard.dtype.itemsize) == "xla":
+        a_full = jax.lax.all_gather(a_shard, ctx.axes, tiled=True)
+        out = jnp.dot(a_full, b, preferred_element_type=jnp.float32
+                      ).astype(a_shard.dtype)
+        return (out, a_full) if return_gathered else out
+
     # Pad to 4 sublane-aligned quarters (sliced back below).
     mq = round_up_rows(pl.cdiv(m, 4), a_shard.dtype)
     m4 = 4 * mq
@@ -625,5 +632,12 @@ def gemm_rs_torus(a, b, ctx: TorusContext):
         return gemm_rs(a, b, GEMMReduceScatterContext(
             axis=ax, world_size=world, gemm=ctx.gemm,
             collective_id=ctx.collective_id, interpret=ctx.interpret))
+    mt, _ = a.shape
+    n = b.shape[1]
+    if ctx.resolve_method(mt // world * n * a.dtype.itemsize) == "xla":
+        partial = jnp.dot(a, b, preferred_element_type=jnp.float32)
+        return jax.lax.psum_scatter(
+            partial.reshape(world, mt // world, n), ctx.axes,
+            scatter_dimension=0, tiled=False).astype(a.dtype)
     partial = matmul(a, b, config=ctx.gemm, interpret=ctx.interpret)
     return reduce_scatter_torus(partial, ctx)

@@ -1,0 +1,81 @@
+"""Shipped AOT kernel-family bundles — the serving hot path, declared
+over shape spaces with runtime variant selection.
+
+Reference: `python/triton_dist/tools/compile_aot.py:61-183`
+(`aot_compile_spaces` declaring signature/grid spaces per kernel) +
+`scripts/aot_kernels.txt` (the list of kernels the deployment bundle
+ships).  Here each family is one bundle with one variant per tuned
+shape; the native executor picks the variant from the call-site
+signature via `tdt_bundle_select_variant` (csrc/aot_runtime.cc) — no
+Python in the serving loop.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from triton_distributed_tpu.tools.compile_aot import (
+    AotVariant,
+    compile_aot,
+)
+
+#: dtype-code table shared with the C runtime (tools/native.py).
+from triton_distributed_tpu.tools.native import _DTYPE_CODES
+
+
+def build_flash_decode_bundle(out_dir: str, *, batch: int = 8,
+                              heads: int = 32, kv_heads: int = 8,
+                              head_dim: int = 128,
+                              seqs: Sequence[int] = (1024, 4096, 16384),
+                              dtype: str = "bfloat16"):
+    """The decode family: one variant per KV length (the reference
+    AOT-compiles the flash-decode family over declared signature
+    spaces for exactly this serving use)."""
+    from triton_distributed_tpu.kernels.flash_decode import flash_decode
+
+    def decode_fn(q, kc, vc, kv_len):
+        return flash_decode(q, kc, vc, kv_len)[0]
+
+    variants = [
+        AotVariant(
+            f"s{s}",
+            [(batch, heads, head_dim),
+             (batch, kv_heads, s, head_dim),
+             (batch, kv_heads, s, head_dim),
+             (batch,)],
+            [dtype, dtype, dtype, "int32"])
+        for s in seqs
+    ]
+    return compile_aot(decode_fn, "flash_decode", variants, out_dir)
+
+
+def build_ll_gemm_bundle(out_dir: str, *, k: int = 7168, n: int = 7168,
+                         ms: Sequence[int] = (8, 16, 32),
+                         dtype: str = "bfloat16"):
+    """The ag_gemm low-latency projection path at decode sizes (one
+    variant per batch-rows M).  Exported single-device (the in-kernel
+    ring needs a pod; the serving dispatch story — shape-keyed variant
+    selection from C — is identical)."""
+    from triton_distributed_tpu.kernels.allgather_gemm import (
+        AllGatherGEMMContext, ag_gemm)
+
+    ctx = AllGatherGEMMContext(axis="tp", world_size=1, method="ll")
+
+    def ll_fn(a, b):
+        return ag_gemm(a, b, ctx)
+
+    variants = [
+        AotVariant(f"m{m}", [(m, k), (k, n)], [dtype, dtype])
+        for m in ms
+    ]
+    return compile_aot(ll_fn, "ag_gemm_ll", variants, out_dir)
+
+
+def write_call_site_sigs(path: str, arrays) -> None:
+    """Write the call-site signature file `tdt_bundle_select_variant`
+    consumers parse (one line per argument: dtype-code rank dims...)."""
+    with open(path, "w") as f:
+        for a in arrays:
+            code = _DTYPE_CODES[str(a.dtype)]
+            dims = " ".join(str(d) for d in a.shape)
+            f.write(f"{code} {len(a.shape)} {dims}\n")

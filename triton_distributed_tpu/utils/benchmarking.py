@@ -77,7 +77,8 @@ def measure_ops(fs: Sequence[Callable], args: tuple,
 def measure_ops_scanned(fs: Sequence[Callable], args: tuple,
                         mix: Callable, *, n_inner: int = 16,
                         n1: int = 4, repeats: int = 6,
-                        min_window_s: float = 0.5) -> list:
+                        min_window_s: float = 0.5,
+                        carry_args: int = 1) -> list:
     """Per-call latency for SUB-MILLISECOND ops.
 
     One-dispatch-per-call measurement (``measure_ops``) bottoms out at
@@ -90,21 +91,37 @@ def measure_ops_scanned(fs: Sequence[Callable], args: tuple,
     ``mix(args, out) -> new_args`` chains iteration i+1 on iteration
     i's output *inside* the scan (shapes must be preserved; it is
     traced, so no jit wrapper is needed).
+
+    Only the first ``carry_args`` arguments travel through the scan
+    CARRY; the rest enter the body as loop-invariant jit arguments.
+    Carrying invariants is not free: XLA shuffles the full carry every
+    iteration, and measured overhead was ~20% when a decode op's KV
+    cache plus baseline buffers (~0.8 GB) rode the carry.  (They must
+    still be jit ARGUMENTS, not Python closures — closure-captured
+    arrays embed as compile-time constants and blow the tunneled
+    remote-compile request size limit.)
     """
     import jax
 
     def scanned(f):
-        def body(a, _):
-            return mix(a, f(*a)), None
-
         def g(*a):
-            final, _ = jax.lax.scan(body, a, None, length=n_inner)
+            invariant = a[carry_args:]
+
+            def body(c, _):
+                full = c + invariant
+                return mix(full, f(*full))[:carry_args], None
+
+            final, _ = jax.lax.scan(body, a[:carry_args], None,
+                                    length=n_inner)
             return final
 
         return jax.jit(g)
 
     ts = measure_ops([scanned(f) for f in fs], args,
-                     lambda a, out: out, n1=n1, repeats=repeats,
+                     # g returns only the carry: reattach the
+                     # invariant args for the next chained dispatch.
+                     lambda a, out: tuple(out) + tuple(a[len(out):]),
+                     n1=n1, repeats=repeats,
                      min_window_s=min_window_s)
     return [t / n_inner for t in ts]
 
