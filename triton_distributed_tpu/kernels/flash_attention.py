@@ -250,6 +250,337 @@ def flash_attention(q, k, v, *, causal: bool = True,
     return out
 
 
+# ---------------------------------------------------------------------------
+# Backward (training): Pallas dq and dk/dv kernels + custom VJP
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_dq_kernel(nk: int, sk: int, causal: bool,
+                         block_q: int, block_k: int,
+                         off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, dq_ref, acc_scr):
+    """dq = sum_k (p ∘ (do @ v^T - delta)) @ k, accumulated over the
+    kv grid dim.  Grid (B, H, nq, nk); q arrives pre-scaled by
+    scale*log2(e) (so s is exp2-domain), and the final dq is rescaled
+    by the caller.  `lse` is natural-log; delta = rowsum(do * out).
+    """
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def attend_block(masked: bool):
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        if sk % block_k != 0:
+            v = zero_oob_rows(v, ki, block_k, sk)
+            k = zero_oob_rows(k, ki, block_k, sk)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # log2-domain
+        if masked:
+            k_pos = (ki * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 1))
+            if sk % block_k != 0:
+                s = jnp.where(k_pos < sk, s, NEG_INF)
+            if causal:
+                q_pos = (qi * block_q
+                         + jax.lax.broadcasted_iota(
+                             jnp.int32, (block_q, block_k), 0)
+                         + off_ref[0])
+                s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        # p = exp(s_nat - lse) = exp2(s - lse * log2e)
+        # Clamp at 0: s <= lse holds for every real row, so this is
+        # a no-op except on fully-masked rows (lse ~ -inf), where the
+        # unclamped exponent overflows to inf (their do is 0, so the
+        # clamped p=1 contributes nothing).
+        p = jnp.exp2(jnp.minimum(s - lse_ref[0, 0] * LOG2E, 0.0))
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, bk)
+        ds = p * (dp - delta_ref[0, 0])                # (bq, bk)
+        acc_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, D)
+
+    if causal:
+        visible = ki * block_k <= (qi * block_q + block_q - 1
+                                   + off_ref[0])
+        fully = (ki * block_k + block_k - 1
+                 <= qi * block_q + off_ref[0])
+        if sk % block_k != 0:
+            fully = jnp.logical_and(fully, ki != nk - 1)
+        pl.when(jnp.logical_and(visible, fully))(
+            lambda: attend_block(False))
+        pl.when(jnp.logical_and(visible, jnp.logical_not(fully)))(
+            lambda: attend_block(True))
+    elif sk % block_k != 0:
+        pl.when(ki != nk - 1)(lambda: attend_block(False))
+        pl.when(ki == nk - 1)(lambda: attend_block(True))
+    else:
+        attend_block(False)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0, 0] = acc_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(nq: int, sq: int, sk: int, causal: bool,
+                          block_q: int, block_k: int,
+                          off_ref, q_ref, k_ref, v_ref, do_ref,
+                          lse_ref, delta_ref, dk_ref, dv_ref,
+                          dk_scr, dv_scr):
+    """dk = sum_q (p ∘ (do @ v^T - delta))^T @ q_scaled (rescaled by
+    the caller), dv = sum_q p^T @ do — accumulated over the q grid
+    dim.  Grid (B, H, nk, nq): kv block resident, q blocks stream.
+    """
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def attend_block(masked: bool):
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        if sk % block_k != 0:
+            # OOB kv rows are uninitialized on hardware: p's masked
+            # columns are exactly 0, but dp = do @ v^T still computes
+            # 0 x garbage — NaN when the debris decodes as NaN/Inf.
+            v = zero_oob_rows(v, ki, block_k, sk)
+        if sq % block_q != 0:
+            # Ragged q tails: here q rows are the CONTRACTION dim of
+            # dk/dv, so garbage rows would pollute real outputs (in
+            # the dq kernel they only produce garbage rows that the
+            # out-of-bounds write drops).  Zero every q-row-indexed
+            # operand; p and ds are re-zeroed after the arithmetic
+            # because garbage lse/delta can turn 0-rows into NaN.
+            q = zero_oob_rows(q, qi, block_q, sq)
+            do = zero_oob_rows(do, qi, block_q, sq)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if masked:
+            k_pos = (ki * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 1))
+            if sk % block_k != 0:
+                s = jnp.where(k_pos < sk, s, NEG_INF)
+            if causal:
+                q_pos = (qi * block_q
+                         + jax.lax.broadcasted_iota(
+                             jnp.int32, (block_q, block_k), 0)
+                         + off_ref[0])
+                s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp2(jnp.minimum(s - lse_ref[0, 0] * LOG2E, 0.0))
+        if sq % block_q != 0:
+            p = zero_oob_rows(p, qi, block_q, sq)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do.astype(do_ref.dtype),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (bk, D)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0])
+        if sq % block_q != 0:
+            ds = zero_oob_rows(ds, qi, block_q, sq)
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (bk, D)
+
+    nk_last = pl.num_programs(2) - 1
+    if causal:
+        visible = ki * block_k <= (qi * block_q + block_q - 1
+                                   + off_ref[0])
+        fully = (ki * block_k + block_k - 1
+                 <= qi * block_q + off_ref[0])
+        if sk % block_k != 0:
+            fully = jnp.logical_and(fully, ki != nk_last)
+        pl.when(jnp.logical_and(visible, fully))(
+            lambda: attend_block(False))
+        pl.when(jnp.logical_and(visible, jnp.logical_not(fully)))(
+            lambda: attend_block(True))
+    elif sk % block_k != 0:
+        pl.when(ki == nk_last)(lambda: attend_block(True))
+        pl.when(ki != nk_last)(lambda: attend_block(False))
+    else:
+        attend_block(False)
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, do, dlse, *, causal, scale,
+                    kv_offset, block_q, block_k, interpret):
+    """Pallas flash-attention backward: returns (dq, dk, dv).
+
+    q/k/v/out/do: (B, H|Hkv, S, D); lse/dlse: (B, H, Sq) natural-log.
+    The lse cotangent folds into delta for free: d lse / d s = p, so
+    ds = p (dp - (delta - dlse)) — no kernel change, just the delta
+    precompute.  GQA: dk/dv are computed per q-head then group-summed
+    in XLA.
+    """
+    b, h, sq, d = q.shape
+    _, hkv, sk, _ = k.shape
+    group = h // hkv
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    nq = pl.cdiv(sq, bq)
+    nk = pl.cdiv(sk, bk)
+    off = jnp.asarray(kv_offset, jnp.int32).reshape(1)
+
+    qs = (q * jnp.asarray(scale * LOG2E, jnp.float32)).astype(q.dtype)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)             # (b, h, sq, 1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)[..., None]
+    lse4 = lse[..., None]                               # (b, h, sq, 1)
+
+    qspec = pl.BlockSpec((1, 1, bq, d),
+                         lambda bb, hh, qi, ki, *pre: (bb, hh, qi, 0))
+    lspec = pl.BlockSpec((1, 1, bq, 1),
+                         lambda bb, hh, qi, ki, *pre: (bb, hh, qi, 0))
+
+    def kv_index(bb, hh, qi, ki, off_, g=group):
+        if causal:
+            visible = ki * bk <= qi * bq + bq - 1 + off_[0]
+            ki = jax.lax.select(visible, ki, 0)
+        return (bb, hh // g, ki, 0)
+
+    kvspec = pl.BlockSpec((1, 1, bk, d), kv_index)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, nk, sk, causal, bq, bk),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, h, nq, nk),
+            in_specs=[qspec, kvspec, kvspec, qspec, lspec, lspec],
+            out_specs=qspec,
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+            vmem_limit_bytes=VMEM_LIMIT,
+        ),
+        interpret=default_interpret(interpret),
+    )(off, qs, k, v, do, lse4, delta)
+    dq = dq.astype(jnp.float32) * scale
+
+    # dk/dv: kv block resident, q streams.  Per q-head, group-summed
+    # below (memory O(group) — the simple-first layout).
+    def kv_index2(bb, hh, ki, qi, off_, g=group):
+        return (bb, hh // g, ki, 0)
+
+    kvspec2 = pl.BlockSpec((1, 1, bk, d), kv_index2)
+    okvspec2 = pl.BlockSpec((1, 1, bk, d),
+                            lambda bb, hh, ki, qi, *pre: (bb, hh, ki, 0))
+
+    def q_index2(bb, hh, ki, qi, off_):
+        if causal:
+            # Skipped below-the-band q blocks prefetch the next kv
+            # block's first visible q row.
+            visible = ki * bk <= qi * bq + bq - 1 + off_[0]
+            qi = jax.lax.select(visible, qi, nq - 1)
+        return (bb, hh, qi, 0)
+
+    qspec2 = pl.BlockSpec((1, 1, bq, d), q_index2)
+    lspec2 = pl.BlockSpec((1, 1, bq, 1), q_index2)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, nq, sq, sk, causal,
+                          bq, bk),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, h, nk, nq),
+            in_specs=[qspec2, kvspec2, kvspec2, qspec2, lspec2, lspec2],
+            out_specs=(okvspec2, okvspec2),
+            scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                            pltpu.VMEM((bk, d), jnp.float32)],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+            vmem_limit_bytes=VMEM_LIMIT,
+        ),
+        interpret=default_interpret(interpret),
+    )(off, qs, k, v, do, lse4, delta)
+
+    # The kernel accumulates ds^T @ (q * scale * log2e): dividing by
+    # log2e leaves exactly the wanted scale * ds^T @ q.
+    dk = dk * (1.0 / LOG2E)
+    if group > 1:
+        dk = dk.reshape(b, hkv, group, sk, d).sum(axis=2)
+        dv = dv.reshape(b, hkv, group, sk, d).sum(axis=2)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def flash_attention_diff(q, k, v, kv_offset=0, *,
+                         causal: bool = True,
+                         scale: Optional[float] = None,
+                         return_lse: bool = False,
+                         block_q: int = 1024, block_k: int = 1024,
+                         interpret: Optional[bool] = None):
+    """Differentiable flash attention (training path): same forward as
+    `flash_attention`, with a Pallas backward (custom VJP) instead of
+    the reference-attention fallback.  `kv_offset` may be traced (its
+    cotangent is symbolic zero).  With ``return_lse`` the lse output
+    is differentiable too (its cotangent folds into delta), which is
+    what makes the ring-attention lse-merge autodiff end-to-end.
+    Returns (B, H, Sq, D) [, lse (B, H, Sq)]."""
+    d = q.shape[-1]
+    scale_v = scale if scale is not None else d ** -0.5
+
+    def _fwd_pair(q, k, v, off):
+        return flash_attention(
+            q, k, v, causal=causal, scale=scale_v, kv_offset=off,
+            return_lse=True, block_q=block_q, block_k=block_k,
+            interpret=interpret)
+
+    @jax.custom_vjp
+    def _core(q, k, v, off):
+        return _fwd_pair(q, k, v, off)
+
+    def _core_fwd(q, k, v, off):
+        out, lse = _fwd_pair(q, k, v, off)
+        return (out, lse), (q, k, v, off, out, lse)
+
+    def _core_bwd(res, cts):
+        q, k, v, off, out, lse = res
+        do, dlse = cts
+        dq, dk, dv = _flash_backward(
+            q, k, v, out, lse, do, dlse, causal=causal, scale=scale_v,
+            kv_offset=off, block_q=block_q, block_k=block_k,
+            interpret=interpret)
+        import numpy as _np
+        d_off = _np.zeros(_np.shape(off), jax.dtypes.float0)
+        return dq, dk, dv, d_off
+
+    _core.defvjp(_core_fwd, _core_bwd)
+    out, lse = _core(q, k, v, jnp.asarray(kv_offset, jnp.int32))
+    return (out, lse) if return_lse else out
+
+
 def attention_reference(q, k, v, *, causal: bool = True,
                         scale: Optional[float] = None, kv_offset: int = 0):
     """Golden dense attention (fp32)."""

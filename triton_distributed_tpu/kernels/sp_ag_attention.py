@@ -63,6 +63,33 @@ def _merge(out_a, lse_a, out_b, lse_b):
     return out, lse
 
 
+def _ring_attend(q, k_shard, v_shard, axis: str, attend_chunk):
+    """The shared causal ring schedule: the KV shard travels the ring
+    while every rank folds the chunk it holds into the running (out,
+    lse) via the lse-merge.  ``attend_chunk(q, k_c, v_c, off) ->
+    (out, lse)`` supplies the per-chunk attention (plain or
+    differentiable)."""
+    world = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    s_loc = q.shape[2]
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    def chunk(kv, src):
+        k_c, v_c = kv
+        # queries at global offset my*s_loc; kv chunk at src*s_loc.
+        return attend_chunk(q, k_c, v_c, (my - src) * s_loc)
+
+    out, lse = chunk((k_shard, v_shard), my)
+    out = out.astype(jnp.float32)
+    kv = (k_shard, v_shard)
+    for step in range(world - 1):
+        kv = jax.lax.ppermute(kv, axis, perm)
+        src = jax.lax.rem(my - step - 1 + 2 * world, world)
+        o_s, l_s = chunk(kv, src)
+        out, lse = _merge(out, lse, o_s, l_s)
+    return out.astype(q.dtype)
+
+
 def sp_ring_attention(q, k_shard, v_shard, axis: str, *,
                       scale: Optional[float] = None,
                       block_q: int = 1024, block_k: int = 1024,
@@ -74,29 +101,44 @@ def sp_ring_attention(q, k_shard, v_shard, axis: str, *,
     k_shard:  (B, Hkv, S_loc, D) — this rank's KV rows (same layout).
     Returns (B, H, S_loc, D).
     """
-    world = jax.lax.axis_size(axis)
-    my = jax.lax.axis_index(axis)
-    s_loc = q.shape[2]
-    perm = [(i, (i + 1) % world) for i in range(world)]
-
-    def chunk_attend(kv, src):
-        k_c, v_c = kv
-        # queries at global offset my*s_loc; kv chunk at src*s_loc.
-        off = (my - src) * s_loc
+    def attend_chunk(q, k_c, v_c, off):
         return flash_attention(q, k_c, v_c, causal=True, scale=scale,
                                kv_offset=off, return_lse=True,
                                block_q=block_q, block_k=block_k,
                                interpret=interpret)
 
-    out, lse = chunk_attend((k_shard, v_shard), my)
-    out = out.astype(jnp.float32)
-    kv = (k_shard, v_shard)
-    for step in range(world - 1):
-        kv = jax.lax.ppermute(kv, axis, perm)
-        src = jax.lax.rem(my - step - 1 + 2 * world, world)
-        o_s, l_s = chunk_attend(kv, src)
-        out, lse = _merge(out, lse, o_s, l_s)
-    return out.astype(q.dtype)
+    return _ring_attend(q, k_shard, v_shard, axis, attend_chunk)
+
+
+def sp_ring_attention_diff(q, k_shard, v_shard, axis: str, *,
+                           scale: Optional[float] = None,
+                           block_q: int = 1024, block_k: int = 1024,
+                           interpret: Optional[bool] = None):
+    """DIFFERENTIABLE causal ring attention — the long-context
+    TRAINING path (beyond reference parity: the reference's SP
+    attention is inference-only).
+
+    Same ring schedule as :func:`sp_ring_attention`, but each chunk
+    runs `flash_attention_diff` (Pallas forward AND backward via
+    custom VJP) and the lse-merge is plain jnp — so `jax.grad`
+    differentiates the whole ring end-to-end: the backward replays the
+    ring (ppermute transposes to the reverse permutation
+    automatically) with flash backward kernels per chunk, never
+    materializing the S x S score matrix.
+    """
+    from triton_distributed_tpu.kernels.flash_attention import (
+        flash_attention_diff)
+
+    def attend_chunk(q, k_c, v_c, off):
+        # Both out AND lse are differentiable (the lse cotangent from
+        # the merge folds into the backward's delta), so jax.grad sees
+        # the exact merge Jacobian.
+        return flash_attention_diff(
+            q, k_c, v_c, off, causal=True, scale=scale,
+            return_lse=True, block_q=block_q, block_k=block_k,
+            interpret=interpret)
+
+    return _ring_attend(q, k_shard, v_shard, axis, attend_chunk)
 
 
 # ---------------------------------------------------------------------------
@@ -125,8 +167,9 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
     bk = min(block_k, sk)
     nq = pl.cdiv(sq, bq)
     nk = pl.cdiv(sk, bk)
+    ragged = sk % bk != 0
 
-    def inner(*refs, m_scr, l_scr, acc_scr):
+    def inner(*refs, m_scr, l_scr, acc_scr, qs_scr):
         if prev is not None:
             q_blk, k_blk, v_blk, po_blk, pl_blk, oo_blk, ol_blk = refs
         else:
@@ -140,31 +183,39 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
             m_scr[:] = jnp.full_like(m_scr, NEG_INF)
             l_scr[:] = jnp.zeros_like(l_scr)
             acc_scr[:] = jnp.zeros_like(acc_scr)
-
-        def attend_block():
             # exp2-domain online softmax (see `_flash_kernel`): scale
-            # by scale*log2(e) on the (bq, D) q block — 1/nk-th the
-            # work of scaling the (bq, bk) score tile — and use exp2.
+            # by scale*log2(e) once per q row-block — 1/nk-th the work
+            # of per-block scaling, which itself is 1/bk-th the work
+            # of scaling the (bq, bk) score tile.
+            qs_scr[:] = (q_blk[0, 0]
+                         * jnp.asarray(scale * LOG2E, jnp.float32)
+                         ).astype(qs_scr.dtype)
+
+        def attend_block(masked: bool):
             # m_scr is log2-domain; l_scr stays a natural weight sum.
-            q = q_blk[0, 0]
-            q = (q * jnp.asarray(scale * LOG2E, jnp.float32)
-                 ).astype(q.dtype)
+            q = qs_scr[:]
             k = k_blk[0, 0]
             v = v_blk[0, 0]
-            if sk % bk != 0:
+            if ragged:
                 v = zero_oob_rows(v, ki, bk, sk)
             s = jax.lax.dot_general(
                 q, k, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-            k_pos = (ki * bk
-                     + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
-            if sk % bk != 0:
-                s = jnp.where(k_pos < sk, s, NEG_INF)
-            q_pos = (qi * bq
-                     + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-                     + off)
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            # Mask arithmetic only on diagonal / ragged-tail blocks;
+            # interior blocks take the unmasked path (mirrors
+            # `flash_attention._flash_kernel`).
+            if masked:
+                k_pos = (ki * bk
+                         + jax.lax.broadcasted_iota(jnp.int32,
+                                                    (bq, bk), 1))
+                if ragged:
+                    s = jnp.where(k_pos < sk, s, NEG_INF)
+                q_pos = (qi * bq
+                         + jax.lax.broadcasted_iota(jnp.int32,
+                                                    (bq, bk), 0)
+                         + off)
+                s = jnp.where(k_pos <= q_pos, s, NEG_INF)
 
             m_prev = m_scr[:]
             m_new = jnp.maximum(m_prev,
@@ -183,7 +234,15 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
         # within-chunk triangle; whole future chunks are skipped one
         # level up in the ring loop).
         visible = ki * bk <= (qi * bq + bq - 1 + off)
-        pl.when(visible)(attend_block)
+        # Fully-visible blocks (last kv col within the FIRST query
+        # row's horizon) need no causal mask.
+        fully = ki * bk + bk - 1 <= qi * bq + off
+        if ragged:
+            fully = jnp.logical_and(fully, ki != nk - 1)
+        pl.when(jnp.logical_and(visible, fully))(
+            lambda: attend_block(False))
+        pl.when(jnp.logical_and(visible, jnp.logical_not(fully)))(
+            lambda: attend_block(True))
 
         @pl.when(ki == nk - 1)
         def _():
@@ -207,19 +266,26 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
                          lambda bb, hh, qi, ki: (bb, hh, qi, 0))
     lspec = pl.BlockSpec((1, 1, bq, 1),
                          lambda bb, hh, qi, ki: (bb, hh, qi, 0))
-    kvspec = pl.BlockSpec((1, 1, bk, d),
-                          lambda bb, hh, qi, ki, g=group:
-                              (bb, hh // g, ki, 0))
+
+    def kv_index(bb, hh, qi, ki, g=group):
+        # Skipped above-diagonal blocks PREFETCH block 0 (the next q
+        # row's first block) instead of fetching dead KV — same trick
+        # as `flash_attention.kv_index`; `off` is a traced scalar of
+        # the enclosing kernel, closed over here.
+        visible = ki * bk <= qi * bq + bq - 1 + off
+        return (bb, hh // g, jax.lax.select(visible, ki, 0), 0)
+
+    kvspec = pl.BlockSpec((1, 1, bk, d), kv_index)
     in_specs = [qspec, kvspec, kvspec]
     operands = [q_ref, k_ref, v_ref]
     if prev is not None:
         in_specs += [qspec, lspec]
         operands += list(prev)
 
-    def run(m_scr, l_scr, acc_scr):
+    def run(m_scr, l_scr, acc_scr, qs_scr):
         pipeline = pltpu.emit_pipeline(
             functools.partial(inner, m_scr=m_scr, l_scr=l_scr,
-                              acc_scr=acc_scr),
+                              acc_scr=acc_scr, qs_scr=qs_scr),
             grid=(b, h, nq, nk),
             in_specs=in_specs,
             out_specs=[qspec, lspec],
@@ -231,6 +297,7 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
         m_scr=pltpu.VMEM((bq, 1), jnp.float32),
         l_scr=pltpu.VMEM((bq, 1), jnp.float32),
         acc_scr=pltpu.VMEM((bq, d), jnp.float32),
+        qs_scr=pltpu.VMEM((bq, d), q_ref.dtype),
     )
 
 

@@ -27,7 +27,7 @@ from triton_distributed_tpu.kernels.allgather_gemm import (
 )
 from triton_distributed_tpu.kernels.flash_attention import (
     attention_reference,
-    flash_attention,
+    flash_attention_diff,
 )
 from triton_distributed_tpu.kernels.flash_decode import flash_decode
 from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
@@ -181,11 +181,14 @@ class TPAttention:
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if self.mode == "xla":
-            # differentiable path (training); fused path has no VJP yet
+            # dense golden (differentiable; materializes S² — use the
+            # fused mode for long sequences)
             attn = attention_reference(q, k, v, causal=True)
         else:
-            attn = flash_attention(q, k, v, causal=True,
-                                   interpret=self.interpret)
+            # Pallas flash with a Pallas backward (custom VJP): the
+            # fused mode trains too.
+            attn = flash_attention_diff(q, k, v, causal=True,
+                                        interpret=self.interpret)
         attn = attn.transpose(0, 2, 1, 3).reshape(m, -1)
         out = self._out_proj(attn, x.dtype, params)
         return out, (k, v)
