@@ -1,0 +1,82 @@
+"""10 — differentiable ring attention: long-context TRAINING.
+
+Beyond the reference's scope (its SP attention is inference-only):
+`sp_ring_attention_diff` runs a causal ring — the KV shard travels the
+ICI ring while every rank folds the chunk it holds into a running
+online-softmax state — with a Pallas BACKWARD per chunk behind a
+custom VJP.  `jax.grad` differentiates the whole ring end-to-end:
+
+- the lse-merge is exact (the lse cotangent folds into the flash
+  backward's delta term), and
+- neither the S x S score matrix nor the gathered KV ever
+  materializes, forward or backward — the memory that makes
+  million-token training contexts possible.
+
+This example trains a toy objective: push the sharded ring attention's
+output toward a target, and checks the gradient against autodiff
+through the dense O(S^2) reference.
+"""
+
+import functools
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+from examples._bootstrap import make_mesh  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from triton_distributed_tpu.kernels.flash_attention import (  # noqa: E402
+    attention_reference,
+)
+from triton_distributed_tpu.kernels.sp_ag_attention import (  # noqa: E402
+    sp_ring_attention_diff,
+)
+from triton_distributed_tpu.ops import shard_map_op  # noqa: E402
+
+
+def main():
+    mesh = make_mesh(("sp",), (4,))
+    b, h, s, d = 1, 2, 256, 32
+    keys = jax.random.split(jax.random.key(0), 4)
+    q = jax.random.normal(keys[0], (b, h, s, d), jnp.float32)
+    k = jax.random.normal(keys[1], (b, h, s, d), jnp.float32)
+    v = jax.random.normal(keys[2], (b, h, s, d), jnp.float32)
+    target = jax.random.normal(keys[3], (b, h, s, d), jnp.float32)
+
+    ring = shard_map_op(
+        functools.partial(sp_ring_attention_diff, axis="sp",
+                          block_q=32, block_k=32),
+        mesh,
+        in_specs=(P(None, None, "sp", None),) * 3,
+        out_specs=P(None, None, "sp", None))
+
+    def loss_ring(q, k, v):
+        return jnp.mean((ring(q, k, v) - target) ** 2)
+
+    def loss_ref(q, k, v):
+        out = attention_reference(q, k, v, causal=True)
+        return jnp.mean((out - target) ** 2)
+
+    val, grads = jax.jit(jax.value_and_grad(
+        loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+
+    # One SGD step actually reduces the loss.
+    lr = 1e-1
+    q2, k2, v2 = (x - lr * g for x, g in zip((q, k, v), grads))
+    val2 = jax.jit(loss_ring)(q2, k2, v2)
+
+    errs = [float(jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9))
+            for a, b in zip(grads, g_ref)]
+    print(f"loss {float(val):.4f} -> {float(val2):.4f} after one step; "
+          f"grad rel errs dq/dk/dv: "
+          + ", ".join(f"{e:.2e}" for e in errs))
+    assert float(val2) < float(val)
+    assert all(e < 2e-2 for e in errs)
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     "03_hierarchical_allgather.py",
     "07_ag_gemm_overlap.py",
     "09_w8a8_overlap.py",
+    "10_ring_attention_training.py",
 ])
 def test_example_runs(script):
     env = dict(os.environ)
