@@ -165,27 +165,44 @@ def _regime_prefill(mesh, world):
 
 def _regime_decode_ll(mesh, world, m=16):
     """The serving hot path at decode rows: low-latency ag_gemm (one
-    Pallas kernel, B streamed once) vs the XLA composition."""
+    Pallas kernel, B streamed once) vs the XLA composition.
+
+    A ~100 µs op cannot be measured by per-call dispatch through the
+    tunnel (each chained call is 2 dispatches; in bad periods the
+    dispatch floor dominates and the ratio is noise — observed swings
+    0.66..1.43 on the SAME code).  Chain iterations INSIDE one jitted
+    scan instead (`measure_ops_scanned`), ABBA-interleaved."""
     from triton_distributed_tpu.kernels.allgather_gemm import (
         AllGatherGEMMContext,
         ag_gemm,
         ag_gemm_nonoverlap,
     )
     from triton_distributed_tpu.ops import shard_map_op
+    from triton_distributed_tpu.utils.benchmarking import (
+        feedback_mix,
+        measure_ops_scanned,
+    )
 
     a = jax.random.normal(jax.random.key(2), (m, K)).astype(jnp.bfloat16)
     b = jax.random.normal(jax.random.key(3), (K, N_TOTAL)).astype(jnp.bfloat16)
     specs = dict(in_specs=(P("tp", None), P(None, "tp")),
                  out_specs=P(None, "tp"))
     ctx = AllGatherGEMMContext(axis="tp", world_size=world, method="ll")
-    ll = jax.jit(shard_map_op(
-        functools.partial(ag_gemm, ctx=ctx), mesh, **specs))
-    baseline = jax.jit(shard_map_op(
-        functools.partial(ag_gemm_nonoverlap, axis="tp"), mesh, **specs))
-    times, per_repeat = measure_pair([ll, baseline], a, b, K,
-                                     n1=40, n2=440)
-    ratio = ratio_vs_last(per_repeat)[0]
-    return times[0], ratio, f"M={m} ll path"
+    ll = shard_map_op(functools.partial(ag_gemm, ctx=ctx), mesh, **specs)
+    baseline = shard_map_op(
+        functools.partial(ag_gemm_nonoverlap, axis="tp"), mesh, **specs)
+    mix = lambda args, out: (feedback_mix(args[0], out), args[1])
+    import statistics
+    # ABBA within each repeat so first-order drift cancels; pair the
+    # slopes per repeat (adjacent in time), never ratio two medians.
+    _, slopes = measure_ops_scanned(
+        [ll, baseline, baseline, ll], (a, b), mix,
+        n_inner=16, repeats=6, return_slopes=True)
+    pair_ratios = [(b1 + b2) / (l1 + l2)
+                   for l1, b1, b2, l2 in zip(*slopes)]
+    ratio = statistics.median(pair_ratios)
+    t_ll = statistics.median(slopes[0] + slopes[3])
+    return t_ll, ratio, f"M={m} ll path"
 
 
 def _regime_w8a8(mesh, world):

@@ -1,6 +1,6 @@
 """Smoke-run the tutorial examples (reference: `tutorials/01-10` are
 runnable teaching scripts; ours must stay runnable too).  A fast
-subset runs in CI; all eight share the same bootstrap."""
+subset runs in CI; all ten share the same bootstrap."""
 
 import os
 import subprocess

@@ -22,7 +22,8 @@ import numpy as np
 
 def measure_ops(fs: Sequence[Callable], args: tuple,
                 chain: Callable, *, n1: int = 20, n2: int = None,
-                repeats: int = 6, min_window_s: float = 0.5) -> list:
+                repeats: int = 6, min_window_s: float = 0.5,
+                return_slopes: bool = False):
     """Per-call latency (seconds) of each `f(*args) -> out` in `fs`.
 
     ``chain(args, out) -> new_args`` must make call i+1 data-dependent
@@ -32,6 +33,11 @@ def measure_ops(fs: Sequence[Callable], args: tuple,
     ``n2`` auto-calibrates from a pilot so the slope window holds at
     least ``min_window_s`` of device work — a fast op measured with a
     small fixed window drowns in the fetch jitter and reads as ~0.
+
+    With ``return_slopes`` also returns the per-repeat slope lists —
+    A/B callers should pair slopes within a repeat (adjacent in time)
+    rather than ratio two medians, which lets minutes-scale drift land
+    in one op's median.
     """
 
     def total(f, n_calls):
@@ -47,22 +53,25 @@ def measure_ops(fs: Sequence[Callable], args: tuple,
         np.asarray(leaf.reshape(-1)[:1])
         return time.perf_counter() - t0
 
-    for f in fs:
-        total(f, 2)  # warm every jit
+    uniq = {id(f): f for f in fs}
+    for f in uniq.values():
+        total(f, 2)  # warm every distinct jit once
     if n2 is None:
         # Grow each op's window until its measured (t2 - t1) dominates
         # the fetch jitter — a pilot estimate would itself be
         # jitter-dominated for fast ops.  Per-op windows: sizing by
         # the fastest op would charge its large call count to a slow
-        # competitor (minutes per sample).
-        n2s = []
-        for f in fs:
+        # competitor (minutes per sample).  Calibrate each DISTINCT op
+        # once (repeated entries, e.g. an ABBA schedule, share it).
+        cal = {}
+        for fid, f in uniq.items():
             n = max(3 * n1, n1 + 40)
             while n < 8000:
                 if total(f, n) - total(f, n1) >= min_window_s:
                     break
                 n = min(8000, n * 4)
-            n2s.append(n)
+            cal[fid] = n
+        n2s = [cal[id(f)] for f in fs]
     else:
         n2s = [n2] * len(fs)
     slopes = [[] for _ in fs]
@@ -71,14 +80,16 @@ def measure_ops(fs: Sequence[Callable], args: tuple,
             t1 = total(f, n1)
             t2 = total(f, n)
             sl.append(max((t2 - t1) / (n - n1), 1e-9))
-    return [statistics.median(sl) for sl in slopes]
+    medians = [statistics.median(sl) for sl in slopes]
+    return (medians, slopes) if return_slopes else medians
 
 
 def measure_ops_scanned(fs: Sequence[Callable], args: tuple,
                         mix: Callable, *, n_inner: int = 16,
                         n1: int = 4, repeats: int = 6,
                         min_window_s: float = 0.5,
-                        carry_args: int = 1) -> list:
+                        carry_args: int = 1,
+                        return_slopes: bool = False):
     """Per-call latency for SUB-MILLISECOND ops.
 
     One-dispatch-per-call measurement (``measure_ops``) bottoms out at
@@ -117,13 +128,22 @@ def measure_ops_scanned(fs: Sequence[Callable], args: tuple,
 
         return jax.jit(g)
 
-    ts = measure_ops([scanned(f) for f in fs], args,
-                     # g returns only the carry: reattach the
-                     # invariant args for the next chained dispatch.
-                     lambda a, out: tuple(out) + tuple(a[len(out):]),
-                     n1=n1, repeats=repeats,
-                     min_window_s=min_window_s)
-    return [t / n_inner for t in ts]
+    # Dedupe by identity: repeated entries (ABBA schedules) share one
+    # jitted scan — one compile, one window calibration.
+    wrapped = {}
+    gs = [wrapped.setdefault(id(f), scanned(f)) for f in fs]
+    res = measure_ops(gs, args,
+                      # g returns only the carry: reattach the
+                      # invariant args for the next chained dispatch.
+                      lambda a, out: tuple(out) + tuple(a[len(out):]),
+                      n1=n1, repeats=repeats,
+                      min_window_s=min_window_s,
+                      return_slopes=return_slopes)
+    if return_slopes:
+        medians, slopes = res
+        return ([t / n_inner for t in medians],
+                [[s / n_inner for s in sl] for sl in slopes])
+    return [t / n_inner for t in res]
 
 
 def feedback_mix(x, out):
