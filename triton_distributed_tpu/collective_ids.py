@@ -44,6 +44,10 @@ EP_COMBINE = 21
 MOE_MLP_AG = 22
 MOE_MLP_RS = 23
 BROADCAST = 24
+# Backward passes of the differentiable fused ops run in the same
+# program as their forwards (one jit'd train step): distinct ids.
+AG_GEMM_BWD = 25
+GEMM_RS_BWD = 26
 
 _FIRST_USER_ID = 64
 _user_ids = itertools.count(_FIRST_USER_ID)

@@ -440,6 +440,59 @@ def ag_gemm_w8a8(a_shard, b_q, scale_b, ctx: AllGatherGEMMContext,
     return out.reshape(world * m, n)
 
 
+def ag_gemm_diff(a_shard, b, ctx):
+    """DIFFERENTIABLE fused AG-GEMM — training with comm-compute
+    overlap in BOTH directions (beyond reference parity: the
+    reference's overlap ops are inference-only).
+
+    The backward is the dual op: with C = AG(a) @ b,
+
+        da = reduce_scatter(dC @ bᵀ)   →  the fused `gemm_rs` kernel
+        db = AG(a)ᵀ @ dC               →  a local matmul (reuses the
+                                          gathered A saved in fwd)
+
+    so the backward's communication overlaps its GEMM exactly like
+    the forward's.  Residual memory: the gathered A (world × the
+    shard) — the standard activation-recompute tradeoff applies; pass
+    through `jax.checkpoint` to trade it back for a re-gather.
+    """
+    from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
+        GEMMReduceScatterContext, gemm_rs)
+
+    # The backward duals are built for the flat single-axis contexts;
+    # a Hierarchical/Torus ctx would trace the primal fine and then
+    # fail (or silently reduce over the wrong topology) in bwd.
+    assert isinstance(ctx, AllGatherGEMMContext), (
+        "ag_gemm_diff supports flat AllGatherGEMMContext only (2-level"
+        " / torus training duals not implemented yet); got "
+        f"{type(ctx).__name__}")
+
+    @jax.custom_vjp
+    def core(a, w):
+        return ag_gemm(a, w, ctx)
+
+    def fwd(a, w):
+        out, gathered = ag_gemm(a, w, ctx, return_gathered=True)
+        return out, (gathered, w)
+
+    def bwd(res, dc):
+        gathered, w = res
+        rs_ctx = GEMMReduceScatterContext(
+            axis=ctx.axis, world_size=ctx.world_size, gemm=ctx.gemm,
+            method=ctx.method if ctx.method == "xla" else "auto",
+            collective_id=cids.AG_GEMM_BWD,
+            straggler=ctx.straggler,
+            for_correctness=ctx.for_correctness,
+            interpret=ctx.interpret)
+        da = gemm_rs(dc, jnp.swapaxes(w, 0, 1), rs_ctx)
+        db = jnp.dot(jnp.swapaxes(gathered, 0, 1), dc,
+                     preferred_element_type=jnp.float32).astype(w.dtype)
+        return da, db
+
+    core.defvjp(fwd, bwd)
+    return core(a_shard, b)
+
+
 def ag_gemm_nonoverlap(a_shard, b, axis: str):
     """Golden / baseline: XLA collective then matmul (the reference's
     torch fwd mode, `layers/nvidia/tp_mlp.py` "torch" path)."""

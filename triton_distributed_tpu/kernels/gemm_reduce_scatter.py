@@ -292,6 +292,51 @@ def gemm_rs(a, b, ctx):
     return out[:mc] if mcp != mc else out
 
 
+def gemm_rs_diff(a, b, ctx):
+    """DIFFERENTIABLE fused GEMM-RS (see `ag_gemm_diff` — this is its
+    dual).  With o = RS(a @ b) over rows,
+
+        dA = AG(do) @ bᵀ    →  the fused `ag_gemm` kernel (which also
+                               hands back AG(do) = the full dC)
+        db = aᵀ @ dC        →  a local matmul on that gathered dC
+
+    so the backward's all-gather overlaps its GEMM.
+    """
+    from triton_distributed_tpu.kernels.allgather_gemm import (
+        AllGatherGEMMContext, ag_gemm)
+
+    # Flat single-axis contexts only (see ag_gemm_diff).
+    assert isinstance(ctx, GEMMReduceScatterContext), (
+        "gemm_rs_diff supports flat GEMMReduceScatterContext only "
+        "(2-level / torus training duals not implemented yet); got "
+        f"{type(ctx).__name__}")
+
+    @jax.custom_vjp
+    def core(a, w):
+        return gemm_rs(a, w, ctx)
+
+    def fwd(a, w):
+        return gemm_rs(a, w, ctx), (a, w)
+
+    def bwd(res, do):
+        a, w = res
+        ag_ctx = AllGatherGEMMContext(
+            axis=ctx.axis, world_size=ctx.world_size, gemm=ctx.gemm,
+            method=ctx.method if ctx.method == "xla" else "auto",
+            collective_id=cids.GEMM_RS_BWD,
+            straggler=ctx.straggler,
+            for_correctness=ctx.for_correctness,
+            interpret=ctx.interpret)
+        da, dc_full = ag_gemm(do, jnp.swapaxes(w, 0, 1), ag_ctx,
+                              return_gathered=True)
+        db = jnp.dot(jnp.swapaxes(a, 0, 1), dc_full,
+                     preferred_element_type=jnp.float32).astype(w.dtype)
+        return da, db
+
+    core.defvjp(fwd, bwd)
+    return core(a, b)
+
+
 def gemm_rs_nonoverlap(a, b, axis: str):
     """Golden / baseline: matmul then XLA reduce-scatter."""
     world = jax.lax.axis_size(axis)

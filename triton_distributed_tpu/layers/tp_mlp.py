@@ -108,18 +108,28 @@ class TPMLP:
                           preferred_element_type=jnp.float32)
         return self._psum_scatter_rows(partial, x.dtype)
 
-    def _fwd_fused(self, x, params):
+    def _fwd_fused(self, x, params, training: bool = False):
+        from triton_distributed_tpu.kernels.allgather_gemm import (
+            ag_gemm_diff)
+        from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
+            gemm_rs_diff)
+
         ag_ctx = AllGatherGEMMContext(
             axis=self.axis, world_size=self.world_size, gemm=self.gemm,
             collective_id=self.collective_ids[0],
             interpret=self.interpret)
-        h = ag_gemm(x, params["gate_up"], ag_ctx)       # (M, 2*ffn_loc)
-        h = gated_silu(h)                               # (M, ffn_loc)
         rs_ctx = GEMMReduceScatterContext(
             axis=self.axis, world_size=self.world_size, gemm=self.gemm,
             collective_id=self.collective_ids[1],
             interpret=self.interpret)
-        return gemm_rs(h, params["down"], rs_ctx)       # (M/world, hidden)
+        # Training uses the differentiable fused ops (their backwards
+        # are the dual fused kernels — overlap both directions);
+        # inference skips them to avoid saving the gathered residual.
+        up = ag_gemm_diff if training else ag_gemm
+        down = gemm_rs_diff if training else gemm_rs
+        h = up(x, params["gate_up"], ag_ctx)            # (M, 2*ffn_loc)
+        h = gated_silu(h)                               # (M, ffn_loc)
+        return down(h, params["down"], rs_ctx)          # (M/world, hidden)
 
     @staticmethod
     def quantize_params(params):
@@ -166,11 +176,16 @@ class TPMLP:
             collective_id=self.collective_ids[2], interpret=self.interpret)
         return all_reduce(partial, ar_ctx)
 
-    def __call__(self, x, params):
+    def __call__(self, x, params, training: bool = False):
+        # Fail fast at the layer boundary: only xla and fused have
+        # differentiable paths (fused_ar / w8a8 would die deep inside
+        # a non-differentiable Pallas call with an opaque error).
+        assert not training or self.mode in ("xla", "fused"), (
+            f"training=True unsupported for mode={self.mode!r}")
         if self.mode == "xla":
             return self._fwd_xla(x, params)
         if self.mode == "fused":
-            return self._fwd_fused(x, params)
+            return self._fwd_fused(x, params, training=training)
         if self.mode == "fused_ar":
             return self._fwd_fused_ar(x, params)
         if self.mode == "w8a8":

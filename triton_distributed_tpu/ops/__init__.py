@@ -9,11 +9,13 @@ the reference's op entry points exported at
 
 from triton_distributed_tpu.ops.api import (  # noqa: F401
     ag_gemm,
+    ag_gemm_diff,
     all_gather,
     all_reduce,
     all_to_all,
     broadcast,
     gemm_rs,
+    gemm_rs_diff,
     reduce_scatter,
     shard_map_op,
 )

@@ -135,3 +135,25 @@ def gemm_rs(a, b, mesh: Mesh, axis: str = "tp", **kw):
         functools.partial(grs_mod.gemm_rs, ctx=ctx), mesh,
         in_specs=(P(None, axis), P(axis, None)), out_specs=P(axis, None))
     return fn(a, b)
+
+
+def ag_gemm_diff(a, b, mesh: Mesh, axis: str = "tp", **kw):
+    """Differentiable `ag_gemm` (training): the custom VJP's backward
+    is the fused `gemm_rs` — comm-compute overlap both directions."""
+    ctx = agg_mod.create_ag_gemm_context(
+        axis=axis, world_size=mesh.shape[axis], **kw)
+    fn = shard_map_op(
+        functools.partial(agg_mod.ag_gemm_diff, ctx=ctx), mesh,
+        in_specs=(P(axis, None), P(None, axis)), out_specs=P(None, axis))
+    return fn(a, b)
+
+
+def gemm_rs_diff(a, b, mesh: Mesh, axis: str = "tp", **kw):
+    """Differentiable `gemm_rs` (training): the custom VJP's backward
+    is the fused `ag_gemm`."""
+    ctx = grs_mod.create_gemm_rs_context(
+        axis=axis, world_size=mesh.shape[axis], **kw)
+    fn = shard_map_op(
+        functools.partial(grs_mod.gemm_rs_diff, ctx=ctx), mesh,
+        in_specs=(P(None, axis), P(axis, None)), out_specs=P(axis, None))
+    return fn(a, b)
