@@ -77,6 +77,11 @@ class AllGatherGEMMContext:
     straggler: Optional[Tuple[int, int]] = None
     for_correctness: bool = False
     interpret: Optional[bool] = None
+    #: Collective id for the training dual (`ag_gemm_diff`'s backward
+    #: gemm_rs).  None → the registry default; programs with several
+    #: CONCURRENT fused-training instances must give each its own
+    #: (same invariant as collective_id itself).
+    bwd_collective_id: Optional[int] = None
 
     #: Shape-only fallback for "auto" when K/N are unknown: one-shot
     #: ll below this many (padded) gathered rows — the decode regime.
@@ -440,6 +445,22 @@ def ag_gemm_w8a8(a_shard, b_q, scale_b, ctx: AllGatherGEMMContext,
     return out.reshape(world * m, n)
 
 
+def _dual_context(ctx, target_cls, default_bwd_id):
+    """Build the backward dual's context from the forward's — ONE
+    place owns the field mirroring (method downgrade, fault injection,
+    bwd collective id), so fwd and bwd can't silently diverge when a
+    knob is added."""
+    return target_cls(
+        axis=ctx.axis, world_size=ctx.world_size, gemm=ctx.gemm,
+        method=ctx.method if ctx.method == "xla" else "auto",
+        collective_id=(ctx.bwd_collective_id
+                       if ctx.bwd_collective_id is not None
+                       else default_bwd_id),
+        straggler=ctx.straggler,
+        for_correctness=ctx.for_correctness,
+        interpret=ctx.interpret)
+
+
 def ag_gemm_diff(a_shard, b, ctx):
     """DIFFERENTIABLE fused AG-GEMM — training with comm-compute
     overlap in BOTH directions (beyond reference parity: the
@@ -477,13 +498,8 @@ def ag_gemm_diff(a_shard, b, ctx):
 
     def bwd(res, dc):
         gathered, w = res
-        rs_ctx = GEMMReduceScatterContext(
-            axis=ctx.axis, world_size=ctx.world_size, gemm=ctx.gemm,
-            method=ctx.method if ctx.method == "xla" else "auto",
-            collective_id=cids.AG_GEMM_BWD,
-            straggler=ctx.straggler,
-            for_correctness=ctx.for_correctness,
-            interpret=ctx.interpret)
+        rs_ctx = _dual_context(ctx, GEMMReduceScatterContext,
+                               cids.AG_GEMM_BWD)
         da = gemm_rs(dc, jnp.swapaxes(w, 0, 1), rs_ctx)
         db = jnp.dot(jnp.swapaxes(gathered, 0, 1), dc,
                      preferred_element_type=jnp.float32).astype(w.dtype)

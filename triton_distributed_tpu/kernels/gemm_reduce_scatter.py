@@ -60,6 +60,9 @@ class GEMMReduceScatterContext:
     straggler: Optional[tuple] = None
     for_correctness: bool = False
     interpret: Optional[bool] = None
+    #: Collective id for the training dual (`gemm_rs_diff`'s backward
+    #: ag_gemm); None → registry default.  See AllGatherGEMMContext.
+    bwd_collective_id: Optional[int] = None
 
     #: Shape-only fallback for "auto" when K/N are unknown.
     LL_MAX_ROWS = 256
@@ -320,13 +323,10 @@ def gemm_rs_diff(a, b, ctx):
 
     def bwd(res, do):
         a, w = res
-        ag_ctx = AllGatherGEMMContext(
-            axis=ctx.axis, world_size=ctx.world_size, gemm=ctx.gemm,
-            method=ctx.method if ctx.method == "xla" else "auto",
-            collective_id=cids.GEMM_RS_BWD,
-            straggler=ctx.straggler,
-            for_correctness=ctx.for_correctness,
-            interpret=ctx.interpret)
+        from triton_distributed_tpu.kernels.allgather_gemm import (
+            _dual_context)
+        ag_ctx = _dual_context(ctx, AllGatherGEMMContext,
+                               cids.GEMM_RS_BWD)
         da, dc_full = ag_gemm(do, jnp.swapaxes(w, 0, 1), ag_ctx,
                               return_gathered=True)
         db = jnp.dot(jnp.swapaxes(a, 0, 1), dc_full,
