@@ -220,3 +220,37 @@ def test_hierarchical_ep_layer_matches_flat(devices):
 
     assert_allclose(out_hier, out_flat, atol=0, rtol=0,
                     name="hier-vs-flat-ep")
+
+
+def test_ag_gemm_diff_grads_2level(dcn2_ici4_mesh):
+    """Training duals on the two-level mesh: the backward of the
+    dcn x ici fused AG-GEMM is the dcn x ici fused GEMM-RS with the
+    same context (the duality is topology-independent)."""
+    from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm_diff
+
+    m, k, n = 8, 64, 64
+    a = jax.random.normal(jax.random.key(20), (WORLD * m, k)) / 4
+    b = jax.random.normal(jax.random.key(21), (k, WORLD * n)) / 4
+    w = jax.random.normal(jax.random.key(22), (WORLD * m, WORLD * n))
+
+    both = ("dcn", "ici")
+    fused = shard_map_op(
+        lambda aa, bb: ag_gemm_diff(aa, bb, _hctx()), dcn2_ici4_mesh,
+        in_specs=(P(both, None), P(None, both)), out_specs=P(None, both))
+
+    def ref_fn(aa, bb):
+        full = jax.lax.all_gather(aa, both, tiled=True)
+        return jnp.dot(full, bb, preferred_element_type=jnp.float32
+                       ).astype(aa.dtype)
+
+    ref = shard_map_op(ref_fn, dcn2_ici4_mesh,
+                       in_specs=(P(both, None), P(None, both)),
+                       out_specs=P(None, both))
+
+    g_fused = jax.jit(jax.grad(
+        lambda aa, bb: jnp.sum(fused(aa, bb) * w), argnums=(0, 1)))(a, b)
+    g_ref = jax.grad(
+        lambda aa, bb: jnp.sum(ref(aa, bb) * w), argnums=(0, 1))(a, b)
+    for got, want, name in zip(g_fused, g_ref, ("da", "db")):
+        assert_allclose(got, want, atol=5e-3, rtol=5e-3,
+                        name=f"2level diff {name}")

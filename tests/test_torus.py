@@ -222,3 +222,39 @@ def test_reduce_scatter_torus_degenerate_axis(devices):
     out = jax.jit(fn)(x)
     assert_allclose(out, x.sum(axis=0), atol=1e-4, rtol=1e-4,
                     name="rs_torus_8x1")
+
+
+def test_gemm_rs_diff_grads_torus(torus_mesh):
+    """Training duals on the torus mesh: the backward of the torus
+    GEMM-RS is the fused torus AG-GEMM with the same context."""
+    from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
+        gemm_rs_diff)
+
+    mt, k, n = WORLD * 8, WORLD * 16, 64
+    a = jax.random.normal(jax.random.key(30), (mt, k)) / 4
+    b = jax.random.normal(jax.random.key(31), (k, n)) / 4
+    w = jax.random.normal(jax.random.key(32), (mt, n))
+
+    xy = ("x", "y")
+    fused = shard_map_op(
+        lambda aa, bb: gemm_rs_diff(aa, bb, _ctx(torus_mesh)),
+        torus_mesh,
+        in_specs=(P(None, xy), P(xy, None)), out_specs=P(xy, None))
+
+    def ref_fn(aa, bb):
+        partial = jnp.dot(aa, bb, preferred_element_type=jnp.float32)
+        return jax.lax.psum_scatter(
+            partial.reshape(WORLD, mt // WORLD, n), xy,
+            scatter_dimension=0, tiled=False).astype(aa.dtype)
+
+    ref = shard_map_op(ref_fn, torus_mesh,
+                       in_specs=(P(None, xy), P(xy, None)),
+                       out_specs=P(xy, None))
+
+    g_fused = jax.jit(jax.grad(
+        lambda aa, bb: jnp.sum(fused(aa, bb) * w), argnums=(0, 1)))(a, b)
+    g_ref = jax.grad(
+        lambda aa, bb: jnp.sum(ref(aa, bb) * w), argnums=(0, 1))(a, b)
+    for got, want, name in zip(g_fused, g_ref, ("da", "db")):
+        assert_allclose(got, want, atol=5e-3, rtol=5e-3,
+                        name=f"torus diff {name}")

@@ -449,13 +449,38 @@ def _dual_context(ctx, target_cls, default_bwd_id):
     """Build the backward dual's context from the forward's — ONE
     place owns the field mirroring (method downgrade, fault injection,
     bwd collective id), so fwd and bwd can't silently diverge when a
-    knob is added."""
+    knob is added.
+
+    The duality is TOPOLOGY-INDEPENDENT (da of AG-GEMM is a GEMM-RS
+    over the same global row ordering, whatever carried the gather),
+    and `ag_gemm`/`gemm_rs` both dispatch on Hierarchical/Torus
+    contexts — so for those the dual ctx is the SAME ctx with the
+    backward's collective id.
+    """
+    import dataclasses as _dc
+
+    from triton_distributed_tpu.kernels.hierarchical import (
+        HierarchicalContext)
+    from triton_distributed_tpu.kernels.torus import TorusContext
+
+    bwd_id = (ctx.bwd_collective_id
+              if ctx.bwd_collective_id is not None else default_bwd_id)
+    if isinstance(ctx, HierarchicalContext):
+        # Mirror the flat branch's method downgrade: a forward-forced
+        # GEMM method (tuned for the forward's shapes) must not leak
+        # into the differently-shaped backward.
+        return _dc.replace(
+            ctx, collective_id=bwd_id,
+            gemm_method=(ctx.gemm_method if ctx.gemm_method == "xla"
+                         else "auto"))
+    if isinstance(ctx, TorusContext):
+        return _dc.replace(
+            ctx, collective_id=bwd_id,
+            method=ctx.method if ctx.method == "xla" else "auto")
     return target_cls(
         axis=ctx.axis, world_size=ctx.world_size, gemm=ctx.gemm,
         method=ctx.method if ctx.method == "xla" else "auto",
-        collective_id=(ctx.bwd_collective_id
-                       if ctx.bwd_collective_id is not None
-                       else default_bwd_id),
+        collective_id=bwd_id,
         straggler=ctx.straggler,
         for_correctness=ctx.for_correctness,
         interpret=ctx.interpret)
@@ -479,14 +504,6 @@ def ag_gemm_diff(a_shard, b, ctx):
     """
     from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
         GEMMReduceScatterContext, gemm_rs)
-
-    # The backward duals are built for the flat single-axis contexts;
-    # a Hierarchical/Torus ctx would trace the primal fine and then
-    # fail (or silently reduce over the wrong topology) in bwd.
-    assert isinstance(ctx, AllGatherGEMMContext), (
-        "ag_gemm_diff supports flat AllGatherGEMMContext only (2-level"
-        " / torus training duals not implemented yet); got "
-        f"{type(ctx).__name__}")
 
     @jax.custom_vjp
     def core(a, w):

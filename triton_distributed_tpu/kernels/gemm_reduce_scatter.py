@@ -308,12 +308,6 @@ def gemm_rs_diff(a, b, ctx):
     from triton_distributed_tpu.kernels.allgather_gemm import (
         AllGatherGEMMContext, ag_gemm)
 
-    # Flat single-axis contexts only (see ag_gemm_diff).
-    assert isinstance(ctx, GEMMReduceScatterContext), (
-        "gemm_rs_diff supports flat GEMMReduceScatterContext only "
-        "(2-level / torus training duals not implemented yet); got "
-        f"{type(ctx).__name__}")
-
     @jax.custom_vjp
     def core(a, w):
         return gemm_rs(a, w, ctx)

@@ -88,6 +88,11 @@ class HierarchicalContext:
     #: Fault injection, forwarded into every ICI-stage kernel launch.
     straggler: Optional[tuple] = None
     for_correctness: bool = False
+    #: Collective id for the training duals (`ag_gemm_diff` /
+    #: `gemm_rs_diff` backwards); None → registry default.  Programs
+    #: with several CONCURRENT fused-training instances must give each
+    #: its own (same invariant as collective_id).
+    bwd_collective_id: Optional[int] = None
 
     @property
     def world_size(self) -> int:

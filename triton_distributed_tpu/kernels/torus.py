@@ -77,6 +77,9 @@ class TorusContext:
     #: MXU config for the fused torus GEMM ops (`ag_gemm` / `gemm_rs`
     #: accept a TorusContext and consume quarters in arrival order).
     gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
+    #: Collective id for the training duals; None → registry default
+    #: (see HierarchicalContext.bwd_collective_id).
+    bwd_collective_id: Optional[int] = None
 
     @property
     def world_size(self) -> int:
