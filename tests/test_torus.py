@@ -258,3 +258,18 @@ def test_gemm_rs_diff_grads_torus(torus_mesh):
     for got, want, name in zip(g_fused, g_ref, ("da", "db")):
         assert_allclose(got, want, atol=5e-3, rtol=5e-3,
                         name=f"torus diff {name}")
+
+
+@pytest.mark.parametrize("m", [16, 10])   # 10 % 8 != 0 → pad branch
+def test_all_reduce_torus(torus_mesh, m):
+    from triton_distributed_tpu.kernels.torus import all_reduce_torus
+
+    n = 128
+    x = jax.random.normal(jax.random.key(40), (WORLD, m, n), jnp.float32)
+    fn = shard_map_op(
+        lambda xx: all_reduce_torus(xx[0], _ctx(torus_mesh)),
+        torus_mesh,
+        in_specs=P(("x", "y"), None, None), out_specs=P(None, None))
+    out = jax.jit(fn)(x)
+    assert_allclose(out, x.sum(axis=0), atol=1e-4, rtol=1e-4,
+                    name="ar_torus")

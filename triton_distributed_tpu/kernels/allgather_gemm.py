@@ -457,8 +457,6 @@ def _dual_context(ctx, target_cls, default_bwd_id):
     contexts — so for those the dual ctx is the SAME ctx with the
     backward's collective id.
     """
-    import dataclasses as _dc
-
     from triton_distributed_tpu.kernels.hierarchical import (
         HierarchicalContext)
     from triton_distributed_tpu.kernels.torus import TorusContext
@@ -469,14 +467,17 @@ def _dual_context(ctx, target_cls, default_bwd_id):
         # Mirror the flat branch's method downgrade: a forward-forced
         # GEMM method (tuned for the forward's shapes) must not leak
         # into the differently-shaped backward.
-        return _dc.replace(
+        return dataclasses.replace(
             ctx, collective_id=bwd_id,
             gemm_method=(ctx.gemm_method if ctx.gemm_method == "xla"
                          else "auto"))
     if isinstance(ctx, TorusContext):
-        return _dc.replace(
-            ctx, collective_id=bwd_id,
-            method=ctx.method if ctx.method == "xla" else "auto")
+        # TorusContext.method picks the TOPOLOGY schedule (torus vs
+        # xla), not a shape-tuned GEMM method: a forced choice stays
+        # valid for the backward's shapes, so preserve it — a
+        # downgrade here would silently drop the fused torus backward
+        # whenever the perf model ruled against the small shapes.
+        return dataclasses.replace(ctx, collective_id=bwd_id)
     return target_cls(
         axis=ctx.axis, world_size=ctx.world_size, gemm=ctx.gemm,
         method=ctx.method if ctx.method == "xla" else "auto",

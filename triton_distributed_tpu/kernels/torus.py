@@ -644,3 +644,31 @@ def gemm_rs_torus(a, b, ctx: TorusContext):
             scatter_dimension=0, tiled=False).astype(a.dtype)
     partial = matmul(a, b, config=ctx.gemm, interpret=ctx.interpret)
     return reduce_scatter_torus(partial, ctx)
+
+
+def all_reduce_torus(x, ctx: TorusContext):
+    """Sum per-device partials over BOTH torus axes: the canonical
+    RS -> AG composition, each stage the 4-lane torus schedule — all
+    four ICI links busy through both phases (completes the torus
+    method family alongside AG and RS).
+
+    Input (inside shard_map over both axes): (m, n) partials; output:
+    the full reduced (m, n), replicated.
+    """
+    world = ctx.world_size
+    if world <= 1:
+        return x
+    if ctx.resolve_method(x.size * x.dtype.itemsize // world) == "xla":
+        return jax.lax.psum(x, ctx.axes)
+    m, n = x.shape
+    pad = (-m) % world
+    xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+    # Distinct id for the second kernel: RS and AG run sequentially in
+    # one program (same convention as allreduce.py's RING compose).
+    ag_ctx = dataclasses.replace(
+        ctx, collective_id=(cids.ALLREDUCE_RING_AG
+                            if ctx.collective_id == cids.ALLGATHER
+                            else ctx.collective_id))
+    chunk = reduce_scatter_torus(xp, ctx)          # (mp / world, n)
+    full = all_gather_torus(chunk, ag_ctx)         # (mp, n)
+    return full[:m] if pad else full
