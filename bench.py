@@ -213,6 +213,79 @@ def _regime_decode_ll(mesh, world, m=16):
     return t_ll, ratio, f"M={m} ll path{tie}"
 
 
+def _regime_flash_decode(mesh, world, s=8192):
+    """Serving decode attention: our flash_decode kernel vs its
+    STRONGEST available baselines — JAX's public Pallas
+    paged-attention decode kernel and the dense XLA GQA decode —
+    taking the per-repeat MIN of the two as the denominator.  Unlike
+    decode_ll this regime has a real numerator at world=1 (the kernel
+    either beats the strongest public decode kernel or it doesn't), so
+    it carries signal in the min-headline (VERDICT r3 next #5)."""
+    import statistics
+
+    from jax.experimental.pallas.ops.tpu.paged_attention import (
+        paged_attention)
+
+    from triton_distributed_tpu.kernels.flash_decode import flash_decode
+    from triton_distributed_tpu.utils.benchmarking import (
+        feedback_mix,
+        measure_ops_scanned,
+    )
+
+    b, h, hkv, d = 8, 32, 8, 128
+    q = (jax.random.normal(jax.random.key(6), (b, h, d)) / 4
+         ).astype(jnp.bfloat16)
+    kc = (jax.random.normal(jax.random.key(7), (b, hkv, s, d)) / 4
+          ).astype(jnp.bfloat16)
+    vc = (jax.random.normal(jax.random.key(8), (b, hkv, s, d)) / 4
+          ).astype(jnp.bfloat16)
+    kv_len = jnp.full((b,), s, jnp.int32)
+
+    page_size = 256
+    pages_per_seq = s // page_size
+    k_pages = kc.transpose(1, 0, 2, 3).reshape(
+        hkv, b * pages_per_seq, page_size, d)
+    v_pages = vc.transpose(1, 0, 2, 3).reshape(
+        hkv, b * pages_per_seq, page_size, d)
+    page_indices = jnp.arange(b * pages_per_seq, dtype=jnp.int32
+                              ).reshape(b, pages_per_seq)
+    scale = d ** -0.5
+
+    def ours(q_, kc_, vc_, kv_len_, *_):
+        return flash_decode(q_, kc_, vc_, kv_len_)[0]
+
+    def paged(q_, kc_, vc_, kv_len_, k_pages_, v_pages_, pidx_):
+        return paged_attention(q_ * scale, k_pages_, v_pages_,
+                               kv_len_, pidx_,
+                               pages_per_compute_block=4)
+
+    def xla_decode(q_, kc_, vc_, kv_len_, *_):
+        g = h // hkv
+        qg = q_.reshape(b, hkv, g, d).astype(jnp.float32)
+        sc = jnp.einsum("bkgd,bksd->bkgs", qg,
+                        kc_.astype(jnp.float32)) * scale
+        mask = jnp.arange(s)[None, :] < kv_len_[:, None]
+        sc = jnp.where(mask[:, None, None, :], sc, -1e30)
+        p = jax.nn.softmax(sc, axis=-1)
+        out = jnp.einsum("bkgs,bksd->bkgd", p, vc_.astype(jnp.float32))
+        return out.reshape(b, h, d).astype(q_.dtype)
+
+    mix = lambda args, out: (feedback_mix(args[0], out),) + args[1:]
+    # ABBA: ours brackets the baselines within each repeat so drift
+    # cancels in the per-repeat pairing.
+    _, slopes = measure_ops_scanned(
+        [ours, paged, xla_decode, ours],
+        (q, kc, vc, kv_len, k_pages, v_pages, page_indices), mix,
+        n_inner=16, repeats=8, return_slopes=True)
+    pair_ratios = [min(tp, tx) / ((o1 + o2) / 2)
+                   for o1, tp, tx, o2 in zip(*slopes)]
+    ratio = statistics.median(pair_ratios)
+    t_ours = statistics.median(slopes[0] + slopes[3])
+    kv_gbps = 2 * b * hkv * s * d * 2 / t_ours / 1e9
+    return (t_ours, ratio,
+            f"S={s} vs min(paged, xla) ({kv_gbps:.0f} GB/s KV)")
+
+
 def _regime_w8a8(mesh, world):
     """Quantized inference (beyond-reference capability): int8 fused
     AG-GEMM vs the bf16 XLA composition a user would otherwise run."""
@@ -252,18 +325,25 @@ def main():
     world = len(devices)
     mesh = Mesh(np.array(devices), ("tp",))
 
-    # Three regimes (VERDICT r2 #8): the headline is the MINIMUM
-    # vs_baseline across them, so a lucky draw in one regime can't
-    # carry the round.
+    # Headline = MINIMUM vs_baseline across the SIGNAL regimes, so a
+    # lucky draw in one regime can't carry the round.  decode_ll ties
+    # by construction at world=1 (VERDICT r3 weak #3): it is reported
+    # as the harness noise bound but does NOT gate the min — every
+    # regime in the min has a real numerator (prefill vs XLA overlap
+    # composition, flash_decode vs the strongest public decode
+    # kernels, w8a8 vs the bf16 composition).
     regimes = {
         "prefill_fused": _regime_prefill(mesh, world),
-        "decode_ll": _regime_decode_ll(mesh, world),
+        "flash_decode": _regime_flash_decode(mesh, world),
         "w8a8": _regime_w8a8(mesh, world),
     }
+    noise_bound = _regime_decode_ll(mesh, world)
     worst = min(regimes, key=lambda r: regimes[r][1])
     t_worst, r_worst, _ = regimes[worst]
     detail = "; ".join(f"{name}={r:.3f} ({d})"
                        for name, (t, r, d) in regimes.items())
+    detail += (f"; noise_bound:decode_ll={noise_bound[1]:.3f} "
+               f"({noise_bound[2]})")
     print(json.dumps({
         "metric": f"min vs_baseline over regimes [{detail}] "
                   f"(M={M_TOTAL} K={K} N={N_TOTAL}, "

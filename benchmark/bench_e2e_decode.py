@@ -34,9 +34,14 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--prefill", type=int, default=128)
+    # The slope denominator (g2 - g1) sets the noise floor: each
+    # sample pays two tunnel fetches whose jitter is fixed, so the
+    # per-step slope error scales as jitter / (g2 - g1).  The round-3
+    # ratio_range of [0.475, 1.769] came from a 128-step denominator;
+    # 480 steps cuts the same jitter to ~±8% (VERDICT r3 next #6).
     ap.add_argument("--g1", type=int, default=32)
-    ap.add_argument("--g2", type=int, default=160)
-    ap.add_argument("--repeats", type=int, default=4)
+    ap.add_argument("--g2", type=int, default=512)
+    ap.add_argument("--repeats", type=int, default=6)
     ap.add_argument("--layers", type=int, default=0,
                     help="override layer count (0 = config default)")
     args = ap.parse_args()
@@ -101,17 +106,24 @@ def main():
                                                slopes["fused"])
                          if x is not None and f is not None)
     pair_ratios = pair_ratios or [float("nan")]
+    world = len(devices)
     for mode in ("fused", "xla"):
         per_step = results[mode]
         print(json.dumps({
             "bench": "e2e_decode", "mode": mode, "B": b,
             "layers": cfg.num_layers,
+            "gen_span": [args.g1, args.g2],
             "ms_per_step": round(per_step * 1e3, 3),
             "tokens_per_s": round(b / per_step, 1),
             **({"vs_baseline":
-                round(results["xla"] / results["fused"], 3),
+                round(statistics.median(pair_ratios), 3),
                 "ratio_range": [round(pair_ratios[0], 3),
-                                round(pair_ratios[-1], 3)]}
+                                round(pair_ratios[-1], 3)],
+                # At world=1 the two modes' decode graphs are
+                # HLO-equivalent: the ratio bounds harness noise and
+                # is NOT overlap-speedup evidence (that exists only at
+                # world > 1).
+                "degenerate_world1_tie": world <= 1}
                if mode == "xla" else {}),
         }), flush=True)
 

@@ -24,7 +24,9 @@
 
 #include "tdt_aot_runtime.h"
 
-#define MAX_IO 16
+/* Large enough for a whole flat model signature (tokens + parameter
+ * leaves + KV-cache leaves of the decode-step bundle). */
+#define MAX_IO 96
 #define MAX_OPTS 32
 
 static void *read_file(const char *path, size_t expect) {
@@ -220,6 +222,76 @@ int main(int argc, char **argv) {
   double rel = max_err / max_ref;
   int ok = rel < 5e-2;
   printf("AOT_NATIVE_%s maxrelerr=%g\n", ok ? "OK" : "FAIL", rel);
+
+  /* Optional SERVING LOOP (the deployment story the reference's AOT
+   * exists for — csrc/op_pybind.cc:25 in a C++ server): with
+   * <bundle>/test_loop.txt present ("n_steps" then one target arg
+   * index per output, -1 = not fed back), outputs are wired back to
+   * their argument slots (next tokens -> tokens, new KV cache -> KV
+   * cache) and the compiled step re-executes n_steps more times with
+   * NO Python and NO recompilation.  Final outputs are compared
+   * against test_loop_out<i>.bin when shipped. */
+  snprintf(path, sizeof(path), "%s/test_loop.txt", bundle_dir);
+  FILE *lf = fopen(path, "r");
+  if (ok && lf) {
+    int steps = 0, tgt[MAX_IO];
+    if (fscanf(lf, "%d", &steps) != 1) steps = 0;
+    for (int i = 0; i < nouts; i++)
+      if (fscanf(lf, "%d", &tgt[i]) != 1) tgt[i] = -1;
+    fclose(lf);
+
+    void *outs2[MAX_IO] = {0};
+    for (int i = 0; i < nouts; i++) {
+      const tdt_sig *s = tdt_bundle_out_sig(bundle, variant, i);
+      outs2[i] = malloc(tdt_sig_bytes(s));
+    }
+    void **cur = outs, **nxt = outs2;
+    for (int t = 0; t < steps; t++) {
+      for (int i = 0; i < nouts; i++)
+        if (tgt[i] >= 0 && tgt[i] < nargs) args[tgt[i]] = cur[i];
+      rc = tdt_compiled_execute(exe, (const void **)args, nxt);
+      if (rc != TDT_OK) {
+        fprintf(stderr, "loop step %d: %s: %s\n", t, tdt_status_str(rc),
+                tdt_last_error());
+        return 1;
+      }
+      void **tmp = cur;
+      cur = nxt;
+      nxt = tmp;
+    }
+
+    double lerr = 0.0, lref = 1e-9;
+    int compared = 0;
+    for (int i = 0; i < nouts; i++) {
+      const tdt_sig *s = tdt_bundle_out_sig(bundle, variant, i);
+      snprintf(path, sizeof(path), "%s/test_loop_out%d.bin", bundle_dir,
+               i);
+      FILE *probe = fopen(path, "rb");
+      if (!probe) continue;
+      fclose(probe);
+      void *expl = read_file(path, tdt_sig_bytes(s));
+      if (!expl) return 1;
+      size_t item = s->dtype == TDT_BF16 ? 2 : 4;
+      size_t n = tdt_sig_bytes(s) / item;
+      for (size_t j = 0; j < n; j++) {
+        double got = as_float((unsigned char *)cur[i], s->dtype, j);
+        double ref = as_float((unsigned char *)expl, s->dtype, j);
+        double err = fabs(got - ref);
+        if (err > lerr) lerr = err;
+        if (fabs(ref) > lref) lref = fabs(ref);
+      }
+      free(expl);
+      compared++;
+      fprintf(stderr, "loop out%d tgt=%d err_so_far=%g\n", i, tgt[i],
+              lerr);
+    }
+    double lrel = lerr / lref;
+    ok = compared == 0 || lrel < 5e-2;
+    printf("LOOP_%s steps=%d compared=%d maxrelerr=%g\n",
+           ok ? "OK" : "FAIL", steps, compared, lrel);
+  } else if (lf) {
+    fclose(lf);
+  }
 
   tdt_compiled_free(exe);
   tdt_client_destroy(client);

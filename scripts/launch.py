@@ -54,6 +54,17 @@ def main() -> int:
         procs.append(subprocess.Popen(
             [sys.executable, args.script, *args.script_args], env=env))
 
+    def _kill_group(sig=signal.SIGTERM):
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(sig)
+
+    # A SIGTERM to the launcher (e.g. a test-harness timeout killing
+    # us) must not ORPHAN the group: stranded workers keep ports and
+    # CPU, deadlocking every later launch on the machine.
+    signal.signal(signal.SIGTERM,
+                  lambda *a: (_kill_group(), sys.exit(143)))
+
     rc = 0
     try:
         # First failure kills the group (a hung peer would otherwise
@@ -72,9 +83,18 @@ def main() -> int:
         for p in pending.values():
             p.wait()
     except KeyboardInterrupt:
-        for p in procs:
-            p.send_signal(signal.SIGINT)
+        # Give the workers a grace period to run their own SIGINT
+        # cleanup (finalize_distributed, port release) before the
+        # finally-block's SIGTERM backstop fires.
+        _kill_group(signal.SIGINT)
+        deadline = 20
+        while deadline and any(p.poll() is None for p in procs):
+            import time
+            time.sleep(0.25)
+            deadline -= 1
         rc = 130
+    finally:
+        _kill_group()
     return rc
 
 

@@ -158,6 +158,40 @@ def test_flash_backward_fully_masked_rows():
         assert bool(jnp.all(jnp.isfinite(g))), name
 
 
+def test_flash_backward_masked_rows_no_leak():
+    """ADVICE r3 (flash_attention.py bwd): a DIRECT
+    flash_attention_diff call with a negative kv_offset and a NONZERO
+    upstream cotangent on the fully-masked rows must not leak gradient
+    through those rows (the clamp-only backward gave them p ~ 1).  The
+    contract: masked rows contribute nothing, so the grads must equal
+    those of the same loss with the masked rows' cotangent zeroed."""
+    from triton_distributed_tpu.kernels.flash_attention import (
+        flash_attention_diff)
+
+    b, h, s, d, off = 1, 2, 128, 32, -64
+    keys = jax.random.split(jax.random.key(13), 4)
+    q = jax.random.normal(keys[0], (b, h, s, d), jnp.float32)
+    k = jax.random.normal(keys[1], (b, h, s, d), jnp.float32)
+    v = jax.random.normal(keys[2], (b, h, s, d), jnp.float32)
+    w = jax.random.normal(keys[3], (b, h, s, d), jnp.float32)
+
+    def loss_flash(q, k, v):
+        out = flash_attention_diff(q, k, v, off, causal=True,
+                                   block_q=64, block_k=64)
+        return jnp.sum(out * w)          # w nonzero on MASKED rows too
+
+    def loss_ref(q, k, v):
+        out = attention_reference(q, k, v, causal=True, kv_offset=off)
+        return jnp.sum(out[:, :, -off:] * w[:, :, -off:])
+
+    g_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for got, ref, name in zip(g_flash, g_ref, ("dq", "dk", "dv")):
+        assert bool(jnp.all(jnp.isfinite(got))), name
+        assert_allclose(got, ref, atol=2e-2, rtol=2e-2,
+                        name=f"{name} masked-rows-no-leak")
+
+
 def test_ring_attention_differentiable(sp4_mesh):
     """sp_ring_attention built on flash_attention_diff chunks must
     autodiff end-to-end and match the dense reference's gradients —

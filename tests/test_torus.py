@@ -106,7 +106,7 @@ def test_torus_auto_crossover():
     assert ctx.resolve_method(1024) == "xla"           # 1 KB: latency
     assert ctx.resolve_method(64 << 20) == "torus"     # 64 MB: bandwidth
 
-    t_torus = estimate_torus_ag_time_us(64 << 20, 4, 4,
+    t_torus = estimate_torus_ag_time_us(64 << 20, (4, 4),
                                         closed_ring=True)
     t_ring = estimate_all_gather_time_us(64 << 20, 16,
                                          closed_ring=True)
@@ -273,3 +273,37 @@ def test_all_reduce_torus(torus_mesh, m):
     out = jax.jit(fn)(x)
     assert_allclose(out, x.sum(axis=0), atol=1e-4, rtol=1e-4,
                     name="ar_torus")
+
+
+def test_paired_ag_id_distinct():
+    """ADVICE r3 (torus.py all_reduce): the AllReduce AG stage must get
+    a DISTINCT collective id for ANY user-supplied RS id, not only the
+    default — RS and AG run sequentially in one program."""
+    from triton_distributed_tpu import collective_ids as cids
+    from triton_distributed_tpu.kernels.torus import _paired_ag_id
+
+    assert _paired_ag_id(cids.ALLGATHER) == cids.ALLREDUCE_RING_AG
+    user = cids.allocate()
+    ag = _paired_ag_id(user)
+    assert ag != user
+    assert ag == _paired_ag_id(user)          # stable across traces
+    assert ag not in cids.builtin_ids().values()
+
+
+def test_all_reduce_torus_user_id(torus_mesh):
+    """all_reduce_torus with a user-allocated collective id must still
+    be correct (the AG stage derives its own paired id)."""
+    from triton_distributed_tpu import collective_ids as cids
+    from triton_distributed_tpu.kernels.torus import all_reduce_torus
+
+    m, n = 16, 128
+    x = jax.random.normal(jax.random.key(7), (WORLD, m, n), jnp.float32)
+    uid = cids.allocate()
+    fn = shard_map_op(
+        lambda xx: all_reduce_torus(
+            xx[0], _ctx(torus_mesh, collective_id=uid)),
+        torus_mesh,
+        in_specs=P(("x", "y"), None, None), out_specs=P(None, None))
+    out = jax.jit(fn)(x.reshape(WORLD, m, n))
+    assert_allclose(out, x.sum(0), atol=1e-4, rtol=1e-4,
+                    name="ar_torus_user_id")

@@ -1,0 +1,342 @@
+"""Compile-only topology validation of every MULTI-DEVICE kernel
+family (VERDICT r3 next #3 / missing #2).
+
+The CPU interpret harness proves schedules correct; the single
+attached chip degenerates multi-device kernels to their single-axis
+or world=1 paths before `pallas_call` — so until now the torus /
+2-level / fused-ring / EP / SP kernels had NEVER been Mosaic-compiled
+at a multi-chip world.  PJRT supports compile-for-topology: build an
+abstract v5e-8 `TopologyDescription`, jit each kernel over a mesh of
+its abstract devices and `.lower().compile()` — full Mosaic lowering
+and TPU codegen at world=8, no execution, no extra chips.  A Mosaic
+error (tiling, semaphore misuse, DMA shape) fails the test exactly as
+it would on a real pod.
+
+Reference analogue: every multi-rank test compiles the real kernel on
+devices under torchrun (SURVEY.md §4); this is the TPU-available
+equivalent evidence.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.kernels.matmul import MatmulConfig
+
+
+WORLD = 8
+
+
+@functools.lru_cache(maxsize=None)
+def _topo_devices():
+    from jax.experimental import topologies
+    return tuple(topologies.get_topology_desc("v5e:2x4", "tpu").devices)
+
+
+def _mesh(shape, axes):
+    return Mesh(np.array(_topo_devices()).reshape(shape), axes)
+
+
+def _compile(fn, mesh, in_specs, out_specs, arg_shapes, dtypes):
+    """jit(shard_map(fn)) over the abstract mesh and compile for the
+    topology — Mosaic runs for real; nothing executes."""
+    jitted = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, check_vma=False))
+    if not isinstance(dtypes, (list, tuple)):
+        dtypes = [dtypes] * len(arg_shapes)
+    flat_specs = in_specs if isinstance(in_specs, tuple) else (in_specs,)
+    args = [jax.ShapeDtypeStruct(s, d, sharding=NamedSharding(mesh, sp))
+            for s, d, sp in zip(arg_shapes, dtypes, flat_specs)]
+    compiled = jitted.lower(*args).compile()
+    assert compiled is not None
+    return compiled
+
+
+# ---------------------------------------------------------------------------
+# Base collectives at world=8
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("method", ["ring", "push_all", "bidir_ring"])
+def test_topo_allgather(method):
+    from triton_distributed_tpu.kernels.allgather import (
+        AllGatherContext, AllGatherMethod, all_gather)
+
+    ctx = AllGatherContext(axis="tp", world_size=WORLD,
+                           method=AllGatherMethod(method))
+    _compile(functools.partial(all_gather, ctx=ctx), _mesh((8,), ("tp",)),
+             P("tp", None), P(None, None),
+             [(WORLD * 16, 256)], jnp.bfloat16)
+
+
+@pytest.mark.parametrize("method", ["ring", "scatter_reduce"])
+def test_topo_reduce_scatter(method):
+    from triton_distributed_tpu.kernels.reduce_scatter import (
+        ReduceScatterContext, ReduceScatterMethod, reduce_scatter)
+
+    ctx = ReduceScatterContext(axis="tp", world_size=WORLD,
+                               method=ReduceScatterMethod(method))
+    _compile(functools.partial(reduce_scatter, ctx=ctx),
+             _mesh((8,), ("tp",)),
+             P("tp", None), P("tp", None),
+             [(WORLD * 16, 256)], jnp.float32)
+
+
+@pytest.mark.parametrize("method",
+                         ["one_shot", "two_shot", "ring", "chain"])
+def test_topo_allreduce(method):
+    from triton_distributed_tpu.kernels.allreduce import (
+        AllReduceContext, AllReduceMethod, all_reduce)
+
+    ctx = AllReduceContext(axis="tp", world_size=WORLD,
+                           method=AllReduceMethod(method))
+    _compile(functools.partial(all_reduce, ctx=ctx), _mesh((8,), ("tp",)),
+             P("tp", None), P("tp", None),
+             [(128, 256)], jnp.float32)
+
+
+def test_topo_fast_allgather():
+    from triton_distributed_tpu.kernels.low_latency_allgather import (
+        create_fast_allgather_context, fast_allgather)
+
+    ctx = create_fast_allgather_context("tp", WORLD)
+    _compile(functools.partial(fast_allgather, ctx=ctx),
+             _mesh((8,), ("tp",)),
+             P("tp", None), P(None, None),
+             [(WORLD * 8, 128)], jnp.bfloat16)
+
+
+# ---------------------------------------------------------------------------
+# Fused-ring overlap GEMMs at world=8
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("method", ["fused", "ll"])
+@pytest.mark.parametrize("k", [256, 192])   # 192: lane-unaligned K
+def test_topo_ag_gemm(method, k):
+    from triton_distributed_tpu.kernels.allgather_gemm import (
+        AllGatherGEMMContext, ag_gemm)
+
+    ctx = AllGatherGEMMContext(axis="tp", world_size=WORLD,
+                               method=method,
+                               gemm=MatmulConfig(128, 128, 128))
+    _compile(lambda a, b: ag_gemm(a, b, ctx), _mesh((8,), ("tp",)),
+             (P("tp", None), P(None, "tp")), P(None, "tp"),
+             [(WORLD * 128, k), (k, WORLD * 128)], jnp.bfloat16)
+
+
+@pytest.mark.parametrize("method", ["fused", "ll"])
+@pytest.mark.parametrize("k_loc", [128, 64])   # 64: lane-unaligned K
+def test_topo_gemm_rs(method, k_loc):
+    from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
+        GEMMReduceScatterContext, gemm_rs)
+
+    ctx = GEMMReduceScatterContext(axis="tp", world_size=WORLD,
+                                   method=method,
+                                   gemm=MatmulConfig(128, 128, 128))
+    _compile(lambda a, b: gemm_rs(a, b, ctx), _mesh((8,), ("tp",)),
+             (P(None, "tp"), P("tp", None)), P("tp", None),
+             [(WORLD * 128, WORLD * k_loc), (WORLD * k_loc, 256)],
+             jnp.bfloat16)
+
+
+# ---------------------------------------------------------------------------
+# Torus schedules: 2-axis (2, 4) and 3-axis (2, 2, 2)
+# ---------------------------------------------------------------------------
+
+def _torus_ctx(sizes, axes):
+    from triton_distributed_tpu.kernels.torus import TorusContext
+    return TorusContext(axes=axes, sizes=sizes, method="torus",
+                        gemm=MatmulConfig(128, 128, 128))
+
+
+@pytest.mark.parametrize("shape,axes", [
+    ((2, 4), ("x", "y")),
+    ((2, 2, 2), ("x", "y", "z")),
+])
+def test_topo_torus_allgather(shape, axes):
+    from triton_distributed_tpu.kernels.torus import all_gather_torus
+
+    ctx = _torus_ctx(shape, axes)
+    _compile(lambda x: all_gather_torus(x, ctx), _mesh(shape, axes),
+             P(axes, None), P(None, None),
+             [(WORLD * 48, 256)], jnp.bfloat16)
+
+
+@pytest.mark.parametrize("shape,axes", [
+    ((2, 4), ("x", "y")),
+    ((2, 2, 2), ("x", "y", "z")),
+])
+def test_topo_torus_reduce_scatter(shape, axes):
+    from triton_distributed_tpu.kernels.torus import reduce_scatter_torus
+
+    ctx = _torus_ctx(shape, axes)
+    _compile(lambda x: reduce_scatter_torus(x[0], ctx),
+             _mesh(shape, axes),
+             P(axes, None, None), P(axes, None),
+             [(WORLD, WORLD * 48, 256)], jnp.float32)
+
+
+@pytest.mark.parametrize("shape,axes", [
+    ((2, 4), ("x", "y")),
+    ((2, 2, 2), ("x", "y", "z")),
+])
+def test_topo_torus_ag_gemm(shape, axes):
+    from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm
+
+    ctx = _torus_ctx(shape, axes)
+    _compile(lambda a, b: ag_gemm(a, b, ctx), _mesh(shape, axes),
+             (P(axes, None), P(None, axes)), P(None, axes),
+             [(WORLD * 96, 256), (256, WORLD * 128)], jnp.bfloat16)
+
+
+def test_topo_torus_gemm_rs():
+    from triton_distributed_tpu.kernels.gemm_reduce_scatter import gemm_rs
+
+    axes = ("x", "y")
+    ctx = _torus_ctx((2, 4), axes)
+    _compile(lambda a, b: gemm_rs(a, b, ctx), _mesh((2, 4), axes),
+             (P(None, axes), P(axes, None)), P(axes, None),
+             [(WORLD * 96, WORLD * 64), (WORLD * 64, 256)], jnp.bfloat16)
+
+
+# ---------------------------------------------------------------------------
+# Two-level (dcn × ici) paths on the (2, 4) mesh
+# ---------------------------------------------------------------------------
+
+def _hctx(**kw):
+    from triton_distributed_tpu.kernels.hierarchical import (
+        HierarchicalContext)
+    return HierarchicalContext(dcn_axis="dcn", ici_axis="ici",
+                               dcn_size=2, ici_size=4,
+                               gemm=MatmulConfig(128, 128, 128), **kw)
+
+
+def test_topo_hierarchical_ag_gemm():
+    from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm
+
+    both = ("dcn", "ici")
+    _compile(lambda a, b: ag_gemm(a, b, _hctx()),
+             _mesh((2, 4), both),
+             (P(both, None), P(None, both)), P(None, both),
+             [(WORLD * 128, 256), (256, WORLD * 128)], jnp.bfloat16)
+
+
+def test_topo_hierarchical_gemm_rs():
+    from triton_distributed_tpu.kernels.gemm_reduce_scatter import gemm_rs
+
+    both = ("dcn", "ici")
+    _compile(lambda a, b: gemm_rs(a, b, _hctx()),
+             _mesh((2, 4), both),
+             (P(None, both), P(both, None)), P(both, None),
+             [(WORLD * 128, WORLD * 64), (WORLD * 64, 256)],
+             jnp.bfloat16)
+
+
+def test_topo_hierarchical_all_to_all():
+    from triton_distributed_tpu.kernels.hierarchical import (
+        hierarchical_all_to_all)
+
+    both = ("dcn", "ici")
+    cap, hidden = 8, 128
+    _compile(lambda s, c: hierarchical_all_to_all(s[0], c[0], _hctx()),
+             _mesh((2, 4), both),
+             (P(both, None, None, None), P(both, None, None)),
+             (P(both, None, None), P(both, None)),
+             [(WORLD, WORLD, cap, hidden), (WORLD, WORLD, 1)],
+             [jnp.bfloat16, jnp.int32])
+
+
+# ---------------------------------------------------------------------------
+# EP / MoE at world=8
+# ---------------------------------------------------------------------------
+
+def test_topo_ep_all_to_all():
+    from triton_distributed_tpu.kernels.low_latency_all_to_all import (
+        AllToAllContext, fast_all_to_all)
+
+    cap, hidden = 8, 128
+    ctx = AllToAllContext(axis="ep", world_size=WORLD,
+                          max_tokens_per_rank=cap, hidden=hidden)
+    _compile(lambda s, c: fast_all_to_all(s[0], c[0], ctx),
+             _mesh((8,), ("ep",)),
+             (P("ep", None, None, None), P("ep", None, None)),
+             (P("ep", None, None), P("ep", None)),
+             [(WORLD, WORLD, cap, hidden), (WORLD, WORLD, 1)],
+             [jnp.bfloat16, jnp.int32])
+
+
+def test_topo_ag_group_gemm():
+    from triton_distributed_tpu.kernels.allgather_group_gemm import (
+        AGGroupGEMMContext, ag_group_gemm)
+
+    e, cap, k, n = 4, 128, 256, 128
+    ctx = AGGroupGEMMContext(axis="tp", world_size=WORLD, num_experts=e,
+                             gemm=MatmulConfig(128, 128, 128))
+    _compile(lambda bb, ww, cc: ag_group_gemm(bb, ww, ctx, counts=cc),
+             _mesh((8,), ("tp",)),
+             (P("tp", None, None), P(None, None, "tp"), P(None, None)),
+             P(None, None, None, "tp"),
+             [(WORLD * e, cap, k), (e, k, WORLD * n), (WORLD, e)],
+             [jnp.bfloat16, jnp.bfloat16, jnp.int32])
+
+
+def test_topo_moe_reduce_rs_fused():
+    from triton_distributed_tpu.kernels.moe_reduce_rs import (
+        MoEReduceRSContext, moe_reduce_rs_fused)
+
+    e, cap, mc, k, n = 4, 128, 128, 64, 128
+    ctx = MoEReduceRSContext(axis="tp", world_size=WORLD, num_experts=e,
+                             topk=2, gemm=MatmulConfig(128, 128, 64))
+    _compile(functools.partial(moe_reduce_rs_fused, ctx=ctx),
+             _mesh((8,), ("tp",)),
+             (P(None, None, None, "tp"), P(None, "tp", None),
+              P(None, None, None, None)),
+             P("tp", None),
+             [(WORLD, e, cap, WORLD * k), (e, WORLD * k, n),
+              (WORLD, e, mc, cap)], jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# SP / long-context at world=8
+# ---------------------------------------------------------------------------
+
+def test_topo_sp_ag_attention_fused():
+    from triton_distributed_tpu.kernels.sp_ag_attention import (
+        sp_ag_attention_fused)
+
+    b, h, s_loc, d = 1, 2, 128, 128
+    _compile(functools.partial(sp_ag_attention_fused, axis="sp",
+                               block_q=128, block_k=128),
+             _mesh((8,), ("sp",)),
+             (P(None, None, "sp", None),) * 3, P(None, None, "sp", None),
+             [(b, h, WORLD * s_loc, d)] * 3, jnp.bfloat16)
+
+
+def test_topo_sp_ring_attention():
+    from triton_distributed_tpu.kernels.sp_ag_attention import (
+        sp_ring_attention)
+
+    b, h, s_loc, d = 1, 2, 128, 128
+    _compile(functools.partial(sp_ring_attention, axis="sp",
+                               block_q=128, block_k=128),
+             _mesh((8,), ("sp",)),
+             (P(None, None, "sp", None),) * 3, P(None, None, "sp", None),
+             [(b, h, WORLD * s_loc, d)] * 3, jnp.bfloat16)
+
+
+def test_topo_sp_flash_decode():
+    from triton_distributed_tpu.kernels.flash_decode import sp_flash_decode
+
+    b, h, s_loc, d = 1, 4, 128, 128
+    _compile(lambda qq, kk, vv, ll: sp_flash_decode(
+                 qq, kk, vv, ll[0], axis="sp", block_k=128),
+             _mesh((8,), ("sp",)),
+             (P(None, None, None), P(None, None, "sp", None),
+              P(None, None, "sp", None), P("sp", None)),
+             P(None, None, None),
+             [(b, h, d), (b, h, WORLD * s_loc, d),
+              (b, h, WORLD * s_loc, d), (WORLD, b)],
+             [jnp.bfloat16, jnp.bfloat16, jnp.bfloat16, jnp.int32])

@@ -240,7 +240,12 @@ class ContextualAutotuner:
         cfg = self.configs[idx]
         if hit is not None and repr(hit.config) == reprs[idx]:
             return hit  # local entry agrees: keep its timing/ranking
-        return _Entry(cfg, 0.0, [(0.0, cfg)])
+        # Adopted without a local measurement: NaN timing + empty
+        # ranking, so consumers of time_s/ranking (finalist
+        # re-examination by margin) can't mistake a fabricated 0.0 for
+        # a real result.  Never persisted: __call__ only writes disk
+        # entries on the re-tune path.
+        return _Entry(cfg, float("nan"), [])
 
     def __call__(self, *args, **kwargs):
         key = self.key_fn(*args, **kwargs)

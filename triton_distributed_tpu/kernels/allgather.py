@@ -64,6 +64,11 @@ class AllGatherContext:
     method: AllGatherMethod = AllGatherMethod.AUTO
     collective_id: int = cids.ALLGATHER
     interpret: Optional[bool] = None
+    #: Fault injection (reference `_run_straggler`,
+    #: `stress_test_ag_gemm.py:119-121`): (rank, cycles) delays that
+    #: rank at kernel entry; `for_correctness` staggers every rank.
+    straggler: Optional[tuple] = None
+    for_correctness: bool = False
 
     def resolve_method(self, nbytes_per_shard: int) -> AllGatherMethod:
         """Auto-select like `get_auto_all_gather_method`
@@ -91,11 +96,13 @@ def create_allgather_context(axis: str, world_size: int,
 # Ring all-gather (bandwidth optimal)
 # ---------------------------------------------------------------------------
 
-def _ring_ag_kernel(axis, world, x_ref, o_ref, local_sem, send_sem,
-                    recv_sems):
+def _ring_ag_kernel(axis, world, straggler, fc, x_ref, o_ref, local_sem,
+                    send_sem, recv_sems):
     my = jax.lax.axis_index(axis)
     right = jax.lax.rem(my + 1, world)
 
+    dl.maybe_straggle(axis, straggler)
+    dl.correctness_delay(axis, fc)
     # Entry barrier: the left neighbor must not put into our o_ref
     # while we are still in the previous program (ADVICE r1).
     dl.entry_barrier(axis, world, neighbors_only=True)
@@ -173,8 +180,10 @@ def emit_push_allgather(axis, world, x_ref, o_ref, local_sem, send_sem,
     jax.lax.fori_loop(1, world, drain, 0, unroll=True)
 
 
-def _push_all_ag_kernel(axis, world, x_ref, o_ref, local_sem, send_sem,
-                        recv_sems):
+def _push_all_ag_kernel(axis, world, straggler, fc, x_ref, o_ref,
+                        local_sem, send_sem, recv_sems):
+    dl.maybe_straggle(axis, straggler)
+    dl.correctness_delay(axis, fc)
     emit_push_allgather(axis, world, x_ref, o_ref, local_sem, send_sem,
                         recv_sems)
 
@@ -183,14 +192,16 @@ def _push_all_ag_kernel(axis, world, x_ref, o_ref, local_sem, send_sem,
 # Bidirectional ring (two half-width rings in opposite directions)
 # ---------------------------------------------------------------------------
 
-def _bidir_ring_ag_kernel(axis, world, x_ref, o_ref, local_sem, send_sems,
-                          recv_sems):
+def _bidir_ring_ag_kernel(axis, world, straggler, fc, x_ref, o_ref,
+                          local_sem, send_sems, recv_sems):
     # o_ref shape: (world, 2, half_rows, cols); halves travel opposite
     # directions. recv_sems shape (world, 2).
     my = jax.lax.axis_index(axis)
     right = jax.lax.rem(my + 1, world)
     left = jax.lax.rem(my - 1 + world, world)
 
+    dl.maybe_straggle(axis, straggler)
+    dl.correctness_delay(axis, fc)
     dl.entry_barrier(axis, world, neighbors_only=True)
     dl.local_copy(x_ref, o_ref.at[my], local_sem)
 
@@ -249,7 +260,8 @@ def all_gather(x, ctx: AllGatherContext):
     if method == AllGatherMethod.BIDIR_RING and m % 2 == 0 and world > 2:
         xr = x.reshape(2, m // 2, n)
         out = pl.pallas_call(
-            functools.partial(_bidir_ring_ag_kernel, ctx.axis, world),
+            functools.partial(_bidir_ring_ag_kernel, ctx.axis, world,
+                              ctx.straggler, ctx.for_correctness),
             out_shape=jax.ShapeDtypeStruct((world, 2, m // 2, n), x.dtype),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=pl.BlockSpec(memory_space=pl.ANY),
@@ -266,7 +278,8 @@ def all_gather(x, ctx: AllGatherContext):
     kernel = (_push_all_ag_kernel if method == AllGatherMethod.PUSH_ALL
               else _ring_ag_kernel)
     out = pl.pallas_call(
-        functools.partial(kernel, ctx.axis, world),
+        functools.partial(kernel, ctx.axis, world, ctx.straggler,
+                          ctx.for_correctness),
         out_shape=jax.ShapeDtypeStruct((world, m, n), x.dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),

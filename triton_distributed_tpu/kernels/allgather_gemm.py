@@ -39,6 +39,7 @@ from triton_distributed_tpu.kernels.matmul import (
     MatmulConfig,
     emit_chunked_matmul,
     emit_matmul,
+    pad_contraction_lanes,
     round_up_rows,
 )
 from triton_distributed_tpu.language import core as dl
@@ -301,6 +302,11 @@ def ag_gemm(a_shard, b, ctx, return_gathered: bool = False):
     mp = round_up_rows(m, a_shard.dtype)
     a_p = (a_shard if mp == m
            else jnp.pad(a_shard, ((0, mp - m), (0, 0))))
+    # Lane-align K (see `matmul.pad_contraction_lanes`); gathered A
+    # is sliced back below.
+    k_orig = k
+    a_p, b, k = pad_contraction_lanes(a_p, b)
+    kp_pad = k != k_orig
 
     kernel = (_ag_gemm_ll_kernel if method == "ll"
               else _ag_gemm_fused_kernel)
@@ -338,7 +344,9 @@ def ag_gemm(a_shard, b, ctx, return_gathered: bool = False):
     out = out.reshape(world * m, n)
     if return_gathered:
         g = gathered[:, :m] if mp != m else gathered
-        return out, g.reshape(world * m, k)
+        if kp_pad:
+            g = g[:, :, :k_orig]
+        return out, g.reshape(world * m, k_orig)
     return out
 
 

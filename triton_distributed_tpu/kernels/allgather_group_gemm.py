@@ -30,7 +30,10 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_distributed_tpu import collective_ids as cids
 
 from triton_distributed_tpu.kernels.grouped_gemm import emit_grouped_matmul
-from triton_distributed_tpu.kernels.matmul import MatmulConfig
+from triton_distributed_tpu.kernels.matmul import (
+    MatmulConfig,
+    pad_contraction_lanes,
+)
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
     comm_compiler_params,
@@ -119,6 +122,11 @@ def ag_group_gemm(buckets, expert_weights, ctx: AGGroupGEMMContext,
     e2, k2, n = expert_weights.shape
     assert e == e2 == ctx.num_experts and k == k2
     has_counts = counts is not None
+
+    # Lane-align K (see `matmul.pad_contraction_lanes`; the K-padded
+    # gathered buffer is an internal staging output, never returned).
+    buckets, expert_weights, k = pad_contraction_lanes(
+        buckets, expert_weights, axis_b=1)
 
     operands = [buckets, expert_weights]
     in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * 2

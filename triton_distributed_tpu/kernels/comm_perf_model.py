@@ -146,39 +146,56 @@ def estimate_one_shot_time_us(nbytes: int, world: int,
     return link_transits * nbytes / bw * 1e6 + lat
 
 
-def estimate_torus_ag_time_us(nbytes_per_shard: int, wx: int, wy: int,
+def estimate_torus_ag_time_us(nbytes_per_shard: int, sizes,
                               spec: IciSpec = None,
                               closed_ring: bool = None) -> float:
-    """4-quarter 2-axis torus AG (`kernels/torus.py`): each directed
-    link carries one quarter's phase-1 chunks plus another quarter's
-    phase-2 slabs.  Per x-link traffic: (wx-1)(wy+1)·nbytes/4; per
-    y-link: (wy-1)(wx+1)·nbytes/4 — the busiest link decides.  For
-    wx = wy = w that is (w²-1)·nbytes/4, i.e. HALF a bidirectional
-    single-axis ring's load and a QUARTER of a unidirectional one."""
+    """Multi-lane torus AG (`kernels/torus.py`, 2 or 3 axes): with the
+    cyclic-rotation lane schedule, axis ``ax`` appears at phase p in
+    exactly one lane per direction, whose slab there is
+    nbytes/L · prod(sizes of the p axes cyclically preceding ax).
+    Per-directed-link load along ax:
+    (w_ax - 1) · nbytes/L · Σ_p Π_{j=1..p} w_{(ax-j) mod nd} — the
+    busiest axis decides.  For a square 2-axis torus that is
+    (w²-1)·nbytes/4 (HALF a bidirectional single-axis ring's load);
+    for a cubic 3-axis torus (w³-1)·nbytes/6 — a THIRD."""
+    sizes = tuple(int(s) for s in sizes)
+    nd = len(sizes)
+    L = 2 * nd
     spec = spec or get_ici_spec()
     closed = rings_closed() if closed_ring is None else closed_ring
     bw = spec.link_gbps * 1e9
     load = 1.0 if closed else 2.0
-    per_x = (wx - 1) * (wy + 1) * nbytes_per_shard / 4.0
-    per_y = (wy - 1) * (wx + 1) * nbytes_per_shard / 4.0
-    hops = (wx - 1) + (wy - 1)      # serialized phase-1 + phase-2 steps
-    return (load * max(per_x, per_y) / bw * 1e6
+    per_axis = []
+    for ai, w in enumerate(sizes):
+        tot = 0.0
+        for p in range(nd):
+            prod = 1
+            for j in range(1, p + 1):
+                prod *= sizes[(ai - j) % nd]
+            tot += prod
+        per_axis.append((w - 1) * tot * nbytes_per_shard / L)
+    hops = sum(w - 1 for w in sizes)   # serialized per-phase steps
+    return (load * max(per_axis) / bw * 1e6
             + hops * spec.latency_us)
 
 
-def torus_beats_single_axis(nbytes_per_shard: int, wx: int, wy: int,
+def torus_beats_single_axis(nbytes_per_shard: int, sizes,
                             spec: IciSpec = None,
                             margin: float = 0.7) -> bool:
-    """Crossover for the 2-axis torus schedule vs the best single-axis
-    method over the flattened world: the torus wins on bandwidth
-    (~2× a bidir ring) once payloads amortize its extra latency (two
-    serialized ring phases + 4-way chunk split).  ``margin`` is the
-    same hysteresis convention as `choose_ll_or_fused`: the torus
-    kernel's un-modeled fixed costs (two-axis entry barrier, 4×
-    strided-DMA issue) mean a marginal modeled win is not a real one,
-    so the simple path is kept unless the win is decisive."""
-    world = wx * wy
-    t_torus = estimate_torus_ag_time_us(nbytes_per_shard, wx, wy, spec)
+    """Crossover for the multi-axis torus schedule vs the best
+    single-axis method over the flattened world: the torus wins on
+    bandwidth (~nd× a bidir ring) once payloads amortize its extra
+    latency (nd serialized ring phases + 2·nd-way chunk split).
+    ``margin`` is the same hysteresis convention as
+    `choose_ll_or_fused`: the torus kernel's un-modeled fixed costs
+    (per-axis entry barrier, 2·nd× strided-DMA issue) mean a marginal
+    modeled win is not a real one, so the simple path is kept unless
+    the win is decisive."""
+    sizes = tuple(int(s) for s in sizes)
+    world = 1
+    for s in sizes:
+        world *= s
+    t_torus = estimate_torus_ag_time_us(nbytes_per_shard, sizes, spec)
     t_1axis = min(
         estimate_all_gather_time_us(nbytes_per_shard, world, spec),
         estimate_one_shot_time_us(nbytes_per_shard, world, spec))

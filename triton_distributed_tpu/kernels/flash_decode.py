@@ -266,12 +266,19 @@ def sp_flash_decode(q, k_shard, v_shard, kv_len_local, axis: str, *,
                               method=AllGatherMethod.PUSH_ALL,
                               collective_id=collective_id,
                               interpret=interpret)
-    # Pack (out, lse) into one payload row per rank for a single LL AG.
-    payload = jnp.concatenate(
-        [out.astype(jnp.float32).reshape(b * h, d),
-         lse.reshape(b * h, 1)], axis=1)              # (B*H, D+1)
-    gathered = all_gather(payload, ag_ctx)            # (world*B*H, D+1)
-    gathered = gathered.reshape(world, b, h, d + 1)
+    # Pack (out, lse) into one payload row per rank for a single LL
+    # AG, LANE-PADDED to a 128 multiple: Mosaic rejects DMA slices of
+    # rank-3 blocks whose last dim isn't tile-aligned (topology-
+    # compile catch at D+1 = 129).  The pad bytes are dead weight on a
+    # KB-scale latency-bound transfer — irrelevant, and far cheaper
+    # than a second AG for the 1-column lse.
+    dp = d + 1 + ((-(d + 1)) % 128)
+    payload = jnp.zeros((b * h, dp), jnp.float32)
+    payload = payload.at[:, :d].set(
+        out.astype(jnp.float32).reshape(b * h, d))
+    payload = payload.at[:, d].set(lse.reshape(b * h))
+    gathered = all_gather(payload, ag_ctx)            # (world*B*H, dp)
+    gathered = gathered.reshape(world, b, h, dp)
     outs = gathered[..., :d]
     lses = gathered[..., d]
     return combine_partials(outs, lses).astype(q.dtype)

@@ -33,6 +33,7 @@ from triton_distributed_tpu.kernels.matmul import (
     MatmulConfig,
     emit_chunked_matmul,
     emit_matmul,
+    pad_contraction_lanes,
     round_up_rows,
 )
 from triton_distributed_tpu.kernels.reduce_scatter import (
@@ -249,6 +250,9 @@ def gemm_rs(a, b, ctx):
     a3 = a.reshape(world, mc, k)
     if mcp != mc:
         a3 = jnp.pad(a3, ((0, 0), (0, mcp - mc), (0, 0)))
+    # Lane-align K (see `matmul.pad_contraction_lanes`; topology-
+    # compile catch at k_local=64 — interpret mode accepts anything).
+    a3, b, k = pad_contraction_lanes(a3, b)
 
     if method == "ll":
         kernel = _gemm_rs_ll_kernel

@@ -85,6 +85,9 @@ class HierarchicalContext:
     #: `gemm_reduce_scatter.py:515-576`).
     gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
     gemm_method: str = "auto"      # auto | fused | ll | xla (ICI stage)
+    #: ICI stage of `hierarchical_all_to_all`: "auto" (Pallas LL
+    #: kernel) or "xla" (cross-process capable — see AllToAllContext).
+    a2a_method: str = "auto"
     #: Fault injection, forwarded into every ICI-stage kernel launch.
     straggler: Optional[tuple] = None
     for_correctness: bool = False
@@ -102,12 +105,16 @@ class HierarchicalContext:
         return AllGatherContext(
             axis=self.ici_axis, world_size=self.ici_size,
             method=self.ag_method, collective_id=self.collective_id,
+            straggler=self.straggler,
+            for_correctness=self.for_correctness,
             interpret=self.interpret)
 
     def _rs_ctx(self) -> ReduceScatterContext:
         return ReduceScatterContext(
             axis=self.ici_axis, world_size=self.ici_size,
             method=self.rs_method, collective_id=self.collective_id,
+            straggler=self.straggler,
+            for_correctness=self.for_correctness,
             interpret=self.interpret)
 
     def _ag_gemm_ctx(self):
@@ -268,7 +275,8 @@ def hierarchical_all_to_all(send_tokens, send_counts,
     ici_ctx = AllToAllContext(
         axis=ctx.ici_axis, world_size=ici,
         max_tokens_per_rank=dcn * cap, hidden=hidden,
-        collective_id=ctx.collective_id, interpret=ctx.interpret)
+        collective_id=ctx.collective_id, method=ctx.a2a_method,
+        interpret=ctx.interpret)
 
     # ---- stage 2: ICI fan-out (Pallas, one-sided puts) --------------
     if has_scale:

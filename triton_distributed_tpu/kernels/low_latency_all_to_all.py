@@ -53,6 +53,11 @@ class AllToAllContext:
     max_tokens_per_rank: int
     hidden: int
     collective_id: int = cids.ALL_TO_ALL
+    #: "auto" (the Pallas one-sided-put kernel) or "xla"
+    #: (`jax.lax.all_to_all` — golden reference, and the only method
+    #: that can cross PROCESS boundaries, e.g. the DCN-stage of a
+    #: multi-host launch or interpret-mode cross-process tests).
+    method: str = "auto"
     # Fault injection — see AllGatherGEMMContext.
     straggler: Optional[tuple] = None
     for_correctness: bool = False
@@ -138,6 +143,16 @@ def fast_all_to_all(send_tokens, send_counts, ctx: AllToAllContext,
     world = ctx.world_size
     cap, hidden = send_tokens.shape[1], send_tokens.shape[2]
     has_scale = send_scales is not None
+
+    if ctx.method == "xla":
+        a2a = functools.partial(jax.lax.all_to_all, axis_name=ctx.axis,
+                                split_axis=0, concat_axis=0,
+                                tiled=False)
+        rt = a2a(send_tokens)
+        rc = a2a(send_counts.astype(jnp.int32))
+        if has_scale:
+            return rt, rc, a2a(send_scales)
+        return rt, rc
 
     # Mosaic DMA slices need lane-dim (last-dim) alignment to 128;
     # narrow payloads (counts (world, 1), scale slots) are padded here

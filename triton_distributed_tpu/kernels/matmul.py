@@ -269,3 +269,26 @@ def round_up_rows(m: int, dtype) -> int:
     itemsize = jnp.dtype(dtype).itemsize
     min_rows = {1: 32, 2: 16}.get(itemsize, 8)
     return (m + min_rows - 1) // min_rows * min_rows
+
+
+def pad_contraction_lanes(a, b, axis_a: int = -1, axis_b: int = 0):
+    """Zero-pad the shared contraction dim of ``a`` (its ``axis_a``)
+    and ``b`` (its ``axis_b``) to the 128-lane multiple.
+
+    Mosaic rejects lane-dim slices of rank-3+ blocks that aren't
+    128-aligned (caught by the topology-compile suite at
+    k_local = 64), so every kernel that streams rank-3+ A chunks pads
+    K on the host.  Zero-padding the contraction dim is exact: zero
+    columns of A times zero rows of B contribute nothing.
+
+    Returns (a, b, k_padded)."""
+    k = a.shape[axis_a]
+    pad = (-k) % 128
+    if pad:
+        pa = [(0, 0)] * a.ndim
+        pa[axis_a if axis_a >= 0 else a.ndim + axis_a] = (0, pad)
+        pb = [(0, 0)] * b.ndim
+        pb[axis_b] = (0, pad)
+        a = jnp.pad(a, pa)
+        b = jnp.pad(b, pb)
+    return a, b, k + pad

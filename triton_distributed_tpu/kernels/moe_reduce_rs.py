@@ -41,7 +41,10 @@ from triton_distributed_tpu.kernels.grouped_gemm import (
     emit_grouped_matmul,
     grouped_matmul,
 )
-from triton_distributed_tpu.kernels.matmul import MatmulConfig
+from triton_distributed_tpu.kernels.matmul import (
+    MatmulConfig,
+    pad_contraction_lanes,
+)
 from triton_distributed_tpu.kernels.reduce_scatter import (
     ReduceScatterContext,
     ReduceScatterMethod,
@@ -194,6 +197,10 @@ def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
         buckets = jnp.pad(
             buckets, ((0, 0), (0, 0), (0, cap_p), (0, 0)))
         cap += cap_p
+    # Lane-align the grouped GEMM's contraction dim (see
+    # `matmul.pad_contraction_lanes`).
+    buckets, expert_weights, k = pad_contraction_lanes(
+        buckets, expert_weights, axis_b=1)
 
     operands = [buckets, expert_weights, combine_mats]
     in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * 3

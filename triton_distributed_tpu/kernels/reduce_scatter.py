@@ -58,6 +58,11 @@ class ReduceScatterContext:
     method: ReduceScatterMethod = ReduceScatterMethod.AUTO
     collective_id: int = cids.REDUCE_SCATTER
     interpret: Optional[bool] = None
+    #: Fault injection (reference `_run_straggler`,
+    #: `stress_test_ag_gemm.py:119-121`): (rank, cycles) delays that
+    #: rank at kernel entry; `for_correctness` staggers every rank.
+    straggler: Optional[tuple] = None
+    for_correctness: bool = False
 
     def resolve_method(self, nbytes_per_chunk: int) -> ReduceScatterMethod:
         if self.method != ReduceScatterMethod.AUTO:
@@ -108,23 +113,19 @@ def _emit_reduce_sum(src_ref, out_ref, *, world, m, n, block_m=256,
 
 def emit_add_into(dst, a_ref, b_ref, shape):
     """dst = a + b (f32 accumulate), pipelined through VMEM; handles
-    2D (rows, n) chunk refs and 3D (w, rows, n) slab refs.  Shared by
+    2D (rows, n) chunk refs and any number of leading slab dims —
+    (w, rows, n), (wa, wb, rows, n) for the 3-axis torus.  Shared by
     the ring/chain/torus reduce kernels — one place owns the blocking
     and the cast dance.  ``dst`` may alias ``a_ref``."""
     def inner(a_blk, b_blk, o_blk):
         o_blk[:] = (a_blk[:].astype(jnp.float32)
                     + b_blk[:].astype(jnp.float32)).astype(o_blk.dtype)
 
-    if len(shape) == 3:
-        w, rows, n = shape
-        bm = min(256, rows)
-        grid = (w, pl.cdiv(rows, bm))
-        spec = pl.BlockSpec((1, bm, n), lambda i, j: (i, j, 0))
-    else:
-        rows, n = shape
-        bm = min(256, rows)
-        grid = (pl.cdiv(rows, bm),)
-        spec = pl.BlockSpec((bm, n), lambda i: (i, 0))
+    lead, (rows, n) = tuple(shape[:-2]), shape[-2:]
+    bm = min(256, rows)
+    grid = lead + (pl.cdiv(rows, bm),)
+    spec = pl.BlockSpec((1,) * len(lead) + (bm, n),
+                        lambda *ids: ids[:-1] + (ids[-1], 0))
     pltpu.emit_pipeline(
         inner, grid=grid, in_specs=[spec] * 2, out_specs=[spec],
     )(a_ref, b_ref, dst)
@@ -176,6 +177,8 @@ def emit_scatter_reduce(axis, world, src_ref, out_ref, rbuf_ref,
 
 def _scatter_reduce_kernel(ctx, m, n, x_ref, out_ref, rbuf_ref,
                            local_sem, send_sem, recv_sems):
+    dl.maybe_straggle(ctx.axis, ctx.straggler)
+    dl.correctness_delay(ctx.axis, ctx.for_correctness)
     emit_scatter_reduce(ctx.axis, ctx.world_size, x_ref, out_ref,
                         rbuf_ref, local_sem, send_sem, recv_sems,
                         m=m, n=n)
@@ -191,6 +194,8 @@ def _ring_rs_kernel(ctx, m, n, x_ref, out_ref, staging_ref, accum_ref,
     my = jax.lax.axis_index(ctx.axis)
     right = jax.lax.rem(my + 1, world)
     left = jax.lax.rem(my - 1 + world, world)
+    dl.maybe_straggle(ctx.axis, ctx.straggler)
+    dl.correctness_delay(ctx.axis, ctx.for_correctness)
     dl.entry_barrier(ctx.axis, world, neighbors_only=True)
 
     def add_into(dst, a_ref, b_ref):

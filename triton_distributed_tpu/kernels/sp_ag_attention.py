@@ -47,6 +47,9 @@ from triton_distributed_tpu.utils.platform import (
 )
 
 NEG_INF = -1e30
+#: Lane width of the fused kernel's lse state tiles (the value is
+#: broadcast across lanes; 128 = the Mosaic lane tile).
+LSE_W = 128
 
 
 def _merge(out_a, lse_a, out_b, lse_b):
@@ -252,7 +255,9 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
             # natural-log (the prev-merge below depends on it).
             l_c = m_scr[:] * LN2 + jnp.log(l)
             if prev is not None:
-                la = pl_blk[0, 0]
+                # lse state is lane-BROADCAST ((bq, 128) tiles, every
+                # lane the same value — see lspec); read column 0.
+                la = pl_blk[0, 0][:, :1]
                 m = jnp.maximum(jnp.maximum(la, l_c), NEG_INF / 2)
                 wa = jnp.exp(la - m)
                 wb = jnp.exp(l_c - m)
@@ -260,11 +265,19 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
                 o_c = (po_blk[0, 0] * wa + o_c * wb) / denom
                 l_c = m + jnp.log(denom)
             oo_blk[0, 0] = o_c.astype(oo_blk.dtype) if final else o_c
-            ol_blk[0, 0] = l_c
+            ol_blk[0, 0] = jnp.broadcast_to(l_c, (l_c.shape[0], LSE_W))
 
     qspec = pl.BlockSpec((1, 1, bq, d),
                          lambda bb, hh, qi, ki: (bb, hh, qi, 0))
-    lspec = pl.BlockSpec((1, 1, bq, 1),
+    # lse state is (b, h, sq, LSE_W) with the value BROADCAST across
+    # the 128-lane dim: a (..., bq, 1) layout would make the pipeline
+    # DMA slice the lane dim at width 1 — Mosaic rejects non-128
+    # lane slices (topology-compile catch; the single-chip path
+    # short-circuits to `flash_attention` and never compiled this
+    # kernel's multi-chunk path on hardware) — while a lane-major
+    # (1, bq) layout breaks for bq < 128.  Full-width aligned lane
+    # blocks + ragged SUBLANES are the layout Mosaic likes.
+    lspec = pl.BlockSpec((1, 1, bq, LSE_W),
                          lambda bb, hh, qi, ki: (bb, hh, qi, 0))
 
     def kv_index(bb, hh, qi, ki, g=group):
@@ -311,7 +324,8 @@ def _emit_state_fill(out_o, out_l, *, b, h, sq, d, block_q):
         ol_blk[0, 0] = jnp.full_like(ol_blk[0, 0], NEG_INF)
 
     qspec = pl.BlockSpec((1, 1, bq, d), lambda bb, hh, qi: (bb, hh, qi, 0))
-    lspec = pl.BlockSpec((1, 1, bq, 1), lambda bb, hh, qi: (bb, hh, qi, 0))
+    lspec = pl.BlockSpec((1, 1, bq, LSE_W),
+                         lambda bb, hh, qi: (bb, hh, qi, 0))
     pltpu.emit_pipeline(inner, grid=(b, h, pl.cdiv(sq, bq)),
                         in_specs=[], out_specs=[qspec, lspec])(
         out_o, out_l)
@@ -329,7 +343,8 @@ def _emit_state_carry(src_o, src_l, out_o, out_l, *, b, h, sq, d,
         ol_blk[0, 0] = sl_blk[0, 0]
 
     qspec = pl.BlockSpec((1, 1, bq, d), lambda bb, hh, qi: (bb, hh, qi, 0))
-    lspec = pl.BlockSpec((1, 1, bq, 1), lambda bb, hh, qi: (bb, hh, qi, 0))
+    lspec = pl.BlockSpec((1, 1, bq, LSE_W),
+                         lambda bb, hh, qi: (bb, hh, qi, 0))
     pltpu.emit_pipeline(inner, grid=(b, h, pl.cdiv(sq, bq)),
                         in_specs=[qspec, lspec],
                         out_specs=[qspec, lspec])(
@@ -469,11 +484,11 @@ def sp_ag_attention_fused(q, k_shard, v_shard, axis: str, *,
                           block_q, block_k, h // hkv, b, h, hkv, s_loc, d),
         out_shape=(
             jax.ShapeDtypeStruct((b, h, s_loc, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, s_loc, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s_loc, LSE_W), jnp.float32),
             jax.ShapeDtypeStruct((world, b, hkv, s_loc, d), q.dtype),
             jax.ShapeDtypeStruct((world, b, hkv, s_loc, d), q.dtype),
             jax.ShapeDtypeStruct((2, b, h, s_loc, d), jnp.float32),
-            jax.ShapeDtypeStruct((2, b, h, s_loc, 1), jnp.float32),
+            jax.ShapeDtypeStruct((2, b, h, s_loc, LSE_W), jnp.float32),
         ),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),

@@ -289,11 +289,27 @@ def emit_broadcast(axis: str, world: int, root, src_ref, dst_ref,
 # Fault injection (straggler / race-widening delays)
 # ---------------------------------------------------------------------------
 
-def maybe_straggle(axis: str, straggler):
+def _flat_rank(axis):
+    """Rank along ``axis``; for a SEQUENCE of axes, the flattened
+    row-major rank over all of them (multi-axis torus kernels straggle
+    by flat rank so one knob addresses any lane/quadrant)."""
+    if isinstance(axis, str):
+        return jax.lax.axis_index(axis)
+    flat = None
+    for a in axis:
+        idx = jax.lax.axis_index(a)
+        flat = idx if flat is None else flat * jax.lax.axis_size(a) + idx
+    return flat
+
+
+def maybe_straggle(axis, straggler):
     """Delay one rank before it communicates (reference
     `_run_straggler`, `kernels/nvidia/allreduce.py:146`; stress use
     `test/stress/stress_test_ag_gemm.py:119-121`).
 
+    ``axis``: one mesh axis name, or a sequence of axis names — then
+    ``rank`` addresses the row-major flattened rank over them (the
+    multi-axis torus kernels' convention).
     ``straggler``: None or (rank, cycles).  On TPU the rank spins
     ``cycles`` ns (`pl.delay`); in interpret mode it sleeps the
     simulated device's host thread — a *real* wall-clock skew, so the
@@ -304,24 +320,25 @@ def maybe_straggle(axis: str, straggler):
     rank, cycles = straggler
     from triton_distributed_tpu.utils.platform import is_tpu
 
+    me = _flat_rank(axis)
     if is_tpu():
-        @pl.when(jax.lax.axis_index(axis) == rank)
+        @pl.when(me == rank)
         def _():
             pl.delay(cycles)
     else:
-        _host_sleep(jax.lax.axis_index(axis) == rank, cycles)
+        _host_sleep(me == rank, cycles)
 
 
-def correctness_delay(axis: str, enabled: bool, cycles: int = 100_000):
+def correctness_delay(axis, enabled: bool, cycles: int = 100_000):
     """Rank-staggered delay before communication on EVERY rank — the
     reference's `for_correctness` knob (`allgather_gemm.py:506-508`):
     widen race windows so ordering bugs surface deterministically
-    instead of once a week."""
+    instead of once a week.  ``axis`` as in :func:`maybe_straggle`."""
     if not enabled:
         return
     from triton_distributed_tpu.utils.platform import is_tpu
 
-    my = jax.lax.axis_index(axis)
+    my = _flat_rank(axis)
     if is_tpu():
         pl.delay((my + 1) * cycles)
     else:

@@ -71,6 +71,94 @@ def build_ll_gemm_bundle(out_dir: str, *, k: int = 7168, n: int = 7168,
     return compile_aot(ll_fn, "ag_gemm_ll", variants, out_dir)
 
 
+def build_decode_step_bundle(out_dir: str, *, cfg=None,
+                             batches: Sequence[int] = (1, 4),
+                             kv_cap: int = 128, seed: int = 0):
+    """One FULL serving decode step (attn + mlp + lm head + greedy
+    sample) per batch-size variant — the reference's AOT raison
+    d'être: a C++ deployment serving a model with no Python in the
+    loop (`tools/compile_aot.py:61-183` consumed by
+    `csrc/op_pybind.cc:25` via `scripts/aot_kernels.txt`).
+
+    The exported signature is FLAT: ``(tokens, *param_leaves,
+    *cache_leaves) -> (next_tokens, logits, *new_cache_leaves)`` so
+    the C runtime can feed buffers positionally and loop by writing
+    ``next_tokens`` back to ``tokens`` and the new cache leaves back
+    to the cache arguments; ``logits`` is verification-only (see
+    ``write_loop_spec``).
+
+    Returns (bundle, params, step) where ``step`` is the
+    flat-signature python function itself (golden generator for
+    tests; it serves every batch variant).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from triton_distributed_tpu.models import ModelConfig
+    from triton_distributed_tpu.models.qwen import Qwen3
+
+    cfg = cfg or ModelConfig.tiny()
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    model = Qwen3(cfg, mesh, mode="fused")
+    decode = model.make_decode_fn()
+    params = model.init_params(jax.random.key(seed))
+    p_leaves, p_tree = jax.tree.flatten(params)
+    n_p = len(p_leaves)
+
+    # The cache TREE STRUCTURE is batch-independent (lists of
+    # per-layer arrays + offset), so one flat step serves every
+    # batch-size variant.
+    c_tree = jax.tree.structure(model.create_cache(batches[0], kv_cap))
+
+    def step(tokens, *leaves):
+        ps = jax.tree.unflatten(p_tree, leaves[:n_p])
+        cache = jax.tree.unflatten(c_tree, leaves[n_p:])
+        logits, new_cache = decode(ps, tokens, cache)
+        # Deterministic next-token schedule instead of greedy argmax:
+        # on an UNTRAINED random model argmax is chaotic — a 1-ulp
+        # logit difference between two compilations of the same
+        # exported program flips the token and the trajectories can't
+        # be compared across runtimes.  The integer schedule keeps the
+        # fed-back trajectory exact, while the returned logits and
+        # the fed-back KV cache verify the full model numerics (attn,
+        # mlp, lm head) at every step.  A real deployment swaps this
+        # one line for its sampler.
+        nxt = jax.lax.rem(tokens * 31 + 7,
+                          jnp.int32(cfg.vocab_size)).astype(jnp.int32)
+        return (nxt, logits) + tuple(jax.tree.leaves(new_cache))
+
+    variants = []
+    for b in batches:
+        cache = model.create_cache(b, kv_cap)
+        c_leaves = jax.tree.leaves(cache)
+        example = ([jnp.zeros((b,), jnp.int32)] + list(p_leaves)
+                   + list(c_leaves))
+        variants.append(AotVariant(
+            f"b{b}",
+            [tuple(a.shape) for a in example],
+            [str(a.dtype) for a in example]))
+
+    bundle = compile_aot(step, "decode_step", variants, out_dir)
+    return bundle, params, step
+
+
+def write_loop_spec(path: str, n_steps: int, n_params: int,
+                    n_cache: int) -> None:
+    """Write the serving-loop feedback spec `csrc/aot_test.c` consumes:
+    line 1 = step count; then one TARGET ARG INDEX per output (-1 =
+    not fed back).  For the decode-step signature, out0 (next tokens)
+    feeds arg0, out1 (logits) is verification-only, and the new cache
+    leaves feed the trailing cache args."""
+    with open(path, "w") as f:
+        f.write(f"{n_steps}\n")
+        f.write("0\n")                       # next tokens -> tokens
+        f.write("-1\n")                      # logits: compared only
+        for i in range(n_cache):
+            f.write(f"{1 + n_params + i}\n")  # cache leaf i
+
+
 def write_call_site_sigs(path: str, arrays) -> None:
     """Write the call-site signature file `tdt_bundle_select_variant`
     consumers parse (one line per argument: dtype-code rank dims...)."""
