@@ -84,7 +84,9 @@ def main():
         runners[mode] = run
 
     slopes = {m: [] for m in runners}
+    rounds = []
     for _ in range(args.repeats):
+        rnd = {}
         for m in ("fused", "xla", "xla", "fused"):   # ABBA
             t1 = runners[m](args.g1)
             t2 = runners[m](args.g2)
@@ -93,19 +95,33 @@ def main():
             # sample (clamping would leak an absurd sentinel into the
             # paired ratios and the median).
             sl = (t2 - t1) / (args.g2 - args.g1)
-            slopes[m].append(sl if sl > 0 else None)
+            if sl > 0:
+                slopes[m].append(sl)
+                rnd.setdefault(m, []).append(sl)
+        rounds.append(rnd)
 
-    results = {m: statistics.median([s for s in sl if s is not None])
-               for m, sl in slopes.items()}
+    results = {m: statistics.median(sl) for m, sl in slopes.items()}
     # Paired per-round ratios expose the noise band the medians hide:
     # at world=1 the two modes' decode graphs are equivalent (the only
     # HLO diff is two world-1 no-op all_gathers), so any deviation of
     # the ratio from 1.0 here bounds the harness noise, not a real
-    # fused overhead.  Pairs with a discarded sample drop out.
-    pair_ratios = sorted(x / f for x, f in zip(slopes["xla"],
-                                               slopes["fused"])
-                         if x is not None and f is not None)
-    pair_ratios = pair_ratios or [float("nan")]
+    # fused overhead.  Each round's ratio SUMS its two adjacent
+    # samples per mode (ABBA); and because the four slopes of a round
+    # measure equivalent programs seconds apart, a round whose own
+    # max/min slope spread exceeds 1.5x contains a tunnel glitch (a
+    # late fetch collapsing one slope) and is DISCARDED — the count is
+    # reported so a glitchy run is visibly a glitchy run.
+    kept, discarded = [], 0
+    for r in rounds:
+        four = r.get("xla", []) + r.get("fused", [])
+        if len(four) != 4:
+            discarded += 1
+            continue
+        if max(four) / min(four) > 1.5:
+            discarded += 1
+            continue
+        kept.append(sum(r["xla"]) / sum(r["fused"]))
+    pair_ratios = sorted(kept) or [float("nan")]
     world = len(devices)
     for mode in ("fused", "xla"):
         per_step = results[mode]
@@ -119,6 +135,8 @@ def main():
                 round(statistics.median(pair_ratios), 3),
                 "ratio_range": [round(pair_ratios[0], 3),
                                 round(pair_ratios[-1], 3)],
+                "rounds_kept": len(kept),
+                "rounds_discarded_glitch": discarded,
                 # At world=1 the two modes' decode graphs are
                 # HLO-equivalent: the ratio bounds harness noise and
                 # is NOT overlap-speedup evidence (that exists only at

@@ -43,6 +43,19 @@ def main() -> int:
 
     world = args.nproc * args.nnodes
     procs = []
+
+    def _kill_group(sig=signal.SIGTERM):
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(sig)
+
+    # Installed BEFORE the spawn loop: a SIGTERM mid-spawn (harness
+    # timeout while workers pay interpreter+jax startup) must not
+    # orphan the already-spawned half of the group — stranded workers
+    # keep ports and CPU, deadlocking every later launch.
+    signal.signal(signal.SIGTERM,
+                  lambda *a: (_kill_group(), sys.exit(143)))
+
     for local in range(args.nproc):
         rank = args.node_rank * args.nproc + local
         env = dict(os.environ)
@@ -53,17 +66,6 @@ def main() -> int:
             env["JAX_PLATFORMS"] = "cpu"
         procs.append(subprocess.Popen(
             [sys.executable, args.script, *args.script_args], env=env))
-
-    def _kill_group(sig=signal.SIGTERM):
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(sig)
-
-    # A SIGTERM to the launcher (e.g. a test-harness timeout killing
-    # us) must not ORPHAN the group: stranded workers keep ports and
-    # CPU, deadlocking every later launch on the machine.
-    signal.signal(signal.SIGTERM,
-                  lambda *a: (_kill_group(), sys.exit(143)))
 
     rc = 0
     try:

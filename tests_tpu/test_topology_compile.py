@@ -61,7 +61,8 @@ def _compile(fn, mesh, in_specs, out_specs, arg_shapes, dtypes):
 # ---------------------------------------------------------------------------
 
 @pytest.mark.parametrize("method", ["ring", "push_all", "bidir_ring"])
-def test_topo_allgather(method):
+@pytest.mark.parametrize("n", [256, 192])   # 192: lane-unaligned cols
+def test_topo_allgather(method, n):
     from triton_distributed_tpu.kernels.allgather import (
         AllGatherContext, AllGatherMethod, all_gather)
 
@@ -69,11 +70,12 @@ def test_topo_allgather(method):
                            method=AllGatherMethod(method))
     _compile(functools.partial(all_gather, ctx=ctx), _mesh((8,), ("tp",)),
              P("tp", None), P(None, None),
-             [(WORLD * 16, 256)], jnp.bfloat16)
+             [(WORLD * 16, n)], jnp.bfloat16)
 
 
 @pytest.mark.parametrize("method", ["ring", "scatter_reduce"])
-def test_topo_reduce_scatter(method):
+@pytest.mark.parametrize("n", [256, 192])   # 192: lane-unaligned cols
+def test_topo_reduce_scatter(method, n):
     from triton_distributed_tpu.kernels.reduce_scatter import (
         ReduceScatterContext, ReduceScatterMethod, reduce_scatter)
 
@@ -82,12 +84,13 @@ def test_topo_reduce_scatter(method):
     _compile(functools.partial(reduce_scatter, ctx=ctx),
              _mesh((8,), ("tp",)),
              P("tp", None), P("tp", None),
-             [(WORLD * 16, 256)], jnp.float32)
+             [(WORLD * 16, n)], jnp.float32)
 
 
 @pytest.mark.parametrize("method",
                          ["one_shot", "two_shot", "ring", "chain"])
-def test_topo_allreduce(method):
+@pytest.mark.parametrize("n", [256, 192])   # 192: lane-unaligned cols
+def test_topo_allreduce(method, n):
     from triton_distributed_tpu.kernels.allreduce import (
         AllReduceContext, AllReduceMethod, all_reduce)
 
@@ -95,7 +98,7 @@ def test_topo_allreduce(method):
                            method=AllReduceMethod(method))
     _compile(functools.partial(all_reduce, ctx=ctx), _mesh((8,), ("tp",)),
              P("tp", None), P("tp", None),
-             [(128, 256)], jnp.float32)
+             [(128, n)], jnp.float32)
 
 
 def test_topo_fast_allgather():
@@ -156,13 +159,14 @@ def _torus_ctx(sizes, axes):
     ((2, 4), ("x", "y")),
     ((2, 2, 2), ("x", "y", "z")),
 ])
-def test_topo_torus_allgather(shape, axes):
+@pytest.mark.parametrize("n", [256, 192])   # 192: lane-unaligned cols
+def test_topo_torus_allgather(shape, axes, n):
     from triton_distributed_tpu.kernels.torus import all_gather_torus
 
     ctx = _torus_ctx(shape, axes)
     _compile(lambda x: all_gather_torus(x, ctx), _mesh(shape, axes),
              P(axes, None), P(None, None),
-             [(WORLD * 48, 256)], jnp.bfloat16)
+             [(WORLD * 48, n)], jnp.bfloat16)
 
 
 @pytest.mark.parametrize("shape,axes", [
@@ -183,13 +187,14 @@ def test_topo_torus_reduce_scatter(shape, axes):
     ((2, 4), ("x", "y")),
     ((2, 2, 2), ("x", "y", "z")),
 ])
-def test_topo_torus_ag_gemm(shape, axes):
+@pytest.mark.parametrize("k", [256, 192])   # 192: lane-unaligned K
+def test_topo_torus_ag_gemm(shape, axes, k):
     from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm
 
     ctx = _torus_ctx(shape, axes)
     _compile(lambda a, b: ag_gemm(a, b, ctx), _mesh(shape, axes),
              (P(axes, None), P(None, axes)), P(None, axes),
-             [(WORLD * 96, 256), (256, WORLD * 128)], jnp.bfloat16)
+             [(WORLD * 96, k), (k, WORLD * 128)], jnp.bfloat16)
 
 
 def test_topo_torus_gemm_rs():

@@ -37,6 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu import collective_ids as cids
 
+from triton_distributed_tpu.kernels.matmul import pad_lanes
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
     comm_compiler_params,
@@ -248,11 +249,15 @@ def all_gather(x, ctx: AllGatherContext):
     Output: (world * m, n).
     """
     world = ctx.world_size
-    m, n = x.shape
     method = ctx.resolve_method(x.size * x.dtype.itemsize)
 
     if method == AllGatherMethod.XLA:
         return jax.lax.all_gather(x, ctx.axis, tiled=True)
+
+    # Lane-align the payload columns (Mosaic memref_slice rule — see
+    # `matmul.pad_lanes`); sliced back on exit.
+    x, n_orig = pad_lanes(x)
+    m, n = x.shape
 
     interpret = default_interpret(ctx.interpret)
     cparams = comm_compiler_params(ctx.collective_id, world)
@@ -273,7 +278,8 @@ def all_gather(x, ctx: AllGatherContext):
             compiler_params=cparams,
             interpret=interpret,
         )(xr)
-        return out.reshape(world * m, n)
+        out = out.reshape(world * m, n)
+        return out[:, :n_orig] if n != n_orig else out
 
     kernel = (_push_all_ag_kernel if method == AllGatherMethod.PUSH_ALL
               else _ring_ag_kernel)
@@ -291,4 +297,5 @@ def all_gather(x, ctx: AllGatherContext):
         compiler_params=cparams,
         interpret=interpret,
     )(x)
-    return out.reshape(world * m, n)
+    out = out.reshape(world * m, n)
+    return out[:, :n_orig] if n != n_orig else out

@@ -40,6 +40,7 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_distributed_tpu import collective_ids as cids
 
 from triton_distributed_tpu.kernels.reduce_scatter import _emit_reduce_sum
+from triton_distributed_tpu.kernels.matmul import pad_lanes
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
     comm_compiler_params,
@@ -295,6 +296,12 @@ def all_reduce(x, ctx: AllReduceContext):
     interpret = default_interpret(ctx.interpret)
     cparams = comm_compiler_params(ctx.collective_id, world)
 
+    # Lane-align the payload columns (Mosaic memref_slice rule — see
+    # `matmul.pad_lanes`); sliced back on exit.  The RING compose
+    # above delegates to hosts that pad themselves.
+    x, n_orig = pad_lanes(x)
+    m, n = x.shape
+
     if method == AllReduceMethod.CHAIN:
         if world <= 1:
             return x     # rank 0 would wait on a put that never comes
@@ -316,7 +323,8 @@ def all_reduce(x, ctx: AllReduceContext):
             compiler_params=cparams,
             interpret=interpret,
         )(x.reshape(P, mc, n))
-        return out.reshape(m, n)
+        out = out.reshape(m, n)
+        return out[:, :n_orig] if n != n_orig else out
 
     # NOTE: HBM communication buffers are extra *outputs* (discarded),
     # not scratch — Mosaic only allows vmem/smem/semaphore scratch.
@@ -340,7 +348,8 @@ def all_reduce(x, ctx: AllReduceContext):
             compiler_params=cparams,
             interpret=interpret,
         )(x.reshape(world, mc, n))
-        return out.reshape(m, n)
+        out = out.reshape(m, n)
+        return out[:, :n_orig] if n != n_orig else out
 
     # ONE_SHOT (also the fallback when shapes don't tile)
     out, _ = pl.pallas_call(
@@ -359,4 +368,4 @@ def all_reduce(x, ctx: AllReduceContext):
         compiler_params=cparams,
         interpret=interpret,
     )(x)
-    return out
+    return out[:, :n_orig] if n != n_orig else out

@@ -271,6 +271,22 @@ def round_up_rows(m: int, dtype) -> int:
     return (m + min_rows - 1) // min_rows * min_rows
 
 
+def pad_lanes(x, multiple: int = 128):
+    """Zero-pad the LAST dim to a 128 multiple and return (padded,
+    original_width).
+
+    Mosaic's `memref_slice` requires the lane (last) extent of any
+    rank-3+ sliced block to be a 128 multiple — even when the slice
+    covers the whole dim (topology-compile catch at n=192 on the
+    torus AG slabs).  Collective hosts pad payload columns on entry
+    and slice them back on exit."""
+    n = x.shape[-1]
+    pad = (-n) % multiple
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    return x, n
+
+
 def pad_contraction_lanes(a, b, axis_a: int = -1, axis_b: int = 0):
     """Zero-pad the shared contraction dim of ``a`` (its ``axis_a``)
     and ``b`` (its ``axis_b``) to the 128-lane multiple.

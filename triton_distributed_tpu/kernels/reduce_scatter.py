@@ -35,6 +35,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu import collective_ids as cids
 
+from triton_distributed_tpu.kernels.matmul import pad_lanes
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
     comm_compiler_params,
@@ -255,15 +256,19 @@ def reduce_scatter(x, ctx: ReduceScatterContext):
     """x: per-device partials (world*m, n) → this device's reduced
     chunk (m, n).  Call inside shard_map."""
     world = ctx.world_size
-    mt, n = x.shape
+    mt = x.shape[0]
     assert mt % world == 0, (x.shape, world)
     m = mt // world
-    method = ctx.resolve_method(m * n * x.dtype.itemsize)
+    method = ctx.resolve_method(m * x.shape[1] * x.dtype.itemsize)
 
     if method == ReduceScatterMethod.XLA:
         return jax.lax.psum_scatter(
-            x.reshape(world, m, n), ctx.axis, scatter_dimension=0,
-            tiled=False)
+            x.reshape(world, m, x.shape[1]), ctx.axis,
+            scatter_dimension=0, tiled=False)
+
+    # Lane-align the payload columns (see `matmul.pad_lanes`).
+    x, n_orig = pad_lanes(x)
+    n = x.shape[1]
 
     interpret = default_interpret(ctx.interpret)
     cparams = comm_compiler_params(ctx.collective_id, world)
@@ -288,7 +293,7 @@ def reduce_scatter(x, ctx: ReduceScatterContext):
             compiler_params=cparams,
             interpret=interpret,
         )(xr)
-        return out
+        return out[:, :n_orig] if n != n_orig else out
 
     # RING
     out, _, _ = pl.pallas_call(
@@ -309,4 +314,4 @@ def reduce_scatter(x, ctx: ReduceScatterContext):
         compiler_params=cparams,
         interpret=interpret,
     )(xr)
-    return out
+    return out[:, :n_orig] if n != n_orig else out

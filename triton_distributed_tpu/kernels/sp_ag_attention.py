@@ -47,9 +47,30 @@ from triton_distributed_tpu.utils.platform import (
 )
 
 NEG_INF = -1e30
-#: Lane width of the fused kernel's lse state tiles (the value is
-#: broadcast across lanes; 128 = the Mosaic lane tile).
+#: Lane width of the fused kernel lse state tiles (128 = the Mosaic
+#: lane tile).  When the q row block is a 128 multiple (production
+#: blocks), the lse rides PACKED: 128 consecutive q rows fold into one
+#: (sublane, lane) tile row, so the state costs sq*4 bytes, not
+#: sq*512.  Smaller row blocks (tests) fall back to lane-BROADCAST
+#: tiles: Mosaic rejects lane extents that are not 128 multiples, so
+#: a (bq, 1) layout cannot be DMA-sliced at all (topology-compile
+#: catch).
 LSE_W = 128
+
+
+def _lse_packed(bq: int) -> bool:
+    return bq % LSE_W == 0
+
+
+def _lse_rows(sq: int, bq: int) -> int:
+    """Second-minor extent of the lse state array."""
+    import math
+    return math.ceil(sq / LSE_W) if _lse_packed(bq) else sq
+
+
+def _lse_block(bq: int) -> int:
+    """Block sublane extent of one q row block lse tile."""
+    return bq // LSE_W if _lse_packed(bq) else bq
 
 
 def _merge(out_a, lse_a, out_b, lse_b):
@@ -255,9 +276,11 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
             # natural-log (the prev-merge below depends on it).
             l_c = m_scr[:] * LN2 + jnp.log(l)
             if prev is not None:
-                # lse state is lane-BROADCAST ((bq, 128) tiles, every
-                # lane the same value — see lspec); read column 0.
-                la = pl_blk[0, 0][:, :1]
+                # Packed layout: unfold the (bq//128, 128) tile back
+                # to a (bq, 1) column (verified-supported Mosaic
+                # relayout); broadcast layout: read column 0.
+                la = (pl_blk[0, 0].reshape(bq, 1) if packed
+                      else pl_blk[0, 0][:, :1])
                 m = jnp.maximum(jnp.maximum(la, l_c), NEG_INF / 2)
                 wa = jnp.exp(la - m)
                 wb = jnp.exp(l_c - m)
@@ -265,19 +288,15 @@ def _emit_flash_chunk(q_ref, k_ref, v_ref, out_o, out_l, *, off, scale,
                 o_c = (po_blk[0, 0] * wa + o_c * wb) / denom
                 l_c = m + jnp.log(denom)
             oo_blk[0, 0] = o_c.astype(oo_blk.dtype) if final else o_c
-            ol_blk[0, 0] = jnp.broadcast_to(l_c, (l_c.shape[0], LSE_W))
+            ol_blk[0, 0] = (l_c.reshape(bq // LSE_W, LSE_W) if packed
+                            else jnp.broadcast_to(l_c, (bq, LSE_W)))
 
+    packed = _lse_packed(bq)
     qspec = pl.BlockSpec((1, 1, bq, d),
                          lambda bb, hh, qi, ki: (bb, hh, qi, 0))
-    # lse state is (b, h, sq, LSE_W) with the value BROADCAST across
-    # the 128-lane dim: a (..., bq, 1) layout would make the pipeline
-    # DMA slice the lane dim at width 1 — Mosaic rejects non-128
-    # lane slices (topology-compile catch; the single-chip path
-    # short-circuits to `flash_attention` and never compiled this
-    # kernel's multi-chunk path on hardware) — while a lane-major
-    # (1, bq) layout breaks for bq < 128.  Full-width aligned lane
-    # blocks + ragged SUBLANES are the layout Mosaic likes.
-    lspec = pl.BlockSpec((1, 1, bq, LSE_W),
+    # lse layout: see LSE_W — packed (bq//128, 128) fold for 128-
+    # multiple row blocks, lane-broadcast (bq, 128) otherwise.
+    lspec = pl.BlockSpec((1, 1, _lse_block(bq), LSE_W),
                          lambda bb, hh, qi, ki: (bb, hh, qi, 0))
 
     def kv_index(bb, hh, qi, ki, g=group):
@@ -324,7 +343,7 @@ def _emit_state_fill(out_o, out_l, *, b, h, sq, d, block_q):
         ol_blk[0, 0] = jnp.full_like(ol_blk[0, 0], NEG_INF)
 
     qspec = pl.BlockSpec((1, 1, bq, d), lambda bb, hh, qi: (bb, hh, qi, 0))
-    lspec = pl.BlockSpec((1, 1, bq, LSE_W),
+    lspec = pl.BlockSpec((1, 1, _lse_block(bq), LSE_W),
                          lambda bb, hh, qi: (bb, hh, qi, 0))
     pltpu.emit_pipeline(inner, grid=(b, h, pl.cdiv(sq, bq)),
                         in_specs=[], out_specs=[qspec, lspec])(
@@ -343,7 +362,7 @@ def _emit_state_carry(src_o, src_l, out_o, out_l, *, b, h, sq, d,
         ol_blk[0, 0] = sl_blk[0, 0]
 
     qspec = pl.BlockSpec((1, 1, bq, d), lambda bb, hh, qi: (bb, hh, qi, 0))
-    lspec = pl.BlockSpec((1, 1, bq, LSE_W),
+    lspec = pl.BlockSpec((1, 1, _lse_block(bq), LSE_W),
                          lambda bb, hh, qi: (bb, hh, qi, 0))
     pltpu.emit_pipeline(inner, grid=(b, h, pl.cdiv(sq, bq)),
                         in_specs=[qspec, lspec],
@@ -478,17 +497,18 @@ def sp_ag_attention_fused(q, k_shard, v_shard, axis: str, *,
 
     qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
     base = jnp.asarray(kv_base, jnp.int32).reshape(1)
+    lrows = _lse_rows(s_loc, min(block_q, s_loc))
 
     out, lse, *_ = pl.pallas_call(
         functools.partial(_sp_ag_attn_fused_kernel, axis, world, scale,
                           block_q, block_k, h // hkv, b, h, hkv, s_loc, d),
         out_shape=(
             jax.ShapeDtypeStruct((b, h, s_loc, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, s_loc, LSE_W), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, lrows, LSE_W), jnp.float32),
             jax.ShapeDtypeStruct((world, b, hkv, s_loc, d), q.dtype),
             jax.ShapeDtypeStruct((world, b, hkv, s_loc, d), q.dtype),
             jax.ShapeDtypeStruct((2, b, h, s_loc, d), jnp.float32),
-            jax.ShapeDtypeStruct((2, b, h, s_loc, LSE_W), jnp.float32),
+            jax.ShapeDtypeStruct((2, b, h, lrows, LSE_W), jnp.float32),
         ),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -519,7 +539,11 @@ def sp_ag_attention_fused(q, k_shard, v_shard, axis: str, *,
         interpret=default_interpret(interpret),
     )(qoff, base, q, k_shard, v_shard)
     if return_lse:
-        return out, lse[..., 0]
+        if _lse_packed(min(block_q, s_loc)):
+            lse = lse.reshape(b, h, lrows * LSE_W)[:, :, :s_loc]
+        else:
+            lse = lse[..., 0]
+        return out, lse
     return out
 
 

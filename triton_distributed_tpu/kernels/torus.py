@@ -67,6 +67,8 @@ from triton_distributed_tpu import collective_ids as cids
 from triton_distributed_tpu.kernels.matmul import (
     MatmulConfig,
     emit_matmul,
+    pad_contraction_lanes,
+    pad_lanes,
     round_up_rows,
 )
 from triton_distributed_tpu.kernels.reduce_scatter import (
@@ -316,13 +318,17 @@ def all_gather_torus(x, ctx: TorusContext):
 
     nd = len(sizes)
     L = 2 * nd
-    m, n = x.shape
-    # Pieces must be SUBLANE-ALIGNED, not just L-divisible: Mosaic
-    # rejects DMA slices of unaligned row counts (caught by the
-    # topology-compile suite — interpret mode accepts any shape).
+    m, _ = x.shape
+    # Pieces must be SUBLANE-ALIGNED (row counts) and LANE-ALIGNED
+    # (column counts): Mosaic rejects DMA slices of unaligned blocks
+    # in either dim (topology-compile catches — interpret mode
+    # accepts any shape).
+    xp, n_orig = pad_lanes(x)
+    n = xp.shape[1]
     ms = round_up_rows(pl.cdiv(m, L), x.dtype)
     pad = L * ms - m
-    xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+    if pad:
+        xp = jnp.pad(xp, ((0, pad), (0, 0)))
     maxw = max(sizes)
 
     out = pl.pallas_call(
@@ -341,7 +347,9 @@ def all_gather_torus(x, ctx: TorusContext):
     out = out.reshape(world, L * ms, n)
     if pad:
         out = out[:, :m]
-    return out.reshape(world * m, n)
+    if n != n_orig:
+        out = out[..., :n_orig]
+    return out.reshape(world * m, n_orig)
 
 
 # ---------------------------------------------------------------------------
@@ -545,13 +553,15 @@ def reduce_scatter_torus(x, ctx: TorusContext):
 
     nd = len(sizes)
     L = 2 * nd
-    mt, n = x.shape
+    mt, _ = x.shape
     assert mt % world == 0, (x.shape, world)
     m = mt // world
-    # Sublane-aligned pieces (see all_gather_torus).
+    # Sublane- and lane-aligned pieces (see all_gather_torus).
+    xp, n_orig = pad_lanes(x)
+    n = xp.shape[1]
     ms = round_up_rows(pl.cdiv(m, L), x.dtype)
     pad = L * ms - m
-    xr = x.reshape(world, m, n)
+    xr = xp.reshape(world, m, n)
     if pad:
         xr = jnp.pad(xr, ((0, 0), (0, pad), (0, 0)))
     maxw = max(sizes)
@@ -583,7 +593,9 @@ def reduce_scatter_torus(x, ctx: TorusContext):
         interpret=default_interpret(ctx.interpret),
     )(xr.reshape(sizes + (L, ms, n)))
     out = out.reshape(L * ms, n)
-    return out[:m] if pad else out
+    if pad:
+        out = out[:m]
+    return out[:, :n_orig] if n != n_orig else out
 
 
 # ---------------------------------------------------------------------------
@@ -667,7 +679,14 @@ def ag_gemm_torus(a_shard, b, ctx: TorusContext,
 
     nd = len(sizes)
     L = 2 * nd
-    # Pad to L sublane-aligned pieces (sliced back below).
+    # Pad to L sublane-aligned pieces (sliced back below), and
+    # lane-align BOTH GEMM dims: K (contraction — a cols + b rows)
+    # and N (b cols — the out/gathered slabs are rank-4+ sliced
+    # blocks, same Mosaic lane rule as the collectives).
+    k_orig, n_orig = k, n
+    a_shard, b, k = pad_contraction_lanes(a_shard, b)
+    b, _ = pad_lanes(b)
+    n = b.shape[1]
     ms = round_up_rows(pl.cdiv(m, L), a_shard.dtype)
     mL = L * ms
     a_p = (a_shard if mL == m
@@ -704,12 +723,16 @@ def ag_gemm_torus(a_shard, b, ctx: TorusContext,
     out = out.reshape(world, mL, n)
     if mL != m:
         out = out[:, :m]
-    out = out.reshape(world * m, n)
+    if n != n_orig:
+        out = out[..., :n_orig]
+    out = out.reshape(world * m, n_orig)
     if return_gathered:
         g = gathered.reshape(world, mL, k)
         if mL != m:
             g = g[:, :m]
-        return out, g.reshape(world * m, k)
+        if k != k_orig:
+            g = g[..., :k_orig]
+        return out, g.reshape(world * m, k_orig)
     return out
 
 
