@@ -106,3 +106,37 @@ def test_straggler_injection(tp4_mesh):
                       in_specs=P("tp", None, None), out_specs=P(None, None))
     out = jax.jit(fn)(xs)
     assert_allclose(out, xs.sum(axis=0), atol=1e-4, rtol=1e-4)
+
+
+def test_chain_world1_unaligned_cols(devices):
+    """CHAIN's world<=1 degenerate return must give back the ORIGINAL
+    shape, not the lane-padded one (review catch: the early return sat
+    after the pad_lanes call)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(devices[:1]), ("tp",))
+    ctx = AllReduceContext(axis="tp", world_size=1,
+                           method=AllReduceMethod.CHAIN)
+    x = jnp.arange(16 * 192, dtype=jnp.float32).reshape(16, 192)
+    fn = shard_map_op(functools.partial(all_reduce, ctx=ctx), mesh,
+                      in_specs=P(None, None), out_specs=P(None, None))
+    out = jax.jit(fn)(x)
+    assert out.shape == (16, 192), out.shape
+    assert_allclose(out, x, atol=0, rtol=0, name="chain-w1-192")
+
+
+@pytest.mark.parametrize("method", [
+    AllReduceMethod.ONE_SHOT,
+    AllReduceMethod.TWO_SHOT,
+    AllReduceMethod.RING,
+])
+def test_allreduce_unaligned_cols(tp4_mesh, method):
+    """n % 128 != 0 payloads ride the pad_lanes path and must still be
+    exact (interpret check of the lane-alignment sweep)."""
+    world, m, n = 4, 16, 192
+    x = jax.random.normal(jax.random.key(5), (world, m, n), jnp.float32)
+    out = _run_ar(tp4_mesh, x, method)
+    assert out.shape == (m, n), out.shape
+    assert_allclose(out, x.sum(0), atol=1e-4, rtol=1e-4,
+                    name=f"ar-192-{method.value}")

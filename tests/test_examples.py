@@ -17,6 +17,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     "07_ag_gemm_overlap.py",
     "09_w8a8_overlap.py",
     "10_ring_attention_training.py",
+    "11_torus_collectives.py",
 ])
 def test_example_runs(script):
     env = dict(os.environ)

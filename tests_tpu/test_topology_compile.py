@@ -173,14 +173,15 @@ def test_topo_torus_allgather(shape, axes, n):
     ((2, 4), ("x", "y")),
     ((2, 2, 2), ("x", "y", "z")),
 ])
-def test_topo_torus_reduce_scatter(shape, axes):
+@pytest.mark.parametrize("n", [256, 192])   # 192: lane-unaligned cols
+def test_topo_torus_reduce_scatter(shape, axes, n):
     from triton_distributed_tpu.kernels.torus import reduce_scatter_torus
 
     ctx = _torus_ctx(shape, axes)
     _compile(lambda x: reduce_scatter_torus(x[0], ctx),
              _mesh(shape, axes),
              P(axes, None, None), P(axes, None),
-             [(WORLD, WORLD * 48, 256)], jnp.float32)
+             [(WORLD, WORLD * 48, n)], jnp.float32)
 
 
 @pytest.mark.parametrize("shape,axes", [

@@ -37,7 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu import collective_ids as cids
 
-from triton_distributed_tpu.kernels.matmul import pad_lanes
+from triton_distributed_tpu.kernels.matmul import pad_lanes, unpad_lanes
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
     comm_compiler_params,
@@ -278,8 +278,7 @@ def all_gather(x, ctx: AllGatherContext):
             compiler_params=cparams,
             interpret=interpret,
         )(xr)
-        out = out.reshape(world * m, n)
-        return out[:, :n_orig] if n != n_orig else out
+        return unpad_lanes(out.reshape(world * m, n), n_orig)
 
     kernel = (_push_all_ag_kernel if method == AllGatherMethod.PUSH_ALL
               else _ring_ag_kernel)
@@ -297,5 +296,4 @@ def all_gather(x, ctx: AllGatherContext):
         compiler_params=cparams,
         interpret=interpret,
     )(x)
-    out = out.reshape(world * m, n)
-    return out[:, :n_orig] if n != n_orig else out
+    return unpad_lanes(out.reshape(world * m, n), n_orig)

@@ -40,7 +40,7 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_distributed_tpu import collective_ids as cids
 
 from triton_distributed_tpu.kernels.reduce_scatter import _emit_reduce_sum
-from triton_distributed_tpu.kernels.matmul import pad_lanes
+from triton_distributed_tpu.kernels.matmul import pad_lanes, unpad_lanes
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
     comm_compiler_params,
@@ -304,7 +304,9 @@ def all_reduce(x, ctx: AllReduceContext):
 
     if method == AllReduceMethod.CHAIN:
         if world <= 1:
-            return x     # rank 0 would wait on a put that never comes
+            # rank 0 would wait on a put that never comes; return the
+            # UNPADDED input (x was lane-padded above).
+            return unpad_lanes(x, n_orig)
         P = _chain_chunks(m)
         mc = m // P
         out, _ = pl.pallas_call(
@@ -323,8 +325,7 @@ def all_reduce(x, ctx: AllReduceContext):
             compiler_params=cparams,
             interpret=interpret,
         )(x.reshape(P, mc, n))
-        out = out.reshape(m, n)
-        return out[:, :n_orig] if n != n_orig else out
+        return unpad_lanes(out.reshape(m, n), n_orig)
 
     # NOTE: HBM communication buffers are extra *outputs* (discarded),
     # not scratch — Mosaic only allows vmem/smem/semaphore scratch.
@@ -348,8 +349,7 @@ def all_reduce(x, ctx: AllReduceContext):
             compiler_params=cparams,
             interpret=interpret,
         )(x.reshape(world, mc, n))
-        out = out.reshape(m, n)
-        return out[:, :n_orig] if n != n_orig else out
+        return unpad_lanes(out.reshape(m, n), n_orig)
 
     # ONE_SHOT (also the fallback when shapes don't tile)
     out, _ = pl.pallas_call(
@@ -368,4 +368,4 @@ def all_reduce(x, ctx: AllReduceContext):
         compiler_params=cparams,
         interpret=interpret,
     )(x)
-    return out[:, :n_orig] if n != n_orig else out
+    return unpad_lanes(out, n_orig)

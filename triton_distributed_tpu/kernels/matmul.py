@@ -287,6 +287,13 @@ def pad_lanes(x, multiple: int = 128):
     return x, n
 
 
+def unpad_lanes(x, n_orig: int):
+    """Inverse of :func:`pad_lanes`: slice the last dim back to the
+    original width.  Unconditional — when nothing was padded the
+    slice is a jit no-op, so call sites need no guard."""
+    return x[..., :n_orig]
+
+
 def pad_contraction_lanes(a, b, axis_a: int = -1, axis_b: int = 0):
     """Zero-pad the shared contraction dim of ``a`` (its ``axis_a``)
     and ``b`` (its ``axis_b``) to the 128-lane multiple.

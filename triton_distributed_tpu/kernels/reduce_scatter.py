@@ -35,7 +35,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu import collective_ids as cids
 
-from triton_distributed_tpu.kernels.matmul import pad_lanes
+from triton_distributed_tpu.kernels.matmul import pad_lanes, unpad_lanes
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
     comm_compiler_params,
@@ -293,7 +293,7 @@ def reduce_scatter(x, ctx: ReduceScatterContext):
             compiler_params=cparams,
             interpret=interpret,
         )(xr)
-        return out[:, :n_orig] if n != n_orig else out
+        return unpad_lanes(out, n_orig)
 
     # RING
     out, _, _ = pl.pallas_call(
@@ -314,4 +314,4 @@ def reduce_scatter(x, ctx: ReduceScatterContext):
         compiler_params=cparams,
         interpret=interpret,
     )(xr)
-    return out[:, :n_orig] if n != n_orig else out
+    return unpad_lanes(out, n_orig)

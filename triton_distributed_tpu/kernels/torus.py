@@ -70,6 +70,7 @@ from triton_distributed_tpu.kernels.matmul import (
     pad_contraction_lanes,
     pad_lanes,
     round_up_rows,
+    unpad_lanes,
 )
 from triton_distributed_tpu.kernels.reduce_scatter import (
     emit_add_into as _add_into,
@@ -347,9 +348,7 @@ def all_gather_torus(x, ctx: TorusContext):
     out = out.reshape(world, L * ms, n)
     if pad:
         out = out[:, :m]
-    if n != n_orig:
-        out = out[..., :n_orig]
-    return out.reshape(world * m, n_orig)
+    return unpad_lanes(out, n_orig).reshape(world * m, n_orig)
 
 
 # ---------------------------------------------------------------------------
@@ -595,7 +594,7 @@ def reduce_scatter_torus(x, ctx: TorusContext):
     out = out.reshape(L * ms, n)
     if pad:
         out = out[:m]
-    return out[:, :n_orig] if n != n_orig else out
+    return unpad_lanes(out, n_orig)
 
 
 # ---------------------------------------------------------------------------
@@ -723,15 +722,12 @@ def ag_gemm_torus(a_shard, b, ctx: TorusContext,
     out = out.reshape(world, mL, n)
     if mL != m:
         out = out[:, :m]
-    if n != n_orig:
-        out = out[..., :n_orig]
-    out = out.reshape(world * m, n_orig)
+    out = unpad_lanes(out, n_orig).reshape(world * m, n_orig)
     if return_gathered:
         g = gathered.reshape(world, mL, k)
         if mL != m:
             g = g[:, :m]
-        if k != k_orig:
-            g = g[..., :k_orig]
+        g = unpad_lanes(g, k_orig)
         return out, g.reshape(world * m, k_orig)
     return out
 
