@@ -254,3 +254,26 @@ def test_ag_gemm_diff_grads_2level(dcn2_ici4_mesh):
     for got, want, name in zip(g_fused, g_ref, ("da", "db")):
         assert_allclose(got, want, atol=5e-3, rtol=5e-3,
                         name=f"2level diff {name}")
+
+
+def test_hierarchical_a2a_xla_method_matches(dcn2_ici4_mesh):
+    """`a2a_method="xla"` (the only ICI method that can cross process
+    boundaries — used by the multi-process launcher test) must be
+    BIT-IDENTICAL to the Pallas LL kernel on the same mesh."""
+    cap, hidden = 8, 128
+    send = jax.random.normal(jax.random.key(31),
+                             (WORLD, WORLD, cap, hidden), jnp.float32)
+    counts = jax.random.randint(jax.random.key(32), (WORLD, WORLD, 1),
+                                1, cap + 1).astype(jnp.int32)
+    both = ("dcn", "ici")
+    outs = {}
+    for m in ("auto", "xla"):
+        fn = shard_map_op(
+            lambda s, c, m=m: hierarchical_all_to_all(
+                s[0], c[0], _hctx(a2a_method=m)),
+            dcn2_ici4_mesh,
+            in_specs=(P(both, None, None, None), P(both, None, None)),
+            out_specs=(P(both, None, None), P(both, None)))
+        outs[m] = jax.jit(fn)(send, counts)
+    for a, b in zip(outs["auto"], outs["xla"]):
+        assert_allclose(a, b, atol=0, rtol=0, name="a2a xla==pallas")
