@@ -167,3 +167,26 @@ def test_autotuner_disk_cache(tmp_path):
     t6(a)
     t6(b)
     assert len(calls) == 2  # both keys hit the disk cache
+
+
+def test_collective_disk_hit_adopts_with_nan_sentinel(monkeypatch):
+    """ADVICE r3: when rank 0's disk hit is adopted by a rank whose
+    local cache missed, the fabricated entry must carry NaN timing and
+    an EMPTY ranking — a 0.0 sentinel would read as a real measurement
+    to finalist re-examination by margin."""
+    import math
+
+    from jax.experimental import multihost_utils
+
+    from triton_distributed_tpu.autotuner import ContextualAutotuner
+
+    tuner = ContextualAutotuner(lambda *a, **k: None,
+                                configs=["cfgA", "cfgB"])
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # Rank 0 (authoritative) hit config index 1; this rank missed.
+    monkeypatch.setattr(multihost_utils, "broadcast_one_to_all",
+                        lambda x: 1)
+    entry = tuner._collective_disk_hit(None)
+    assert entry.config == "cfgB"
+    assert math.isnan(entry.time_s)
+    assert entry.ranking == []
