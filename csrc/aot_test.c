@@ -244,6 +244,18 @@ int main(int argc, char **argv) {
     for (int i = 0; i < nouts; i++) {
       const tdt_sig *s = tdt_bundle_out_sig(bundle, variant, i);
       outs2[i] = malloc(tdt_sig_bytes(s));
+      if (!outs2[i]) {
+        fprintf(stderr, "loop: out of memory for output %d (%zu B)\n",
+                i, tdt_sig_bytes(s));
+        return 1;
+      }
+      /* A malformed spec (target index past the arg list) would
+       * silently break the feedback wiring — report it. */
+      if (tgt[i] >= nargs)
+        fprintf(stderr,
+                "loop: test_loop.txt target %d for output %d is out of "
+                "range (nargs=%d); output not fed back\n",
+                tgt[i], i, nargs);
     }
     void **cur = outs, **nxt = outs2;
     for (int t = 0; t < steps; t++) {

@@ -96,7 +96,19 @@ def main() -> int:
             deadline -= 1
         rc = 130
     finally:
+        # SIGTERM, then escalate: a worker wedged in a collective can
+        # ignore SIGTERM and outlive the launcher holding ports (ADVICE
+        # r4) — poll briefly and SIGKILL survivors.
         _kill_group()
+        import time
+        deadline = 20  # 5 s
+        while deadline and any(p.poll() is None for p in procs):
+            time.sleep(0.25)
+            deadline -= 1
+        _kill_group(signal.SIGKILL)
+        for p in procs:
+            if p.poll() is None:
+                p.wait()
     return rc
 
 

@@ -55,6 +55,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import itertools
+import threading
 from typing import Optional, Tuple
 
 import jax
@@ -142,15 +143,21 @@ def create_torus_context(axes, sizes, **kw) -> TorusContext:
 #: Stable per-RS-id allocation of the AllReduce AG-stage id (ADVICE
 #: r3): the default maps to the registry constant; any other id gets
 #: ONE registry-allocated partner, cached so repeated traces reuse it.
+#: Growth is bounded by the number of DISTINCT user-supplied RS ids
+#: (user ids come from `cids.allocate()`, so programs allocate a
+#: handful, not unbounded); the lock makes check-then-allocate atomic
+#: under concurrent tracing (ADVICE r4).
 _PAIRED_AG_IDS: dict = {}
+_PAIRED_AG_IDS_LOCK = threading.Lock()
 
 
 def _paired_ag_id(rs_id: int) -> int:
     if rs_id == cids.ALLGATHER:
         return cids.ALLREDUCE_RING_AG
-    if rs_id not in _PAIRED_AG_IDS:
-        _PAIRED_AG_IDS[rs_id] = cids.allocate()
-    return _PAIRED_AG_IDS[rs_id]
+    with _PAIRED_AG_IDS_LOCK:
+        if rs_id not in _PAIRED_AG_IDS:
+            _PAIRED_AG_IDS[rs_id] = cids.allocate()
+        return _PAIRED_AG_IDS[rs_id]
 
 
 def lane_schedules(nd: int):
