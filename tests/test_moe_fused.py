@@ -96,6 +96,40 @@ def test_moe_mlp_fused_vs_xla(tp4_mesh, topk):
                     name=f"moe-mlp-topk{topk}")
 
 
+def test_moe_mlp_w8a8_vs_dequantized_xla(tp4_mesh):
+    """mode="w8a8" (int8 weights + on-the-fly int8 activations through
+    both fused kernels) tracks the XLA golden run on the DEQUANTIZED
+    weights — the only remaining error source is activation
+    quantization (~1/127 per element)."""
+    world, mc, h, ffn, e = 4, 32, 64, 64, 4
+    layer_kw = dict(axis="tp", world_size=world, hidden=h, ffn=ffn,
+                    num_experts=e, topk=2)
+    x = jax.random.normal(jax.random.key(30), (world * mc, h),
+                          jnp.float32) / 4
+    qlayer = MoEMLP(mode="w8a8", **layer_kw)
+    params = qlayer.init_params(jax.random.key(31), dtype=jnp.float32)
+    qparams = qlayer.quantize_params(params)
+
+    fn = shard_map_op(
+        lambda xx, pp: qlayer(xx, pp),
+        tp4_mesh,
+        in_specs=(P("tp", None), qlayer.global_param_specs_w8a8()),
+        out_specs=P("tp", None))
+    got = jax.jit(fn)(x, qparams)
+
+    xlayer = MoEMLP(mode="xla", **layer_kw)
+    fnx = shard_map_op(
+        lambda xx, pp: xlayer(xx, pp),
+        tp4_mesh,
+        in_specs=(P("tp", None), xlayer.global_param_specs()),
+        out_specs=P("tp", None))
+    ref = jax.jit(fnx)(x, qlayer.dequantize_params(qparams,
+                                                   jnp.float32))
+    err = np.abs(np.asarray(got, np.float32) - np.asarray(ref))
+    scale = float(jnp.abs(ref).max()) + 1e-6
+    assert err.max() < 4e-2 * scale, (err.max(), scale)
+
+
 def test_qwen_moe_e2e(tp4_mesh):
     """MoE model: fused prefill logits match the XLA golden; decode
     steps run and stay finite + consistent."""

