@@ -169,6 +169,35 @@ def test_autotuner_disk_cache(tmp_path):
     assert len(calls) == 2  # both keys hit the disk cache
 
 
+def test_tune_and_disk_winner(tmp_path, monkeypatch):
+    """`tune` reports disk_hit truthfully and `disk_winner` reads the
+    persisted winner with NO timing — the bench→AOT bridge (VERDICT
+    r4 missing #1: benches tune online, AOT builders ship the same
+    winner)."""
+    import jax
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.autotuner import disk_winner, tune
+
+    def op(a, *, config):
+        return a * config
+
+    path = str(tmp_path / "cache.json")
+    a = jnp.ones((8, 128))
+    cfg1, hit1 = tune(op, [2.0, 3.0], (a,), iters=1, cache_path=path)
+    assert not hit1 and cfg1 in (2.0, 3.0)
+    cfg2, hit2 = tune(op, [2.0, 3.0], (a,), iters=1, cache_path=path)
+    assert hit2 and cfg2 == cfg1
+
+    # No-timing lookup, incl. via abstract ShapeDtypeStructs.
+    sds = (jax.ShapeDtypeStruct((8, 128), "float32"),)
+    assert disk_winner(op, [2.0, 3.0], sds, cache_path=path) == cfg1
+    # unknown shape / changed candidates -> None (never a stale pick)
+    sds2 = (jax.ShapeDtypeStruct((16, 128), "float32"),)
+    assert disk_winner(op, [2.0, 3.0], sds2, cache_path=path) is None
+    assert disk_winner(op, [5.0], sds, cache_path=path) is None
+
+
 def test_collective_disk_hit_adopts_with_nan_sentinel(monkeypatch):
     """ADVICE r3: when rank 0's disk hit is adopted by a rank whose
     local cache missed, the fabricated entry must carry NaN timing and

@@ -126,6 +126,23 @@ def quantize_kv(k, v):
     return k_q, v_q, ks, vs
 
 
+def flash_decode_config_space(s: int):
+    """block_k candidates for the contextual autotuner — the KV block
+    length trades DMA granularity against grid bookkeeping (the hand
+    sweep in docs/performance.md picked 4096; the tuner re-derives it
+    per shape and persists it)."""
+    out = [bk for bk in (1024, 2048, 4096, 8192) if bk <= s]
+    return out or [s]
+
+
+def flash_decode_tunable(q, k_cache, v_cache, kv_len, *, config, **kw):
+    """`flash_decode` under the autotuner calling convention
+    (``config`` = block_k).  Module-level so the tuner's disk key is
+    shared between benches and AOT builders."""
+    return flash_decode(q, k_cache, v_cache, kv_len, block_k=config,
+                        **kw)
+
+
 def flash_decode(q, k_cache, v_cache, kv_len, *,
                  k_scale=None, v_scale=None,
                  scale: Optional[float] = None, block_k: int = 4096,

@@ -23,6 +23,29 @@ from triton_distributed_tpu.tools.compile_aot import (
 from triton_distributed_tpu.tools.native import _DTYPE_CODES
 
 
+def _tuned_decode_block_k(batch, heads, kv_heads, head_dim, s, dtype):
+    """Winner for this decode shape from the ContextualAutotuner's
+    persistent disk cache (None when this shape was never tuned → the
+    kernel default).  The bench populates the cache online; the AOT
+    builder ships the SAME tuned config — the reference's
+    `aot_compile_spaces` over its autotuner's config spaces
+    (`tools/compile_aot.py:61`, `scripts/aot_kernels.txt`)."""
+    import jax
+
+    from triton_distributed_tpu.autotuner import disk_winner
+    from triton_distributed_tpu.kernels.flash_decode import (
+        flash_decode_config_space,
+        flash_decode_tunable,
+    )
+
+    sds = (jax.ShapeDtypeStruct((batch, heads, head_dim), dtype),
+           jax.ShapeDtypeStruct((batch, kv_heads, s, head_dim), dtype),
+           jax.ShapeDtypeStruct((batch, kv_heads, s, head_dim), dtype),
+           jax.ShapeDtypeStruct((batch,), "int32"))
+    return disk_winner(flash_decode_tunable,
+                       flash_decode_config_space(s), sds)
+
+
 def build_flash_decode_bundle(out_dir: str, *, batch: int = 8,
                               heads: int = 32, kv_heads: int = 8,
                               head_dim: int = 128,
@@ -30,11 +53,19 @@ def build_flash_decode_bundle(out_dir: str, *, batch: int = 8,
                               dtype: str = "bfloat16"):
     """The decode family: one variant per KV length (the reference
     AOT-compiles the flash-decode family over declared signature
-    spaces for exactly this serving use)."""
+    spaces for exactly this serving use).  Each variant compiles with
+    the machine-tuned block_k when the autotune disk cache has one for
+    its shape."""
     from triton_distributed_tpu.kernels.flash_decode import flash_decode
 
+    tuned = {s: _tuned_decode_block_k(batch, heads, kv_heads, head_dim,
+                                      s, dtype) for s in seqs}
+
     def decode_fn(q, kc, vc, kv_len):
-        return flash_decode(q, kc, vc, kv_len)[0]
+        s = kc.shape[2]
+        bk = tuned.get(s)
+        kw = {"block_k": bk} if bk else {}
+        return flash_decode(q, kc, vc, kv_len, **kw)[0]
 
     variants = [
         AotVariant(
@@ -69,6 +100,46 @@ def build_ll_gemm_bundle(out_dir: str, *, k: int = 7168, n: int = 7168,
         for m in ms
     ]
     return compile_aot(ll_fn, "ag_gemm_ll", variants, out_dir)
+
+
+def build_flash_attention_bundle(out_dir: str, *, batch: int = 1,
+                                 heads: int = 8, head_dim: int = 128,
+                                 seqs: Sequence[int] = (1024, 4096),
+                                 dtype: str = "bfloat16"):
+    """Causal prefill attention family: one variant per sequence
+    length, each compiled with the machine-tuned (block_q, block_k)
+    from the autotune disk cache when present (the bench's
+    `flash_attention_tunable` space — same fn identity, same key)."""
+    import jax
+
+    from triton_distributed_tpu.autotuner import disk_winner
+    from triton_distributed_tpu.kernels.flash_attention import (
+        flash_attention,
+        flash_attention_config_space,
+        flash_attention_tunable,
+    )
+
+    tuned = {}
+    for s in seqs:
+        sds = tuple(jax.ShapeDtypeStruct((batch, heads, s, head_dim),
+                                         dtype) for _ in range(3))
+        tuned[s] = disk_winner(flash_attention_tunable,
+                               flash_attention_config_space(s, s), sds)
+
+    def attn_fn(q, k, v):
+        s = q.shape[2]
+        blocks = tuned.get(s)
+        kw = ({"block_q": blocks[0], "block_k": blocks[1]}
+              if blocks else {})
+        return flash_attention(q, k, v, causal=True, **kw)
+
+    variants = [
+        AotVariant(f"s{s}",
+                   [(batch, heads, s, head_dim)] * 3,
+                   [dtype] * 3)
+        for s in seqs
+    ]
+    return compile_aot(attn_fn, "flash_attention", variants, out_dir)
 
 
 def build_decode_step_bundle(out_dir: str, *, cfg=None,
