@@ -1,0 +1,232 @@
+"""Fused MoE pipelines on hardware (VERDICT r4 missing #2 / weak #3):
+
+- `moe_reduce_rs_fused` (grouped down-GEMM + one-hot combine in ONE
+  kernel) vs the staged composition (Pallas grouped GEMM → XLA
+  combine) and pure XLA — measurable at world=1, where the kernel is
+  the chunk pipeline + combine matmul with no RS stage.
+- `ag_group_gemm` (fused AG + grouped GEMM; world=1 = the in-kernel
+  grouped pipeline) vs XLA.
+- int8: `grouped_matmul_w8a8` vs bf16 `grouped_matmul` at the
+  weight-streaming-bound MoE decode shape (E=64, cap=128) — expert
+  weights at half the bytes double the binding roofline — and the
+  quantized fused epilogue vs its bf16 twin.
+
+Reference analogue: the MoE layer/e2e bench recipes
+(`docs/e2e.md:30-123`) and the published a2a dispatch latency
+(`README.md:96-97`).
+
+ABBA bracketing + per-repeat paired ratios + spread fields, like
+`bench_attention.py`.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+import argparse
+import functools
+import json
+import statistics
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_distributed_tpu.kernels import moe_utils
+from triton_distributed_tpu.kernels.allgather_group_gemm import (
+    AGGroupGEMMContext,
+    ag_group_gemm,
+    gated_silu,
+)
+from triton_distributed_tpu.kernels.grouped_gemm import (
+    grouped_matmul,
+    grouped_matmul_w8a8,
+)
+from triton_distributed_tpu.kernels.moe_reduce_rs import (
+    MoEReduceRSContext,
+    moe_reduce_rs_fused,
+)
+from triton_distributed_tpu.kernels.quantized import quantize_sym
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.benchmarking import (
+    feedback_mix,
+    measure_ops_scanned,
+)
+
+
+def _emit(row):
+    print(json.dumps(row), flush=True)
+
+
+def _paired_stats(slopes, self_first, self_last):
+    """slopes rows: [ours, *baselines, ours]; per-repeat pairing."""
+    ours_pairs = [(x + y) / 2 for x, y in zip(slopes[self_first],
+                                              slopes[self_last])]
+    t_self = statistics.median(slopes[self_first] + slopes[self_last])
+
+    def ratio(idx):
+        rs = sorted(t / o for t, o in zip(slopes[idx], ours_pairs))
+        return (round(statistics.median(rs), 3),
+                [round(rs[0], 3), round(rs[-1], 3)])
+
+    return t_self, ratio
+
+
+def bench_moe_epilogue(e, cap, mc, k, n, topk, repeats):
+    """moe_reduce_rs_fused vs staged vs XLA at world=1."""
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    key = jax.random.key(0)
+    buckets = (jax.random.normal(key, (1, e, cap, k)) / 8
+               ).astype(jnp.bfloat16)
+    wdown = (jax.random.normal(jax.random.fold_in(key, 1), (e, k, n))
+             / 8).astype(jnp.bfloat16)
+    ids = jax.random.randint(jax.random.fold_in(key, 2), (mc, topk),
+                             0, e)
+    tw = jax.nn.softmax(jax.random.normal(
+        jax.random.fold_in(key, 3), (mc, topk)), axis=-1)
+    plan = moe_utils.plan_chunks(ids, tw, 1, e, cap)
+    cmats = plan.combine_mats.astype(jnp.bfloat16)
+
+    ctx = MoEReduceRSContext(axis="tp", world_size=1, num_experts=e,
+                             topk=topk)
+
+    def fused(bk, w_, cm):
+        return shard_map_op(
+            lambda b_, ww, c_: moe_reduce_rs_fused(b_, ww, c_, ctx),
+            mesh, in_specs=(P(), P(), P()), out_specs=P())(bk, w_, cm)
+
+    def staged(bk, w_, cm):
+        part = grouped_matmul(bk[0], w_)              # (E, cap, n)
+        return jnp.einsum("emc,ecn->mn", cm[0], part.astype(jnp.float32)
+                          ).astype(bk.dtype)
+
+    def xla(bk, w_, cm):
+        part = jnp.einsum("eck,ekn->ecn", bk[0], w_,
+                          preferred_element_type=jnp.float32)
+        return jnp.einsum("emc,ecn->mn", cm[0].astype(jnp.float32),
+                          part).astype(bk.dtype)
+
+    # chain through buckets (feed the (mc, n) output back into the
+    # bucket tensor so iterations are data-dependent); identical mix
+    # cost for every op in the ABBA set, so ratios are unbiased
+    def mix(a, out):
+        return (feedback_mix(a[0], out[None, None]), a[1], a[2])
+
+    ops = [fused, staged, xla, fused]
+    _, slopes = measure_ops_scanned(
+        ops, (buckets, wdown, cmats), mix,
+        n_inner=16, repeats=repeats, return_slopes=True)
+    t_fused, ratio = _paired_stats(slopes, 0, -1)
+    flops = 2 * e * cap * k * n + 2 * e * mc * cap * n
+    vs_staged, staged_rng = ratio(1)
+    vs_xla, xla_rng = ratio(2)
+    _emit({
+        "bench": "moe_reduce_rs_fused", "world": 1,
+        "E": e, "cap": cap, "mc": mc, "K": k, "N": n,
+        "note": "degenerate_world1_no_rs_stage",
+        "us": round(t_fused * 1e6, 1),
+        "tflops": round(flops / t_fused / 1e12, 1),
+        "vs_staged": vs_staged, "vs_staged_range": staged_rng,
+        "vs_xla": vs_xla, "vs_xla_range": xla_rng,
+    })
+
+
+def bench_ag_group_gemm(e, cap, k, n, repeats):
+    """ag_group_gemm at world=1 (in-kernel grouped pipeline) vs XLA."""
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    key = jax.random.key(1)
+    buckets = (jax.random.normal(key, (e, cap, k)) / 8
+               ).astype(jnp.bfloat16)
+    w = (jax.random.normal(jax.random.fold_in(key, 1), (e, k, n)) / 8
+         ).astype(jnp.bfloat16)
+    ctx = AGGroupGEMMContext(axis="tp", world_size=1, num_experts=e)
+
+    def fused(bk, ww):
+        out = shard_map_op(
+            lambda b_, w_: ag_group_gemm(b_, w_, ctx),
+            mesh, in_specs=(P(), P()), out_specs=P())(bk, ww)
+        return out[0]                                  # (E, cap, n)
+
+    def xla(bk, ww):
+        return jnp.einsum("eck,ekn->ecn", bk, ww,
+                          preferred_element_type=jnp.float32
+                          ).astype(bk.dtype)
+
+    def mix(a, out):
+        return (feedback_mix(a[0], out), a[1])
+
+    ops = [fused, xla, fused]
+    _, slopes = measure_ops_scanned(
+        ops, (buckets, w), mix, n_inner=16, repeats=repeats,
+        return_slopes=True)
+    t_fused, ratio = _paired_stats(slopes, 0, -1)
+    vs_xla, rng = ratio(1)
+    flops = 2 * e * cap * k * n
+    _emit({
+        "bench": "ag_group_gemm", "world": 1,
+        "E": e, "cap": cap, "K": k, "N": n,
+        "note": "degenerate_world1_overhead_only",
+        "us": round(t_fused * 1e6, 1),
+        "tflops": round(flops / t_fused / 1e12, 1),
+        "vs_xla": vs_xla, "vs_xla_range": rng,
+    })
+
+
+def bench_grouped_w8a8(e, cap, k, n, repeats):
+    """int8 grouped GEMM vs bf16 at the weight-bound MoE shape."""
+    key = jax.random.key(2)
+    a = (jax.random.normal(key, (e, cap, k)) / 8).astype(jnp.bfloat16)
+    b = (jax.random.normal(jax.random.fold_in(key, 1), (e, k, n)) / 8
+         ).astype(jnp.bfloat16)
+    a_q, sa = quantize_sym(a, axis=2)
+    b_q, sb = quantize_sym(b, axis=1)
+
+    def int8(aq, af, saq, bq, sbq, bf_b):
+        return grouped_matmul_w8a8(aq, bq, saq, sbq)
+
+    def bf16(aq, af, saq, bq, sbq, bf_b):
+        return grouped_matmul(af, bf_b)
+
+    # Chain BOTH activation tensors on every iteration (the ops read
+    # different ones; an unchained operand would let XLA hoist the
+    # whole matmul out of the scan).  The mix cost is identical for
+    # both ops, so the paired ratio stays unbiased.
+    def mix(a_, out):
+        return (feedback_mix(a_[0], out), feedback_mix(a_[1], out),
+                *a_[2:])
+
+    ops = [int8, bf16, int8]
+    _, slopes = measure_ops_scanned(
+        ops, (a_q, a, sa, b_q, sb, b), mix, n_inner=16,
+        repeats=repeats, carry_args=2, return_slopes=True)
+    t_int8, ratio = _paired_stats(slopes, 0, -1)
+    speedup, rng = ratio(1)
+    flops = 2 * e * cap * k * n
+    _emit({
+        "bench": "grouped_gemm_w8a8", "E": e, "cap": cap, "K": k, "N": n,
+        "us": round(t_int8 * 1e6, 1),
+        "tops": round(flops / t_int8 / 1e12, 1),
+        "speedup_vs_bf16": speedup, "speedup_range": rng,
+    })
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repeats", type=int, default=4)
+    args = ap.parse_args()
+
+    # weight-streaming-bound decode shape (docs/performance.md) and a
+    # compute-bound prefill shape
+    bench_grouped_w8a8(64, 128, 2048, 1408, args.repeats)
+    bench_grouped_w8a8(8, 1024, 7168, 2048, args.repeats)
+    bench_ag_group_gemm(64, 128, 2048, 1408, args.repeats)
+    bench_ag_group_gemm(8, 512, 2048, 1408, args.repeats)
+    bench_moe_epilogue(64, 128, 2048, 2048, 1408, 2, args.repeats)
+    bench_moe_epilogue(8, 512, 2048, 2048, 1408, 2, args.repeats)
+
+
+if __name__ == "__main__":
+    main()

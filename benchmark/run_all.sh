@@ -9,6 +9,6 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 mkdir -p benchmark/results
 for b in ag_gemm gemm_rs allreduce all_to_all attention flash_decode \
-         grouped_gemm e2e_decode int8_gemm; do
+         grouped_gemm moe e2e_decode e2e_prefill int8_gemm; do
   python "benchmark/bench_${b}.py" "$@" | tee "benchmark/results/${b}.json"
 done
