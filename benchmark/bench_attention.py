@@ -27,7 +27,6 @@ import jax.numpy as jnp
 
 from triton_distributed_tpu.autotuner import tune
 from triton_distributed_tpu.kernels.flash_attention import (
-    flash_attention,
     flash_attention_config_space,
     flash_attention_tunable,
 )
@@ -69,8 +68,8 @@ def main():
               f"{'disk cache hit' if disk_hit else 'tuned fresh'} -> "
               f"blocks={blocks}", file=sys.stderr, flush=True)
 
-        flash = functools.partial(flash_attention, causal=True,
-                                  block_q=blocks[0], block_k=blocks[1])
+        flash = functools.partial(flash_attention_tunable,
+                                  config=tuple(blocks))
 
         def xla_attn(q_, k_, v_):
             # XLA's fused attention path (cuDNN/Mosaic-flash when

@@ -64,6 +64,64 @@ def test_flash_attention_diag_static(sq, sk, off, bq):
     assert jnp.isfinite(lse).all()
 
 
+@pytest.mark.parametrize("sub", [64, 128, 256])
+@pytest.mark.parametrize("multi_row", [False, True])
+def test_flash_attention_diag_sub(sub, multi_row):
+    """Explicit `diag_sub` (incl. sub == block_q, the dense-masked
+    single-matmul form) must be numerics-neutral on both the
+    single-diag kernel (one block covers the problem) and the packed
+    schedule's diagonal steps (multi_row)."""
+    b, h, d, bq = 1, 2, 32, 256
+    sq = bq * (2 if multi_row else 1)
+    q = jax.random.normal(jax.random.key(60), (b, h, sq, d))
+    k = jax.random.normal(jax.random.key(61), (b, h // 2, sq, d))
+    v = jax.random.normal(jax.random.key(62), (b, h // 2, sq, d))
+    out, lse = flash_attention(q, k, v, causal=True, block_q=bq,
+                               block_k=bq, diag_sub=sub,
+                               return_lse=True)
+    ref = attention_reference(q, k, v, causal=True)
+    assert_allclose(out, ref, atol=2e-3, rtol=2e-3,
+                    name=f"diag-sub{sub}-rows{multi_row}")
+    # lse must match the dense log-sum-exp (scaled-score domain).
+    scale = d ** -0.5
+    s_full = jnp.einsum("bhqd,bhkd->bhqk", q,
+                        jnp.repeat(k, 2, axis=1)) * scale
+    mask = (jnp.arange(sq)[None, :] <= jnp.arange(sq)[:, None])
+    s_full = jnp.where(mask, s_full, -jnp.inf)
+    ref_lse = jax.scipy.special.logsumexp(s_full, axis=-1)
+    assert_allclose(lse, ref_lse, atol=2e-3, rtol=2e-3,
+                    name=f"diag-sub{sub}-lse")
+
+
+def test_flash_attention_diag_sub_invalid_ignored():
+    """A diag_sub that does not divide the clamped block falls back to
+    the heuristic instead of crashing (the tuner may propose a sub for
+    an unclamped block)."""
+    b, h, s, d = 1, 2, 192, 32
+    q = jax.random.normal(jax.random.key(63), (b, h, s, d))
+    k = jax.random.normal(jax.random.key(64), (b, h, s, d))
+    v = jax.random.normal(jax.random.key(65), (b, h, s, d))
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                          diag_sub=48)
+    ref = attention_reference(q, k, v, causal=True)
+    assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+
+def test_flash_attention_config_space_diag_sub():
+    from triton_distributed_tpu.kernels.flash_attention import (
+        flash_attention_config_space)
+    space = flash_attention_config_space(1024, 1024)
+    assert (1024, 1024, 512) in space
+    assert (1024, 1024, 1024) in space      # dense-masked form
+    # every 3-component entry is square with a dividing sub
+    for c in space:
+        if len(c) == 3:
+            assert c[0] == c[1] and c[0] % c[2] == 0
+    # clamped spaces stay deduplicated
+    small = flash_attention_config_space(256, 256)
+    assert len(set(small)) == len(small)
+
+
 def test_flash_attention_diag_static_ragged_mix():
     """Ragged sk: the last (ragged) block keeps the generic masked
     path even when other rows' diagonal blocks take the static path —

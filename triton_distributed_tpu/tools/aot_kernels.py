@@ -129,9 +129,9 @@ def build_flash_attention_bundle(out_dir: str, *, batch: int = 1,
     def attn_fn(q, k, v):
         s = q.shape[2]
         blocks = tuned.get(s)
-        kw = ({"block_q": blocks[0], "block_k": blocks[1]}
-              if blocks else {})
-        return flash_attention(q, k, v, causal=True, **kw)
+        if blocks:  # (bq, bk) or (bq, bk, diag_sub)
+            return flash_attention_tunable(q, k, v, config=blocks)
+        return flash_attention(q, k, v, causal=True)
 
     variants = [
         AotVariant(f"s{s}",
