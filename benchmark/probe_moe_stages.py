@@ -1,0 +1,140 @@
+"""Stage-level decomposition of the fused MoE epilogue at world=1
+(diagnostic, not part of run_all.sh): where do the 1471 µs go?
+
+Times, with the in-scan harness at the bench_moe E=64/cap=128 shape:
+- the Pallas grouped GEMM (tuned config) vs the XLA grouped einsum,
+- the combine stage alone: XLA einsum vs `emit_combine_matmul`
+  (wrapped in a bare pallas_call) in f32 vs bf16 multiplies,
+- the fused kernel vs the staged composition vs XLA end-to-end.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+import functools
+import json
+import statistics
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_distributed_tpu.kernels import moe_utils
+from triton_distributed_tpu.kernels.grouped_gemm import (
+    emit_combine_matmul,
+    grouped_matmul,
+)
+from triton_distributed_tpu.kernels.matmul import MatmulConfig
+from triton_distributed_tpu.kernels.moe_reduce_rs import (
+    MoEReduceRSContext,
+    moe_reduce_rs_fused,
+)
+from triton_distributed_tpu.ops import shard_map_op
+from triton_distributed_tpu.utils.benchmarking import (
+    feedback_mix,
+    measure_ops_scanned,
+)
+
+E, CAP, MC, K, N, TOPK = 64, 128, 2048, 2048, 1408, 4
+
+
+def main():
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    key = jax.random.key(0)
+    buckets = (jax.random.normal(key, (1, E, CAP, K)) / 8
+               ).astype(jnp.bfloat16)
+    wdown = (jax.random.normal(jax.random.fold_in(key, 1), (E, K, N))
+             / 8).astype(jnp.bfloat16)
+    ids = jax.random.randint(jax.random.fold_in(key, 2), (MC, TOPK),
+                             0, E)
+    tw = jax.nn.softmax(jax.random.normal(
+        jax.random.fold_in(key, 3), (MC, TOPK)), axis=-1)
+    plan = moe_utils.plan_chunks(ids, tw, 1, E, CAP)
+    cmats = plan.combine_mats.astype(jnp.bfloat16)
+    stage = (jax.random.normal(jax.random.fold_in(key, 4),
+                               (E, CAP, N)) / 8).astype(jnp.bfloat16)
+
+    cfg = MatmulConfig(block_m=128, block_n=1408, block_k=1024)
+
+    # --- stage ops ---
+    grouped = jax.jit(functools.partial(grouped_matmul, config=cfg))
+
+    def xla_grouped(bk, w_):
+        return jnp.einsum("eck,ekn->ecn", bk, w_,
+                          preferred_element_type=jnp.float32
+                          ).astype(bk.dtype)
+
+    def xla_combine(cm, st):
+        return jnp.einsum("emc,ecn->mn", cm.astype(jnp.float32),
+                          st.astype(jnp.float32)).astype(st.dtype)
+
+    def pallas_combine(cm, st, *, f32):
+        def kern(cm_ref, st_ref, o_ref):
+            emit_combine_matmul(cm_ref, st_ref, o_ref, num_experts=E,
+                                m=MC, cap=CAP, n=N, mul_f32=f32)
+        return pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct((MC, N), st.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        )(cm, st)
+
+    ctx = MoEReduceRSContext(axis="tp", world_size=1, num_experts=E,
+                             topk=TOPK, gemm=cfg)
+
+    def fused(bk, w_, cm):
+        return shard_map_op(
+            lambda b_, ww, c_: moe_reduce_rs_fused(b_, ww, c_, ctx),
+            mesh, in_specs=(P(), P(), P()), out_specs=P())(bk, w_, cm)
+
+    def staged(bk, w_, cm):
+        part = grouped_matmul(bk[0], w_, config=cfg)
+        return jnp.einsum("emc,ecn->mn", cm[0], part.astype(jnp.float32)
+                          ).astype(bk.dtype)
+
+    def xla_full(bk, w_, cm):
+        part = jnp.einsum("eck,ekn->ecn", bk[0], w_,
+                          preferred_element_type=jnp.float32)
+        return jnp.einsum("emc,ecn->mn", cm[0].astype(jnp.float32),
+                          part).astype(bk.dtype)
+
+    def t_of(name, ops, args, mix, n_inner=8, repeats=4):
+        _, slopes = measure_ops_scanned(ops, args, mix,
+                                        n_inner=n_inner,
+                                        repeats=repeats,
+                                        return_slopes=True)
+        for nm, sl in zip(name, slopes):
+            print(json.dumps({"op": nm,
+                              "us": round(statistics.median(sl) * 1e6,
+                                          1)}), flush=True)
+
+    mixg = lambda a, out: (feedback_mix(a[0], out[..., :K]), a[1])
+    t_of(["pallas_grouped", "xla_grouped"],
+         [lambda b_, w_: grouped(b_, w_),
+          lambda b_, w_: xla_grouped(b_, w_)],
+         (buckets[0], wdown), mixg)
+
+    mixc = lambda a, out: (a[0], feedback_mix(a[1], out[None].repeat(
+        E, 0)[:, :CAP]))
+    t_of(["xla_combine", "pallas_combine_f32", "pallas_combine_bf16"],
+         [lambda c_, s_: xla_combine(c_, s_),
+          lambda c_, s_: pallas_combine(c_, s_, f32=True),
+          lambda c_, s_: pallas_combine(c_, s_, f32=False)],
+         (cmats[0], stage), mixc)
+
+    mixf = lambda a, out: (feedback_mix(a[0], out[None, None, :CAP, :K]
+                                        .astype(a[0].dtype)),
+                           a[1], a[2])
+    t_of(["fused", "staged", "xla_full"],
+         [fused, staged, xla_full], (buckets, wdown, cmats), mixf)
+
+
+if __name__ == "__main__":
+    main()

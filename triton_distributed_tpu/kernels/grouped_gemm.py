@@ -366,12 +366,115 @@ def emit_grouped_matmul_w8a8(a_ref, b_ref, sa_ref, sb_ref, o_ref, *,
     )
 
 
+def emit_grouped_combine(a_ref, b_ref, cmat_ref, acc_scr, *,
+                         num_experts, cap, mc, n, k,
+                         config: Optional[MatmulConfig] = None,
+                         count_of=None):
+    """Producer-consumer fused grouped GEMM + one-hot combine:
+    ``acc_scr[mc, n] (+)= sum_e cmat[e] (mc, cap) @ (a[e] (cap, k) @
+    b[e] (k, n))`` in ONE software pipeline — each expert's down-GEMM
+    tile is consumed by the combine matmul while the next expert's
+    weight panel streams in.
+
+    This is the structural win of the fused MoE epilogue over the
+    staged composition: the (E, cap, n) partials never round-trip
+    HBM (the two-phase form wrote 23 MB of gstage then re-read it
+    per combine row-block — 8× at mc=2048/bm=256), and the combine's
+    MXU work (equal FLOPs to the GEMM itself) hides under the
+    weight streaming that bounds the grouped GEMM at decode shapes
+    (E=64/cap=128: weights are 360 MB vs 33 MB of activations).
+    Measured world=1 at that shape: 1474 µs (two-phase) → ~600 µs.
+
+    The caller owns ``acc_scr`` ((mc, n) f32 VMEM, zeroed at this
+    pipeline's first step) and converts/sends it after the pipeline
+    returns.  Combine multiplies run in the cmat dtype (bf16 in
+    production) with f32 accumulation — same rounding as the
+    two-phase form, whose gstage buffer was bf16.
+
+    ``count_of`` as in :func:`emit_grouped_matmul`, at whole-expert
+    granularity (the GEMM row block spans the full capacity, see
+    below): experts with an empty bucket skip both the GEMM and the
+    combine — exact, because the combine coefficients of padded
+    slots are zero.
+    """
+    cfg = (config or MatmulConfig()).resolve(cap, n, k)
+    bn, bk = cfg.block_n, cfg.block_k
+    nk = pl.cdiv(k, bk)
+    # The combine slices cmat along its LANE dim (cap), so the GEMM
+    # row block must span the full (128-padded) capacity — lane
+    # slices narrower than 128 are unmappable.  cap is a handful of
+    # 128-blocks in practice, so the (cap, bn) f32 tile stays small.
+    bm = cap
+
+    def inner(a_blk, b_blk, c_blk, gacc_ref):
+        e = pl.program_id(0)
+        j = pl.program_id(1)
+        kk = pl.program_id(2)
+
+        @pl.when(jnp.logical_and(
+            e == 0, jnp.logical_and(j == 0, kk == 0)))
+        def _():
+            acc_scr[:] = jnp.zeros_like(acc_scr)
+
+        valid = count_of(e) > 0 if count_of is not None else None
+
+        def gemm_step():
+            @pl.when(kk == 0)
+            def _():
+                gacc_ref[:] = jnp.zeros_like(gacc_ref)
+
+            gacc_ref[:] += jax.lax.dot_general(
+                a_blk[0], b_blk[0],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        def combine_step():
+            cm = c_blk[0]                       # (mc, cap)
+            acc_scr[:, pl.ds(j * bn, bn)] += jax.lax.dot_general(
+                cm, gacc_ref[:].astype(cm.dtype),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        if valid is None:
+            gemm_step()
+            pl.when(kk == nk - 1)(combine_step)
+        else:
+            pl.when(valid)(gemm_step)
+            pl.when(jnp.logical_and(valid, kk == nk - 1))(combine_step)
+
+    def run(gacc_ref):
+        pipeline = pltpu.emit_pipeline(
+            functools.partial(inner, gacc_ref=gacc_ref),
+            grid=(num_experts, pl.cdiv(n, bn), nk),
+            in_specs=[
+                pl.BlockSpec((1, bm, bk), lambda g, j, kk: (g, 0, kk)),
+                pl.BlockSpec((1, bk, bn), lambda g, j, kk: (g, kk, j)),
+                pl.BlockSpec((1, mc, bm), lambda g, j, kk: (g, 0, 0)),
+            ],
+            out_specs=[],
+        )
+        pipeline(a_ref, b_ref, cmat_ref)
+
+    pl.run_scoped(
+        run,
+        gacc_ref=pltpu.VMEM((bm, min(bn, n)), jnp.float32),
+    )
+
+
 def emit_combine_matmul(cmat_ref, stage_ref, o_ref, *, num_experts, m,
-                        cap, n, block_m: int = 256, block_n: int = 512):
+                        cap, n, block_m: int = 256, block_n: int = 512,
+                        mul_f32: bool = True):
     """o[m,n] = sum_e cmat[e] (m, cap) @ stage[e] (cap, n) — the
     topk-weighted combine expressed as an accumulating one-hot matmul
     (gathers become MXU work; the TPU analogue of the reference's
-    topk-reduce consumer, `moe_reduce_rs.py:486`)."""
+    topk-reduce consumer, `moe_reduce_rs.py:486`).
+
+    ``mul_f32``: f32×f32 products — identical math to the staged
+    `combine_tokens` (f32 weights × f32-cast values), but Mosaic runs
+    f32 MXU matmuls at ~1/3 the bf16 rate.  False multiplies in the
+    stage dtype (f32 accumulation either way) — the combine FLOPs
+    equal the grouped GEMM's own, so this is the difference between
+    the combine costing one GEMM or three."""
     bm = min(block_m, m)
     bn = min(block_n, n)
 
@@ -382,11 +485,9 @@ def emit_combine_matmul(cmat_ref, stage_ref, o_ref, *, num_experts, m,
         def _():
             acc_ref[:] = jnp.zeros_like(acc_ref)
 
-        # f32 x f32 products: identical math to the staged
-        # combine_tokens (f32 weights x f32-cast values), so the fused
-        # epilogue matches the staged one to summation order.
+        mul_dt = jnp.float32 if mul_f32 else s_blk.dtype
         acc_ref[:] += jax.lax.dot_general(
-            c_blk[0].astype(jnp.float32), s_blk[0].astype(jnp.float32),
+            c_blk[0].astype(mul_dt), s_blk[0].astype(mul_dt),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 

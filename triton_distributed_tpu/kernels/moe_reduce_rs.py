@@ -38,7 +38,7 @@ from triton_distributed_tpu import collective_ids as cids
 from triton_distributed_tpu.kernels import moe_utils
 from triton_distributed_tpu.kernels.grouped_gemm import (
     emit_combine_matmul,
-    emit_grouped_matmul,
+    emit_grouped_combine,
     grouped_matmul,
 )
 from triton_distributed_tpu.kernels.matmul import (
@@ -106,17 +106,20 @@ def moe_reduce_rs(buckets, expert_weights, expert_ids, slot_of_pair,
 
 
 def _moe_rs_fused_kernel(ctx: MoEReduceRSContext, e, cap, mc, n, k,
-                         has_counts, quantized, *refs):
-    if quantized:
-        (buckets_ref, w_ref, sa_ref, sw_ref, cmat_ref, *refs) = refs
-    else:
-        (buckets_ref, w_ref, cmat_ref, *refs) = refs
-        sa_ref = sw_ref = None
+                         has_counts, *refs):
+    """bf16/f32 path: per chunk, ONE producer-consumer pipeline
+    (`emit_grouped_combine`) folds each expert's down-GEMM tile into
+    a VMEM (mc, n) f32 accumulator as it is produced — the (E, cap,
+    n) partials never touch HBM, and the combine's MXU work hides
+    under the weight streaming that bounds the grouped GEMM at
+    decode shapes (measured world=1, E=64/cap=128: 1474 → ~600 µs
+    vs 894 staged / 770 XLA)."""
+    (buckets_ref, w_ref, cmat_ref, *refs) = refs
     if has_counts:
-        (counts_ref, out_ref, rbuf_ref, gstage_ref, cstage_ref,
+        (counts_ref, out_ref, rbuf_ref, acc_scr, obf_scr,
          send_sems, recv_sems) = refs
     else:
-        (out_ref, rbuf_ref, gstage_ref, cstage_ref,
+        (out_ref, rbuf_ref, acc_scr, obf_scr,
          send_sems, recv_sems) = refs
         counts_ref = None
     world = ctx.world_size
@@ -130,17 +133,71 @@ def _moe_rs_fused_kernel(ctx: MoEReduceRSContext, e, cap, mc, n, k,
         chunk = jax.lax.rem(my + 1 + s, world)
         count_of = (None if counts_ref is None else
                     lambda g, c=chunk: counts_ref[c, g])
-        if quantized:
-            from triton_distributed_tpu.kernels.grouped_gemm import (
-                emit_grouped_matmul_w8a8)
-            emit_grouped_matmul_w8a8(
-                buckets_ref.at[chunk], w_ref, sa_ref.at[chunk], sw_ref,
-                gstage_ref, num_experts=e, m=cap, n=n, k=k,
-                config=ctx.gemm_int8, count_of=count_of)
+        emit_grouped_combine(buckets_ref.at[chunk], w_ref,
+                             cmat_ref.at[chunk], acc_scr,
+                             num_experts=e, cap=cap, mc=mc, n=n, k=k,
+                             config=ctx.gemm, count_of=count_of)
+        slot = s % 2
+        if len(pending) >= 2:
+            # Free the obf slot we are about to overwrite.
+            pending.pop(0).wait_send()
+        obf_scr[slot] = acc_scr[:].astype(obf_scr.dtype)
+        if s == world - 1:
+            # Own chunk: copy straight into our receive slot.
+            local = pltpu.make_async_copy(
+                obf_scr.at[slot], rbuf_ref.at[my], send_sems.at[slot])
+            local.start()
+            local.wait()
         else:
-            emit_grouped_matmul(buckets_ref.at[chunk], w_ref, gstage_ref,
-                                num_experts=e, m=cap, n=n, k=k,
-                                config=ctx.gemm, count_of=count_of)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=obf_scr.at[slot],
+                dst_ref=rbuf_ref.at[my],
+                send_sem=send_sems.at[slot],
+                recv_sem=recv_sems.at[my],
+                device_id=dl.peer_id(ctx.axis, chunk),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            rdma.start()
+            pending.append(rdma)
+
+    for rdma in pending:
+        rdma.wait_send()
+    for i in range(1, world):
+        peer = jax.lax.rem(my + i, world)
+        dl.wait_recv(rbuf_ref.at[peer], recv_sems.at[peer])
+
+    _emit_reduce_sum(rbuf_ref, out_ref, world=world, m=mc, n=n)
+
+
+def _moe_rs_fused_kernel_q(ctx: MoEReduceRSContext, e, cap, mc, n, k,
+                           has_counts, *refs):
+    """Quantized (w8a8) path: two-phase — int8 grouped GEMM into the
+    gstage HBM buffer, then the one-hot combine matmul (the int8
+    producer has its own dequant epilogue; fusing it into the
+    combine pipeline is future work)."""
+    (buckets_ref, w_ref, sa_ref, sw_ref, cmat_ref, *refs) = refs
+    if has_counts:
+        (counts_ref, out_ref, rbuf_ref, gstage_ref, cstage_ref,
+         send_sems, recv_sems) = refs
+    else:
+        (out_ref, rbuf_ref, gstage_ref, cstage_ref,
+         send_sems, recv_sems) = refs
+        counts_ref = None
+    world = ctx.world_size
+    my = jax.lax.axis_index(ctx.axis)
+    dl.entry_barrier(ctx.axis, world)  # every peer puts into rbuf_ref
+
+    pending = []
+    for s in range(world):
+        chunk = jax.lax.rem(my + 1 + s, world)
+        count_of = (None if counts_ref is None else
+                    lambda g, c=chunk: counts_ref[c, g])
+        from triton_distributed_tpu.kernels.grouped_gemm import (
+            emit_grouped_matmul_w8a8)
+        emit_grouped_matmul_w8a8(
+            buckets_ref.at[chunk], w_ref, sa_ref.at[chunk], sw_ref,
+            gstage_ref, num_experts=e, m=cap, n=n, k=k,
+            config=ctx.gemm_int8, count_of=count_of)
         if s == world - 1:
             # Own chunk: combine straight into our receive slot.
             emit_combine_matmul(cmat_ref.at[chunk], gstage_ref,
@@ -245,18 +302,34 @@ def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
         operands.append(counts.astype(jnp.int32))
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
 
-    out, _, _, _ = pl.pallas_call(
-        functools.partial(_moe_rs_fused_kernel, ctx, e, cap, mc, n, k,
-                          has_counts, quantized),
-        out_shape=(
+    if quantized:
+        kern = functools.partial(_moe_rs_fused_kernel_q, ctx, e, cap,
+                                 mc, n, k, has_counts)
+        out_shape = (
             jax.ShapeDtypeStruct((mc, n), out_dtype),
             jax.ShapeDtypeStruct((world, mc, n), out_dtype),   # rbuf
             jax.ShapeDtypeStruct((e, cap, n), out_dtype),      # gstage
             jax.ShapeDtypeStruct((2, mc, n), out_dtype),       # cstage
-        ),
+        )
+        scratch = []
+    else:
+        kern = functools.partial(_moe_rs_fused_kernel, ctx, e, cap,
+                                 mc, n, k, has_counts)
+        out_shape = (
+            jax.ShapeDtypeStruct((mc, n), out_dtype),
+            jax.ShapeDtypeStruct((world, mc, n), out_dtype),   # rbuf
+        )
+        scratch = [
+            pltpu.VMEM((mc, n), jnp.float32),        # acc
+            pltpu.VMEM((2, mc, n), out_dtype),       # obf
+        ]
+
+    res = pl.pallas_call(
+        kern,
+        out_shape=out_shape,
         in_specs=in_specs,
-        out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 4,
-        scratch_shapes=[
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * len(out_shape),
+        scratch_shapes=scratch + [
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((world,)),
         ],
@@ -269,4 +342,4 @@ def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
         ),
         interpret=default_interpret(ctx.interpret),
     )(*operands)
-    return out
+    return res[0]
