@@ -320,6 +320,46 @@ def _regime_w8a8(mesh, world):
     return times[0], ratio, f"{tops:.0f} TOPS int8 vs bf16 XLA"
 
 
+def record_regimes(regimes, noise_bound, world):
+    """Route the regime measurements through the metrics registry so
+    the BENCH line, the flight recorder and a metrics export all carry
+    the same numbers; attach perf-model estimates where one exists and
+    run the audit over them.  TDT_METRICS_EXPORT=<path> additionally
+    writes the full registry snapshot."""
+    import os
+
+    from triton_distributed_tpu.observability import (
+        audit_events, emit_kernel_event, estimate_overlap_gemm_us,
+        get_registry, observability_enabled)
+
+    if not observability_enabled():
+        return
+    gemm_est = {
+        "prefill_fused": ("ag_gemm", M_TOTAL // world, "fused"),
+        "decode_ll": ("ag_gemm", max(16 // world, 1), "ll"),
+    }
+    events = []
+    for name, (t, ratio, detail) in dict(
+            regimes, decode_ll=noise_bound).items():
+        est = None
+        if name in gemm_est:
+            op, m_loc, method = gemm_est[name]
+            est = estimate_overlap_gemm_us(
+                op, m_loc, N_TOTAL // world, K, world, jnp.bfloat16,
+                method)
+        ev = emit_kernel_event(
+            f"bench_{name}", kind="bench", world=world,
+            measured_us=t * 1e6, estimate_us=est,
+            vs_baseline=round(ratio, 3), detail=detail)
+        if ev is not None:
+            events.append(ev)
+        get_registry().gauge("bench_vs_baseline", regime=name).set(ratio)
+    audit_events(events)
+    export = os.environ.get("TDT_METRICS_EXPORT")
+    if export:
+        get_registry().export(export)
+
+
 def main():
     devices = jax.devices()
     world = len(devices)
@@ -338,6 +378,7 @@ def main():
         "w8a8": _regime_w8a8(mesh, world),
     }
     noise_bound = _regime_decode_ll(mesh, world)
+    record_regimes(regimes, noise_bound, world)
     worst = min(regimes, key=lambda r: regimes[r][1])
     t_worst, r_worst, _ = regimes[worst]
     detail = "; ".join(f"{name}={r:.3f} ({d})"

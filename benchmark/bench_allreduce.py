@@ -12,13 +12,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import argparse
 import functools
-import json
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from triton_distributed_tpu.observability import bench_record
 from triton_distributed_tpu.kernels.allreduce import (
     AllReduceContext,
     AllReduceMethod,
@@ -60,7 +60,9 @@ def main():
         t_xla = times[-1]
         nbytes = rows * args.cols * 2
         for m, t in zip(methods, times):
-            print(json.dumps({
+            # Routed through the metrics registry (perf-model estimate
+            # + deviation attach); prints the same JSON line.
+            bench_record({
                 "bench": "allreduce", "world": world, "nbytes": nbytes,
                 "method": m.value, "us": round(t * 1e6, 1),
                 "vs_baseline": round(t_xla / t, 3),
@@ -69,7 +71,7 @@ def main():
                 # psum is a no-op — these rows measure pure kernel
                 # OVERHEAD, not collective performance.
                 "degenerate_world1_overhead_only": world <= 1,
-            }), flush=True)
+            })
 
 
 if __name__ == "__main__":

@@ -13,11 +13,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import argparse
 import functools
-import json
 
 import jax
 import jax.numpy as jnp
 
+from triton_distributed_tpu.observability import bench_record
 from triton_distributed_tpu.autotuner import tune
 from triton_distributed_tpu.kernels.flash_decode import (
     flash_decode,
@@ -141,7 +141,8 @@ def main():
         t_paged = ts[2] if run_paged else None
         t_base = ts[-1]
         kv_bytes = 2 * b * hkv * s * d * kc.dtype.itemsize
-        print(json.dumps({
+        # Routed through the metrics registry; prints the same line.
+        bench_record({
             "bench": "flash_decode", "B": b, "H": h, "Hkv": hkv,
             "S": s, "D": d,
             "us": round(t_ours * 1e6, 1),
@@ -153,7 +154,7 @@ def main():
             "vs_paged": (round(t_paged / t_ours, 3) if run_paged
                          else None),
             "vs_baseline": round(t_base / t_ours, 3),
-        }), flush=True)
+        })
 
 
 if __name__ == "__main__":

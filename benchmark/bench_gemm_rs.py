@@ -11,13 +11,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import argparse
 import functools
-import json
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from triton_distributed_tpu.observability import bench_record
 from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
     GEMMReduceScatterContext,
     gemm_rs,
@@ -70,13 +70,15 @@ def main():
             [fused, base], (a, b), chain_fn(args.k),
             repeats=args.repeats)
         flops = 2 * m_total * args.k * args.n
-        print(json.dumps({
+        # Routed through the metrics registry (perf-model estimate +
+        # deviation attach); prints the same JSON line.
+        bench_record({
             "bench": "gemm_rs", "world": world, "M": m_total,
             "K": args.k, "N": args.n, "method": method,
             "us": round(t_fused * 1e6, 1),
             "tflops": round(flops / t_fused / 1e12, 1),
             "vs_baseline": round(t_base / t_fused, 3),
-        }), flush=True)
+        })
 
 
 if __name__ == "__main__":

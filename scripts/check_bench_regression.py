@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Bench regression gate: diff a fresh bench export against the
+committed ``benchmark/results/*.json`` and exit non-zero on >10%
+latency regressions.
+
+The bench drivers print one JSON object per line (routed through the
+metrics registry — see ``observability.bench_record``); the committed
+results and a fresh run therefore share one schema, and rows are
+matched on their identity fields (everything except the measurements).
+
+Usage:
+    python benchmark/bench_ag_gemm.py > /tmp/fresh/ag_gemm.json
+    python scripts/check_bench_regression.py --fresh /tmp/fresh
+    # or a single file:
+    python scripts/check_bench_regression.py --fresh /tmp/ag.json
+
+Exit codes: 0 ok, 1 regression(s) found, 2 nothing comparable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+#: Measurement (non-identity) fields: everything the run itself
+#: produces.  Identity = all remaining fields (bench, shape, method,
+#: world, ...), so new shape points simply don't match old rows.
+MEASUREMENT_FIELDS = {
+    "us", "ms", "tflops", "tops", "kv_gbps", "vs_baseline", "vs_xla",
+    "vs_paged", "vs_jax_flash", "vs_splash", "vs_strongest",
+    "vs_strongest_range", "vs_xla_range", "ratio_range", "int8_us",
+    "int8_speedup", "ms_per_step", "tokens_per_s",
+    "prefill_tokens_per_s", "estimate_us", "model_deviation",
+    "autotune_disk_hit", "n_inner", "rounds_kept",
+    "rounds_discarded_glitch",
+    # Run-varying outputs that would otherwise identity-mismatch
+    # whole bench families out of the gate (moe, attention,
+    # flash_decode, grouped_gemm):
+    "speedup_vs_bf16", "speedup_range", "vs_staged",
+    "vs_staged_range", "autotuned_blocks", "autotuned_block_k",
+    "autotuned_config",
+}
+#: Fields that may hold the latency to compare, in preference order.
+LATENCY_FIELDS = ("us", "ms", "ms_per_step")
+
+
+def load_rows(path: str) -> list:
+    rows = []
+    paths = (sorted(glob.glob(os.path.join(path, "*.json")))
+             if os.path.isdir(path)
+             else [path] if os.path.exists(path) else [])
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and "bench" in rec:
+                    rows.append(rec)
+    return rows
+
+
+def identity(rec: dict) -> tuple:
+    return tuple(sorted((k, json.dumps(v, sort_keys=True))
+                        for k, v in rec.items()
+                        if k not in MEASUREMENT_FIELDS))
+
+
+def latency_of(rec: dict):
+    for f in LATENCY_FIELDS:
+        v = rec.get(f)
+        if isinstance(v, (int, float)) and v > 0:
+            return f, float(v)
+    return None, None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fresh", required=True,
+                    help="fresh bench output: a JSONL file or a "
+                         "directory of them")
+    ap.add_argument("--baseline",
+                    default=os.path.join(os.path.dirname(
+                        os.path.dirname(os.path.abspath(__file__))),
+                        "benchmark", "results"),
+                    help="committed results dir (default: "
+                         "benchmark/results)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="flag regressions slower than baseline by "
+                         "more than this fraction (default 0.10)")
+    args = ap.parse_args()
+
+    base = {identity(r): r for r in load_rows(args.baseline)}
+    fresh = load_rows(args.fresh)
+    if not base or not fresh:
+        print(f"check_bench_regression: nothing to compare "
+              f"({len(base)} baseline rows, {len(fresh)} fresh rows)")
+        return 2
+
+    compared = regressions = unmatched = 0
+    for rec in fresh:
+        old = base.get(identity(rec))
+        if old is None:
+            # Visible, not silent: an unmatched row is either a new
+            # shape point or an identity-field drift worth noticing.
+            unmatched += 1
+            continue
+        field, new_v = latency_of(rec)
+        _, old_v = latency_of(old)
+        if new_v is None or old_v is None:
+            continue
+        compared += 1
+        ratio = new_v / old_v
+        slower = ratio - 1.0
+        tag = "REGRESSION" if slower > args.threshold else "ok"
+        if slower > args.threshold or slower < -args.threshold:
+            print(f"[{tag:>10}] {rec.get('bench')}: {field} "
+                  f"{old_v:.1f} -> {new_v:.1f} ({slower:+.1%} vs "
+                  f"baseline) {json.dumps(dict(identity(rec)))[:120]}")
+        if slower > args.threshold:
+            regressions += 1
+
+    print(f"check_bench_regression: {compared} rows compared, "
+          f"{unmatched} unmatched (new shape points or identity "
+          f"drift), {regressions} regression(s) beyond "
+          f"{args.threshold:.0%}")
+    if compared == 0:
+        return 2
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,16 @@ def main() -> int:
     ap.add_argument("--nnodes", type=int, default=1)
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (test harness)")
+    ap.add_argument("--flight-dir", default=None,
+                    help="arm the per-rank flight recorder: workers "
+                         "dump their recent kernel events to this "
+                         "directory on SIGTERM/SIGUSR1 (default: "
+                         "inherit TDT_FLIGHT_RECORDER, else off)")
+    ap.add_argument("--timeout", type=float, default=0,
+                    help="watchdog: SIGTERM the group after this many "
+                         "seconds (0 = no limit).  With --flight-dir "
+                         "set, a hung DCN launch leaves per-rank "
+                         "flight-recorder dumps instead of silence")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args()
@@ -56,12 +66,36 @@ def main() -> int:
     signal.signal(signal.SIGTERM,
                   lambda *a: (_kill_group(), sys.exit(143)))
 
+    # Watchdog: a wedged group (the classic silent DCN hang) gets
+    # SIGTERMed after --timeout seconds; workers with the flight
+    # recorder armed dump their event rings from their own SIGTERM
+    # handlers before dying, so the hang becomes diagnosable.
+    timed_out = []
+    if args.timeout > 0:
+        def _on_alarm(*a):
+            if not any(p.poll() is None for p in procs):
+                return  # everyone already exited: not a hang
+            if timed_out:
+                # Second firing: the grace period elapsed and someone
+                # ignored SIGTERM (wedged in a compiled collective,
+                # holding the GIL away from its dump handler) —
+                # SIGKILL so os.wait() below can ever return.
+                _kill_group(signal.SIGKILL)
+                return
+            timed_out.append(True)
+            _kill_group()
+            signal.setitimer(signal.ITIMER_REAL, 10)  # dump grace
+        signal.signal(signal.SIGALRM, _on_alarm)
+        signal.setitimer(signal.ITIMER_REAL, args.timeout)
+
     for local in range(args.nproc):
         rank = args.node_rank * args.nproc + local
         env = dict(os.environ)
         env["TDT_NUM_PROCESSES"] = str(world)
         env["TDT_PROCESS_ID"] = str(rank)
         env["TDT_COORDINATOR"] = args.coordinator
+        if args.flight_dir:
+            env["TDT_FLIGHT_RECORDER"] = args.flight_dir
         if args.cpu:
             env["JAX_PLATFORMS"] = "cpu"
         procs.append(subprocess.Popen(
@@ -84,7 +118,18 @@ def main() -> int:
             p.send_signal(signal.SIGTERM)
         for p in pending.values():
             p.wait()
+        # Group fully reaped: disarm the watchdog so a run finishing
+        # just under --timeout cannot be relabelled 124 by an alarm
+        # firing during cleanup (the finally block has its own
+        # SIGTERM→SIGKILL escalation and needs no timer).
+        if args.timeout > 0:
+            signal.setitimer(signal.ITIMER_REAL, 0)
     except KeyboardInterrupt:
+        # Disarm the watchdog first: a Ctrl-C near the deadline must
+        # report 130, not be relabelled 124 by an alarm firing during
+        # the grace loop below.
+        if args.timeout > 0:
+            signal.setitimer(signal.ITIMER_REAL, 0)
         # Give the workers a grace period to run their own SIGINT
         # cleanup (finalize_distributed, port release) before the
         # finally-block's SIGTERM backstop fires.
@@ -109,6 +154,8 @@ def main() -> int:
         for p in procs:
             if p.poll() is None:
                 p.wait()
+    if timed_out:
+        rc = 124  # timeout(1) convention
     return rc
 
 

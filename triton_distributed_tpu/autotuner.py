@@ -98,14 +98,15 @@ class ContextualAutotuner:
         # would never hit across processes.  functools.partial has no
         # __qualname__: unwrap to the underlying function so two
         # partials of DIFFERENT ops don't collapse to one key.
+        return f"{d.device_kind}/w{jax.device_count()}/{self._fn_id()}"
+
+    def _fn_id(self) -> str:
         fn = self.fn
         while isinstance(fn, functools.partial):
             fn = fn.func
         mod = getattr(fn, "__module__", None)
         qual = getattr(fn, "__qualname__", None)
-        fn_id = (f"{mod}.{qual}" if mod and qual
-                 else type(fn).__name__)
-        return f"{d.device_kind}/w{jax.device_count()}/{fn_id}"
+        return f"{mod}.{qual}" if mod and qual else type(fn).__name__
 
     def _load_disk(self) -> dict:
         try:
@@ -307,15 +308,28 @@ class ContextualAutotuner:
         # entries on the re-tune path.
         return _Entry(cfg, float("nan"), [])
 
+    def _metrics(self):
+        """Registry hooks (None when observability is off)."""
+        from triton_distributed_tpu.observability import (
+            get_registry, observability_enabled)
+        return get_registry() if observability_enabled() else None
+
     def __call__(self, *args, **kwargs):
         key = self.key_fn(*args, **kwargs)
+        reg = self._metrics()
+        if key in self.cache and reg is not None:
+            reg.counter("autotune_cache_hits_total", level="memory").inc()
         if key not in self.cache and self.cache_path:
             hit = self._collective_disk_hit(self._disk_lookup(key))
             if hit is not None:
                 self.cache[key] = hit
                 logger.info("autotune %s: disk cache hit, best=%s",
                             key, hit.config)
+                if reg is not None:
+                    reg.counter("autotune_cache_hits_total",
+                                level="disk").inc()
         if key not in self.cache:
+            t_tune0 = time.perf_counter()
             results = []
             for i, cfg in enumerate(self.configs):
                 try:
@@ -334,6 +348,23 @@ class ContextualAutotuner:
                                      ranking)
             logger.info("autotune %s: best=%s (%.3f ms)", key,
                         self.configs[best_idx], results[0][0] * 1e3)
+            if reg is not None:
+                wall_s = time.perf_counter() - t_tune0
+                reg.counter("autotune_cache_misses_total").inc()
+                reg.histogram("autotune_tuning_seconds").observe(wall_s)
+                from triton_distributed_tpu.observability import (
+                    emit_kernel_event)
+                emit_kernel_event(
+                    # Plain function identity as the op (like every
+                    # other emitter): the device kind already rides in
+                    # the snapshot meta — a device-prefixed op would
+                    # explode label cardinality.
+                    self._fn_id(), kind="autotune",
+                    measured_us=results[0][0] * 1e6,
+                    config=repr(self.configs[best_idx]),
+                    tuning_wall_s=round(wall_s, 3),
+                    n_configs=len(self.configs),
+                    n_failed=len(self.configs) - len(results))
             if self.cache_path:
                 self._disk[f"{self._device_key()}|{key}"] = {
                     "best": repr(self.configs[best_idx]),

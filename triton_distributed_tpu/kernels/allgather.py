@@ -251,6 +251,12 @@ def all_gather(x, ctx: AllGatherContext):
     world = ctx.world_size
     method = ctx.resolve_method(x.size * x.dtype.itemsize)
 
+    # Launch-metadata event (fires once per traced specialization).
+    from triton_distributed_tpu.observability import record_collective
+    record_collective("all_gather", axis=ctx.axis, world=world,
+                      method=method, shape=x.shape, dtype=x.dtype,
+                      payload_bytes=x.size * x.dtype.itemsize)
+
     if method == AllGatherMethod.XLA:
         return jax.lax.all_gather(x, ctx.axis, tiled=True)
 

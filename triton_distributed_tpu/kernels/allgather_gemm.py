@@ -276,6 +276,12 @@ def ag_gemm(a_shard, b, ctx, return_gathered: bool = False):
 
     method = ctx.resolve_method(m, a_shard.dtype, k=k, n=n)
 
+    # Launch-metadata event (fires once per traced specialization).
+    from triton_distributed_tpu.observability import record_overlap_gemm
+    record_overlap_gemm("ag_gemm", axis=ctx.axis, world=world,
+                        method=method, m=m, n=n, k=k,
+                        dtype=a_shard.dtype, config=ctx.gemm)
+
     def xla_dot(a_full):
         return jnp.dot(a_full, b, preferred_element_type=jnp.float32
                        ).astype(a_shard.dtype)
@@ -398,6 +404,11 @@ def ag_gemm_w8a8(a_shard, b_q, scale_b, ctx: AllGatherGEMMContext,
     assert ctx.method in ("auto", "fused"), (
         f"ag_gemm_w8a8 implements the fused ring only, got method="
         f"{ctx.method!r}")
+
+    from triton_distributed_tpu.observability import record_overlap_gemm
+    record_overlap_gemm("ag_gemm_w8a8", axis=ctx.axis, world=world,
+                        method="fused", m=m, n=n, k=k, dtype=jnp.int8,
+                        config=config)
 
     a_q, sa = quantize_sym(a_shard, axis=1)          # (m, k) i8, (m,)
 

@@ -259,7 +259,20 @@ def all_reduce(x, ctx: AllReduceContext):
     if method == AllReduceMethod.AUTO:
         method = get_auto_allreduce_method(x.size * x.dtype.itemsize, world)
 
+    def _record(final_method):
+        # Launch-metadata event (once per traced specialization).
+        # Emitted only for methods that run their own kernel/collective
+        # here — the RING compose delegates to reduce_scatter +
+        # all_gather, which emit their own events (no double counting).
+        from triton_distributed_tpu.observability import (
+            record_collective)
+        record_collective("all_reduce", axis=ctx.axis, world=world,
+                          method=final_method, shape=x.shape,
+                          dtype=x.dtype,
+                          payload_bytes=x.size * x.dtype.itemsize)
+
     if method == AllReduceMethod.XLA:
+        _record(method)
         return jax.lax.psum(x, ctx.axis)
 
     if method == AllReduceMethod.RING:
@@ -293,6 +306,7 @@ def all_reduce(x, ctx: AllReduceContext):
             chunk = reduce_scatter(x, rs_ctx)
             return all_gather(chunk, ag_ctx)
 
+    _record(method)
     interpret = default_interpret(ctx.interpret)
     cparams = comm_compiler_params(ctx.collective_id, world)
 

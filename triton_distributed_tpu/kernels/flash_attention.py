@@ -475,7 +475,11 @@ def flash_attention(q, k, v, *, causal: bool = True,
     knob with no semantic effect — exposed so the autotuner can weigh
     FLOP savings (small sub skips more above-diagonal pieces) against
     MXU efficiency (large sub keeps matmuls big); None keeps the
-    256/128 heuristic.
+    256/128 heuristic.  On hardware `diag_sub` must additionally be a
+    multiple of 128 (the Mosaic lane tiling unit — unaligned sub-tile
+    slices are rejected by the compiler); values that violate either
+    constraint fall back to the heuristic rather than erroring.
+    Interpret mode (CPU tests) accepts any divisor.
     """
     b, h, sq, d = q.shape
     _, hkv, sk, _ = k.shape
@@ -508,6 +512,13 @@ def flash_attention(q, k, v, *, causal: bool = True,
         # with (sub, sub) pieces.  Covers plain causal (off=0) and
         # SP/ring callers whose shard offsets are block multiples.
         sub_req = diag_sub
+        # Hardware lane rule (ADVICE r5): a user/tuner-supplied sub
+        # that is not a 128 multiple would hit Mosaic's tiling check
+        # deep in compilation — fall back to the heuristic instead.
+        # Interpret mode (CPU tests) accepts any divisor.
+        if (sub_req and sub_req % 128 != 0
+                and default_interpret(interpret) is False):
+            sub_req = None
         diag_sub = 0
         if bq == bk and int(kv_offset) % bk == 0:
             if sub_req and bq % sub_req == 0:

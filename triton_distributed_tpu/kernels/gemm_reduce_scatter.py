@@ -241,6 +241,13 @@ def gemm_rs(a, b, ctx):
     mc = mt // world
 
     method = ctx.resolve_method(mc, a.dtype, k=k, n=n)
+
+    # Launch-metadata event (fires once per traced specialization).
+    from triton_distributed_tpu.observability import record_overlap_gemm
+    record_overlap_gemm("gemm_rs", axis=ctx.axis, world=world,
+                        method=method, m=mc, n=n, k=k, dtype=a.dtype,
+                        config=ctx.gemm)
+
     if method == "xla" or world <= 1:
         return gemm_rs_nonoverlap(a, b, ctx.axis)
 

@@ -53,6 +53,7 @@ from triton_distributed_tpu.kernels.reduce_scatter import (
 )
 from triton_distributed_tpu.language import core as dl
 from triton_distributed_tpu.utils.platform import (
+    COMM_VMEM_LIMIT,
     comm_compiler_params,
     default_interpret,
 )
@@ -169,20 +170,18 @@ def _moe_rs_fused_kernel(ctx: MoEReduceRSContext, e, cap, mc, n, k,
     _emit_reduce_sum(rbuf_ref, out_ref, world=world, m=mc, n=n)
 
 
-def _moe_rs_fused_kernel_q(ctx: MoEReduceRSContext, e, cap, mc, n, k,
-                           has_counts, *refs):
-    """Quantized (w8a8) path: two-phase — int8 grouped GEMM into the
-    gstage HBM buffer, then the one-hot combine matmul (the int8
-    producer has its own dequant epilogue; fusing it into the
-    combine pipeline is future work)."""
-    (buckets_ref, w_ref, sa_ref, sw_ref, cmat_ref, *refs) = refs
-    if has_counts:
-        (counts_ref, out_ref, rbuf_ref, gstage_ref, cstage_ref,
-         send_sems, recv_sems) = refs
-    else:
-        (out_ref, rbuf_ref, gstage_ref, cstage_ref,
-         send_sems, recv_sems) = refs
-        counts_ref = None
+def _emit_two_phase_pipeline(ctx: MoEReduceRSContext, e, cap, mc, n,
+                             produce, cmat_ref, counts_ref, out_ref,
+                             rbuf_ref, gstage_ref, cstage_ref,
+                             send_sems, recv_sems):
+    """Shared two-phase chunk loop: for each destination chunk (in the
+    rank+1 gemm_rs swizzle), ``produce(chunk, count_of)`` runs the
+    grouped GEMM into the HBM gstage, the one-hot combine matmul
+    writes the chunk into a double-buffered cstage slot (own chunk:
+    straight into our receive slot), and the RDMA put to the owner
+    overlaps the next chunk's compute.  One copy of the
+    semaphore/slot-reuse choreography for both the float and the
+    quantized producer."""
     world = ctx.world_size
     my = jax.lax.axis_index(ctx.axis)
     dl.entry_barrier(ctx.axis, world)  # every peer puts into rbuf_ref
@@ -192,12 +191,7 @@ def _moe_rs_fused_kernel_q(ctx: MoEReduceRSContext, e, cap, mc, n, k,
         chunk = jax.lax.rem(my + 1 + s, world)
         count_of = (None if counts_ref is None else
                     lambda g, c=chunk: counts_ref[c, g])
-        from triton_distributed_tpu.kernels.grouped_gemm import (
-            emit_grouped_matmul_w8a8)
-        emit_grouped_matmul_w8a8(
-            buckets_ref.at[chunk], w_ref, sa_ref.at[chunk], sw_ref,
-            gstage_ref, num_experts=e, m=cap, n=n, k=k,
-            config=ctx.gemm_int8, count_of=count_of)
+        produce(chunk, count_of)
         if s == world - 1:
             # Own chunk: combine straight into our receive slot.
             emit_combine_matmul(cmat_ref.at[chunk], gstage_ref,
@@ -229,6 +223,65 @@ def _moe_rs_fused_kernel_q(ctx: MoEReduceRSContext, e, cap, mc, n, k,
         dl.wait_recv(rbuf_ref.at[peer], recv_sems.at[peer])
 
     _emit_reduce_sum(rbuf_ref, out_ref, world=world, m=mc, n=n)
+
+
+def _moe_rs_fused_kernel_2p(ctx: MoEReduceRSContext, e, cap, mc, n, k,
+                            has_counts, *refs):
+    """bf16/f32 two-phase fallback (ADVICE r5): when the single-phase
+    pipeline's VMEM scratch — (4 + 2·itemsize)·mc·n for the f32
+    accumulator plus double-buffered send staging — would not fit
+    `COMM_VMEM_LIMIT`, stage the grouped GEMM through HBM (gstage)
+    and run the combine matmul into the HBM cstage/recv slots, the
+    same two-phase structure as the quantized kernel."""
+    (buckets_ref, w_ref, cmat_ref, *refs) = refs
+    if has_counts:
+        (counts_ref, out_ref, rbuf_ref, gstage_ref, cstage_ref,
+         send_sems, recv_sems) = refs
+    else:
+        (out_ref, rbuf_ref, gstage_ref, cstage_ref,
+         send_sems, recv_sems) = refs
+        counts_ref = None
+
+    from triton_distributed_tpu.kernels.grouped_gemm import (
+        emit_grouped_matmul)
+
+    def produce(chunk, count_of):
+        emit_grouped_matmul(buckets_ref.at[chunk], w_ref, gstage_ref,
+                            num_experts=e, m=cap, n=n, k=k,
+                            config=ctx.gemm, count_of=count_of)
+
+    _emit_two_phase_pipeline(ctx, e, cap, mc, n, produce, cmat_ref,
+                             counts_ref, out_ref, rbuf_ref, gstage_ref,
+                             cstage_ref, send_sems, recv_sems)
+
+
+def _moe_rs_fused_kernel_q(ctx: MoEReduceRSContext, e, cap, mc, n, k,
+                           has_counts, *refs):
+    """Quantized (w8a8) path: two-phase — int8 grouped GEMM into the
+    gstage HBM buffer, then the one-hot combine matmul (the int8
+    producer has its own dequant epilogue; fusing it into the
+    combine pipeline is future work)."""
+    (buckets_ref, w_ref, sa_ref, sw_ref, cmat_ref, *refs) = refs
+    if has_counts:
+        (counts_ref, out_ref, rbuf_ref, gstage_ref, cstage_ref,
+         send_sems, recv_sems) = refs
+    else:
+        (out_ref, rbuf_ref, gstage_ref, cstage_ref,
+         send_sems, recv_sems) = refs
+        counts_ref = None
+
+    from triton_distributed_tpu.kernels.grouped_gemm import (
+        emit_grouped_matmul_w8a8)
+
+    def produce(chunk, count_of):
+        emit_grouped_matmul_w8a8(
+            buckets_ref.at[chunk], w_ref, sa_ref.at[chunk], sw_ref,
+            gstage_ref, num_experts=e, m=cap, n=n, k=k,
+            config=ctx.gemm_int8, count_of=count_of)
+
+    _emit_two_phase_pipeline(ctx, e, cap, mc, n, produce, cmat_ref,
+                             counts_ref, out_ref, rbuf_ref, gstage_ref,
+                             cstage_ref, send_sems, recv_sems)
 
 
 def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
@@ -279,6 +332,11 @@ def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
         cap += cap_p
 
     out_dtype = buckets.dtype
+    # The combine is an MXU matmul over one-hot-weighted coefficients:
+    # run it at the activation dtype (ADVICE r5 — an f32 cmat forces
+    # the whole combine to the f32 MXU rate; accumulation stays f32
+    # inside the kernels either way).
+    combine_mats = combine_mats.astype(out_dtype)
     if quantized:
         from triton_distributed_tpu.kernels.quantized import quantize_sym
 
@@ -313,16 +371,52 @@ def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
         )
         scratch = []
     else:
-        kern = functools.partial(_moe_rs_fused_kernel, ctx, e, cap,
-                                 mc, n, k, has_counts)
-        out_shape = (
-            jax.ShapeDtypeStruct((mc, n), out_dtype),
-            jax.ShapeDtypeStruct((world, mc, n), out_dtype),   # rbuf
-        )
-        scratch = [
-            pltpu.VMEM((mc, n), jnp.float32),        # acc
-            pltpu.VMEM((2, mc, n), out_dtype),       # obf
-        ]
+        # Single-phase scratch: f32 (mc, n) accumulator + double-
+        # buffered (2, mc, n) send staging.  When that footprint
+        # cannot fit the scoped-VMEM ceiling (ADVICE r5: large
+        # mc·n chunks), fall back to the two-phase kernel that
+        # stages through HBM instead of silently failing to compile.
+        scratch_bytes = (4 + 2 * out_dtype.itemsize) * mc * n
+        if scratch_bytes > COMM_VMEM_LIMIT:
+            kern = functools.partial(_moe_rs_fused_kernel_2p, ctx, e,
+                                     cap, mc, n, k, has_counts)
+            out_shape = (
+                jax.ShapeDtypeStruct((mc, n), out_dtype),
+                jax.ShapeDtypeStruct((world, mc, n), out_dtype),  # rbuf
+                jax.ShapeDtypeStruct((e, cap, n), out_dtype),   # gstage
+                jax.ShapeDtypeStruct((2, mc, n), out_dtype),    # cstage
+            )
+            scratch = []
+        else:
+            kern = functools.partial(_moe_rs_fused_kernel, ctx, e, cap,
+                                     mc, n, k, has_counts)
+            out_shape = (
+                jax.ShapeDtypeStruct((mc, n), out_dtype),
+                jax.ShapeDtypeStruct((world, mc, n), out_dtype),  # rbuf
+            )
+            scratch = [
+                pltpu.VMEM((mc, n), jnp.float32),        # acc
+                pltpu.VMEM((2, mc, n), out_dtype),       # obf
+            ]
+
+    # Launch-metadata event (fires once per traced specialization).
+    from triton_distributed_tpu.observability import (
+        emit_kernel_event, estimate_compute_us, observability_enabled)
+    if observability_enabled():
+        flops = (2 * world * e * cap * n * k
+                 + 2 * world * mc * e * cap * n)
+        comm_bytes = ((world - 1) * mc * n * out_dtype.itemsize
+                      if world > 1 else 0)
+        emit_kernel_event(
+            "moe_reduce_rs_fused", kind="fused_gemm",
+            method=("w8a8" if quantized else
+                    "two_phase" if kern.func is _moe_rs_fused_kernel_2p
+                    else "fused"),
+            axis=ctx.axis, world=world, shape=(world, e, cap, k, n),
+            dtype=out_dtype, bytes_moved=comm_bytes, flops=flops,
+            estimate_us=estimate_compute_us(
+                flops, jnp.int8 if quantized else out_dtype),
+            config=ctx.gemm)
 
     res = pl.pallas_call(
         kern,

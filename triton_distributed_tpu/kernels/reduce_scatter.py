@@ -261,6 +261,12 @@ def reduce_scatter(x, ctx: ReduceScatterContext):
     m = mt // world
     method = ctx.resolve_method(m * x.shape[1] * x.dtype.itemsize)
 
+    # Launch-metadata event (fires once per traced specialization).
+    from triton_distributed_tpu.observability import record_collective
+    record_collective("reduce_scatter", axis=ctx.axis, world=world,
+                      method=method, shape=x.shape, dtype=x.dtype,
+                      payload_bytes=m * x.shape[1] * x.dtype.itemsize)
+
     if method == ReduceScatterMethod.XLA:
         return jax.lax.psum_scatter(
             x.reshape(world, m, x.shape[1]), ctx.axis,

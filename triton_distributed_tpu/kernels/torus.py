@@ -317,9 +317,18 @@ def all_gather_torus(x, ctx: TorusContext):
     world = ctx.world_size
     if world <= 1:
         return x
-    if ctx.resolve_method(x.size * x.dtype.itemsize) == "xla":
-        return jax.lax.all_gather(x, ctx.axes, tiled=True)
+    method = ctx.resolve_method(x.size * x.dtype.itemsize)
     axes, sizes = ctx.active()
+    if method == "xla" or len(axes) > 1:
+        # Degenerate tori delegate to all_gather, which emits its own
+        # launch-metadata event.
+        from triton_distributed_tpu.observability import record_collective
+        record_collective("all_gather_torus", axis=ctx.axes, world=world,
+                          method=method, shape=x.shape, dtype=x.dtype,
+                          payload_bytes=x.size * x.dtype.itemsize,
+                          sizes=sizes if len(sizes) > 1 else None)
+    if method == "xla":
+        return jax.lax.all_gather(x, ctx.axes, tiled=True)
     if len(axes) == 1:
         # Degenerate torus: a single-axis ring is the right algorithm.
         return _ag_fallback_1axis(x, ctx, axes)
@@ -548,12 +557,19 @@ def reduce_scatter_torus(x, ctx: TorusContext):
     if world <= 1:
         return x
     mt0 = x.shape[0]
-    if ctx.resolve_method(mt0 // world * x.shape[1]
-                          * x.dtype.itemsize) == "xla":
+    chunk_bytes = mt0 // world * x.shape[1] * x.dtype.itemsize
+    method = ctx.resolve_method(chunk_bytes)
+    axes, sizes = ctx.active()
+    if method == "xla" or len(axes) > 1:
+        from triton_distributed_tpu.observability import record_collective
+        record_collective("reduce_scatter_torus", axis=ctx.axes,
+                          world=world, method=method, shape=x.shape,
+                          dtype=x.dtype, payload_bytes=chunk_bytes,
+                          sizes=sizes if len(sizes) > 1 else None)
+    if method == "xla":
         return jax.lax.psum_scatter(
             x.reshape(world, mt0 // world, -1), ctx.axes,
             scatter_dimension=0, tiled=False)
-    axes, sizes = ctx.active()
     if len(axes) == 1:
         return _rs_fallback_1axis(x, ctx, axes)
 
@@ -780,7 +796,20 @@ def all_reduce_torus(x, ctx: TorusContext):
     world = ctx.world_size
     if world <= 1:
         return x
-    if ctx.resolve_method(x.size * x.dtype.itemsize // world) == "xla":
+    method = ctx.resolve_method(x.size * x.dtype.itemsize // world)
+    if method == "xla":
+        # The non-XLA path composes reduce_scatter_torus +
+        # all_gather_torus, which emit their own events — only the
+        # directly-run XLA collective is recorded here (no double
+        # counting).
+        from triton_distributed_tpu.observability import (
+            record_collective)
+        _, _sizes = ctx.active()
+        record_collective("all_reduce_torus", axis=ctx.axes,
+                          world=world, method=method, shape=x.shape,
+                          dtype=x.dtype,
+                          payload_bytes=x.size * x.dtype.itemsize,
+                          sizes=_sizes if len(_sizes) > 1 else None)
         return jax.lax.psum(x, ctx.axes)
     m, n = x.shape
     pad = (-m) % world

@@ -211,6 +211,9 @@ class MoEMLP:
         # 4. activation (XLA elementwise, fused into the surroundings)
         act = gated_silu(inter)                      # (w, E, cap, f_loc)
         # 5. the fused grouped-GEMM + combine + RS epilogue
+        # (combine_mats are cast to the activation dtype inside
+        # moe_reduce_rs_fused — ADVICE r5: the combine matmul then
+        # runs at the measured bf16 MXU rate, not the f32 one.)
         return moe_reduce_rs_fused(act, params["down"],
                                    plan.combine_mats, rs_ctx,
                                    counts=plan.counts)

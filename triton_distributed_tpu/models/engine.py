@@ -13,6 +13,7 @@ zero Python/dispatch overhead — the XLA equivalent of graph replay.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional
 
 import jax
@@ -58,6 +59,10 @@ class Engine:
 
         self._rollout = jax.jit(rollout, static_argnums=(4,),
                                 donate_argnums=(2,))
+        #: Shapes served so far: the first call per shape pays jit
+        #: trace+compile (tens of seconds on TPU) and must not land in
+        #: the steady-state latency histograms.
+        self._served_shapes = set()
 
     def prefill(self, params, input_ids, cache):
         return self._prefill(params, input_ids, cache)
@@ -77,8 +82,21 @@ class Engine:
         b, s = input_ids.shape
         cache = self.model.create_cache(b)
 
+        # Serving metrics (opt-out with the rest of observability):
+        # prefill tokens/s, steady-state decode ms/step, KV occupancy.
+        # The only extra device sync is ONE block after prefill — serve
+        # already blocks at the end, so steady-state decode pays
+        # nothing.
+        from triton_distributed_tpu.observability import (
+            observability_enabled)
+        obs = observability_enabled()
+        t_serve0 = time.perf_counter()
+
         with group_profile("engine_serve", do_prof=profile):
             logits, cache = self.prefill(params, input_ids, cache)
+            if obs:
+                jax.block_until_ready(logits)
+                t_prefill = time.perf_counter() - t_serve0
             first = sample_token(logits, key, self.temperature,
                                  top_k=self.top_k, top_p=self.top_p)
             tokens = [first]
@@ -113,4 +131,57 @@ class Engine:
             else:
                 out = jnp.stack(tokens, axis=1)
         jax.block_until_ready(out)
+        if obs:
+            # Cold key includes the profile-steps knob: it shifts the
+            # rollout's static `remaining` arg, which retraces and
+            # recompiles even at an already-seen (b, s, gen_len).
+            self._record_serve_metrics(
+                b, s, gen_len, cache, t_prefill,
+                time.perf_counter() - t_serve0,
+                shape_key=(b, s, gen_len, profile_decode_steps,
+                           self.scan_decode))
         return out
+
+    def _record_serve_metrics(self, b, s, gen_len, cache, t_prefill,
+                              t_total, shape_key=None):
+        """Emit one "engine" event + gauges/histograms per serve call.
+        Decode latency is (total - prefill) / steps — steady-state
+        steps run inside one compiled scan, so per-step host timing
+        does not exist by design (that IS the optimisation).
+
+        The first call per shape includes jit trace+compile time: it
+        emits an event tagged ``cold=True`` but is kept OUT of the
+        process-lifetime histograms/gauges, which would otherwise be
+        dominated forever by the one compile outlier."""
+        from triton_distributed_tpu.observability import (
+            emit_kernel_event, get_registry)
+        shape_key = shape_key or (b, s, gen_len)
+        cold = shape_key not in self._served_shapes
+        self._served_shapes.add(shape_key)
+        reg = get_registry()
+        decode_steps = max(gen_len - 1, 1)
+        t_decode = max(t_total - t_prefill, 1e-9)
+        ms_per_step = t_decode / decode_steps * 1e3
+        prefill_tps = b * s / max(t_prefill, 1e-9)
+        try:
+            max_seq = cache.ks[0].shape[2]
+            occupancy = min((s + gen_len) / max_seq, 1.0)
+        except (AttributeError, IndexError):
+            occupancy = None
+        reg.counter("engine_tokens_generated_total").inc(b * gen_len)
+        if not cold:
+            reg.histogram("engine_prefill_ms").observe(t_prefill * 1e3)
+            reg.histogram("engine_decode_step_ms").observe(ms_per_step)
+            reg.gauge("engine_prefill_tokens_per_s").set(prefill_tps)
+            reg.gauge("engine_decode_tokens_per_s").set(
+                b * decode_steps / t_decode)
+            if occupancy is not None:
+                reg.gauge("engine_kv_cache_occupancy").set(occupancy)
+        emit_kernel_event(
+            "engine_serve", kind="engine", shape=(b, s),
+            measured_us=t_total * 1e6, cold=cold,
+            batch=b, prompt_len=s, gen_len=gen_len,
+            prefill_ms=round(t_prefill * 1e3, 3),
+            decode_ms_per_step=round(ms_per_step, 4),
+            prefill_tokens_per_s=round(prefill_tps, 1),
+            kv_occupancy=occupancy)

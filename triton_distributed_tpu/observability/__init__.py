@@ -1,0 +1,45 @@
+"""Observability subsystem: runtime metrics, structured kernel-event
+tracing, perf-model audit, and a multi-process flight recorder.
+
+See docs/observability.md for the metric names, the event schema, and
+the flight-recorder workflow.  Everything here is host-side (the
+device hot path is untouched); the global opt-out is
+``TDT_OBSERVABILITY=0``.
+"""
+
+from triton_distributed_tpu.observability.audit import (  # noqa: F401
+    AuditRow,
+    audit_events,
+    audit_recorded,
+    bench_record,
+    format_report,
+)
+from triton_distributed_tpu.observability.events import (  # noqa: F401
+    EVENT_SCHEMA_VERSION,
+    KernelEvent,
+    capture_events,
+    emit_event,
+    emit_kernel_event,
+)
+from triton_distributed_tpu.observability.instrument import (  # noqa: F401
+    estimate_collective_us,
+    estimate_compute_us,
+    estimate_overlap_gemm_us,
+    record_collective,
+    record_overlap_gemm,
+)
+from triton_distributed_tpu.observability.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    aggregate_across_ranks,
+    get_registry,
+    merge_snapshots,
+    observability_enabled,
+)
+from triton_distributed_tpu.observability.recorder import (  # noqa: F401
+    FlightRecorder,
+    get_flight_recorder,
+    maybe_install_flight_recorder,
+)

@@ -1,0 +1,180 @@
+"""Structured kernel/collective event records.
+
+Reference analogue: kernels self-describe via ``launch_metadata``
+(`allgather_gemm.py:132-144`) — name, shapes, bytes — surfaced in
+nsys/torch traces.  Here every instrumented entry point emits a
+:class:`KernelEvent` carrying the same facts plus the analytic
+perf-model estimate and (where a host-side measurement exists) the
+measured latency, so the perf models double as a standing regression
+detector (:mod:`.audit`).
+
+Emission points are *host-side*: kernel entry points run under jit
+tracing, so a kernel's event fires once per compiled specialization
+(shape/dtype/method) — the launch-metadata moment — at zero per-dispatch
+cost.  Host loops (engine steps, autotuner, bench drivers) emit
+per-invocation events with ``measured_us`` filled in.
+
+Every event lands in the process-global metrics registry
+(``events_total``/``bytes_moved_total`` counters) and the flight
+recorder ring (:mod:`.recorder`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from triton_distributed_tpu.observability.metrics import (
+    get_registry,
+    observability_enabled,
+)
+
+EVENT_SCHEMA_VERSION = 1
+
+#: Field names that round-trip through to_dict/from_dict.
+_FIELDS = ("schema", "ts", "rank", "kind", "op", "method", "axis",
+           "world", "shape", "dtype", "bytes_moved", "flops",
+           "estimate_us", "measured_us", "config", "extra")
+
+
+@dataclasses.dataclass
+class KernelEvent:
+    """One structured record of something that ran (or was compiled).
+
+    kind: "collective" | "fused_gemm" | "autotune" | "engine" |
+          "bench" | free-form.
+    op:   entry-point name ("all_gather", "ag_gemm", ...).
+    bytes_moved: ICI/DCN payload bytes *sent per rank* for the op
+          (0 for world=1 / pure-compute events).
+    estimate_us: analytic perf-model prediction, when one exists.
+    measured_us: host-measured latency, when the caller has one
+          (benches, engine steps); None for trace-time emissions.
+    """
+    kind: str
+    op: str
+    ts: float = 0.0
+    rank: int = 0
+    method: Optional[str] = None
+    axis: Optional[str] = None
+    world: int = 1
+    shape: Optional[tuple] = None
+    dtype: Optional[str] = None
+    bytes_moved: int = 0
+    flops: int = 0
+    estimate_us: Optional[float] = None
+    measured_us: Optional[float] = None
+    config: Optional[str] = None
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    schema: int = EVENT_SCHEMA_VERSION
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["shape"] = list(self.shape) if self.shape is not None else None
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KernelEvent":
+        kw = {k: d[k] for k in _FIELDS if k in d}
+        if kw.get("shape") is not None:
+            kw["shape"] = tuple(kw["shape"])
+        return cls(**kw)
+
+    @property
+    def deviation(self) -> Optional[float]:
+        """measured / estimate ratio (None unless both present)."""
+        if not self.estimate_us or self.measured_us is None:
+            return None
+        return self.measured_us / self.estimate_us
+
+
+# Test/inspection hook: `capture_events` registers a sink that sees
+# every emitted event (in addition to the recorder + registry).
+_SINKS: List = []
+_SINK_LOCK = threading.Lock()
+
+
+class capture_events:
+    """Context manager collecting every event emitted inside it:
+
+        with capture_events() as events:
+            jax.jit(fn)(...)          # trace-time emissions land here
+        assert events[0].op == "all_gather"
+    """
+
+    def __init__(self):
+        self.events: List[KernelEvent] = []
+
+    def __enter__(self):
+        with _SINK_LOCK:
+            _SINKS.append(self.events)
+        return self.events
+
+    def __exit__(self, *exc):
+        with _SINK_LOCK:
+            _SINKS.remove(self.events)
+        return False
+
+
+def emit_event(event: KernelEvent) -> Optional[KernelEvent]:
+    """Route one event to the registry, the flight recorder, and any
+    capture sinks.  No-op (returns None) when observability is off."""
+    if not observability_enabled():
+        return None
+    if not event.ts:
+        event.ts = time.time()
+    from triton_distributed_tpu.observability.metrics import _process_index
+    event.rank = _process_index()
+
+    reg = get_registry()
+    reg.counter("events_total", kind=event.kind, op=event.op).inc()
+    if event.bytes_moved:
+        reg.counter("bytes_moved_total", op=event.op).inc(
+            event.bytes_moved)
+    if event.measured_us is not None:
+        reg.histogram("op_latency_us", op=event.op).observe(
+            event.measured_us)
+
+    from triton_distributed_tpu.observability.recorder import (
+        get_flight_recorder)
+    get_flight_recorder().record(event)
+
+    with _SINK_LOCK:
+        for sink in _SINKS:
+            sink.append(event)
+    return event
+
+
+def emit_kernel_event(op: str, *, kind: str = "collective",
+                      method=None, axis=None, world: int = 1,
+                      shape=None, dtype=None, bytes_moved: int = 0,
+                      flops: int = 0, estimate_us=None,
+                      measured_us=None, config=None, **extra
+                      ) -> Optional[KernelEvent]:
+    """Convenience constructor used by the kernel entry points.
+
+    Cheap by construction: returns immediately when observability is
+    off, and is only ever called from trace-time / host-side code.
+    """
+    if not observability_enabled():
+        return None
+    if hasattr(method, "value"):          # enums → their string value
+        method = method.value
+    if dtype is not None:
+        try:                               # "bfloat16", not the class repr
+            import numpy as np
+            dtype = np.dtype(dtype).name
+        except TypeError:
+            dtype = str(dtype)
+    return emit_event(KernelEvent(
+        kind=kind, op=op, method=method, axis=axis, world=int(world),
+        shape=tuple(int(s) for s in shape) if shape is not None else None,
+        dtype=dtype,
+        bytes_moved=int(bytes_moved), flops=int(flops),
+        estimate_us=(float(estimate_us) if estimate_us is not None
+                     else None),
+        measured_us=(float(measured_us) if measured_us is not None
+                     else None),
+        config=str(config) if config is not None else None,
+        extra=extra))

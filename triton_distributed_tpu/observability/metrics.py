@@ -1,0 +1,309 @@
+"""Rank-aware runtime metrics: counters, gauges, histograms.
+
+Reference analogue: the per-rank chrome traces `group_profile` merges
+(`python/triton_dist/utils.py:508-593`) answer "what ran" for ONE
+profiled window; the registry answers it for the whole process
+lifetime, cheaply enough to stay on in production — the reference has
+no equivalent, and the ROADMAP's serving north-star requires one.
+
+Design:
+
+- Metrics are host-side Python objects updated from *trace-time* hooks
+  and host loops (engine steps, autotuner runs, bench drivers) — never
+  from inside compiled code, so the device hot path pays nothing.
+- Labels are part of the metric identity (Prometheus-style):
+  ``registry.counter("events_total", op="all_gather")``.
+- Histograms use power-of-two buckets (exponent of the upper bound) so
+  merging across ranks is exact bucket-wise addition.
+- ``aggregate_across_ranks`` merges every rank's snapshot over the
+  existing JAX process group (gloo on CPU, DCN on pods) — counters and
+  histograms sum, gauges report min/mean/max — so one rank can export
+  a fleet view.
+
+Opt-out: ``TDT_OBSERVABILITY=0`` turns every hook into a no-op (the
+registry itself keeps working when driven explicitly).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+
+def observability_enabled() -> bool:
+    """Global opt-out switch for every instrumentation hook."""
+    return os.environ.get("TDT_OBSERVABILITY", "1").lower() not in (
+        "0", "off", "false", "no")
+
+
+def _label_key(name: str, labels: dict) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonic counter. ``inc`` only; negative increments rejected."""
+
+    __slots__ = ("name", "labels", "value")
+
+    def __init__(self, name: str, labels: dict):
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name}: negative inc {amount}")
+        self.value += amount
+
+    def snapshot(self):
+        return self.value
+
+
+class Gauge:
+    """Last-write-wins value (e.g. KV-cache occupancy)."""
+
+    __slots__ = ("name", "labels", "value")
+
+    def __init__(self, name: str, labels: dict):
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.value -= amount
+
+    def snapshot(self):
+        return self.value
+
+
+class Histogram:
+    """Power-of-two-bucket histogram: bucket ``e`` counts observations
+    with ``2^(e-1) < v <= 2^e`` (v <= 0 lands in a dedicated bucket).
+    Exact count/sum/min/max ride along; merging two histograms is
+    bucket-wise addition, so cross-rank aggregation loses nothing."""
+
+    __slots__ = ("name", "labels", "count", "sum", "min", "max",
+                 "buckets")
+
+    def __init__(self, name: str, labels: dict):
+        self.name = name
+        self.labels = labels
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.buckets: Dict[int, int] = {}
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        exp = (math.ceil(math.log2(value)) if value > 0
+               else -(2 ** 30))  # non-positive sentinel bucket
+        self.buckets[exp] = self.buckets.get(exp, 0) + 1
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def snapshot(self):
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min if self.count else None,
+            "max": self.max if self.count else None,
+            "mean": self.mean,
+            "buckets": {str(k): v for k, v in sorted(self.buckets.items())},
+        }
+
+
+class MetricsRegistry:
+    """Thread-safe named collection of counters/gauges/histograms.
+
+    One process-global instance (``get_registry``) backs all
+    instrumentation; tests may construct private registries.
+    """
+
+    def __init__(self):
+        # RLock: the flight recorder's signal handler snapshots the
+        # registry from the main thread and may interrupt a metric
+        # update that already holds the lock (see recorder.py).
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get(self, cls, name: str, labels: dict):
+        key = _label_key(name, labels)
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, labels)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {key} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get(Histogram, name, labels)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    # -- export ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """{"counters": {key: val}, "gauges": {...}, "histograms": {...}}
+        plus rank/world/time metadata — the JSON export schema."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        with self._lock:
+            for key, m in self._metrics.items():
+                kind = {Counter: "counters", Gauge: "gauges",
+                        Histogram: "histograms"}[type(m)]
+                out[kind][key] = m.snapshot()
+        out["meta"] = {
+            "rank": _process_index(),
+            "world": _process_count(),
+            "unix_time": time.time(),
+            "schema": 1,
+        }
+        return out
+
+    def to_json(self, **dump_kw) -> str:
+        return json.dumps(self.snapshot(), **dump_kw)
+
+    def export(self, path: str) -> dict:
+        """Write the local snapshot to ``path`` (JSON). Returns it."""
+        snap = self.snapshot()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(snap, f, indent=1, default=str)
+        return snap
+
+
+def _process_index() -> int:
+    # Env first (scripts/launch.py exports TDT_PROCESS_ID): correct
+    # rank labels before jax.distributed comes up, and no backend
+    # initialisation from inside a signal handler's dump path.
+    env = os.environ.get("TDT_PROCESS_ID")
+    if env is not None:
+        return int(env)
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def _process_count() -> int:
+    env = os.environ.get("TDT_NUM_PROCESSES")
+    if env is not None:
+        return int(env)
+    try:
+        import jax
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+_GLOBAL: Optional[MetricsRegistry] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = MetricsRegistry()
+        return _GLOBAL
+
+
+# ---------------------------------------------------------------------------
+# Cross-rank aggregation
+# ---------------------------------------------------------------------------
+
+def merge_snapshots(snaps) -> dict:
+    """Merge per-rank registry snapshots: counters and histogram
+    buckets sum exactly; gauges keep min/mean/max across ranks (a
+    per-rank occupancy has no single true global value)."""
+    merged = {"counters": {}, "gauges": {}, "histograms": {},
+              "meta": {"ranks": len(snaps), "schema": 1}}
+    for snap in snaps:
+        for key, v in snap.get("counters", {}).items():
+            merged["counters"][key] = merged["counters"].get(key, 0.0) + v
+        for key, v in snap.get("gauges", {}).items():
+            g = merged["gauges"].setdefault(
+                key, {"min": math.inf, "max": -math.inf, "sum": 0.0,
+                      "n": 0})
+            g["min"] = min(g["min"], v)
+            g["max"] = max(g["max"], v)
+            g["sum"] += v
+            g["n"] += 1
+        for key, h in snap.get("histograms", {}).items():
+            agg = merged["histograms"].setdefault(
+                key, {"count": 0, "sum": 0.0, "min": None, "max": None,
+                      "buckets": {}})
+            agg["count"] += h["count"]
+            agg["sum"] += h["sum"]
+            for bound in ("min", "max"):
+                vals = [x for x in (agg[bound], h[bound]) if x is not None]
+                if vals:
+                    agg[bound] = (min if bound == "min" else max)(vals)
+            for b, c in h.get("buckets", {}).items():
+                agg["buckets"][b] = agg["buckets"].get(b, 0) + c
+    for g in merged["gauges"].values():
+        g["mean"] = g["sum"] / g["n"] if g["n"] else 0.0
+    for h in merged["histograms"].values():
+        h["mean"] = h["sum"] / h["count"] if h["count"] else 0.0
+    return merged
+
+
+def aggregate_across_ranks(registry: Optional[MetricsRegistry] = None
+                           ) -> dict:
+    """Every rank contributes its snapshot over the JAX process group
+    (``multihost_utils.process_allgather`` on a padded byte buffer —
+    JSON payloads are variable-length, so lengths are exchanged
+    first); all ranks return the same merged view.  Collective: every
+    process in the group must call it.  Single-process: local merge.
+    """
+    registry = registry or get_registry()
+    snap = registry.snapshot()
+    if _process_count() <= 1:
+        return merge_snapshots([snap])
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(
+        json.dumps(snap, default=str).encode(), dtype=np.uint8)
+    lens = multihost_utils.process_allgather(
+        np.int64(payload.size))                      # (world,)
+    buf = np.zeros(int(lens.max()), np.uint8)
+    buf[:payload.size] = payload
+    bufs = multihost_utils.process_allgather(buf)    # (world, maxlen)
+    snaps = [json.loads(bytes(np.asarray(bufs[i][:int(lens[i])])))
+             for i in range(len(lens))]
+    return merge_snapshots(snaps)

@@ -1,0 +1,180 @@
+"""Multi-process flight recorder: a per-rank ring buffer of recent
+events, dumped to disk on SIGTERM/SIGUSR1 so a hung or killed
+multi-process launch leaves a black box instead of silence.
+
+The failure mode this exists for: a DCN collective hangs, the
+launcher's watchdog (``scripts/launch.py --timeout``) SIGTERMs the
+group, and — today — every rank dies mute.  With the recorder armed
+(``TDT_FLIGHT_RECORDER=<dir>``, which ``scripts/launch.py`` plumbs to
+workers), each rank's handler writes
+``<dir>/flight-rank-<N>.json`` with the last events it saw: the op,
+method, peers and byte counts in flight when the world stopped —
+usually enough to see which rank diverged.
+
+Caveat (documented, not solved): a rank wedged *inside* a compiled
+collective holds the GIL out of Python's reach, so its handler fires
+only once the runtime yields; the healthy ranks' dumps are the signal
+(the hung rank is the one with the stale tail).  The launcher's
+SIGKILL escalation still reaps it.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+#: Env knobs (set by scripts/launch.py for workers; usable manually).
+ENV_DIR = "TDT_FLIGHT_RECORDER"
+ENV_CAPACITY = "TDT_FLIGHT_RECORDER_CAPACITY"
+DEFAULT_CAPACITY = 512
+
+
+class FlightRecorder:
+    """Bounded ring of recent :class:`KernelEvent`s.
+
+    ``record`` is a deque append under a lock — cheap enough to stay
+    on in production.  ``dump`` serialises the ring newest-last.
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = int(os.environ.get(ENV_CAPACITY,
+                                          DEFAULT_CAPACITY))
+        # RLock, not Lock: the dump-on-signal handler runs in the main
+        # thread and may interrupt a record() that already holds the
+        # lock — a plain Lock would deadlock the dying rank right at
+        # the moment the dump matters.
+        self._lock = threading.RLock()
+        self._ring = collections.deque(maxlen=capacity)
+        self._installed_dir: Optional[str] = None
+        self._prev_handlers = {}
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def record(self, event) -> None:
+        with self._lock:
+            self._ring.append(event)
+
+    def events(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    # -- dumping --------------------------------------------------------
+
+    def default_path(self, directory: str) -> str:
+        from triton_distributed_tpu.observability.metrics import (
+            _process_index)
+        return os.path.join(directory,
+                            f"flight-rank-{_process_index()}.json")
+
+    def dump(self, path: Optional[str] = None, reason: str = "manual"
+             ) -> Optional[str]:
+        """Write the ring (plus a registry snapshot) to ``path`` or the
+        armed directory.  Returns the path written, or None if there
+        is nowhere to write."""
+        if path is None:
+            directory = self._installed_dir or os.environ.get(ENV_DIR)
+            if not directory:
+                return None
+            path = self.default_path(directory)
+        from triton_distributed_tpu.observability.metrics import (
+            _process_index, get_registry)
+        payload = {
+            "schema": 1,
+            "rank": _process_index(),
+            "pid": os.getpid(),
+            "unix_time": time.time(),
+            "reason": reason,
+            "events": [e.to_dict() for e in self.events()],
+            "metrics": get_registry().snapshot(),
+        }
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, default=str)
+        os.replace(tmp, path)
+        return path
+
+    # -- signal arming --------------------------------------------------
+
+    def install(self, directory: Optional[str] = None) -> bool:
+        """Arm dump-on-signal.  SIGUSR1 dumps and continues (live
+        inspection); SIGTERM dumps, restores the previous handler and
+        re-delivers (so the launcher's kill still kills).  Main-thread
+        only (signal module restriction); returns False when the
+        directory is unset or arming is impossible."""
+        directory = directory or os.environ.get(ENV_DIR)
+        if not directory or self._installed_dir:
+            return bool(self._installed_dir)
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        self._installed_dir = directory
+
+        def _dump_and_continue(signum, frame):
+            self.dump(reason=f"signal-{signum}")
+
+        def _dump_and_die(signum, frame):
+            self.dump(reason=f"signal-{signum}")
+            prev = self._prev_handlers.get(signum)
+            if prev is signal.SIG_IGN:
+                # The process was configured to survive this signal
+                # before we armed: dump but preserve that behavior.
+                return
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                # Default disposition: re-deliver for a true
+                # killed-by-signal exit code.
+                signal.signal(signum, signal.SIG_DFL)
+                try:
+                    os.kill(os.getpid(), signum)
+                except Exception:
+                    sys.exit(128 + signum)
+
+        try:
+            if hasattr(signal, "SIGUSR1"):
+                self._prev_handlers[signal.SIGUSR1] = signal.signal(
+                    signal.SIGUSR1, _dump_and_continue)
+            self._prev_handlers[signal.SIGTERM] = signal.signal(
+                signal.SIGTERM, _dump_and_die)
+        except (ValueError, OSError):
+            self._installed_dir = None
+            return False
+        return True
+
+
+_RECORDER: Optional[FlightRecorder] = None
+_RECORDER_LOCK = threading.Lock()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    global _RECORDER
+    with _RECORDER_LOCK:
+        if _RECORDER is None:
+            _RECORDER = FlightRecorder()
+        return _RECORDER
+
+
+def maybe_install_flight_recorder() -> bool:
+    """Arm the global recorder iff ``TDT_FLIGHT_RECORDER`` names a
+    directory.  Called from `parallel.mesh.initialize_distributed`
+    (every launch.py worker passes through it); safe to call twice."""
+    if not os.environ.get(ENV_DIR):
+        return False
+    return get_flight_recorder().install()

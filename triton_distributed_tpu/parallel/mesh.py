@@ -235,6 +235,12 @@ def initialize_distributed(
         )
     ctx = make_mesh(axis_shapes)
     _GLOBAL_CONTEXT = ctx
+    # Arm the per-rank flight recorder when the launcher (or the user)
+    # exported TDT_FLIGHT_RECORDER — a hung/killed group then dumps
+    # its recent kernel events instead of dying silently.
+    from triton_distributed_tpu.observability import (
+        maybe_install_flight_recorder)
+    maybe_install_flight_recorder()
     return ctx
 
 
