@@ -372,12 +372,17 @@ def main():
     # regime in the min has a real numerator (prefill vs XLA overlap
     # composition, flash_decode vs the strongest public decode
     # kernels, w8a8 vs the bf16 composition).
-    regimes = {
-        "prefill_fused": _regime_prefill(mesh, world),
-        "flash_decode": _regime_flash_decode(mesh, world),
-        "w8a8": _regime_w8a8(mesh, world),
-    }
-    noise_bound = _regime_decode_ll(mesh, world)
+    # Runtime spans bracket each regime so a --trace-dir run (or an
+    # attached jax.profiler) shows where the bench wall time went.
+    from triton_distributed_tpu.observability import span
+    regimes = {}
+    for name, fn in [("prefill_fused", _regime_prefill),
+                     ("flash_decode", _regime_flash_decode),
+                     ("w8a8", _regime_w8a8)]:
+        with span("bench.regime", regime=name, world=world):
+            regimes[name] = fn(mesh, world)
+    with span("bench.regime", regime="decode_ll", world=world):
+        noise_bound = _regime_decode_ll(mesh, world)
     record_regimes(regimes, noise_bound, world)
     worst = min(regimes, key=lambda r: regimes[r][1])
     t_worst, r_worst, _ = regimes[worst]

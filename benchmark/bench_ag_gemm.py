@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from triton_distributed_tpu.observability import bench_record
+from triton_distributed_tpu.observability import bench_record, span
 from triton_distributed_tpu.kernels.allgather_gemm import (
     AllGatherGEMMContext,
     ag_gemm,
@@ -73,18 +73,21 @@ def main():
         base = jax.jit(shard_map_op(
             functools.partial(ag_gemm_nonoverlap, axis="tp"), mesh,
             **specs))
-        t_fused, t_base = measure_ops(
-            [fused, base], (a, b), chain_fn(args.k),
-            repeats=args.repeats)
+        with span("bench.ag_gemm", M=m_total, K=args.k, N=args.n):
+            (t_fused, t_base), slopes = measure_ops(
+                [fused, base], (a, b), chain_fn(args.k),
+                repeats=args.repeats, return_slopes=True)
         flops = 2 * m_total * args.k * args.n
         # Routed through the metrics registry (perf-model estimate +
-        # deviation attach when derivable); prints the same JSON line.
+        # deviation attach when derivable); prints the same JSON line
+        # with p50/p99 over the per-repeat iteration latencies.
         bench_record({
             "bench": "ag_gemm", "world": world, "M": m_total,
             "K": args.k, "N": args.n, "method": method,
             "us": round(t_fused * 1e6, 1),
             "tflops": round(flops / t_fused / 1e12, 1),
             "vs_baseline": round(t_base / t_fused, 3),
+            "samples_us": [s * 1e6 for s in slopes[0]],
         })
 
 

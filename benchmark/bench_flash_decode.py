@@ -17,7 +17,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from triton_distributed_tpu.observability import bench_record
+from triton_distributed_tpu.observability import bench_record, span
 from triton_distributed_tpu.autotuner import tune
 from triton_distributed_tpu.kernels.flash_decode import (
     flash_decode,
@@ -132,20 +132,23 @@ def main():
                      ).astype(jnp.bfloat16),) + a[1:]
 
         ops = [ours, ours_int8] + ([paged] if run_paged else []) + [base]
-        ts = measure_ops_scanned(
-            ops,
-            (q, kc, vc, kv_len, k_q, v_q, ks, vs,
-             k_pages, v_pages, page_indices), mix,
-            repeats=args.repeats)
+        with span("bench.flash_decode", S=s, B=b):
+            ts, slopes = measure_ops_scanned(
+                ops,
+                (q, kc, vc, kv_len, k_q, v_q, ks, vs,
+                 k_pages, v_pages, page_indices), mix,
+                repeats=args.repeats, return_slopes=True)
         t_ours, t_int8 = ts[0], ts[1]
         t_paged = ts[2] if run_paged else None
         t_base = ts[-1]
         kv_bytes = 2 * b * hkv * s * d * kc.dtype.itemsize
-        # Routed through the metrics registry; prints the same line.
+        # Routed through the metrics registry; prints the same line
+        # with p50/p99 over the per-repeat iteration latencies.
         bench_record({
             "bench": "flash_decode", "B": b, "H": h, "Hkv": hkv,
             "S": s, "D": d,
             "us": round(t_ours * 1e6, 1),
+            "samples_us": [t * 1e6 for t in slopes[0]],
             "kv_gbps": round(kv_bytes / t_ours / 1e9, 1),
             "autotuned_block_k": block_k,
             "autotune_disk_hit": disk_hit,

@@ -41,10 +41,15 @@ MEASUREMENT_FIELDS = {
     # flash_decode, grouped_gemm):
     "speedup_vs_bf16", "speedup_range", "vs_staged",
     "vs_staged_range", "autotuned_blocks", "autotuned_block_k",
-    "autotuned_config",
+    "autotuned_config", "p50_us", "p99_us", "samples_us",
 }
 #: Fields that may hold the latency to compare, in preference order.
 LATENCY_FIELDS = ("us", "ms", "ms_per_step")
+#: Tail fields gated IN ADDITION to the primary latency when both the
+#: fresh and baseline rows carry them: a kernel can hold its median
+#: while its p99 blows out (new jitter source), and serving SLOs live
+#: at the tail.
+TAIL_FIELDS = ("p99_us",)
 
 
 def load_rows(path: str) -> list:
@@ -117,14 +122,27 @@ def main() -> int:
         if new_v is None or old_v is None:
             continue
         compared += 1
-        ratio = new_v / old_v
-        slower = ratio - 1.0
-        tag = "REGRESSION" if slower > args.threshold else "ok"
-        if slower > args.threshold or slower < -args.threshold:
-            print(f"[{tag:>10}] {rec.get('bench')}: {field} "
-                  f"{old_v:.1f} -> {new_v:.1f} ({slower:+.1%} vs "
-                  f"baseline) {json.dumps(dict(identity(rec)))[:120]}")
-        if slower > args.threshold:
+        # Gate the primary latency AND the tail (p99) when both rows
+        # carry it — a kernel can hold its mean while its p99 blows
+        # out, and serving SLOs live at the tail.
+        checks = [(field, old_v, new_v)]
+        for tf in TAIL_FIELDS:
+            tn, to = rec.get(tf), old.get(tf)
+            if (isinstance(tn, (int, float)) and tn > 0
+                    and isinstance(to, (int, float)) and to > 0):
+                checks.append((tf, float(to), float(tn)))
+        row_regressed = False
+        for cf, o_v, n_v in checks:
+            slower = n_v / o_v - 1.0
+            tag = "REGRESSION" if slower > args.threshold else "ok"
+            if slower > args.threshold or slower < -args.threshold:
+                print(f"[{tag:>10}] {rec.get('bench')}: {cf} "
+                      f"{o_v:.1f} -> {n_v:.1f} ({slower:+.1%} vs "
+                      f"baseline) "
+                      f"{json.dumps(dict(identity(rec)))[:120]}")
+            if slower > args.threshold:
+                row_regressed = True
+        if row_regressed:
             regressions += 1
 
     print(f"check_bench_regression: {compared} rows compared, "

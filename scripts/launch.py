@@ -20,10 +20,108 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import glob
+import json
 import os
 import signal
 import subprocess
 import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read_heartbeats(directory):
+    """Stdlib-only heartbeat reader (the launcher deliberately never
+    imports jax/the package: worker startup cost stays in the workers,
+    and this runs inside the SIGALRM handler).  Same file format as
+    ``observability.exporter`` writes."""
+    beats = {}
+    for path in glob.glob(os.path.join(directory,
+                                       "heartbeat-rank-*.json")):
+        try:
+            with open(path) as f:
+                hb = json.load(f)
+            beats[int(hb["rank"])] = hb
+        except (OSError, ValueError, KeyError):
+            continue
+    return beats
+
+
+def _rank_health_lines(hb_dir):
+    """Render per-rank heartbeat freshness: which rank stopped beating
+    and what its last span was — the difference between "exit 124" and
+    "rank 2 wedged in span 'dcn_collective' for 9s"."""
+    beats = _read_heartbeats(hb_dir)
+    if not beats:
+        return [f"watchdog: no heartbeats under {hb_dir} (workers "
+                "never armed TDT_HEARTBEAT_DIR?)"]
+    try:
+        interval = float(os.environ.get("TDT_HEARTBEAT_INTERVAL",
+                                        "1.0"))
+    except ValueError:
+        interval = 1.0
+    now = time.time()
+    lines = ["watchdog: rank health from heartbeats:"]
+    ages = {}
+    for rank, hb in sorted(beats.items()):
+        age = now - float(hb.get("unix_time", 0.0))
+        ages[rank] = age
+        stale = age > 3.0 * interval
+        step = (f" step={hb['step']}"
+                if hb.get("step") is not None else "")
+        lines.append(
+            f"  rank {rank}: [{'STALLED' if stale else 'ok':>7}] "
+            f"last beat {age:.1f}s ago, "
+            f"last span={hb.get('last_span')!r}{step}")
+    stale_ranks = [r for r, a in ages.items()
+                   if a > 3.0 * interval]
+    if stale_ranks:
+        worst = max(stale_ranks, key=ages.get)
+        lines.append(
+            f"watchdog: stalled rank {worst} "
+            f"(no heartbeat for {ages[worst]:.1f}s), last span="
+            f"{beats[worst].get('last_span')!r}, open spans="
+            f"{beats[worst].get('open_spans')}")
+    else:
+        # Every beat is fresh: do NOT pin the hang on a healthy rank.
+        # Either --timeout is shorter than the workload, or the wedge
+        # releases the GIL (e.g. a blocking device wait), which keeps
+        # the daemon beat thread alive — report the facts instead.
+        stalest = max(ages, key=ages.get)
+        lines.append(
+            "watchdog: all heartbeats fresh — no stalled rank "
+            "detected (timeout shorter than the workload, or the "
+            f"wedge keeps beats alive); stalest is rank {stalest} "
+            f"({ages[stalest]:.1f}s ago, last span="
+            f"{beats[stalest].get('last_span')!r})")
+    return lines
+
+
+def _merge_traces(trace_dir):
+    """Merge per-rank traces after the group exits.  Subprocess (the
+    package imports jax — keep the launcher light), same CLI a human
+    would run by hand."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (REPO_ROOT + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    try:
+        # -c instead of -m: the package __init__ imports the timeline
+        # module, and runpy warns when re-executing an already-imported
+        # module — same entry point, without the noise.
+        res = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; "
+             "from triton_distributed_tpu.observability import "
+             "timeline; sys.exit(timeline.main(sys.argv[1:]))",
+             trace_dir, "--report"],
+            env=env, capture_output=True, text=True, timeout=120)
+        out = (res.stdout + res.stderr).strip()
+        if out:
+            print(out, file=sys.stderr, flush=True)
+    except (OSError, subprocess.SubprocessError) as e:
+        print(f"launch: trace merge failed: {e}", file=sys.stderr,
+              flush=True)
 
 
 def main() -> int:
@@ -42,17 +140,33 @@ def main() -> int:
                          "dump their recent kernel events to this "
                          "directory on SIGTERM/SIGUSR1 (default: "
                          "inherit TDT_FLIGHT_RECORDER, else off)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="arm runtime span tracing: workers export "
+                         "per-rank Chrome traces here "
+                         "(trace-rank-N.json) and write heartbeats to "
+                         "<dir>/heartbeats; on exit the launcher "
+                         "merges the traces into merged_trace.json + "
+                         "straggler_report.json")
     ap.add_argument("--timeout", type=float, default=0,
                     help="watchdog: SIGTERM the group after this many "
                          "seconds (0 = no limit).  With --flight-dir "
                          "set, a hung DCN launch leaves per-rank "
-                         "flight-recorder dumps instead of silence")
+                         "flight-recorder dumps instead of silence; "
+                         "with --trace-dir set, the timeout report "
+                         "names the stalled rank and its last span "
+                         "from heartbeats")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args()
 
     world = args.nproc * args.nnodes
     procs = []
+    # Heartbeats ride under the trace dir (or wherever the user
+    # already pointed TDT_HEARTBEAT_DIR) — the watchdog reads them to
+    # name the stalled rank.
+    hb_dir = (os.path.join(args.trace_dir, "heartbeats")
+              if args.trace_dir
+              else os.environ.get("TDT_HEARTBEAT_DIR"))
 
     def _kill_group(sig=signal.SIGTERM):
         for p in procs:
@@ -71,6 +185,7 @@ def main() -> int:
     # recorder armed dump their event rings from their own SIGTERM
     # handlers before dying, so the hang becomes diagnosable.
     timed_out = []
+    health_lines = []
     if args.timeout > 0:
         def _on_alarm(*a):
             if not any(p.poll() is None for p in procs):
@@ -83,6 +198,13 @@ def main() -> int:
                 _kill_group(signal.SIGKILL)
                 return
             timed_out.append(True)
+            # BEFORE killing: heartbeat files are freshest now, and a
+            # wedged rank is still distinguishable from its healthy
+            # peers (its beat is the stale one).
+            if hb_dir:
+                health_lines.extend(_rank_health_lines(hb_dir))
+                print("\n".join(health_lines), file=sys.stderr,
+                      flush=True)
             _kill_group()
             signal.setitimer(signal.ITIMER_REAL, 10)  # dump grace
         signal.signal(signal.SIGALRM, _on_alarm)
@@ -96,6 +218,9 @@ def main() -> int:
         env["TDT_COORDINATOR"] = args.coordinator
         if args.flight_dir:
             env["TDT_FLIGHT_RECORDER"] = args.flight_dir
+        if args.trace_dir:
+            env["TDT_TRACE_DIR"] = args.trace_dir
+            env["TDT_HEARTBEAT_DIR"] = hb_dir
         if args.cpu:
             env["JAX_PLATFORMS"] = "cpu"
         procs.append(subprocess.Popen(
@@ -136,7 +261,6 @@ def main() -> int:
         _kill_group(signal.SIGINT)
         deadline = 20
         while deadline and any(p.poll() is None for p in procs):
-            import time
             time.sleep(0.25)
             deadline -= 1
         rc = 130
@@ -145,7 +269,6 @@ def main() -> int:
         # ignore SIGTERM and outlive the launcher holding ports (ADVICE
         # r4) — poll briefly and SIGKILL survivors.
         _kill_group()
-        import time
         deadline = 20  # 5 s
         while deadline and any(p.poll() is None for p in procs):
             time.sleep(0.25)
@@ -154,8 +277,16 @@ def main() -> int:
         for p in procs:
             if p.poll() is None:
                 p.wait()
+    if args.trace_dir:
+        # Group fully reaped: merge whatever per-rank traces the
+        # workers exported into one timeline + straggler report.
+        _merge_traces(args.trace_dir)
     if timed_out:
         rc = 124  # timeout(1) convention
+        # Re-state the verdict next to the exit code (the at-alarm
+        # report may have scrolled past a long worker backtrace).
+        for line in health_lines[-1:]:
+            print(line, file=sys.stderr, flush=True)
     return rc
 
 

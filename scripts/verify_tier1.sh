@@ -3,6 +3,13 @@
 # so CI and humans run the same thing.  CPU-only, non-slow tests,
 # bounded at 870 s; prints DOTS_PASSED=<n> (count of passing tests)
 # and exits with pytest's status.
+#
+# Hardened beyond the raw invocation:
+#  - pytest collection ERRORS fail the gate even when every collected
+#    test passed (a broken import silently shrinking the suite must
+#    not read as green);
+#  - a trace-export smoke run (span -> Chrome trace -> timeline merge
+#    -> Prometheus render) guards the observability runtime on CPU.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -14,4 +21,54 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" \
     | tr -cd . | wc -c)
+
+# Collection errors are failures, not noise: pytest's summary line
+# ("... N errors in 12.3s") reports them — catch them even if rc came
+# back 0.  Match only the timing summary line, not arbitrary test
+# output that happens to contain the word "errors".
+n_errors=$(grep -aE 'in [0-9.]+s' "$LOG" \
+    | grep -aoE '[0-9]+ errors?' | tail -1 \
+    | grep -oE '[0-9]+' || true)
+if [ "${n_errors:-0}" -gt 0 ]; then
+    echo "COLLECTION_ERRORS=${n_errors}"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
+# Trace-export smoke: spans -> per-rank Chrome trace -> merged
+# timeline + straggler report -> Prometheus text.  Pure host-side
+# observability, cheap enough to run every gate.
+smoke_log=$(JAX_PLATFORMS=cpu python - <<'EOF' 2>&1
+import json, os, tempfile, time
+from triton_distributed_tpu.observability import (
+    get_registry, get_tracer, prometheus_text, span)
+from triton_distributed_tpu.observability.timeline import (
+    merge_directory)
+
+d = tempfile.mkdtemp(prefix="tdt-smoke-")
+with span("smoke.outer", phase="verify"):
+    with span("smoke.inner"):
+        time.sleep(0.001)
+for rank in (0, 1):  # two synthetic ranks so the merge has work
+    os.environ["TDT_PROCESS_ID"] = str(rank)
+    path = get_tracer().export_chrome_trace(
+        os.path.join(d, f"trace-rank-{rank}.json"))
+    trace = json.load(open(path))
+    assert any(e.get("ph") == "X" for e in trace["traceEvents"]), path
+report = merge_directory(d)
+assert os.path.exists(os.path.join(d, "merged_trace.json"))
+assert "smoke.outer" in report["spans"], report
+get_registry().counter("smoke_total").inc()
+text = prometheus_text()
+assert any(line.split() == ["smoke_total", "1.0"]
+           for line in text.splitlines()), text
+print("TRACE_SMOKE=ok")
+EOF
+)
+smoke_rc=$?
+echo "$smoke_log" | tail -5
+if [ "$smoke_rc" -ne 0 ]; then
+    echo "TRACE_SMOKE=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
 exit $rc

@@ -1,0 +1,588 @@
+"""Runtime-observability tests: span tracer semantics (nesting,
+threading, ring cap, disabled path), Chrome-trace export validity,
+cross-rank timeline merge + skew/straggler attribution on synthetic
+traces, heartbeat freshness, Prometheus exposition, flight-dump span
+forensics, and two real 2-process `scripts/launch.py` runs — a happy
+path whose per-rank traces must merge into one valid timeline, and a
+forced hang whose `--timeout` exit must name the stalled rank and its
+last span."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from triton_distributed_tpu.observability import (
+    KernelEvent,
+    MetricsRegistry,
+    get_tracer,
+    prometheus_text,
+    rank_health_report,
+    format_rank_health,
+    span,
+    start_metrics_server,
+    traced,
+)
+from triton_distributed_tpu.observability.exporter import (
+    HeartbeatWriter,
+    heartbeat_path,
+)
+from triton_distributed_tpu.observability.recorder import FlightRecorder
+from triton_distributed_tpu.observability.timeline import (
+    MERGED_NAME,
+    REPORT_NAME,
+    main as timeline_main,
+    merge_traces,
+    skew_rows,
+    straggler_report,
+)
+from triton_distributed_tpu.observability.tracing import (
+    NULL_SPAN,
+    SpanTracer,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Span tracer
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_attrs():
+    tr = SpanTracer(capacity=16)
+    with tr.span("outer", phase="p") as outer:
+        assert outer.depth == 0
+        assert [s.name for s in tr.open_spans()] == ["outer"]
+        with tr.span("inner") as inner:
+            assert inner.depth == 1
+            assert tr.last_span().name == "inner"
+        assert tr.last_span().name == "outer"
+    done = tr.finished()
+    assert [s.name for s in done] == ["inner", "outer"]  # close order
+    assert done[1].attrs == {"phase": "p"}
+    assert done[0].dur >= 0 and done[0].ts <= done[1].ts + done[1].dur
+    assert tr.open_spans() == []
+
+
+def test_span_ring_is_bounded():
+    tr = SpanTracer(capacity=4)
+    for i in range(9):
+        with tr.span(f"s{i}"):
+            pass
+    assert len(tr) == 4
+    assert [s.name for s in tr.finished()] == ["s5", "s6", "s7", "s8"]
+
+
+def test_span_records_exceptions():
+    tr = SpanTracer(capacity=4)
+    with pytest.raises(RuntimeError):
+        with tr.span("boom"):
+            raise RuntimeError("x")
+    (s,) = tr.finished()
+    assert s.attrs["error"] == "'RuntimeError'" or "RuntimeError" in str(
+        s.attrs["error"])
+    assert s.dur is not None
+
+
+def test_span_disabled_is_allocation_free(monkeypatch):
+    monkeypatch.setenv("TDT_OBSERVABILITY", "0")
+    before = len(get_tracer())
+    # The disabled path hands back ONE shared object: no Span, no
+    # ring append, no lock.
+    assert span("a") is span("b") is NULL_SPAN
+    with span("c", k=1):
+        pass
+    assert len(get_tracer()) == before
+
+
+def test_traced_decorator():
+    tr = get_tracer()
+
+    @traced(name="unit.work")
+    def work(x):
+        return x + 1
+
+    assert work(1) == 2
+    assert any(s.name == "unit.work" for s in tr.finished())
+
+
+def test_span_threading():
+    tr = SpanTracer(capacity=64)
+    barrier = threading.Barrier(4)
+
+    def worker(i):
+        barrier.wait()
+        with tr.span("thread.outer", idx=i):
+            with tr.span("thread.inner", idx=i):
+                time.sleep(0.005)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    done = tr.finished()
+    assert len(done) == 8
+    inners = [s for s in done if s.name == "thread.inner"]
+    assert len({s.tid for s in inners}) == 4       # one per thread
+    assert all(s.depth == 1 for s in inners)       # nesting per-thread
+    assert tr.open_spans() == []
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_export_is_valid(tmp_path, monkeypatch):
+    monkeypatch.setenv("TDT_PROCESS_ID", "3")
+    tr = SpanTracer(capacity=16)
+    with tr.span("phase.a", step=1):
+        time.sleep(0.001)
+    open_span = tr.span("phase.open")
+    open_span.__enter__()
+    try:
+        path = str(tmp_path / "trace-rank-3.json")
+        assert tr.export_chrome_trace(path) == path
+        trace = json.load(open(path))     # valid JSON on disk
+    finally:
+        open_span.__exit__(None, None, None)
+    assert trace["metadata"]["rank"] == 3
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"phase.a", "phase.open"}
+    for e in xs:
+        assert e["pid"] == 3
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+        assert e["dur"] >= 0
+    (still_open,) = [e for e in xs if e["name"] == "phase.open"]
+    assert still_open["args"]["open"] is True
+    # Metadata lanes for Perfetto.
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in trace["traceEvents"])
+    # No armed dir and no explicit path -> nowhere to write.
+    monkeypatch.delenv("TDT_TRACE_DIR", raising=False)
+    assert tr.export_chrome_trace() is None
+
+
+# ---------------------------------------------------------------------------
+# Timeline merge / skew / straggler (synthetic traces)
+# ---------------------------------------------------------------------------
+
+def _mk_trace(rank, starts, name="train.step", dur=50.0):
+    evs = [{"name": name, "ph": "X", "cat": "span", "ts": t,
+            "dur": dur, "pid": rank, "tid": 1, "args": {}}
+           for t in starts]
+    return {"traceEvents": evs, "metadata": {"rank": rank}}
+
+
+def test_timeline_skew_and_straggler():
+    tr0 = _mk_trace(0, [1000.0, 2000.0, 3000.0])
+    tr1 = _mk_trace(1, [1100.0, 2200.0, 3050.0])
+    rows = skew_rows([tr0, tr1])
+    assert [r["skew_us"] for r in rows] == [100.0, 200.0, 50.0]
+    assert all(r["last_rank"] == 1 for r in rows)
+
+    report = straggler_report([tr0, tr1])
+    agg = report["spans"]["train.step"]
+    assert agg["straggler_rank"] == 1
+    assert agg["straggler_fraction"] == 1.0
+    assert agg["occurrences"] == 3
+    assert agg["max_skew_us"] == 200.0
+    assert agg["mean_skew_us"] == pytest.approx(350.0 / 3, abs=1e-3)
+    # Rank 0 waited for rank 1 at every barrier: 100+200+50.
+    assert agg["barrier_wait_us"]["0"] == pytest.approx(350.0)
+    json.dumps(report)  # report is JSON-serialisable as-is
+
+    # A span seen on one rank only contributes nothing.
+    solo = _mk_trace(0, [1.0], name="solo")
+    assert "solo" not in straggler_report([tr0, tr1, solo])["spans"]
+
+
+def test_timeline_merge_rebases_clock():
+    tr0 = _mk_trace(0, [5000.0])
+    tr1 = _mk_trace(1, [5100.0])
+    merged = merge_traces([tr0, tr1])
+    xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert min(e["ts"] for e in xs) == 0.0
+    assert {e["pid"] for e in xs} == {0, 1}
+    assert merged["metadata"]["t0_unix_us"] == 5000.0
+    assert merged["metadata"]["ranks"] == [0, 1]
+    names = [e for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert {e["args"]["name"] for e in names} == {"rank 0", "rank 1"}
+
+
+def test_timeline_cli_merges_directory(tmp_path, capsys):
+    for rank, starts in ((0, [10.0, 20.0]), (1, [15.0, 26.0])):
+        with open(tmp_path / f"trace-rank-{rank}.json", "w") as f:
+            json.dump(_mk_trace(rank, starts), f)
+    assert timeline_main([str(tmp_path), "--report"]) == 0
+    out = capsys.readouterr().out
+    assert "straggler=rank 1" in out
+    merged = json.load(open(tmp_path / MERGED_NAME))
+    assert {e["pid"] for e in merged["traceEvents"]
+            if e.get("ph") == "X"} == {0, 1}
+    report = json.load(open(tmp_path / REPORT_NAME))
+    assert report["spans"]["train.step"]["straggler_rank"] == 1
+    # Empty dir: a clean error, not a stack trace.
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert timeline_main([str(empty)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+def test_prometheus_text_format():
+    reg = MetricsRegistry()
+    reg.counter("c_total", op="ag").inc(2)
+    reg.gauge("occ").set(1.5)
+    h = reg.histogram("lat_us", op="x")
+    for v in (1.0, 3.0, 100.0):
+        h.observe(v)
+    lines = prometheus_text(registry=reg).splitlines()
+    assert "# TYPE c_total counter" in lines
+    assert 'c_total{op="ag"} 2.0' in lines
+    assert "occ 1.5" in lines
+    # po2 buckets surface as cumulative Prometheus le= series:
+    # 1.0 -> le=1.0, 3.0 -> le=4.0, 100.0 -> le=128.0.
+    assert 'lat_us_bucket{op="x",le="1.0"} 1' in lines
+    assert 'lat_us_bucket{op="x",le="4.0"} 2' in lines
+    assert 'lat_us_bucket{op="x",le="128.0"} 3' in lines
+    assert 'lat_us_bucket{op="x",le="+Inf"} 3' in lines
+    assert 'lat_us_sum{op="x"} 104.0' in lines
+    assert 'lat_us_count{op="x"} 3' in lines
+    # One TYPE line per metric name, before its samples.
+    assert sum(1 for l in lines
+               if l == "# TYPE lat_us histogram") == 1
+
+
+def test_metrics_server_serves_prometheus_and_health():
+    reg = MetricsRegistry()
+    reg.counter("served_total").inc()
+    srv = start_metrics_server(0, registry=reg)
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        resp = urllib.request.urlopen(f"{url}/metrics", timeout=10)
+        assert resp.status == 200
+        assert "text/plain" in resp.headers["Content-Type"]
+        body = resp.read().decode()
+        assert "served_total 1.0" in body.splitlines()
+        health = json.loads(urllib.request.urlopen(
+            f"{url}/healthz", timeout=10).read())
+        assert health["schema"] == 1 and "last_span" in health
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{url}/nope", timeout=10)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_freshness_and_stall_report(tmp_path):
+    hb_dir = str(tmp_path)
+    w = HeartbeatWriter(hb_dir, interval=0.05)
+    with span("serving.decode", step=7):
+        path = w.write_now()
+    payload = json.load(open(path))
+    assert payload["last_span"] == "serving.decode"
+    assert payload["rank"] == 0
+    assert abs(payload["unix_time"] - time.time()) < 5.0
+
+    # A peer whose heartbeat stopped 60s ago reads as stalled.
+    stale = dict(payload, rank=1, unix_time=payload["unix_time"] - 60,
+                 last_span="dcn_collective.wait", step=3)
+    with open(heartbeat_path(hb_dir, 1), "w") as f:
+        json.dump(stale, f)
+    report = rank_health_report(hb_dir, interval=1.0)
+    assert report["stalest_rank"] == 1
+    assert report["stalled_ranks"] == [1]
+    assert report["ranks"][1]["last_span"] == "dcn_collective.wait"
+    assert report["ranks"][0]["stale"] is False
+    text = format_rank_health(report)
+    assert "STALLED" in text and "dcn_collective.wait" in text
+
+    # Background writer refreshes the file.
+    w.start()
+    time.sleep(0.2)
+    w.stop()
+    assert rank_health_report(hb_dir, interval=0.05)["ranks"][0][
+        "age_s"] < 1.0
+
+
+def test_maybe_start_exporters_tolerate_bad_env(monkeypatch):
+    """Malformed opt-in env must never kill the rank at startup
+    (these run inside initialize_distributed)."""
+    from triton_distributed_tpu.observability.exporter import (
+        maybe_start_heartbeat, maybe_start_metrics_server)
+
+    monkeypatch.setenv("TDT_METRICS_PORT", "")
+    assert maybe_start_metrics_server() is None
+    monkeypatch.setenv("TDT_METRICS_PORT", "auto")
+    assert maybe_start_metrics_server() is None
+    monkeypatch.delenv("TDT_HEARTBEAT_DIR", raising=False)
+    assert maybe_start_heartbeat() is None
+
+
+def test_launcher_health_lines_do_not_blame_fresh_ranks(tmp_path):
+    """The watchdog must not pin a hang on a healthy rank: when every
+    heartbeat is fresh it reports facts, naming a STALLED rank only
+    when one actually stopped beating."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_launch_under_test", os.path.join(REPO, "scripts",
+                                           "launch.py"))
+    launch = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(launch)
+
+    now = time.time()
+    for rank, age in ((0, 0.1), (1, 0.4)):
+        with open(tmp_path / f"heartbeat-rank-{rank}.json", "w") as f:
+            json.dump({"rank": rank, "unix_time": now - age,
+                       "last_span": "train.step", "step": 2}, f)
+    lines = "\n".join(launch._rank_health_lines(str(tmp_path)))
+    assert "watchdog: stalled rank" not in lines
+    assert "STALLED" not in lines
+    assert "all heartbeats fresh" in lines
+
+    # Rank 1 stops beating -> it (and only it) is the verdict.
+    with open(tmp_path / "heartbeat-rank-1.json", "w") as f:
+        json.dump({"rank": 1, "unix_time": now - 60,
+                   "last_span": "dcn.wait", "step": 2}, f)
+    lines = "\n".join(launch._rank_health_lines(str(tmp_path)))
+    assert "watchdog: stalled rank 1" in lines and "dcn.wait" in lines
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder forensics (satellite: dumps answer "what was this
+# rank doing")
+# ---------------------------------------------------------------------------
+
+def test_flight_dump_includes_open_spans_and_heartbeat(tmp_path):
+    fr = FlightRecorder(capacity=4)
+    fr.record(KernelEvent(kind="collective", op="all_gather"))
+    with span("engine.decode_step", step=11):
+        path = fr.dump(str(tmp_path / "f.json"), reason="test")
+    payload = json.load(open(path))
+    assert "engine.decode_step" in [s["name"]
+                                    for s in payload["open_spans"]]
+    assert payload["heartbeat"]["last_span"] == "engine.decode_step"
+    assert payload["heartbeat"]["open_spans"] == ["engine.decode_step"]
+
+
+# ---------------------------------------------------------------------------
+# group_profile (satellite: rank-aware + graceful no-op)
+# ---------------------------------------------------------------------------
+
+def test_group_profile_rank_aware_and_graceful(tmp_path, monkeypatch):
+    from triton_distributed_tpu.utils import profiling
+
+    # Multi-process: each rank writes its own subdirectory, no
+    # collisions on a shared trace path.
+    monkeypatch.setenv("TDT_NUM_PROCESSES", "2")
+    monkeypatch.setenv("TDT_PROCESS_ID", "1")
+    with profiling.group_profile("unit", trace_dir=str(tmp_path)):
+        pass
+    assert (tmp_path / "unit" / "rank-1").is_dir()
+
+    # A missing/broken profiler plugin degrades to an unprofiled
+    # region, not a crash.
+    def broken(*a, **k):
+        raise RuntimeError("profiler plugin unavailable")
+
+    monkeypatch.setattr(profiling.jax.profiler, "start_trace", broken)
+    ran = []
+    with profiling.group_profile("unit2", trace_dir=str(tmp_path)):
+        ran.append(1)
+    assert ran == [1]
+
+    # Single-process keeps the flat layout (back-compat).
+    monkeypatch.undo()
+    monkeypatch.setenv("TDT_NUM_PROCESSES", "1")
+    with profiling.group_profile("flat", trace_dir=str(tmp_path)):
+        pass
+    assert (tmp_path / "flat").is_dir()
+    assert not (tmp_path / "flat" / "rank-0").exists()
+
+
+# ---------------------------------------------------------------------------
+# Bench per-iteration percentiles (satellite: p50/p99, not just mean)
+# ---------------------------------------------------------------------------
+
+def test_bench_record_attaches_percentiles_and_histogram():
+    from triton_distributed_tpu.observability import (
+        bench_record, get_registry)
+
+    reg = get_registry()
+    before = reg.histogram("bench_iteration_us",
+                           bench="ag_gemm").snapshot()["count"]
+    rec = bench_record(
+        {"bench": "ag_gemm", "world": 8, "M": 4096, "K": 7168,
+         "N": 7168, "method": "fused", "us": 900.0,
+         "samples_us": [850.0, 900.0, 950.0, 1200.0]},
+        print_line=False)
+    assert "samples_us" not in rec        # raw list consumed, not printed
+    assert rec["p50_us"] == 900.0
+    assert rec["p99_us"] == 1200.0        # tail, not mean
+    h = reg.histogram("bench_iteration_us", bench="ag_gemm").snapshot()
+    assert h["count"] == before + 4 and h["max"] == 1200.0
+    json.dumps(rec)                       # still one JSON line
+
+
+def test_percentile_nearest_rank():
+    from triton_distributed_tpu.observability import percentile
+
+    xs = list(range(1, 101))
+    assert percentile(xs, 50) == 50
+    assert percentile(xs, 99) == 99
+    assert percentile(xs, 100) == 100
+    assert percentile([7.0], 99) == 7.0
+    with pytest.raises(ValueError):
+        percentile([], 50)
+
+
+# ---------------------------------------------------------------------------
+# Autotuner trial spans
+# ---------------------------------------------------------------------------
+
+def test_autotuner_emits_trial_spans():
+    from triton_distributed_tpu.autotuner import ContextualAutotuner
+
+    tr = get_tracer()
+    before = sum(1 for s in tr.finished()
+                 if s.name == "autotune.trial")
+
+    def op(a, *, config):
+        return a * config
+
+    tuner = ContextualAutotuner(op, [2.0, 3.0], iters=1, warmup=1)
+    tuner(jnp.ones((4, 8)))
+    trials = [s for s in tr.finished() if s.name == "autotune.trial"]
+    assert len(trials) - before == 2
+    assert {s.attrs["config"] for s in trials[-2:]} == {"2.0", "3.0"}
+
+
+# ---------------------------------------------------------------------------
+# Real 2-process launch.py --trace-dir runs
+# ---------------------------------------------------------------------------
+
+WORKER_TRACE = textwrap.dedent("""
+    import os, sys, time
+    from triton_distributed_tpu.observability import (
+        maybe_install_trace_export, maybe_start_heartbeat, set_step,
+        span)
+
+    rank = int(os.environ["TDT_PROCESS_ID"])
+    assert maybe_install_trace_export()
+    assert maybe_start_heartbeat() is not None
+
+    # File barrier: process spawn + import times differ by O(seconds),
+    # which would swamp the deliberate skew below.
+    ready = sys.argv[1]
+    open(os.path.join(ready, f"r{rank}"), "w").close()
+    for _ in range(2400):
+        if all(os.path.exists(os.path.join(ready, f"r{i}"))
+               for i in (0, 1)):
+            break
+        time.sleep(0.05)
+
+    for step in range(3):
+        set_step(step)
+        if rank == 1:
+            time.sleep(0.06)   # rank 1 is the deliberate straggler
+        with span("train.step", step=step):
+            with span("collective.all_gather"):
+                time.sleep(0.01)
+""")
+
+
+def _run_launcher(extra_args, worker_src, tmp_path, env_extra=None,
+                  worker_args=()):
+    worker = tmp_path / "worker.py"
+    worker.write_text(worker_src)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("TDT_OBSERVABILITY", None)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "launch.py"),
+         "--nproc", "2", "--cpu", *extra_args, str(worker),
+         *[str(a) for a in worker_args]],
+        env=env, capture_output=True, text=True, timeout=300)
+
+
+def test_launcher_trace_dir_merges_timeline(tmp_path):
+    """Happy path: 2 ranks emit spans, exit cleanly; the launcher must
+    leave per-rank traces, ONE valid merged Chrome trace, and a
+    straggler report that names rank 1 (the deliberate laggard)."""
+    trace_dir = tmp_path / "traces"
+    res = _run_launcher(["--trace-dir", str(trace_dir)], WORKER_TRACE,
+                        tmp_path, worker_args=[tmp_path])
+    assert res.returncode == 0, (res.returncode, res.stdout, res.stderr)
+    for rank in (0, 1):
+        per_rank = json.load(open(trace_dir / f"trace-rank-{rank}.json"))
+        assert per_rank["metadata"]["rank"] == rank
+        assert any(e.get("name") == "train.step"
+                   for e in per_rank["traceEvents"])
+    merged = json.load(open(trace_dir / MERGED_NAME))
+    xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert {e["pid"] for e in xs} == {0, 1}
+    assert {e["name"] for e in xs} >= {"train.step",
+                                       "collective.all_gather"}
+    report = json.load(open(trace_dir / REPORT_NAME))
+    step = report["spans"]["train.step"]
+    assert step["occurrences"] == 3
+    assert step["straggler_rank"] == 1, (report, res.stderr)
+    assert step["max_skew_us"] > 10_000        # >= one 60 ms delay
+    # Heartbeats were written under the trace dir.
+    assert (trace_dir / "heartbeats" / "heartbeat-rank-0.json").exists()
+
+
+WORKER_STALL = textwrap.dedent("""
+    import os, time
+    from triton_distributed_tpu.observability import (
+        maybe_start_heartbeat, span)
+
+    rank = int(os.environ["TDT_PROCESS_ID"])
+    hb = maybe_start_heartbeat()
+    assert hb is not None
+    with span("warmup", rank=rank):
+        time.sleep(0.05)
+    if rank == 1:
+        # Simulate a rank wedged inside a compiled collective: a span
+        # left open and the heartbeat thread silenced (the real wedge
+        # holds the GIL so the beat thread starves the same way).
+        ctx = span("dcn_collective.wait", step=3)
+        ctx.__enter__()
+        hb.write_now()
+        hb.stop()
+    time.sleep(600)
+""")
+
+
+def test_launcher_timeout_names_stalled_rank(tmp_path):
+    """Forced hang: --timeout must still exit 124, and the watchdog
+    must say WHICH rank stalled and what its last span was (read from
+    heartbeats) instead of a bare timeout."""
+    trace_dir = tmp_path / "traces"
+    res = _run_launcher(
+        ["--trace-dir", str(trace_dir), "--timeout", "6"],
+        WORKER_STALL, tmp_path,
+        env_extra={"TDT_HEARTBEAT_INTERVAL": "0.2"})
+    assert res.returncode == 124, (res.returncode, res.stdout,
+                                   res.stderr)
+    assert "stalled rank 1" in res.stderr, res.stderr
+    assert "dcn_collective.wait" in res.stderr, res.stderr
+    # Rank 0 kept beating: reported healthy, with its own last span.
+    assert "rank 0" in res.stderr and "'warmup'" in res.stderr

@@ -329,11 +329,18 @@ class ContextualAutotuner:
                     reg.counter("autotune_cache_hits_total",
                                 level="disk").inc()
         if key not in self.cache:
+            from triton_distributed_tpu.observability import span
             t_tune0 = time.perf_counter()
             results = []
             for i, cfg in enumerate(self.configs):
                 try:
-                    t = self._bench_one(cfg, args, kwargs)
+                    # One runtime span per candidate trial: the tuning
+                    # wall time becomes attributable per-config on the
+                    # cross-rank timeline (a candidate that compiles
+                    # slowly on one rank shows up as that rank's span).
+                    with span("autotune.trial", op=self._fn_id(),
+                              config=repr(cfg), index=i):
+                        t = self._bench_one(cfg, args, kwargs)
                     results.append((t, i))
                     self._log(f"{key}: config[{i}]={cfg} -> {t*1e3:.3f} ms")
                 except Exception as e:  # config invalid on this hw

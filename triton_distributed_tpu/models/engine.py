@@ -86,17 +86,23 @@ class Engine:
         # prefill tokens/s, steady-state decode ms/step, KV occupancy.
         # The only extra device sync is ONE block after prefill — serve
         # already blocks at the end, so steady-state decode pays
-        # nothing.
+        # nothing.  Runtime spans (observability.tracing) bracket the
+        # same phases for the cross-rank timeline; the scan path is one
+        # dispatch, so it gets ONE span, not per-step spans (per-step
+        # host timing does not exist there by design).
         from triton_distributed_tpu.observability import (
-            observability_enabled)
+            observability_enabled, set_step, span)
         obs = observability_enabled()
         t_serve0 = time.perf_counter()
 
-        with group_profile("engine_serve", do_prof=profile):
-            logits, cache = self.prefill(params, input_ids, cache)
-            if obs:
-                jax.block_until_ready(logits)
-                t_prefill = time.perf_counter() - t_serve0
+        with span("engine.serve", batch=b, prompt_len=s,
+                  gen_len=gen_len), \
+                group_profile("engine_serve", do_prof=profile):
+            with span("engine.prefill", batch=b, prompt_len=s):
+                logits, cache = self.prefill(params, input_ids, cache)
+                if obs:
+                    jax.block_until_ready(logits)
+                    t_prefill = time.perf_counter() - t_serve0
             first = sample_token(logits, key, self.temperature,
                                  top_k=self.top_k, top_p=self.top_p)
             tokens = [first]
@@ -112,20 +118,29 @@ class Engine:
                 with group_profile("engine_decode_steps",
                                    do_prof=not profile):
                     for _ in range(n_prof):
-                        cur, cache, key = self._step(params, cur, cache,
-                                                     key)
+                        if obs:
+                            set_step(len(tokens))
+                        with span("engine.decode_step",
+                                  step=len(tokens)):
+                            cur, cache, key = self._step(
+                                params, cur, cache, key)
                         tokens.append(cur)
             remaining = gen_len - len(tokens)
             if remaining > 0:
                 if self.scan_decode:
-                    toks, cache = self._rollout(params, cur, cache, key,
-                                                remaining)
+                    with span("engine.decode_scan", steps=remaining):
+                        toks, cache = self._rollout(params, cur, cache,
+                                                    key, remaining)
                     out = jnp.concatenate(
                         [jnp.stack(tokens, axis=1), toks], axis=1)
                 else:
                     for _ in range(remaining):
-                        cur, cache, key = self._step(params, cur, cache,
-                                                     key)
+                        if obs:
+                            set_step(len(tokens))
+                        with span("engine.decode_step",
+                                  step=len(tokens)):
+                            cur, cache, key = self._step(
+                                params, cur, cache, key)
                         tokens.append(cur)
                     out = jnp.stack(tokens, axis=1)
             else:

@@ -1,9 +1,11 @@
 """Observability subsystem: runtime metrics, structured kernel-event
-tracing, perf-model audit, and a multi-process flight recorder.
+tracing, runtime span tracing with cross-rank timeline merge, live
+rank-health export (Prometheus + heartbeats), perf-model audit, and a
+multi-process flight recorder.
 
-See docs/observability.md for the metric names, the event schema, and
-the flight-recorder workflow.  Everything here is host-side (the
-device hot path is untouched); the global opt-out is
+See docs/observability.md for the metric names, the event/span
+schemas, and the flight-recorder/timeline workflows.  Everything here
+is host-side (the device hot path is untouched); the global opt-out is
 ``TDT_OBSERVABILITY=0``.
 """
 
@@ -13,6 +15,7 @@ from triton_distributed_tpu.observability.audit import (  # noqa: F401
     audit_recorded,
     bench_record,
     format_report,
+    percentile,
 )
 from triton_distributed_tpu.observability.events import (  # noqa: F401
     EVENT_SCHEMA_VERSION,
@@ -38,8 +41,36 @@ from triton_distributed_tpu.observability.metrics import (  # noqa: F401
     merge_snapshots,
     observability_enabled,
 )
+from triton_distributed_tpu.observability.exporter import (  # noqa: F401
+    HeartbeatWriter,
+    MetricsServer,
+    format_rank_health,
+    heartbeat_payload,
+    maybe_start_heartbeat,
+    maybe_start_metrics_server,
+    prometheus_text,
+    rank_health_report,
+    read_heartbeats,
+    start_metrics_server,
+)
 from triton_distributed_tpu.observability.recorder import (  # noqa: F401
     FlightRecorder,
     get_flight_recorder,
     maybe_install_flight_recorder,
+)
+from triton_distributed_tpu.observability.timeline import (  # noqa: F401
+    format_straggler_report,
+    merge_directory,
+    merge_traces,
+    skew_rows,
+    straggler_report,
+)
+from triton_distributed_tpu.observability.tracing import (  # noqa: F401
+    Span,
+    SpanTracer,
+    get_tracer,
+    maybe_install_trace_export,
+    set_step,
+    span,
+    traced,
 )

@@ -132,6 +132,17 @@ def _estimate_for_bench(rec: dict) -> Optional[float]:
         return None
 
 
+def percentile(samples, q: float) -> float:
+    """Nearest-rank percentile of raw samples (q in [0, 100]).  With
+    the drivers' handful of per-repeat slopes, p99 degenerates to the
+    max — still the right tail bound to gate on."""
+    xs = sorted(samples)
+    if not xs:
+        raise ValueError("percentile of no samples")
+    rank = max(int(len(xs) * q / 100.0 + 0.999999) - 1, 0)
+    return xs[min(rank, len(xs) - 1)]
+
+
 def bench_record(rec: dict, *, print_line: bool = True) -> dict:
     """Route one bench measurement through the registry.
 
@@ -141,16 +152,30 @@ def bench_record(rec: dict, *, print_line: bool = True) -> dict:
     metrics, and the (augmented) line is printed — so stdout, the
     committed benchmark/results/*.json and the registry export all
     carry the same record.
+
+    ``samples_us`` (optional, consumed): per-iteration latencies.
+    Each lands in the ``bench_iteration_us{bench=...}`` registry
+    histogram, and the line gains ``p50_us``/``p99_us`` — tails, not
+    just the mean, so `scripts/check_bench_regression.py` can gate on
+    p99 (a kernel that got jittery without moving its median).
     """
     import json
 
     from triton_distributed_tpu.observability.events import (
         emit_kernel_event)
     from triton_distributed_tpu.observability.metrics import (
-        observability_enabled)
+        get_registry, observability_enabled)
 
     rec = dict(rec)
+    samples = rec.pop("samples_us", None)
     us = rec.get("us")
+    if observability_enabled() and samples:
+        hist = get_registry().histogram("bench_iteration_us",
+                                        bench=str(rec.get("bench")))
+        for s in samples:
+            hist.observe(float(s))
+        rec.setdefault("p50_us", round(percentile(samples, 50), 1))
+        rec.setdefault("p99_us", round(percentile(samples, 99), 1))
     if observability_enabled() and us is not None:
         est = _estimate_for_bench(rec)
         if est is not None:

@@ -1,0 +1,352 @@
+"""Live rank-health export: Prometheus text exposition of the metrics
+registry over a stdlib HTTP server, and a per-rank heartbeat file a
+watchdog can read without touching the (possibly wedged) process.
+
+Two transports because the two failure modes differ:
+
+- **/metrics** (opt-in ``TDT_METRICS_PORT``): a scraper polls a healthy
+  serving rank — counters, gauges, po2-bucket histograms rendered in
+  Prometheus text format 0.0.4.  Stdlib ``http.server`` only; no new
+  dependencies.
+- **heartbeat files** (opt-in ``TDT_HEARTBEAT_DIR``): a background
+  daemon thread writes ``heartbeat-rank-<N>.json`` every
+  ``TDT_HEARTBEAT_INTERVAL`` seconds (default 1).  When a rank wedges
+  inside a compiled collective its HTTP server still answers (separate
+  thread) but its *heartbeat goes stale* — the file's age is the health
+  signal, and its body (last span, step, timestamp) says what the rank
+  was doing.  ``scripts/launch.py --timeout`` reads these to name the
+  stalled rank instead of exiting with a bare 124.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import re
+import threading
+import time
+from typing import Dict, Optional
+
+from triton_distributed_tpu.observability.metrics import (
+    MetricsRegistry,
+    _process_index,
+    get_registry,
+)
+
+ENV_METRICS_PORT = "TDT_METRICS_PORT"
+ENV_HEARTBEAT_DIR = "TDT_HEARTBEAT_DIR"
+ENV_HEARTBEAT_INTERVAL = "TDT_HEARTBEAT_INTERVAL"
+DEFAULT_HEARTBEAT_INTERVAL = 1.0
+
+#: Heartbeats older than this many intervals are reported stale.
+STALE_INTERVALS = 3.0
+
+#: Registry keys are ``name{k="v",...}`` — split the name out so
+#: histogram expansions can splice ``_bucket``/``_sum`` suffixes in.
+_KEY_RE = re.compile(r'^(?P<name>[^{]+)(?:\{(?P<labels>.*)\})?$')
+
+
+def _split_key(key: str):
+    m = _KEY_RE.match(key)
+    name = m.group("name") if m else key
+    labels = m.group("labels") or "" if m else ""
+    return name, labels
+
+
+def _fmt(name: str, labels: str, value, extra_label: str = "") -> str:
+    inner = ",".join(x for x in (labels, extra_label) if x)
+    label_part = f"{{{inner}}}" if inner else ""
+    if value is None or (isinstance(value, float) and math.isnan(value)):
+        value = "NaN"
+    elif value == math.inf:
+        value = "+Inf"
+    return f"{name}{label_part} {value}"
+
+
+def prometheus_text(snapshot: Optional[dict] = None,
+                    registry: Optional[MetricsRegistry] = None) -> str:
+    """Render a registry snapshot in Prometheus text format 0.0.4.
+
+    Histograms expand to the conventional ``_bucket{le=...}`` series:
+    the registry's po2 bucket with exponent ``e`` holds observations in
+    ``(2^(e-1), 2^e]``, so its cumulative count lands at ``le="2^e"``
+    (the non-positive sentinel bucket lands at ``le="0"``).
+    """
+    if snapshot is None:
+        snapshot = (registry or get_registry()).snapshot()
+    rank = snapshot.get("meta", {}).get("rank", _process_index())
+    lines = []
+    seen_types = set()
+
+    def typ(name, kind):
+        if name not in seen_types:
+            seen_types.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+
+    for key, v in sorted(snapshot.get("counters", {}).items()):
+        name, labels = _split_key(key)
+        typ(name, "counter")
+        lines.append(_fmt(name, labels, v))
+    for key, v in sorted(snapshot.get("gauges", {}).items()):
+        name, labels = _split_key(key)
+        typ(name, "gauge")
+        lines.append(_fmt(name, labels, v))
+    for key, h in sorted(snapshot.get("histograms", {}).items()):
+        name, labels = _split_key(key)
+        typ(name, "histogram")
+        cum = 0
+        buckets = sorted((int(e), c) for e, c in
+                         h.get("buckets", {}).items())
+        for e, c in buckets:
+            cum += c
+            le = "0" if e <= -(2 ** 29) else repr(float(2 ** e))
+            lines.append(_fmt(f"{name}_bucket", labels, cum,
+                              f'le="{le}"'))
+        lines.append(_fmt(f"{name}_bucket", labels, h.get("count", 0),
+                          'le="+Inf"'))
+        lines.append(_fmt(f"{name}_sum", labels, h.get("sum", 0.0)))
+        lines.append(_fmt(f"{name}_count", labels, h.get("count", 0)))
+    lines.append("# TYPE tdt_rank gauge")
+    lines.append(_fmt("tdt_rank", "", rank))
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# HTTP exposition (stdlib only)
+# ---------------------------------------------------------------------------
+
+class MetricsServer:
+    """Minimal threaded HTTP server answering ``GET /metrics`` (and
+    ``/healthz`` with the heartbeat payload as JSON)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: Optional[MetricsRegistry] = None):
+        import http.server
+
+        reg = registry  # bind for the handler closure
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                if self.path.startswith("/metrics"):
+                    body = prometheus_text(registry=reg).encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.startswith("/healthz"):
+                    body = json.dumps(heartbeat_payload()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # keep stdout clean
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tdt-metrics-http",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+_SERVER: Optional[MetricsServer] = None
+_SERVER_LOCK = threading.Lock()
+
+
+def start_metrics_server(port: int = 0,
+                         registry: Optional[MetricsRegistry] = None
+                         ) -> MetricsServer:
+    return MetricsServer(port=port, registry=registry)
+
+
+def maybe_start_metrics_server() -> Optional[MetricsServer]:
+    """Start the process-global /metrics server iff
+    ``TDT_METRICS_PORT`` is set (0 picks an ephemeral port); safe to
+    call twice."""
+    global _SERVER
+    port = os.environ.get(ENV_METRICS_PORT)
+    if not port:  # unset or explicitly emptied to disable
+        return None
+    with _SERVER_LOCK:
+        if _SERVER is None:
+            try:
+                _SERVER = start_metrics_server(int(port))
+            except (OSError, ValueError):
+                # Port taken or malformed env: health export must not
+                # kill the serving process.
+                return None
+        return _SERVER
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat files
+# ---------------------------------------------------------------------------
+
+def heartbeat_payload() -> dict:
+    """What this rank is doing right now: last/open spans, logical
+    step, registry event count — the body a watchdog reads to name a
+    stalled rank's last known activity."""
+    from triton_distributed_tpu.observability import tracing
+    tracer = tracing.get_tracer()
+    last = tracer.last_span()
+    return {
+        "schema": 1,
+        "rank": _process_index(),
+        "pid": os.getpid(),
+        "unix_time": time.time(),
+        "step": tracing.current_step(),
+        "last_span": last.name if last is not None else None,
+        "open_spans": [s.name for s in tracer.open_spans()],
+    }
+
+
+def heartbeat_path(directory: str, rank: Optional[int] = None) -> str:
+    rank = _process_index() if rank is None else rank
+    return os.path.join(directory, f"heartbeat-rank-{rank}.json")
+
+
+class HeartbeatWriter:
+    """Background daemon thread writing this rank's heartbeat file
+    every ``interval`` seconds (atomic tmp+rename so readers never see
+    a torn file)."""
+
+    def __init__(self, directory: str,
+                 interval: float = DEFAULT_HEARTBEAT_INTERVAL):
+        self.directory = directory
+        self.interval = float(interval)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def write_now(self) -> str:
+        path = heartbeat_path(self.directory)
+        os.makedirs(self.directory, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(heartbeat_payload(), f)
+        os.replace(tmp, path)
+        return path
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.write_now()
+            except OSError:
+                pass  # disk hiccups must not kill the worker
+            self._stop.wait(self.interval)
+
+    def start(self) -> "HeartbeatWriter":
+        if self._thread is None:
+            self.write_now()  # first beat synchronously: the watchdog
+            self._thread = threading.Thread(  # sees every rank arm
+                target=self._run, name="tdt-heartbeat", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval)
+            self._thread = None
+
+
+_HEARTBEAT: Optional[HeartbeatWriter] = None
+_HEARTBEAT_LOCK = threading.Lock()
+
+
+def maybe_start_heartbeat() -> Optional[HeartbeatWriter]:
+    """Start the per-rank heartbeat iff ``TDT_HEARTBEAT_DIR`` names a
+    directory (``scripts/launch.py --trace-dir`` exports it); safe to
+    call twice."""
+    global _HEARTBEAT
+    directory = os.environ.get(ENV_HEARTBEAT_DIR)
+    if not directory:
+        return None
+    with _HEARTBEAT_LOCK:
+        if _HEARTBEAT is None:
+            try:
+                interval = float(os.environ.get(
+                    ENV_HEARTBEAT_INTERVAL,
+                    DEFAULT_HEARTBEAT_INTERVAL))
+            except ValueError:  # malformed env must not kill the rank
+                interval = DEFAULT_HEARTBEAT_INTERVAL
+            _HEARTBEAT = HeartbeatWriter(directory, interval).start()
+        return _HEARTBEAT
+
+
+# ---------------------------------------------------------------------------
+# Watchdog side: read + report
+# ---------------------------------------------------------------------------
+
+def read_heartbeats(directory: str) -> Dict[int, dict]:
+    """{rank: payload} for every parseable heartbeat file."""
+    out: Dict[int, dict] = {}
+    for path in glob.glob(os.path.join(directory,
+                                       "heartbeat-rank-*.json")):
+        try:
+            with open(path) as f:
+                hb = json.load(f)
+            out[int(hb["rank"])] = hb
+        except (OSError, ValueError, KeyError):
+            continue
+    return out
+
+
+def rank_health_report(directory: str, now: Optional[float] = None,
+                       interval: float = DEFAULT_HEARTBEAT_INTERVAL
+                       ) -> dict:
+    """Summarise heartbeat freshness: per-rank age/last-span/step, the
+    stalest rank, and which ranks look stalled (age >
+    ``STALE_INTERVALS`` × interval).  This is what the launcher prints
+    when its ``--timeout`` watchdog fires, so a 124 exit names the
+    stalled rank instead of just a number."""
+    now = time.time() if now is None else now
+    beats = read_heartbeats(directory)
+    ranks = {}
+    for rank, hb in sorted(beats.items()):
+        age = now - float(hb.get("unix_time", 0.0))
+        ranks[rank] = {
+            "age_s": round(age, 3),
+            "last_span": hb.get("last_span"),
+            "open_spans": hb.get("open_spans", []),
+            "step": hb.get("step"),
+            "stale": age > STALE_INTERVALS * interval,
+        }
+    stalest = (max(ranks, key=lambda r: ranks[r]["age_s"])
+               if ranks else None)
+    return {"ranks": ranks, "stalest_rank": stalest,
+            "stalled_ranks": [r for r, h in ranks.items()
+                              if h["stale"]]}
+
+
+def format_rank_health(report: dict) -> str:
+    if not report.get("ranks"):
+        return "rank health: no heartbeats found"
+    lines = ["rank health (from heartbeats):"]
+    for rank, h in sorted(report["ranks"].items()):
+        mark = "STALLED" if h["stale"] else "ok"
+        step = f" step={h['step']}" if h.get("step") is not None else ""
+        lines.append(
+            f"  rank {rank}: [{mark:>7}] last beat {h['age_s']:.1f}s "
+            f"ago, last span={h['last_span']!r}{step}")
+    if report.get("stalled_ranks"):
+        worst = report["stalest_rank"]
+        h = report["ranks"][worst]
+        lines.append(
+            f"  => rank {worst} looks wedged in span "
+            f"{h['last_span']!r} (no heartbeat for {h['age_s']:.1f}s)")
+    return "\n".join(lines)

@@ -230,7 +230,10 @@ def _process_count() -> int:
 
 
 _GLOBAL: Optional[MetricsRegistry] = None
-_GLOBAL_LOCK = threading.Lock()
+# RLock for the same reason as the registry's own lock: the flight
+# recorder's signal handler calls get_registry() from the main thread
+# and may interrupt a get_registry() already inside the lock.
+_GLOBAL_LOCK = threading.RLock()
 
 
 def get_registry() -> MetricsRegistry:

@@ -103,6 +103,19 @@ class FlightRecorder:
             "events": [e.to_dict() for e in self.events()],
             "metrics": get_registry().snapshot(),
         }
+        # "What was this rank doing?" — the spans still open at dump
+        # time and the heartbeat body it would have written next.  Best
+        # effort: forensics must never turn a dump into a crash.
+        try:
+            from triton_distributed_tpu.observability.exporter import (
+                heartbeat_payload)
+            from triton_distributed_tpu.observability.tracing import (
+                get_tracer)
+            payload["open_spans"] = [s.to_dict() for s in
+                                     get_tracer().open_spans()]
+            payload["heartbeat"] = heartbeat_payload()
+        except Exception:
+            pass
         os.makedirs(os.path.dirname(os.path.abspath(path)),
                     exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}"

@@ -1,0 +1,274 @@
+"""Cross-rank timeline merge: combine the per-rank Chrome traces
+written by :mod:`.tracing` into ONE timeline on the shared clock, and
+mine it for the question single-rank traces cannot answer — *which
+rank is the straggler*.
+
+Reference analogue: ``group_profile`` merges per-rank torch-profiler
+chrome traces after manually aligning clocks
+(`python/triton_dist/utils.py:373-593`).  Here alignment is free by
+construction: every span timestamp is already on the unix clock
+(:data:`.tracing._CLOCK_BASE`), so merging is concatenation with
+per-rank ``pid`` lanes, and what remains is the analysis:
+
+- **skew**: for the k-th occurrence of a span name across ranks,
+  ``max(start) - min(start)`` — how far apart the ranks entered the
+  same region (same-host ranks share the clock exactly; cross-host,
+  NTP bounds it, and the per-file export metadata carries each rank's
+  clock base for manual correction).
+- **straggler attribution**: per span name, the rank that entered last,
+  per occurrence; a rank that is *consistently* last is the straggler
+  every other rank's collective waits on.  ``barrier_wait_us`` charges
+  each non-straggler the time it spent waiting (last_start − own
+  start) — the aggregate cost of the skew.
+
+Importable (``merge_traces`` / ``skew_rows`` / ``straggler_report``)
+and runnable::
+
+    python -m triton_distributed_tpu.observability.timeline \
+        ./tracedir -o merged.json --report
+
+``scripts/launch.py --trace-dir`` runs the same merge automatically
+when the group exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+TRACE_GLOB = "trace-rank-*.json"
+MERGED_NAME = "merged_trace.json"
+REPORT_NAME = "straggler_report.json"
+
+
+def load_trace(path: str) -> dict:
+    with open(path) as f:
+        trace = json.load(f)
+    if "traceEvents" not in trace:
+        raise ValueError(f"{path}: not a Chrome trace "
+                         "(no traceEvents)")
+    return trace
+
+
+def find_trace_files(directory: str) -> List[str]:
+    return sorted(glob.glob(os.path.join(directory, TRACE_GLOB)))
+
+
+def trace_rank(trace: dict, default: int = 0) -> int:
+    return int(trace.get("metadata", {}).get("rank", default))
+
+
+def _span_events(trace: dict) -> List[dict]:
+    return [e for e in trace.get("traceEvents", [])
+            if e.get("ph") == "X"]
+
+
+def merge_traces(traces: Sequence[dict]) -> dict:
+    """One Chrome trace with each rank in its own ``pid`` lane.
+    Timestamps are rebased to the earliest event (Perfetto renders
+    absolute unix-µs stamps poorly); the offset is kept in metadata."""
+    t0 = min((e["ts"] for tr in traces for e in _span_events(tr)),
+             default=0.0)
+    events: List[dict] = []
+    ranks = []
+    for i, tr in enumerate(traces):
+        rank = trace_rank(tr, default=i)
+        ranks.append(rank)
+        events.append({"ph": "M", "name": "process_name", "pid": rank,
+                       "args": {"name": f"rank {rank}"}})
+        events.append({"ph": "M", "name": "process_sort_index",
+                       "pid": rank, "args": {"sort_index": rank}})
+        for e in _span_events(tr):
+            e = dict(e)
+            e["pid"] = rank
+            e["ts"] = round(e["ts"] - t0, 3)
+            events.append(e)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "schema": 1,
+            "ranks": sorted(ranks),
+            "t0_unix_us": t0,
+            "clock": "unix-us rebased to t0_unix_us",
+        },
+    }
+
+
+def _occurrences_by_name(traces: Sequence[dict]
+                         ) -> Dict[str, Dict[int, List[dict]]]:
+    """{span_name: {rank: [events sorted by ts]}} — the k-th element of
+    each rank's list is matched as the k-th occurrence."""
+    by_name: Dict[str, Dict[int, List[dict]]] = {}
+    for i, tr in enumerate(traces):
+        rank = trace_rank(tr, default=i)
+        for e in _span_events(tr):
+            by_name.setdefault(e["name"], {}).setdefault(
+                rank, []).append(e)
+    for ranks in by_name.values():
+        for evs in ranks.values():
+            evs.sort(key=lambda e: e["ts"])
+    return by_name
+
+
+def skew_rows(traces: Sequence[dict]) -> List[dict]:
+    """One row per (span name, occurrence) seen on >= 2 ranks:
+    cross-rank start skew, duration spread, and the last-arriving
+    (straggler) rank."""
+    rows = []
+    for name, per_rank in sorted(_occurrences_by_name(traces).items()):
+        if len(per_rank) < 2:
+            continue
+        n = min(len(evs) for evs in per_rank.values())
+        for k in range(n):
+            starts = {r: evs[k]["ts"] for r, evs in per_rank.items()}
+            durs = {r: evs[k].get("dur", 0.0)
+                    for r, evs in per_rank.items()}
+            last = max(starts, key=starts.get)
+            first = min(starts, key=starts.get)
+            rows.append({
+                "name": name,
+                "occurrence": k,
+                "skew_us": round(starts[last] - starts[first], 3),
+                "first_rank": first,
+                "last_rank": last,
+                "dur_spread_us": round(
+                    max(durs.values()) - min(durs.values()), 3),
+                "slowest_rank": max(durs, key=durs.get),
+                "starts_us": starts,
+            })
+    return rows
+
+
+def straggler_report(traces: Sequence[dict]) -> dict:
+    """Aggregate :func:`skew_rows` per span name: how often each rank
+    arrived last, the consistent straggler (mode of last-arrivers),
+    and the barrier wait each other rank paid for it."""
+    rows = skew_rows(traces)
+    per_name: Dict[str, dict] = {}
+    for row in rows:
+        agg = per_name.setdefault(row["name"], {
+            "occurrences": 0, "last_counts": {}, "max_skew_us": 0.0,
+            "total_skew_us": 0.0, "barrier_wait_us": {}})
+        agg["occurrences"] += 1
+        last = row["last_rank"]
+        agg["last_counts"][last] = agg["last_counts"].get(last, 0) + 1
+        agg["max_skew_us"] = max(agg["max_skew_us"], row["skew_us"])
+        agg["total_skew_us"] += row["skew_us"]
+        last_start = row["starts_us"][last]
+        for rank, start in row["starts_us"].items():
+            if rank != last:
+                agg["barrier_wait_us"][rank] = round(
+                    agg["barrier_wait_us"].get(rank, 0.0)
+                    + (last_start - start), 3)
+    for name, agg in per_name.items():
+        straggler = max(agg["last_counts"],
+                        key=lambda r: agg["last_counts"][r])
+        agg["straggler_rank"] = straggler
+        agg["straggler_fraction"] = round(
+            agg["last_counts"][straggler] / agg["occurrences"], 3)
+        agg["mean_skew_us"] = round(
+            agg["total_skew_us"] / agg["occurrences"], 3)
+        del agg["total_skew_us"]
+        # JSON object keys must be strings; ranks arrive as ints.
+        agg["last_counts"] = {str(k): v
+                              for k, v in agg["last_counts"].items()}
+        agg["barrier_wait_us"] = {
+            str(k): v for k, v in agg["barrier_wait_us"].items()}
+    return {
+        "schema": 1,
+        "ranks": sorted({trace_rank(tr, i)
+                         for i, tr in enumerate(traces)}),
+        "spans": per_name,
+    }
+
+
+def format_straggler_report(report: dict) -> str:
+    spans = report.get("spans", {})
+    if not spans:
+        return ("straggler report: no span appeared on >= 2 ranks "
+                "(nothing to attribute)")
+    lines = [f"straggler report over ranks {report['ranks']}:"]
+    for name, agg in sorted(
+            spans.items(),
+            key=lambda kv: -kv[1]["max_skew_us"]):
+        lines.append(
+            f"  {name}: straggler=rank {agg['straggler_rank']} "
+            f"(last in {agg['straggler_fraction']:.0%} of "
+            f"{agg['occurrences']} occurrence(s)), "
+            f"skew mean={agg['mean_skew_us']:.0f}us "
+            f"max={agg['max_skew_us']:.0f}us")
+        for rank, wait in sorted(agg["barrier_wait_us"].items()):
+            lines.append(f"    rank {rank} waited {wait:.0f}us total")
+    return "\n".join(lines)
+
+
+def merge_directory(directory: str, out: Optional[str] = None,
+                    report_out: Optional[str] = None) -> Optional[dict]:
+    """Merge every per-rank trace in ``directory`` into
+    ``merged_trace.json`` + ``straggler_report.json`` (both under the
+    directory unless overridden).  Returns the report, or None when no
+    trace files exist (a killed run may have exported nothing)."""
+    paths = find_trace_files(directory)
+    if not paths:
+        return None
+    traces = [load_trace(p) for p in paths]
+    merged = merge_traces(traces)
+    out = out or os.path.join(directory, MERGED_NAME)
+    with open(out, "w") as f:
+        json.dump(merged, f)
+    report = straggler_report(traces)
+    report["merged_trace"] = out
+    report_out = report_out or os.path.join(directory, REPORT_NAME)
+    with open(report_out, "w") as f:
+        json.dump(report, f, indent=1)
+    return report
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Merge per-rank span traces into one Chrome "
+                    "timeline and print the straggler report.")
+    ap.add_argument("traces", nargs="+",
+                    help="a directory of trace-rank-*.json, or "
+                         "explicit trace files")
+    ap.add_argument("-o", "--out", default=None,
+                    help="merged Chrome-trace output path")
+    ap.add_argument("--report-out", default=None,
+                    help="straggler report JSON output path")
+    ap.add_argument("--report", action="store_true",
+                    help="print the human-readable straggler report")
+    args = ap.parse_args(argv)
+
+    if len(args.traces) == 1 and os.path.isdir(args.traces[0]):
+        paths = find_trace_files(args.traces[0])
+        default_dir = args.traces[0]
+    else:
+        paths = list(args.traces)
+        default_dir = os.path.dirname(paths[0]) or "."
+    if not paths:
+        print(f"timeline: no {TRACE_GLOB} files in {args.traces[0]}",
+              file=sys.stderr)
+        return 2
+    traces = [load_trace(p) for p in paths]
+    out = args.out or os.path.join(default_dir, MERGED_NAME)
+    with open(out, "w") as f:
+        json.dump(merge_traces(traces), f)
+    report = straggler_report(traces)
+    report["merged_trace"] = out
+    report_out = args.report_out or os.path.join(default_dir,
+                                                 REPORT_NAME)
+    with open(report_out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"timeline: merged {len(paths)} rank trace(s) -> {out}")
+    if args.report:
+        print(format_straggler_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

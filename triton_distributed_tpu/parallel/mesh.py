@@ -237,10 +237,21 @@ def initialize_distributed(
     _GLOBAL_CONTEXT = ctx
     # Arm the per-rank flight recorder when the launcher (or the user)
     # exported TDT_FLIGHT_RECORDER — a hung/killed group then dumps
-    # its recent kernel events instead of dying silently.
+    # its recent kernel events instead of dying silently.  Likewise
+    # the runtime-observability exports: TDT_TRACE_DIR arms the atexit
+    # Chrome-trace dump, TDT_HEARTBEAT_DIR the live heartbeat thread,
+    # TDT_METRICS_PORT the /metrics HTTP endpoint
+    # (scripts/launch.py --trace-dir plumbs the first two).
     from triton_distributed_tpu.observability import (
-        maybe_install_flight_recorder)
+        maybe_install_flight_recorder,
+        maybe_install_trace_export,
+        maybe_start_heartbeat,
+        maybe_start_metrics_server,
+    )
     maybe_install_flight_recorder()
+    maybe_install_trace_export()
+    maybe_start_heartbeat()
+    maybe_start_metrics_server()
     return ctx
 
 
