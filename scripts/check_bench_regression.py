@@ -42,6 +42,10 @@ MEASUREMENT_FIELDS = {
     "speedup_vs_bf16", "speedup_range", "vs_staged",
     "vs_staged_range", "autotuned_blocks", "autotuned_block_k",
     "autotuned_config", "p50_us", "p99_us", "samples_us",
+    # Serving bench (bench_serving.py): TTFT/TBT rows share the
+    # latency "us" + p50/p99 fields; these ride along.
+    "useful_tokens", "speedup_vs_serial", "continuous_beats_serial",
+    "machine_drift_suspected", "makespan_spread",
 }
 #: Fields that may hold the latency to compare, in preference order.
 LATENCY_FIELDS = ("us", "ms", "ms_per_step")

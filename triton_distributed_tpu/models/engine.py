@@ -37,28 +37,19 @@ class Engine:
         self._prefill = jax.jit(model.make_prefill_fn())
         decode_fn = model.make_decode_fn()
 
-        def step(params, tokens, cache, key):
-            logits, cache = decode_fn(params, tokens, cache)
-            key, sub = jax.random.split(key)
-            nxt = sample_token(logits, sub, temperature, top_k=top_k,
-                               top_p=top_p)
-            return nxt, cache, key
+        # The step/rollout composition is shared with the
+        # continuous-batching runtime (serving.engine_batched): Engine
+        # is the thin static-batch client of the same code.  Imported
+        # lazily — serving.engine_batched imports models submodules.
+        from triton_distributed_tpu.serving.engine_batched import (
+            make_rollout_fn, make_step_fn)
 
+        step = make_step_fn(decode_fn, temperature, top_k=top_k,
+                            top_p=top_p)
         # donate cache so XLA updates it in place across steps
         self._step = jax.jit(step, donate_argnums=(2,))
-
-        def rollout(params, first_tokens, cache, key, gen_len):
-            def body(carry, _):
-                tokens, cache, key = carry
-                nxt, cache, key = step(params, tokens, cache, key)
-                return (nxt, cache, key), nxt
-
-            (_, cache, _), toks = jax.lax.scan(
-                body, (first_tokens, cache, key), length=gen_len)
-            return toks.T, cache          # (B, gen_len)
-
-        self._rollout = jax.jit(rollout, static_argnums=(4,),
-                                donate_argnums=(2,))
+        self._rollout = jax.jit(make_rollout_fn(step),
+                                static_argnums=(4,), donate_argnums=(2,))
         #: Shapes served so far: the first call per shape pays jit
         #: trace+compile (tens of seconds on TPU) and must not land in
         #: the steady-state latency histograms.
@@ -69,7 +60,7 @@ class Engine:
 
     def serve(self, params, input_ids, gen_len: int,
               key: Optional[jax.Array] = None, profile: bool = False,
-              profile_decode_steps: int = 0):
+              profile_decode_steps: int = 0, cache=None):
         """input_ids: (B, S) — S and B must tile the tp axis (pad
         upstream).  Returns generated tokens (B, gen_len).
 
@@ -77,10 +68,31 @@ class Engine:
         decode steps (the reference Engine captures 64 decode steps to
         `trace_static.json`, `models/engine.py:151-177`); implies the
         per-step loop for the traced prefix.
+
+        ``cache``: caller-provided KV cache to reuse instead of
+        allocating (and zeroing) a fresh one per call — its offset is
+        reset, stale KV beyond the new offset is never attended.  When
+        given, serve returns ``(tokens, cache)``; the cache is donated
+        through the decode jits, so the caller MUST rebind to the
+        returned one (the passed-in buffer is consumed).  This is what
+        lets a serving loop issue back-to-back serves without
+        re-zeroing HBM.
         """
         key = key if key is not None else jax.random.key(0)
         b, s = input_ids.shape
-        cache = self.model.create_cache(b)
+        caller_cache = cache is not None
+        if caller_cache:
+            assert int(cache.offset.shape[0]) == b, (
+                f"cache batch {cache.offset.shape[0]} != input batch {b}")
+            # Undersized caches fail loudly: decode's KV writes clamp
+            # at max_seq-1, which would silently corrupt the last row.
+            cache_seq = int(cache.ks[0].shape[2])
+            assert s + gen_len <= cache_seq + 1, (
+                f"cache max_seq={cache_seq} cannot hold prompt {s} + "
+                f"gen_len {gen_len}")
+            cache = cache.set_offset(0)
+        else:
+            cache = self.model.create_cache(b)
 
         # Serving metrics (opt-out with the rest of observability):
         # prefill tokens/s, steady-state decode ms/step, KV occupancy.
@@ -155,6 +167,8 @@ class Engine:
                 time.perf_counter() - t_serve0,
                 shape_key=(b, s, gen_len, profile_decode_steps,
                            self.scan_decode))
+        if caller_cache:
+            return out, cache
         return out
 
     def _record_serve_metrics(self, b, s, gen_len, cache, t_prefill,

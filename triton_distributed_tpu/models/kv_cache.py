@@ -98,6 +98,31 @@ class KVCache:
     def inc_offset(self, n: int = 1):
         return dataclasses.replace(self, offset=self.offset + n)
 
+    def reset_slot(self, b):
+        """Free batch row ``b`` for reuse: zero its offset.  The K/V
+        data itself is left in place — a slot is semantically empty
+        when its offset is 0 (every attention path masks positions
+        ``>= offset``), and the next `insert_prefill` overwrites the
+        row anyway, so re-zeroing HBM here would be pure waste."""
+        return dataclasses.replace(
+            self, offset=self.offset.at[b].set(0))
+
+    def bytes_per_slot(self) -> int:
+        """HBM bytes one batch row pins across all layers — the unit
+        the serving scheduler's KV admission budget is counted in.
+        Covers K+V (and the per-token dequant scales when the cache is
+        int8-quantized)."""
+        total = 0
+        for k, v in zip(self.ks, self.vs):
+            per_row = k.shape[1] * k.shape[2] * k.shape[3]
+            total += per_row * (k.dtype.itemsize + v.dtype.itemsize)
+        if self.quantized:
+            for ks_, vs_ in zip(self.kss, self.vss):
+                per_row = ks_.shape[1] * ks_.shape[2]
+                total += per_row * (ks_.dtype.itemsize
+                                    + vs_.dtype.itemsize)
+        return total
+
     def set_offset(self, value):
         return dataclasses.replace(
             self, offset=jnp.broadcast_to(

@@ -1,0 +1,35 @@
+"""Continuous-batching serving runtime.
+
+Orca-style iteration-level scheduling over a slot-partitioned KV
+cache: new requests join the RUNNING decode batch via in-flight
+bucketed prefill + slot insert instead of waiting for the batch to
+drain.  See docs/serving.md for architecture, slot lifecycle, metric
+names and the bucketing/recompile tradeoff.
+"""
+
+from triton_distributed_tpu.serving.engine_batched import (  # noqa: F401
+    DEFAULT_PREFILL_BUCKETS,
+    make_insert_fn,
+    make_masked_step_fn,
+    make_rollout_fn,
+    make_step_fn,
+    masked_sample,
+    pad_prompt,
+    pick_bucket,
+    request_key,
+)
+from triton_distributed_tpu.serving.request import (  # noqa: F401
+    FinishReason,
+    RejectReason,
+    Request,
+    RequestState,
+)
+from triton_distributed_tpu.serving.scheduler import (  # noqa: F401
+    ContinuousBatchingScheduler,
+    SchedulerConfig,
+)
+from triton_distributed_tpu.serving.slots import SlotKV  # noqa: F401
+from triton_distributed_tpu.serving.toy import (  # noqa: F401
+    ToyConfig,
+    ToyModel,
+)

@@ -1,0 +1,267 @@
+"""Slot-batched decode: the jitted functions behind both the
+continuous-batching scheduler and `models.engine.Engine`.
+
+Three compiled programs cover the whole serving loop:
+
+- the **masked decode step** — ONE program for all slots, whatever mix
+  of requests occupies them.  Free/finished slots are masked: they
+  emit ``pad_id`` deterministically (never sample stale logits), their
+  cache offsets don't advance, and their RNG keys don't advance, so a
+  request's token stream is a function of its own (prompt, seed) and
+  not of whoever shares the batch;
+- the **bucketed prefill** — the model's ordinary prefill jitted per
+  length bucket (prompts are right-padded to a small fixed set of
+  lengths, bounding XLA recompiles to ``len(buckets)`` programs);
+- the **slot insert** — `dynamic_update_slice` of a freshly prefilled
+  single-row cache into a free slot of the donated decode cache, with
+  the slot's offset set to ``prompt_len - 1``.
+
+The insert sets offset to ``prompt_len - 1`` (not ``prompt_len``) and
+seeds the slot's input token with the *last prompt token*: the next
+masked step then recomputes position ``s-1``'s KV (bit-identical —
+same token, same rope position) and emits the request's first
+generated token.  This is what makes right-padded bucket prefill
+exact: the padded tail's logits and KV are never consumed (causal
+attention keeps positions ``< s`` untouched by the pad, offsets mask
+the tail), so no gather-at-true-length correction pass is needed.
+
+`Engine` builds its unmasked single-batch step/rollout from the same
+`make_step_fn`/`make_rollout_fn`, keeping one sampling/step
+composition for both the static-batch and continuous paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.models.kv_cache import KVCache
+from triton_distributed_tpu.models.utils import sample_token
+
+#: Default prefill length buckets: one compiled prefill program per
+#: entry actually used.  Powers of two keep padding waste < 2x.
+DEFAULT_PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+# ---------------------------------------------------------------------------
+# Shared step composition (Engine's static-batch path uses these too)
+# ---------------------------------------------------------------------------
+
+
+def make_step_fn(decode_fn, temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0):
+    """Unmasked decode+sample step: one batch-wide PRNG key
+    (`Engine`'s original semantics)."""
+
+    def step(params, tokens, cache, key):
+        logits, cache = decode_fn(params, tokens, cache)
+        key, sub = jax.random.split(key)
+        nxt = sample_token(logits, sub, temperature, top_k=top_k,
+                           top_p=top_p)
+        return nxt, cache, key
+
+    return step
+
+
+def make_rollout_fn(step_fn):
+    """`lax.scan` of ``step_fn`` over a static number of steps —
+    steady-state decode as one dispatch (the CUDA-graph analogue)."""
+
+    def rollout(params, first_tokens, cache, key, gen_len):
+        def body(carry, _):
+            tokens, cache, key = carry
+            nxt, cache, key = step_fn(params, tokens, cache, key)
+            return (nxt, cache, key), nxt
+
+        (_, cache, _), toks = jax.lax.scan(
+            body, (first_tokens, cache, key), length=gen_len)
+        return toks.T, cache          # (B, gen_len)
+
+    return rollout
+
+
+# ---------------------------------------------------------------------------
+# Masked (slot-batched) step
+# ---------------------------------------------------------------------------
+
+
+def masked_sample(logits, keys, active, pad_id: int,
+                  temperature: float = 0.0, top_k: int = 0,
+                  top_p: float = 1.0):
+    """Per-slot sampling under an activity mask.
+
+    logits: (B, V); keys: (B, 2) uint32 legacy PRNG keys; active: (B,)
+    bool.  Active rows sample with their OWN key (vmapped
+    `sample_token`, so temperature/top-k/top-p semantics match the
+    single-request engine exactly); masked rows return ``pad_id``
+    deterministically — stale logits of a free slot must never reach
+    the sampler.
+    """
+    def row(lg, k):
+        return sample_token(lg[None, :], k, temperature, top_k=top_k,
+                            top_p=top_p)[0]
+
+    sampled = jax.vmap(row)(logits, keys)
+    return jnp.where(active, sampled,
+                     jnp.int32(pad_id)).astype(jnp.int32)
+
+
+def _masked_body(decode_fn, temperature, top_k, top_p, pad_id):
+    """One masked decode+sample step (unjitted): the shared core of
+    the single-step and scanned-block variants."""
+
+    def body(params, tokens, cache, keys, active):
+        prev_offset = cache.offset
+        logits, cache = decode_fn(params, tokens, cache)
+        new_keys, subs = _split_rows(keys)
+        nxt = masked_sample(logits, subs, active, pad_id, temperature,
+                            top_k=top_k, top_p=top_p)
+        cache = dataclasses.replace(
+            cache, offset=jnp.where(active, cache.offset, prev_offset))
+        keys = jnp.where(active[:, None], new_keys, keys)
+        return nxt, cache, keys
+
+    return body
+
+
+def make_masked_step_fn(decode_fn, temperature: float = 0.0,
+                        top_k: int = 0, top_p: float = 1.0,
+                        pad_id: int = 0, donate: bool = True):
+    """One jitted decode step over all B slots.
+
+    ``(params, tokens (B,), cache, keys (B,2), active (B,) bool) ->
+    (next_tokens (B,), cache, keys)``
+
+    Masked rows: emit ``pad_id``, keep their cache offset (the model's
+    decode advances every row; the step restores masked rows'), and
+    keep their PRNG key — so a slot's stream depends only on its own
+    request.  The cache and keys are donated: XLA updates them in
+    place, and the caller must rebind to the returned ones.
+    """
+    step = _masked_body(decode_fn, temperature, top_k, top_p, pad_id)
+    if donate:
+        return jax.jit(step, donate_argnums=(2, 3))
+    return jax.jit(step)
+
+
+def make_masked_block_fn(decode_fn, temperature: float = 0.0,
+                         top_k: int = 0, top_p: float = 1.0,
+                         pad_id: int = 0, block: int = 8,
+                         donate: bool = True):
+    """``block`` scanned masked steps per dispatch — multi-step
+    scheduling: amortizes per-step host/dispatch overhead when the
+    model step is cheap relative to it (small models, CPU).
+
+    ``(params, tokens, cache, keys, active) ->
+    (tokens (B, block), cache, keys)``
+
+    The activity mask is FIXED for the block: rows that hit EOS
+    mid-block keep decoding and their post-EOS tokens are discarded by
+    the scheduler (bounded over-generation, <= block-1 steps — exactly
+    the waste the serial engine pays for its WHOLE ``gen_len``).  The
+    caller must ensure every active row has >= ``block`` KV positions
+    of headroom (the scheduler falls back to single steps near the
+    horizon).  A row's pre-EOS tokens and key chain are identical to
+    the single-step path's.
+    """
+    body = _masked_body(decode_fn, temperature, top_k, top_p, pad_id)
+
+    def blockstep(params, tokens, cache, keys, active):
+        def scan_body(carry, _):
+            tokens, cache, keys = carry
+            nxt, cache, keys = body(params, tokens, cache, keys, active)
+            return (nxt, cache, keys), nxt
+
+        (_, cache, keys), toks = jax.lax.scan(
+            scan_body, (tokens, cache, keys), length=block)
+        return toks.T, cache, keys
+
+    if donate:
+        return jax.jit(blockstep, donate_argnums=(2, 3))
+    return jax.jit(blockstep)
+
+
+def _split_rows(keys):
+    """Split each row's legacy (2,) uint32 key -> (carry, subkey)."""
+
+    def one(k):
+        ks = jax.random.split(k)
+        return ks[0], ks[1]
+
+    return jax.vmap(one)(keys)
+
+
+def request_key(seed: int):
+    """The slot key a request starts from: pure function of its seed."""
+    return jax.random.PRNGKey(seed)
+
+
+# ---------------------------------------------------------------------------
+# Slot insert
+# ---------------------------------------------------------------------------
+
+
+def make_insert_fn(donate: bool = True):
+    """``(big_cache, keys, row_cache, key, slot, offset) ->
+    (big_cache, keys)`` — write a freshly prefilled single-row cache
+    (batch 1, max_seq = its length bucket) into slot ``slot`` of the
+    decode cache, set that slot's offset, and set its PRNG key — one
+    dispatch per admission.  One compiled program per (bucket,
+    cache-geometry); ``slot``/``offset`` are traced scalars, so slot
+    choice never recompiles.  The big cache and keys are donated."""
+
+    def insert(big: KVCache, keys, row: KVCache, key, slot, offset):
+        slot = jnp.asarray(slot, jnp.int32)
+        ks = [jax.lax.dynamic_update_slice(
+                  bk, rk.astype(bk.dtype), (slot, 0, 0, 0))
+              for bk, rk in zip(big.ks, row.ks)]
+        vs = [jax.lax.dynamic_update_slice(
+                  bv, rv.astype(bv.dtype), (slot, 0, 0, 0))
+              for bv, rv in zip(big.vs, row.vs)]
+        off = jax.lax.dynamic_update_slice(
+            big.offset, jnp.reshape(jnp.asarray(offset, jnp.int32), (1,)),
+            (slot,))
+        rep = dict(ks=ks, vs=vs, offset=off)
+        if big.quantized:
+            rep["kss"] = [jax.lax.dynamic_update_slice(
+                              bs, rs, (slot, 0, 0))
+                          for bs, rs in zip(big.kss, row.kss)]
+            rep["vss"] = [jax.lax.dynamic_update_slice(
+                              bs, rs, (slot, 0, 0))
+                          for bs, rs in zip(big.vss, row.vss)]
+        keys = jax.lax.dynamic_update_slice(
+            keys, key.astype(keys.dtype)[None, :], (slot, 0))
+        return dataclasses.replace(big, **rep), keys
+
+    if donate:
+        return jax.jit(insert, donate_argnums=(0, 1))
+    return jax.jit(insert)
+
+
+# ---------------------------------------------------------------------------
+# Prefill bucketing
+# ---------------------------------------------------------------------------
+
+
+def pick_bucket(length: int,
+                buckets: Sequence[int]) -> Optional[int]:
+    """Smallest bucket >= length, or None when the prompt exceeds all
+    buckets (reject upstream)."""
+    for b in sorted(buckets):
+        if length <= b:
+            return int(b)
+    return None
+
+
+def pad_prompt(prompt: Sequence[int], bucket: int,
+               pad_id: int = 0) -> Tuple[jnp.ndarray, int]:
+    """Right-pad to the bucket length.  Returns ((1, bucket) int32 ids,
+    true length).  Right padding is exact here — see the module
+    docstring for why the padded tail is never consumed."""
+    s = len(prompt)
+    assert 0 < s <= bucket, (s, bucket)
+    ids = list(prompt) + [pad_id] * (bucket - s)
+    return jnp.asarray(ids, jnp.int32)[None, :], s

@@ -1,0 +1,389 @@
+"""Continuous-batching scheduler: Orca-style iteration-level loop.
+
+Every `step()` is one scheduler iteration:
+
+1. **admit** — while the FIFO head has arrived, a slot is free and the
+   KV budget allows, run a bucketed single-row prefill and
+   `SlotKV.insert_prefill` it into the running decode batch (requests
+   join mid-flight; nobody waits for the batch to drain);
+2. **decode** — ONE jitted masked step for all slots
+   (`engine_batched.make_masked_step_fn`); free/finished slots emit
+   the pad id and don't advance offsets or RNG keys;
+3. **retire** — the step's tokens are synced to host (the one
+   unavoidable sync: EOS is data-dependent), appended, streamed via
+   ``on_token``, and rows that hit EOS / ``max_new_tokens`` / the KV
+   horizon release their slot for the next joiner.
+
+Backpressure is at `submit`: a bounded queue and static feasibility
+checks reject with a typed reason instead of queueing unservable work.
+
+Time comes from an injectable ``clock`` (+ optional ``clock_advance``
+for virtual time), so tests and `benchmark/bench_serving.py` replay
+deterministic arrival schedules.  Request-level observability rides
+the PR-1/2 stack: TTFT / TBT / queue-wait histograms, queue-depth /
+slot-occupancy / KV-budget gauges (all in the Prometheus export), and
+one `serving.request` span per request feeding the cross-rank
+timeline.  Metric names: docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Callable, Deque, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.serving.engine_batched import (
+    DEFAULT_PREFILL_BUCKETS,
+    make_masked_block_fn,
+    make_masked_step_fn,
+    pad_prompt,
+    pick_bucket,
+    request_key,
+)
+from triton_distributed_tpu.serving.request import (
+    FinishReason,
+    RejectReason,
+    Request,
+    RequestState,
+)
+from triton_distributed_tpu.serving.slots import SlotKV
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    num_slots: int = 8
+    #: Bounded submit queue — `submit` rejects (QUEUE_FULL) beyond it.
+    max_queue: int = 64
+    #: Prefill length buckets (entries > max_seq are dropped); one
+    #: compiled prefill per bucket actually used.
+    prefill_buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS
+    #: Decode-cache sequence capacity; None = model config's
+    #: max_seq_len.
+    max_seq: Optional[int] = None
+    #: Cap on KV bytes live slots may pin (None = all slots).
+    kv_budget_bytes: Optional[int] = None
+    pad_id: int = 0
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    #: Decode steps per host sync (multi-step scheduling).  1 = check
+    #: EOS after every token (lowest latency).  K>1 scans K masked
+    #: steps in one dispatch and retires at block granularity —
+    #: over-generating <= K-1 discarded tokens past EOS — which
+    #: amortizes host/dispatch overhead when the model step is cheap
+    #: relative to it (small models, CPU).  Pre-EOS tokens are
+    #: identical either way.
+    steps_per_sync: int = 1
+
+
+class ContinuousBatchingScheduler:
+    """model: anything with the engine contract (`create_cache`,
+    `make_prefill_fn`, `make_decode_fn`) — `models.qwen.Qwen3` or
+    `serving.toy.ToyModel`."""
+
+    def __init__(self, model, params,
+                 config: Optional[SchedulerConfig] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 clock_advance: Optional[Callable[[float], None]] = None):
+        self.model = model
+        self.params = params
+        self.config = cfg = config or SchedulerConfig()
+        self.clock = clock or time.monotonic
+        #: With a virtual clock, how the idle loop moves time forward
+        #: to the next arrival; with the default wall clock we sleep.
+        self._clock_advance = clock_advance
+        max_seq = cfg.max_seq or model.config.max_seq_len
+        self.max_seq = int(max_seq)
+        self.buckets = tuple(sorted(
+            b for b in cfg.prefill_buckets if b <= self.max_seq))
+        if not self.buckets:
+            raise ValueError(
+                f"no prefill bucket fits max_seq={self.max_seq}")
+        self.slots = SlotKV(model.create_cache(cfg.num_slots,
+                                               max_seq=self.max_seq),
+                            cfg.kv_budget_bytes)
+        self._prefill = jax.jit(model.make_prefill_fn())
+        decode_fn = model.make_decode_fn()
+        self._step = make_masked_step_fn(
+            decode_fn, cfg.temperature, cfg.top_k, cfg.top_p,
+            cfg.pad_id)
+        assert cfg.steps_per_sync >= 1, cfg.steps_per_sync
+        self._block_fn = (make_masked_block_fn(
+            decode_fn, cfg.temperature, cfg.top_k, cfg.top_p,
+            cfg.pad_id, block=cfg.steps_per_sync)
+            if cfg.steps_per_sync > 1 else None)
+        self._tokens = np.full(cfg.num_slots, cfg.pad_id, np.int32)
+        #: Per-bucket reusable prefill input caches (see _admit).
+        self._row_caches: Dict[int, object] = {}
+        self._queue: Deque[Request] = collections.deque()
+        self._by_slot: Dict[int, Request] = {}
+        self._spans: Dict[int, object] = {}
+        self._stopped = False
+        self.finished: List[Request] = []
+        self._update_gauges()
+
+    # -- submission / backpressure --------------------------------------
+
+    def submit(self, req: Request) -> bool:
+        """Enqueue; False = rejected with ``req.reject_reason`` set."""
+        now = self.clock()
+        req.t_arrival = (req.arrival_time if req.arrival_time is not None
+                         else now)
+        reason = None
+        if self._stopped:
+            reason = RejectReason.STOPPED
+        elif len(self._queue) >= self.config.max_queue:
+            reason = RejectReason.QUEUE_FULL
+        elif pick_bucket(req.prompt_len, self.buckets) is None:
+            reason = RejectReason.PROMPT_TOO_LONG
+        elif req.prompt_len + req.max_new_tokens > self.max_seq + 1:
+            # offset after the last generated token may reach max_seq:
+            # position max_seq-1 is the last writable KV row, and the
+            # final token needs no KV write of its own.
+            reason = RejectReason.EXCEEDS_KV_CAPACITY
+        elif self.slots.kv_budget_bytes < self.slots.bytes_per_slot:
+            # a budget below one slot can never admit anything —
+            # queueing it would make drain() spin forever.
+            reason = RejectReason.EXCEEDS_KV_CAPACITY
+        reg = self._registry()
+        if reason is not None:
+            req.state = RequestState.REJECTED
+            req.reject_reason = reason
+            if reg:
+                reg.counter("serving_requests_rejected_total",
+                            reason=reason.value).inc()
+            return False
+        self._queue.append(req)
+        if reg:
+            reg.counter("serving_requests_submitted_total").inc()
+            reg.gauge("serving_queue_depth").set(len(self._queue))
+        return True
+
+    # -- the iteration loop ---------------------------------------------
+
+    def has_work(self) -> bool:
+        return bool(self._queue) or bool(self._by_slot)
+
+    def step(self) -> dict:
+        """One scheduler iteration.  Returns counts for introspection:
+        ``{"admitted", "active", "retired"}``."""
+        now = self.clock()
+        admitted = self._admit(now)
+        retired = 0
+        active_n = len(self._by_slot)
+        if self._by_slot:
+            retired = self._decode_step()
+        elif self._queue:
+            # Nothing running, head not arrived yet: move time.
+            dt = self._queue[0].t_arrival - now
+            if dt > 0:
+                if self._clock_advance is not None:
+                    self._clock_advance(dt)
+                else:
+                    time.sleep(min(dt, 0.001))
+        if admitted or retired:
+            self._update_gauges()
+        return {"admitted": admitted, "active": active_n,
+                "retired": retired}
+
+    def drain(self) -> List[Request]:
+        """Run until queue and slots are empty; returns the finished
+        requests in completion order."""
+        while self.has_work():
+            self.step()
+        return self.finished
+
+    def run(self, requests: Sequence[Request]) -> List[Request]:
+        """Submit everything (arrivals still gate admission), then
+        drain."""
+        for r in requests:
+            self.submit(r)
+        return self.drain()
+
+    def stop(self) -> None:
+        """Abort: live requests finish with reason STOPPED, queued ones
+        are rejected, later submits are rejected."""
+        self._stopped = True
+        for slot in list(self._by_slot):
+            self._retire(slot, self.clock(), FinishReason.STOPPED)
+        reg = self._registry()
+        while self._queue:
+            req = self._queue.popleft()
+            req.state = RequestState.REJECTED
+            req.reject_reason = RejectReason.STOPPED
+            # Same accounting as the submit() reject path, so
+            # submitted == completed + rejected + in-flight holds
+            # across a shutdown.
+            if reg:
+                reg.counter("serving_requests_rejected_total",
+                            reason=RejectReason.STOPPED.value).inc()
+        self._update_gauges()
+
+    # -- internals ------------------------------------------------------
+
+    def _registry(self):
+        from triton_distributed_tpu.observability import (
+            get_registry, observability_enabled)
+        return get_registry() if observability_enabled() else None
+
+    def _admit(self, now: float) -> int:
+        from triton_distributed_tpu.observability import get_tracer
+        n = 0
+        while (self._queue and not self._stopped
+               and self._queue[0].t_arrival <= now
+               and self.slots.can_admit()):
+            req = self._queue.popleft()
+            bucket = pick_bucket(req.prompt_len, self.buckets)
+            assert bucket is not None  # submit() validated
+            ids, s = pad_prompt(req.prompt, bucket, self.config.pad_id)
+            # One reusable input row cache per bucket: prefill is
+            # functional (input untouched, output fully overwritten up
+            # to the bucket), so admissions don't re-zero HBM — the
+            # same point as Engine.serve's caller-provided cache.
+            row_in = self._row_caches.get(bucket)
+            if row_in is None:
+                row_in = self.model.create_cache(1, max_seq=bucket)
+                self._row_caches[bucket] = row_in
+            reg = self._registry()
+            t0 = time.perf_counter()
+            _, row_cache = self._prefill(self.params, ids, row_in)
+            if reg:
+                # dispatch is async: block so the histogram records
+                # prefill compute, not dispatch (as Engine.serve does)
+                jax.block_until_ready(row_cache.ks[0])
+                reg.histogram("serving_prefill_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
+            slot = self.slots.insert_prefill(row_cache, s,
+                                             request_key(req.seed))
+            self._tokens[slot] = req.prompt[-1]
+            req.state = RequestState.RUNNING
+            req.slot = slot
+            req.bucket = bucket
+            req.t_admitted = now
+            self._by_slot[slot] = req
+            sp = get_tracer().span(
+                "serving.request", request_id=req.request_id,
+                prompt_len=req.prompt_len, slot=slot, bucket=bucket)
+            sp.__enter__()
+            self._spans[slot] = sp
+            if reg:
+                reg.counter("serving_prefills_total",
+                            bucket=str(bucket)).inc()
+                reg.histogram("serving_queue_wait_ms").observe(
+                    max(now - req.t_arrival, 0.0) * 1e3)
+            n += 1
+        return n
+
+    def _block_size(self) -> int:
+        """Steps for this dispatch: the configured block, unless some
+        active row is within a block of its KV horizon (its offset may
+        not cross max_seq) — then single steps until it retires."""
+        k = self.config.steps_per_sync
+        if self._block_fn is None:
+            return 1
+        for req in self._by_slot.values():
+            # current offset = prompt_len - 1 + generated; K steps
+            # write offsets up to offset + K - 1 <= max_seq - 1.
+            if (self.max_seq - req.prompt_len - len(req.generated)
+                    + 1) < k:
+                return 1
+        return k
+
+    def _decode_step(self) -> int:
+        t0 = time.perf_counter()
+        k = self._block_size()
+        fn = self._block_fn if k > 1 else self._step
+        toks, cache, keys = fn(
+            self.params, jnp.asarray(self._tokens), self.slots.cache,
+            self.slots.keys, self.slots.active_mask())
+        self.slots.cache = cache
+        self.slots.keys = keys
+        toks_host = np.asarray(toks)      # THE host sync (EOS check)
+        if k == 1:
+            toks_host = toks_host[:, None]
+        now = self.clock()
+        reg = self._registry()
+        if reg:
+            reg.histogram("serving_decode_step_ms").observe(
+                (time.perf_counter() - t0) * 1e3 / k)
+        retired = 0
+        generated = 0
+        rows = list(self._by_slot.items())
+        for slot, req in rows:
+            for j in range(k):
+                token = int(toks_host[slot, j])
+                req.generated.append(token)
+                generated += 1
+                if req.t_first_token is None:
+                    req.t_first_token = now
+                    if reg:
+                        reg.histogram("serving_ttft_ms").observe(
+                            max(req.ttft, 0.0) * 1e3)
+                elif reg:
+                    # With k>1 the whole block lands at one sync: TBT
+                    # is reported at sync granularity (the first
+                    # block token carries the gap, the rest ~0).
+                    reg.histogram("serving_tbt_ms").observe(
+                        max(now - req.t_last_token, 0.0) * 1e3)
+                req.t_last_token = now
+                if req.on_token is not None:
+                    req.on_token(req, token)
+                reason = None
+                if token in req.eos_token_ids:
+                    reason = FinishReason.EOS
+                elif len(req.generated) >= req.max_new_tokens:
+                    reason = FinishReason.LENGTH
+                elif (req.prompt_len + len(req.generated)
+                      > self.max_seq):
+                    # The NEXT step would write KV at offset
+                    # prompt+generated-1 > max_seq-1; the admission
+                    # rule mirrors this (the final token needs no KV
+                    # write of its own).
+                    reason = FinishReason.KV_CAPACITY
+                if reason is not None:
+                    # Tokens the block decoded past this point are
+                    # discarded — bounded over-generation.
+                    self._retire(slot, now, reason)
+                    retired += 1
+                    break
+            else:
+                self._tokens[slot] = int(toks_host[slot, k - 1])
+        if reg:
+            reg.counter("serving_tokens_generated_total").inc(generated)
+        return retired
+
+    def _retire(self, slot: int, now: float,
+                reason: FinishReason) -> None:
+        req = self._by_slot.pop(slot)
+        req.state = RequestState.FINISHED
+        req.finish_reason = reason
+        req.t_finish = now
+        self.slots.release(slot)
+        self._tokens[slot] = self.config.pad_id
+        sp = self._spans.pop(slot, None)
+        if sp is not None:
+            sp.__exit__(None, None, None)
+        reg = self._registry()
+        if reg:
+            reg.counter("serving_requests_completed_total",
+                        reason=reason.value).inc()
+            if req.latency is not None:
+                reg.histogram("serving_request_latency_ms").observe(
+                    req.latency * 1e3)
+        self.finished.append(req)
+
+    def _update_gauges(self) -> None:
+        reg = self._registry()
+        if not reg:
+            return
+        reg.gauge("serving_queue_depth").set(len(self._queue))
+        reg.gauge("serving_active_slots").set(self.slots.active_slots)
+        reg.gauge("serving_slot_occupancy").set(self.slots.occupancy)
+        reg.gauge("serving_kv_bytes_in_use").set(self.slots.bytes_in_use)
+        reg.gauge("serving_kv_budget_bytes").set(
+            self.slots.kv_budget_bytes)

@@ -1,0 +1,106 @@
+"""Slot-batched KV view: B fixed slots over one donated `KVCache`.
+
+The decode cache is allocated ONCE at batch = ``num_slots`` and then
+only ever updated functionally inside donated jitted programs (the
+masked step, the slot insert) — XLA reuses the buffers in place, so
+admitting a request never re-zeroes HBM and never changes the decode
+program's shapes.  This is the XLA-functional adaptation of a paged /
+slot-partitioned KV pool: the cache already carries a per-row offset
+vector, so a "slot" is just a batch row plus host-side bookkeeping of
+which rows are live.
+
+`SlotKV` owns the per-slot device state (the cache and the per-slot
+PRNG keys — the key write rides the insert program, one dispatch per
+admission) and the host-side free list / KV admission budget
+(`KVCache.bytes_per_slot`).  The scheduler (`serving.scheduler`)
+holds request state; this class never sees requests.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.models.kv_cache import KVCache
+from triton_distributed_tpu.serving.engine_batched import make_insert_fn
+
+
+class SlotKV:
+    def __init__(self, cache: KVCache,
+                 kv_budget_bytes: Optional[int] = None):
+        self.cache = cache
+        self.num_slots = int(cache.offset.shape[0])
+        self.max_seq = int(cache.ks[0].shape[2])
+        self.bytes_per_slot = cache.bytes_per_slot()
+        #: Admission budget: total KV bytes live slots may pin.  The
+        #: cache is preallocated, so this caps *concurrency* (e.g. run
+        #: 4 of 8 slots when sharing HBM with another engine), not
+        #: allocation.  None/0 = all slots usable.
+        self.kv_budget_bytes = (kv_budget_bytes
+                                or self.num_slots * self.bytes_per_slot)
+        #: Per-slot legacy PRNG keys, advanced by the masked step for
+        #: active rows only; the insert overwrites a reused slot's key.
+        self.keys = jnp.zeros((self.num_slots, 2), jnp.uint32)
+        self._free: List[int] = list(range(self.num_slots))
+        #: Host mirror of slot liveness, maintained incrementally —
+        #: the per-step mask transfer is one tiny host->device copy,
+        #: not a rebuild.
+        self._active = np.zeros(self.num_slots, bool)
+        self._insert = make_insert_fn()
+
+    # -- occupancy ------------------------------------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_slots(self) -> int:
+        return self.num_slots - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.active_slots / self.num_slots
+
+    @property
+    def bytes_in_use(self) -> int:
+        return self.active_slots * self.bytes_per_slot
+
+    def can_admit(self) -> bool:
+        return bool(self._free) and (
+            self.bytes_in_use + self.bytes_per_slot
+            <= self.kv_budget_bytes)
+
+    def active_mask(self) -> jnp.ndarray:
+        """(num_slots,) bool — True where a request is live."""
+        return jnp.asarray(self._active)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def insert_prefill(self, row_cache: KVCache, prompt_len: int,
+                       key: jnp.ndarray) -> int:
+        """Claim a free slot and write a single-row prefilled cache
+        into it, offset set to ``prompt_len - 1`` (the masked step
+        recomputes position s-1 and emits the first token — see
+        `engine_batched`) and the slot's PRNG key set to ``key``.
+        Returns the slot index."""
+        assert self.can_admit(), "insert_prefill without can_admit()"
+        assert int(row_cache.offset.shape[0]) == 1, row_cache.offset.shape
+        assert row_cache.ks[0].shape[2] <= self.max_seq
+        slot = self._free.pop(0)
+        self.cache, self.keys = self._insert(
+            self.cache, self.keys, row_cache, key,
+            jnp.int32(slot), jnp.int32(prompt_len - 1))
+        self._active[slot] = True
+        return slot
+
+    def release(self, slot: int) -> None:
+        """Retire a slot: offset zeroed (`KVCache.reset_slot` — the
+        data stays, every attention path masks ``>= offset``) and the
+        slot returns to the free list."""
+        assert 0 <= slot < self.num_slots and slot not in self._free
+        self.cache = self.cache.reset_slot(slot)
+        self._active[slot] = False
+        self._free.append(slot)

@@ -1,0 +1,102 @@
+"""Toy single-layer attention LM implementing the engine contract.
+
+Same interface as `models.qwen.Qwen3` (`create_cache` /
+`make_prefill_fn` / `make_decode_fn`, prefill sets the offset, decode
+writes KV at per-row offsets and attends positions ``< offset+1``) but
+pure jnp — no shard_map, no mesh — so the serving scheduler, its
+tier-1 tests and the CPU benchmark exercise the REAL continuous-
+batching machinery (bucketed prefill, slot insert, masked step) on any
+host.  Position embeddings make the logits depend on absolute
+position, so a wrong slot offset or a consumed pad tail shows up as
+wrong tokens, not silence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.models.kv_cache import KVCache
+
+
+@dataclasses.dataclass
+class ToyConfig:
+    vocab_size: int = 97
+    hidden: int = 32
+    max_seq_len: int = 128
+    quantize_kv_cache: bool = False
+
+
+class ToyModel:
+    def __init__(self, config: Optional[ToyConfig] = None):
+        self.config = config or ToyConfig()
+
+    def init_params(self, key):
+        cfg = self.config
+        ks = jax.random.split(key, 6)
+        h, v = cfg.hidden, cfg.vocab_size
+        n = lambda k, shape: (jax.random.normal(k, shape)  # noqa: E731
+                              * h ** -0.5).astype(jnp.float32)
+        return {
+            "embed": n(ks[0], (v, h)),
+            "pe": n(ks[1], (cfg.max_seq_len, h)),
+            "wq": n(ks[2], (h, h)),
+            "wk": n(ks[3], (h, h)),
+            "wv": n(ks[4], (h, h)),
+            "wo": n(ks[5], (h, v)),
+        }
+
+    def create_cache(self, batch: int, max_seq: Optional[int] = None):
+        cfg = self.config
+        return KVCache.create(
+            num_layers=1, batch=batch, num_kv_heads=1,
+            max_seq=max_seq or cfg.max_seq_len, head_dim=cfg.hidden,
+            dtype=jnp.float32, quantized=cfg.quantize_kv_cache)
+
+    def make_prefill_fn(self):
+        scale = self.config.hidden ** -0.5
+
+        def prefill(params, ids, cache: KVCache):
+            b, s = ids.shape
+            x = params["embed"][ids] + params["pe"][:s][None]
+            q = x @ params["wq"]
+            k = x @ params["wk"]
+            v = x @ params["wv"]
+            scores = jnp.einsum("bqh,bkh->bqk", q, k) * scale
+            causal = jnp.tril(jnp.ones((s, s), bool))
+            att = jax.nn.softmax(
+                jnp.where(causal[None], scores, -jnp.inf), axis=-1)
+            out = jnp.einsum("bqk,bkh->bqh", att, v)
+            logits = out[:, -1] @ params["wo"]
+            cache = cache.write_prefill(0, k[:, None], v[:, None])
+            return logits, cache.set_offset(s)
+
+        return prefill
+
+    def make_decode_fn(self):
+        scale = self.config.hidden ** -0.5
+
+        def decode(params, tokens, cache: KVCache):
+            offset = cache.offset                       # (B,)
+            x = params["embed"][tokens] + params["pe"][offset]
+            q = x @ params["wq"]
+            k = x @ params["wk"]
+            v = x @ params["wv"]
+            upd = lambda c, u, o: jax.lax.dynamic_update_slice(  # noqa: E731
+                c, u, (0, o, 0))
+            ks = jax.vmap(upd)(cache.ks[0], k[:, None, None, :], offset)
+            vs = jax.vmap(upd)(cache.vs[0], v[:, None, None, :], offset)
+            smax = ks.shape[2]
+            mask = jnp.arange(smax)[None, :] <= offset[:, None]
+            scores = jnp.einsum("bh,bsh->bs", q, ks[:, 0]) * scale
+            att = jax.nn.softmax(
+                jnp.where(mask, scores, -jnp.inf), axis=-1)
+            out = jnp.einsum("bs,bsh->bh", att, vs[:, 0])
+            logits = out @ params["wo"]
+            cache = cache.set_layer(0, ks, vs)
+            return logits, cache.inc_offset(1)
+
+        return decode
