@@ -12,7 +12,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # repo root
 
 import argparse
-import functools
 import json
 
 import jax

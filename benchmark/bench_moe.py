@@ -26,7 +26,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # repo root
 
 import argparse
-import functools
 import json
 import statistics
 
@@ -39,7 +38,6 @@ from triton_distributed_tpu.kernels import moe_utils
 from triton_distributed_tpu.kernels.allgather_group_gemm import (
     AGGroupGEMMContext,
     ag_group_gemm,
-    gated_silu,
 )
 from triton_distributed_tpu.kernels.grouped_gemm import (
     grouped_matmul,

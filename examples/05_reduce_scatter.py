@@ -11,7 +11,6 @@ Reference: `tutorials/05-intra-node-reduce-scatter.py`
   flow-control problem the reference solves with barrier arrays.
 """
 
-import functools
 import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Dependency-free lint gate: the fallback for containers without ruff.
+
+Enforces the core of the ruff.toml rule set with only the stdlib:
+
+- E9:   files must parse (`compile()`; a broken file must never merge);
+- F401: unused imports (respects `# noqa` / `# noqa: F401` on the
+        import line; `__init__.py` re-export facades are exempt, and
+        `__graft_entry__.py`-style underscore names are kept);
+- F811: an import name rebound by a later import in the same scope.
+
+Usage:  python scripts/lint.py [paths...]     (default: repo tree)
+Exit 0 = clean, 1 = findings.  `scripts/verify_tier1.sh` prefers
+`ruff check .` and falls back to this script, so the gate runs
+everywhere with the same core semantics.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+EXCLUDE_PARTS = {"__pycache__", ".git", "csrc", "results"}
+NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?", re.I)
+
+
+def _noqa(lines, lineno: int, code: str) -> bool:
+    try:
+        m = NOQA_RE.search(lines[lineno - 1])
+    except IndexError:
+        return False
+    if not m:
+        return False
+    codes = m.group("codes")
+    if codes is None:
+        return True        # bare noqa silences everything
+    return code in {c.strip() for c in codes.split(",")}
+
+
+class _Imports:
+    """Module-TOP-LEVEL import bindings plus all name usage anywhere.
+
+    Function-local imports are deliberately out of scope: the
+    codebase's lazy-import idiom re-imports the same name in many
+    functions, which a scope-blind checker would misread as F811.
+    Imports under top-level `if`/`try` are conditional by design and
+    exempt too.  Ruff (when installed) checks the full scoped rules.
+    """
+
+    def __init__(self, tree: ast.Module):
+        self.imports = {}     # name -> lineno of the binding
+        self.rebound = []     # (name, first_lineno, again_lineno)
+        self.used = set()
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self._bind(alias.asname or alias.name.split(".")[0],
+                               node.lineno)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for alias in node.names:
+                    if alias.name != "*":
+                        self._bind(alias.asname or alias.name,
+                                   node.lineno)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                         ast.Load):
+                self.used.add(node.id)
+
+    def _bind(self, name: str, lineno: int):
+        if name in self.imports:
+            self.rebound.append((name, self.imports[name], lineno))
+        self.imports[name] = lineno
+
+
+def lint_file(path: pathlib.Path) -> list[str]:
+    src = path.read_text()
+    problems = []
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: E999 {e.msg}"]
+
+    lines = src.splitlines()
+    v = _Imports(tree)
+
+    # Names listed in __all__ count as used (and ONLY those strings —
+    # treating every string constant as a usage would silently miss
+    # unused imports that ruff flags, diverging the two gates).
+    for node in tree.body:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target] if isinstance(node, ast.AugAssign)
+                   else [])
+        if any(isinstance(t, ast.Name) and t.id == "__all__"
+               for t in targets):
+            for c in ast.walk(node):
+                if isinstance(c, ast.Constant) and isinstance(c.value,
+                                                              str):
+                    v.used.add(c.value)
+
+    # F401 exemption for re-export facades mirrors ruff.toml's
+    # per-file-ignores exactly: __init__.py skips F401 only — F811
+    # still applies there.
+    if path.name != "__init__.py":
+        for name, lineno in sorted(v.imports.items(),
+                                   key=lambda p: p[1]):
+            if name.startswith("_"):
+                continue
+            if name in v.used:
+                continue
+            if _noqa(lines, lineno, "F401"):
+                continue
+            problems.append(
+                f"{path}:{lineno}: F401 `{name}` imported but unused")
+
+    for name, first, again in v.rebound:
+        if _noqa(lines, again, "F811"):
+            continue
+        problems.append(
+            f"{path}:{again}: F811 import `{name}` shadows the import "
+            f"on line {first}")
+    return problems
+
+
+def main(argv) -> int:
+    roots = [pathlib.Path(p) for p in argv] or [
+        pathlib.Path("triton_distributed_tpu"),
+        pathlib.Path("tests"),
+        pathlib.Path("scripts"),
+        pathlib.Path("benchmark"),
+        pathlib.Path("examples"),
+        pathlib.Path("tests_tpu"),
+        pathlib.Path("bench.py"),
+    ]
+    files = []
+    for root in roots:
+        if root.is_file() and root.suffix == ".py":
+            files.append(root)
+        elif root.is_dir():
+            files.extend(
+                p for p in sorted(root.rglob("*.py"))
+                if not EXCLUDE_PARTS & set(p.parts))
+    problems = []
+    for f in files:
+        problems.extend(lint_file(f))
+    for p in problems:
+        print(p)
+    print(f"lint: {len(files)} files, {len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

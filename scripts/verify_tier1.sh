@@ -8,10 +8,43 @@
 #  - pytest collection ERRORS fail the gate even when every collected
 #    test passed (a broken import silently shrinking the suite must
 #    not read as green);
+#  - a lint gate (`ruff check .` when installed, scripts/lint.py as
+#    the dependency-free fallback — see ruff.toml);
+#  - a static comm-sanitizer sweep over every registered kernel
+#    (`python -m triton_distributed_tpu.analysis`), which must report
+#    ZERO findings — a leaked semaphore or unmatched wait in a shipped
+#    collective fails tier-1 before any TPU sees it;
 #  - a trace-export smoke run (span -> Chrome trace -> timeline merge
 #    -> Prometheus render) guards the observability runtime on CPU.
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+# Lint gate: prefer ruff (full scoped rules), fall back to the
+# stdlib-only checker so the gate runs in every container.
+if command -v ruff >/dev/null 2>&1; then
+    if ! ruff check .; then
+        echo "LINT=FAILED (ruff)"
+        exit 1
+    fi
+else
+    if ! python scripts/lint.py; then
+        echo "LINT=FAILED (scripts/lint.py)"
+        exit 1
+    fi
+fi
+echo "LINT=ok"
+
+# Static comm-graph sanitizer sweep: every registered kernel on its
+# representative meshes must analyze clean (docs/analysis.md).
+# Bounded like the pytest stage: replays run kernel loops as plain
+# Python, so a runaway loop bound must fail the gate, not hang CI
+# (normal sweep is ~5 s; 120 s is generous headroom).
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+        python -m triton_distributed_tpu.analysis -q; then
+    echo "ANALYSIS_SWEEP=FAILED"
+    exit 1
+fi
+echo "ANALYSIS_SWEEP=ok"
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 rm -f "$LOG"

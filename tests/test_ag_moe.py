@@ -5,7 +5,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import pytest
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu.kernels import moe_utils

@@ -1,7 +1,6 @@
 """Low-latency AllToAll + MoE routing tests (reference:
 `test/nvidia/test_all_to_all.py`, `test_moe_utils.py`)."""
 
-import functools
 
 import jax
 import jax.numpy as jnp

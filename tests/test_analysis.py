@@ -1,0 +1,239 @@
+"""Comm-graph sanitizer: API, model semantics, and the full-registry
+sweep (acceptance: every shipped kernel analyzes clean on
+representative meshes).
+
+These tests need no TPU and no `pallas_call` — the sanitizer replays
+kernel bodies under recording shims on an abstract machine, so they
+run on any host (including containers whose jax lacks interpret-mode
+features).
+"""
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.analysis import (
+    FindingKind,
+    RefSpec,
+    SemSpec,
+    all_kernels,
+    analyze_kernel,
+    iter_specs,
+    record_traces,
+    sweep,
+)
+from triton_distributed_tpu.language import core as dl
+
+W = 4
+M, N = 8, 128
+REFS = [RefSpec("x", (M, N), jnp.float32),
+        RefSpec("o", (W, M, N), jnp.float32)]
+SEMS = [SemSpec("send"), SemSpec("recv", (W,))]
+
+
+def _exchange(x_ref, o_ref, send, recv):
+    """Clean right-neighbor exchange: barrier, put, wait both sides."""
+    my = jax.lax.axis_index("tp")
+    right = jax.lax.rem(my + 1, W)
+    left = jax.lax.rem(my - 1 + W, W)
+    dl.entry_barrier("tp", W)
+    dl.put_nbi(x_ref, o_ref.at[my], send, recv.at[my],
+               dl.peer_id("tp", right))
+    dl.wait_recv(o_ref.at[left], recv.at[left])
+    dl.wait_send(x_ref, send)
+
+
+def test_clean_kernel_no_findings():
+    assert analyze_kernel(_exchange, {"tp": W}, refs=REFS, sems=SEMS) == []
+
+
+def test_traces_are_per_rank_and_cross_rank():
+    machine = record_traces(_exchange, axis_sizes={"tp": W}, refs=REFS,
+                            sems=SEMS)
+    assert sorted(machine.traces) == [(r,) for r in range(W)]
+    puts = [op for t in machine.traces.values() for op in t
+            if op.kind == "put"]
+    assert len(puts) == W
+    # every put targets the right neighbor's o[my] slot
+    for op in puts:
+        my = op.rank[0]
+        assert op.peer == ((my + 1) % W,)
+        assert op.dst_ref == "o" and op.dst_key == (my,)
+        assert op.amount == M * N * 4
+
+
+def test_shims_are_restored_after_analysis():
+    orig = (pltpu.make_async_remote_copy, pltpu.semaphore_signal,
+            pl.when, jax.lax.fori_loop)
+    analyze_kernel(_exchange, {"tp": W}, refs=REFS, sems=SEMS)
+    assert (pltpu.make_async_remote_copy, pltpu.semaphore_signal,
+            pl.when, jax.lax.fori_loop) == orig
+
+
+def test_analysis_does_not_require_tpu_or_pallas_call(monkeypatch):
+    # pallas_call must never be reached during a replay.
+    def boom(*a, **k):
+        raise AssertionError("pallas_call reached under analysis")
+
+    monkeypatch.setattr(pl, "pallas_call", boom)
+    assert analyze_kernel(_exchange, {"tp": W}, refs=REFS, sems=SEMS) == []
+
+
+def test_put_blocking_is_local_completion_only():
+    """`dl.put` (blocking) waits for LOCAL completion only — SHMEM
+    semantics: the analyzer model must NOT credit remote visibility to
+    a plain put, so a reader that skips wait_recv races."""
+
+    def reader_without_wait(x_ref, o_ref, send, recv):
+        my = jax.lax.axis_index("tp")
+        right = jax.lax.rem(my + 1, W)
+        left = jax.lax.rem(my - 1 + W, W)
+        dl.entry_barrier("tp", W)
+        # Blocking put: source is reusable afterwards...
+        dl.put(x_ref, o_ref.at[my], send, recv.at[my],
+               dl.peer_id("tp", right))
+        x_ref[...] = 0                      # legal: local completion
+        _ = o_ref[left]                     # ILLEGAL: no wait_recv
+        dl.wait_recv(o_ref.at[left], recv.at[left])
+
+    findings = analyze_kernel(reader_without_wait, {"tp": W}, refs=REFS,
+                              sems=SEMS)
+    kinds = {f.kind for f in findings}
+    assert FindingKind.RACE_READ_BEFORE_WAIT in kinds, findings
+    # ... and the source overwrite after the blocking put is NOT a
+    # finding (wait_send is part of dl.put).
+    assert FindingKind.RACE_SRC_REUSE not in kinds, findings
+
+
+def test_run_scoped_scratch_names_are_spmd_symmetric():
+    """`pl.run_scoped` scratch (including DMA semaphores) must get the
+    SAME abstract name on every rank — allocation order is
+    deterministic, and the per-replay counter reset keeps rank 1's
+    scoped semaphore matching the name a rank-0 put credits.  A
+    correct user kernel using the run_scoped-semaphore idiom must
+    analyze clean."""
+
+    def scoped_exchange(x_ref, o_ref):
+        def body(send, recv):
+            my = jax.lax.axis_index("tp")
+            right = jax.lax.rem(my + 1, W)
+            left = jax.lax.rem(my - 1 + W, W)
+            dl.entry_barrier("tp", W)
+            dl.put_nbi(x_ref, o_ref.at[my], send, recv.at[my],
+                       dl.peer_id("tp", right))
+            dl.wait_recv(o_ref.at[left], recv.at[left])
+            dl.wait_send(x_ref, send)
+
+        pl.run_scoped(body, pltpu.SemaphoreType.DMA(()),
+                      pltpu.SemaphoreType.DMA((W,)))
+
+    findings = analyze_kernel(scoped_exchange, {"tp": W}, refs=REFS,
+                              sems=[])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_value_refs_steer_control_flow():
+    def rooted(x_ref, root_ref, o_ref, send, recv):
+        my = jax.lax.axis_index("tp")
+        root = root_ref[0]
+        dl.entry_barrier("tp", W)
+        dl.emit_broadcast("tp", W, root, x_ref, o_ref, send, send, recv)
+
+    findings = analyze_kernel(
+        rooted, {"tp": W},
+        refs=[RefSpec("x", (M, N), jnp.float32),
+              RefSpec("root", (1,), np.int32,
+                      value=np.array([1], np.int32)),
+              RefSpec("o", (M, N), jnp.float32)],
+        sems=[SemSpec("send"), SemSpec("recv")])
+    assert findings == []
+
+
+def test_grid_replay_runs_each_step():
+    seen = []
+
+    def body(x_ref, sem):
+        seen.append((jax.lax.axis_index("tp"), pl.program_id(0)))
+
+    analyze_kernel(body, {"tp": 2},
+                   refs=[RefSpec("x", (M, N), jnp.float32)],
+                   sems=[SemSpec("sem")], grid=(3,))
+    assert sorted(seen) == [(r, g) for r in range(2) for g in range(3)]
+
+
+def test_shape_and_dtype_symmetry():
+    def bad(x_ref, o_ref, send, recv):
+        my = jax.lax.axis_index("tp")
+        right = jax.lax.rem(my + 1, W)
+        dl.entry_barrier("tp", W)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=x_ref, dst_ref=o_ref,      # (M,N) -> (W,M,N)
+            send_sem=send, recv_sem=recv.at[my],
+            device_id=dl.peer_id("tp", right))
+        rdma.start()
+        left = jax.lax.rem(my - 1 + W, W)
+        pltpu.make_async_copy(o_ref, o_ref, recv.at[left]).wait()
+        rdma.wait_send()
+
+    kinds = {f.kind for f in analyze_kernel(bad, {"tp": W}, refs=REFS,
+                                            sems=SEMS)}
+    assert FindingKind.SHAPE_MISMATCH in kinds
+
+
+# ---------------------------------------------------------------------------
+# Registry sweep — the acceptance criterion: zero findings on every
+# shipped kernel across its representative meshes.
+# ---------------------------------------------------------------------------
+
+def test_registry_covers_all_kernel_families():
+    names = all_kernels()
+    for family in ("allgather.", "allreduce.", "reduce_scatter.",
+                   "all_to_all.", "ag_gemm.", "gemm_rs.",
+                   "moe_reduce_rs.", "ag_group_gemm.", "common_ops.",
+                   "sp_ag_attention.", "torus.", "hierarchical.",
+                   "ll_allgather.", "flash_decode."):
+        assert any(n.startswith(family) for n in names), (family, names)
+
+
+@pytest.mark.parametrize("name,mesh,spec", [
+    pytest.param(n, m, s, id=f"{n}[{','.join(f'{a}={v}' for a, v in m.items())}]")
+    for n, m, s in iter_specs()
+])
+def test_shipped_kernels_analyze_clean(name, mesh, spec):
+    from triton_distributed_tpu.analysis import analyze_spec
+    findings = analyze_spec(spec)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_sweep_api_shape():
+    results = list(sweep(["allgather.ring"]))
+    assert len(results) == 2          # two representative meshes
+    for name, mesh, findings in results:
+        assert name == "allgather.ring"
+        assert findings == []
+
+
+def test_cli_sweep_exit_zero():
+    from triton_distributed_tpu.analysis.__main__ import main
+    assert main(["-q", "-k", "allgather.*"]) == 0
+
+
+def test_cli_list_and_bad_kernel():
+    from triton_distributed_tpu.analysis.__main__ import main
+    assert main(["--list"]) == 0
+    assert main(["-k", "no_such_kernel"]) == 2
+
+
+def test_comm_graph_build():
+    from triton_distributed_tpu.analysis.graph import build_graph
+    machine = record_traces(_exchange, axis_sizes={"tp": W}, refs=REFS,
+                            sems=SEMS)
+    g = build_graph(machine)
+    assert g.completed
+    # cross-rank sem edges exist (barrier + put/wait matching)
+    assert any(kind == "sem" for _, _, kind in g.edges)
+    assert "digraph" in g.to_dot()

@@ -1,0 +1,211 @@
+"""Mutation corpus: seeded single-defect kernel variants, one per
+defect class the sanitizer claims to catch.
+
+The base kernel is a clean one-shot exchange (entry barrier, one-sided
+put to the right neighbor, arrival wait, send drain — the skeleton of
+every shipped collective).  Each mutant introduces exactly ONE defect;
+the test asserts the sanitizer reports the *right* finding kind for
+it, and that the unmutated kernel stays clean (no false positives).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.analysis import (
+    FindingKind,
+    RefSpec,
+    SemSpec,
+    analyze_kernel,
+)
+from triton_distributed_tpu.language import core as dl
+
+W = 4
+M, N = 8, 128
+AXIS = "tp"
+REFS = [RefSpec("x", (M, N), jnp.float32),
+        RefSpec("o", (W, M, N), jnp.float32)]
+SEMS = [SemSpec("send"), SemSpec("recv", (W,)), SemSpec("flag")]
+
+
+def _me_right_left():
+    my = jax.lax.axis_index(AXIS)
+    return my, jax.lax.rem(my + 1, W), jax.lax.rem(my - 1 + W, W)
+
+
+def base(x_ref, o_ref, send, recv, flag):
+    """Clean exchange + a signal/wait flag round (so flag-defect
+    mutants change one line, not the structure)."""
+    my, right, left = _me_right_left()
+    dl.entry_barrier(AXIS, W)
+    dl.put_nbi(x_ref, o_ref.at[my], send, recv.at[my],
+               dl.peer_id(AXIS, right))
+    dl.notify(flag, device_id=dl.peer_id(AXIS, right))
+    dl.signal_wait_until(flag, 1)
+    dl.wait_recv(o_ref.at[left], recv.at[left])
+    dl.wait_send(x_ref, send)
+    _ = o_ref[left]              # consume the delivered chunk
+
+
+# --- mutants: exactly one defect each -------------------------------------
+
+def mut_leaked_sem(x_ref, o_ref, send, recv, flag):
+    """Signal the flag but never wait it: leaks 1 per rank at exit."""
+    my, right, left = _me_right_left()
+    dl.entry_barrier(AXIS, W)
+    dl.put_nbi(x_ref, o_ref.at[my], send, recv.at[my],
+               dl.peer_id(AXIS, right))
+    dl.notify(flag, device_id=dl.peer_id(AXIS, right))
+    # (missing) dl.signal_wait_until(flag, 1)
+    dl.wait_recv(o_ref.at[left], recv.at[left])
+    dl.wait_send(x_ref, send)
+    _ = o_ref[left]
+
+
+def mut_double_wait(x_ref, o_ref, send, recv, flag):
+    """wait_recv twice on one delivery: the kernel can never finish."""
+    base(x_ref, o_ref, send, recv, flag)
+    my, right, left = _me_right_left()
+    dl.wait_recv(o_ref.at[left], recv.at[left])      # second drain
+
+
+def mut_missing_barrier_one_rank(x_ref, o_ref, send, recv, flag):
+    """Rank 2 skips barrier_all: peers wait for arrivals forever."""
+    my, right, left = _me_right_left()
+    if my != 2:
+        dl.barrier_all(AXIS)
+    dl.put_nbi(x_ref, o_ref.at[my], send, recv.at[my],
+               dl.peer_id(AXIS, right))
+    dl.notify(flag, device_id=dl.peer_id(AXIS, right))
+    dl.signal_wait_until(flag, 1)
+    dl.wait_recv(o_ref.at[left], recv.at[left])
+    dl.wait_send(x_ref, send)
+    _ = o_ref[left]
+
+
+def mut_read_before_wait_recv(x_ref, o_ref, send, recv, flag):
+    """Read the remotely-written chunk before its wait_recv."""
+    my, right, left = _me_right_left()
+    dl.entry_barrier(AXIS, W)
+    dl.put_nbi(x_ref, o_ref.at[my], send, recv.at[my],
+               dl.peer_id(AXIS, right))
+    dl.notify(flag, device_id=dl.peer_id(AXIS, right))
+    dl.signal_wait_until(flag, 1)
+    _ = o_ref[left]                                  # MOVED before wait
+    dl.wait_recv(o_ref.at[left], recv.at[left])
+    dl.wait_send(x_ref, send)
+
+
+def mut_src_reuse_before_wait_send(x_ref, o_ref, send, recv, flag):
+    """Overwrite the put's source before draining the send sem."""
+    my, right, left = _me_right_left()
+    dl.entry_barrier(AXIS, W)
+    dl.put_nbi(x_ref, o_ref.at[my], send, recv.at[my],
+               dl.peer_id(AXIS, right))
+    x_ref[...] = 0                                   # src still in flight
+    dl.notify(flag, device_id=dl.peer_id(AXIS, right))
+    dl.signal_wait_until(flag, 1)
+    dl.wait_recv(o_ref.at[left], recv.at[left])
+    dl.wait_send(x_ref, send)
+    _ = o_ref[left]
+
+
+def mut_shape_mismatch(x_ref, o_ref, send, recv, flag):
+    """Put (M,N) src into the whole (W,M,N) dst."""
+    my, right, left = _me_right_left()
+    dl.entry_barrier(AXIS, W)
+    rdma = pltpu.make_async_remote_copy(
+        src_ref=x_ref, dst_ref=o_ref,                # wrong dst slice
+        send_sem=send, recv_sem=recv.at[my],
+        device_id=dl.peer_id(AXIS, right))
+    rdma.start()
+    dl.notify(flag, device_id=dl.peer_id(AXIS, right))
+    dl.signal_wait_until(flag, 1)
+    pltpu.make_async_copy(o_ref, o_ref, recv.at[left]).wait()
+    rdma.wait_send()
+
+
+def mut_wait_without_signal(x_ref, o_ref, send, recv, flag):
+    """Wait on a flag no rank ever signals."""
+    my, right, left = _me_right_left()
+    dl.entry_barrier(AXIS, W)
+    dl.put_nbi(x_ref, o_ref.at[my], send, recv.at[my],
+               dl.peer_id(AXIS, right))
+    # (missing) dl.notify(flag, device_id=...)
+    dl.signal_wait_until(flag, 1)
+    dl.wait_recv(o_ref.at[left], recv.at[left])
+    dl.wait_send(x_ref, send)
+    _ = o_ref[left]
+
+
+def mut_barrier_count_mismatch(x_ref, o_ref, send, recv, flag):
+    """Hand-rolled barrier waiting for W signals instead of W-1."""
+    my, right, left = _me_right_left()
+    bsem = pltpu.get_barrier_semaphore()
+
+    def body(i, _):
+        peer = jax.lax.rem(my + i, W)
+        pltpu.semaphore_signal(bsem, inc=1,
+                               device_id=dl.peer_id(AXIS, peer))
+        return 0
+
+    jax.lax.fori_loop(1, W, body, 0)
+    pltpu.semaphore_wait(bsem, W)                    # off by one
+    dl.put_nbi(x_ref, o_ref.at[my], send, recv.at[my],
+               dl.peer_id(AXIS, right))
+    dl.notify(flag, device_id=dl.peer_id(AXIS, right))
+    dl.signal_wait_until(flag, 1)
+    dl.wait_recv(o_ref.at[left], recv.at[left])
+    dl.wait_send(x_ref, send)
+
+
+def mut_overdrain_send(x_ref, o_ref, send, recv, flag):
+    """Drain the send semaphore twice for one put."""
+    base(x_ref, o_ref, send, recv, flag)
+    dl.wait_send(x_ref, send)                        # second drain
+
+
+CORPUS = [
+    (mut_leaked_sem, FindingKind.SEM_LEAK),
+    (mut_double_wait, FindingKind.SEM_OVERDRAIN),
+    (mut_missing_barrier_one_rank, FindingKind.BARRIER_MISMATCH),
+    (mut_read_before_wait_recv, FindingKind.RACE_READ_BEFORE_WAIT),
+    (mut_src_reuse_before_wait_send, FindingKind.RACE_SRC_REUSE),
+    (mut_shape_mismatch, FindingKind.SHAPE_MISMATCH),
+    (mut_wait_without_signal, FindingKind.UNSATISFIED_WAIT),
+    (mut_barrier_count_mismatch, FindingKind.BARRIER_MISMATCH),
+    (mut_overdrain_send, FindingKind.SEM_OVERDRAIN),
+]
+
+
+def _analyze(fn):
+    return analyze_kernel(fn, {AXIS: W}, refs=REFS, sems=SEMS,
+                          name=fn.__name__)
+
+
+def test_corpus_has_at_least_eight_defect_classes():
+    assert len(CORPUS) >= 8
+    assert len({fn for fn, _ in CORPUS}) == len(CORPUS)
+
+
+def test_base_kernel_is_clean():
+    assert _analyze(base) == []
+
+
+@pytest.mark.parametrize("mutant,expected",
+                         CORPUS, ids=[fn.__name__ for fn, _ in CORPUS])
+def test_mutant_caught_with_right_kind(mutant, expected):
+    findings = _analyze(mutant)
+    kinds = {f.kind for f in findings}
+    assert expected in kinds, (
+        f"{mutant.__name__}: expected {expected}, got "
+        + ("\n".join(str(f) for f in findings) or "no findings"))
+
+
+@pytest.mark.parametrize("mutant,expected",
+                         CORPUS, ids=[fn.__name__ for fn, _ in CORPUS])
+def test_mutant_findings_carry_location(mutant, expected):
+    for f in _analyze(mutant):
+        assert f.kernel == mutant.__name__
+        assert f.message

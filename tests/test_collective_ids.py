@@ -16,6 +16,55 @@ def test_user_allocation_disjoint():
     assert a != b and a not in ids and b not in ids
 
 
+def test_allocate_exhaustion_is_a_clear_error(monkeypatch):
+    """Id-space exhaustion must raise at allocation time with an
+    actionable message, not surface as an opaque Mosaic failure."""
+    import itertools
+
+    import pytest
+
+    monkeypatch.setattr(cids, "_user_ids",
+                        itertools.count(cids._MAX_IDS - 1))
+    last = cids.allocate()
+    assert last == cids._MAX_IDS - 1
+    with pytest.raises(RuntimeError, match="exhausted"):
+        cids.allocate()
+    # the guard keeps failing (no silent wraparound or reuse)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        cids.allocate()
+
+
+def test_allocate_duplicate_grant_is_rejected(monkeypatch):
+    """A rewound counter (the duplicate-grant bug class) is caught
+    instead of silently handing the same barrier semaphore to two
+    concurrent kernels."""
+    import itertools
+
+    import pytest
+
+    first = cids.allocate()
+    monkeypatch.setattr(cids, "_user_ids", itertools.count(first))
+    with pytest.raises(RuntimeError, match="already in use"):
+        cids.allocate()
+
+
+def test_allocate_never_returns_a_builtin(monkeypatch):
+    """Even a counter misconfigured into the built-in range cannot
+    grant a built-in id."""
+    import itertools
+
+    import pytest
+
+    monkeypatch.setattr(cids, "_user_ids",
+                        itertools.count(cids.ALLGATHER))
+    with pytest.raises(RuntimeError, match="built-in"):
+        cids.allocate()
+
+
+def test_builtin_range_below_user_range():
+    assert max(cids.builtin_ids().values()) < cids._FIRST_USER_ID
+
+
 def test_no_magic_collective_id_literals():
     """Grep audit (VERDICT r4 weak #2): every ``collective_id``
     default in the package must be a registry expression (``cids.X``

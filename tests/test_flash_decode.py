@@ -1,7 +1,6 @@
 """Flash-decode tests (reference: `test/nvidia/test_decode_attn.py`,
 `test_sp_decode_attn.py`)."""
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -16,7 +16,6 @@ TPU-specific hazards the table documents:
 - entry barriers under stragglers.
 """
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -161,6 +160,61 @@ def test_put_blocking_source_reuse(tp8_mesh):
     expect = np.roll(np.asarray(x).reshape(WORLD, 8, 128), 1, axis=0)
     assert_allclose(out, expect.reshape(WORLD * 8, 128), atol=0, rtol=0,
                     name="put")
+
+
+def test_put_local_completion_is_not_remote_visibility():
+    """Row `putmem`: the dl.put docstring promises SHMEM blocking-put
+    semantics — returning means LOCAL completion (source reusable),
+    NOT remote delivery.  The static sanitizer encodes exactly that
+    asymmetry: after `dl.put` the source may be overwritten (no
+    src-reuse finding), but a peer reading its destination without
+    `wait_recv` is a race — put alone establishes no remote
+    visibility, even when a separate notify/flag round trails it.
+
+    Runs on the abstract machine (no TPU, no pallas_call), so it
+    exercises the contract on any host.
+    """
+    from triton_distributed_tpu.analysis import (
+        FindingKind, RefSpec, SemSpec, analyze_kernel)
+
+    world = 4
+
+    def make_kernel(reader_waits: bool):
+        def kernel(x_ref, o_ref, send_sem, recv_sems, flag):
+            my = dl.rank("tp")
+            right = jax.lax.rem(my + 1, world)
+            left = jax.lax.rem(my - 1 + world, world)
+            dl.entry_barrier("tp", world)
+            # Blocking put = put_nbi + wait_send: local completion.
+            dl.put(x_ref, o_ref.at[my], send_sem, recv_sems.at[my],
+                   dl.peer_id("tp", right))
+            x_ref[...] = 0          # legal: source is reusable
+            # A trailing flag round does NOT order the DMA's landing.
+            dl.notify(flag, device_id=dl.peer_id("tp", right))
+            dl.signal_wait_until(flag, 1)
+            if reader_waits:
+                dl.wait_recv(o_ref.at[left], recv_sems.at[left])
+                _ = o_ref[left]
+            else:
+                _ = o_ref[left]     # no visibility guarantee!
+                dl.wait_recv(o_ref.at[left], recv_sems.at[left])
+        return kernel
+
+    refs = [RefSpec("x", SHAPE, jnp.float32),
+            RefSpec("o", (world,) + SHAPE, jnp.float32)]
+    sems = [SemSpec("send"), SemSpec("recv", (world,)), SemSpec("flag")]
+
+    clean = analyze_kernel(make_kernel(True), {"tp": world},
+                           refs=refs, sems=sems)
+    assert clean == [], clean
+
+    kinds = {f.kind for f in analyze_kernel(make_kernel(False),
+                                            {"tp": world},
+                                            refs=refs, sems=sems)}
+    assert FindingKind.RACE_READ_BEFORE_WAIT in kinds
+    # The post-put source overwrite must NOT be flagged: dl.put's
+    # wait_send made the source safe to reuse.
+    assert FindingKind.RACE_SRC_REUSE not in kinds
 
 
 def test_put_nbi_descriptor(tp8_mesh):

@@ -1,14 +1,12 @@
 """Layer tests (reference: `test/nvidia/test_tp_mlp.py`,
 `test_tp_attn.py`, `test_ep_a2a.py`)."""
 
-import functools
 
 import jax
 import jax.numpy as jnp
 import pytest
 from jax.sharding import PartitionSpec as P
 
-from triton_distributed_tpu.kernels import moe_utils
 from triton_distributed_tpu.kernels.allgather_group_gemm import gated_silu
 from triton_distributed_tpu.kernels.flash_attention import (
     attention_reference,
@@ -18,7 +16,7 @@ from triton_distributed_tpu.layers.ep_a2a_layer import EPAll2AllLayer
 from triton_distributed_tpu.layers.sp_flash_decode_layer import (
     SpFlashDecodeAttention,
 )
-from triton_distributed_tpu.layers.tp_attn import TPAttention, rms_norm
+from triton_distributed_tpu.layers.tp_attn import TPAttention
 from triton_distributed_tpu.layers.tp_mlp import TPMLP
 from triton_distributed_tpu.ops import shard_map_op
 from triton_distributed_tpu.utils.testing import assert_allclose

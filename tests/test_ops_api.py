@@ -4,7 +4,6 @@ arrays; reference: the exported op entry points,
 
 import jax
 import jax.numpy as jnp
-import pytest
 
 from triton_distributed_tpu import ops
 from triton_distributed_tpu.utils.testing import assert_allclose

@@ -1,6 +1,5 @@
 """ReduceScatter tests (reference: `test/nvidia/test_reduce_scatter.py`)."""
 
-import functools
 
 import jax
 import jax.numpy as jnp

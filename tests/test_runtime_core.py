@@ -13,7 +13,6 @@ from jax.sharding import PartitionSpec as P
 from triton_distributed_tpu.kernels.common_ops import barrier_all_on_axis
 from triton_distributed_tpu.ops import shard_map_op
 from triton_distributed_tpu.parallel.mesh import (
-    MeshContext,
     make_mesh,
     node_topology,
 )

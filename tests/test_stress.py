@@ -16,7 +16,6 @@ import random
 
 import jax
 import jax.numpy as jnp
-import pytest
 from jax.sharding import PartitionSpec as P
 
 from triton_distributed_tpu.kernels.allgather_gemm import (

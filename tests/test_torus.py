@@ -7,7 +7,6 @@ Reference analogues: the 2D ring AllGather
 (2, 4) torus with both axes Pallas-DMA addressable.
 """
 
-import functools
 
 import jax
 import jax.numpy as jnp

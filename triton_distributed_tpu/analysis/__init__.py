@@ -1,0 +1,97 @@
+"""Static cross-rank comm-graph sanitizer for the device language.
+
+Catches — *before launch*, with no TPU — the failure class that
+otherwise deadlocks a slice with no diagnostic: mis-paired
+signal/wait, leaked semaphores, mismatched `barrier_all`
+participation, reads of remotely-written buffers with no `wait_recv`,
+source reuse before `wait_send`, and asymmetric one-sided puts.
+
+Usage (library)::
+
+    from triton_distributed_tpu.analysis import (
+        RefSpec, SemSpec, analyze_kernel)
+
+    findings = analyze_kernel(
+        my_kernel_body, {"tp": 4},
+        refs=[RefSpec("x", (8, 128)), RefSpec("o", (4, 8, 128))],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv", (4,))],
+    )
+    assert not findings, "\\n".join(map(str, findings))
+
+Usage (CLI)::
+
+    python -m triton_distributed_tpu.analysis            # sweep all
+    python -m triton_distributed_tpu.analysis -k allgather.ring
+
+See docs/analysis.md for the machine model and its assumptions.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from triton_distributed_tpu.analysis.checks import run_checks
+from triton_distributed_tpu.analysis.context import (
+    AnalysisContext,
+    record_traces,
+)
+from triton_distributed_tpu.analysis.model import (
+    Finding,
+    FindingKind,
+    Machine,
+)
+from triton_distributed_tpu.analysis.registry import (
+    KernelSpec,
+    RefSpec,
+    SemSpec,
+    all_kernels,
+    iter_specs,
+    register_comm_kernel,
+)
+
+__all__ = [
+    "AnalysisContext",
+    "Finding",
+    "FindingKind",
+    "KernelSpec",
+    "Machine",
+    "RefSpec",
+    "SemSpec",
+    "all_kernels",
+    "analyze_kernel",
+    "analyze_spec",
+    "iter_specs",
+    "record_traces",
+    "register_comm_kernel",
+    "run_checks",
+    "sweep",
+]
+
+
+def analyze_kernel(fn, mesh_shape: Dict[str, int], *,
+                   refs: Sequence[RefSpec] = (),
+                   sems: Sequence[SemSpec] = (),
+                   grid: Tuple[int, ...] = (),
+                   name: Optional[str] = None) -> List[Finding]:
+    """Symbolically execute `fn(*refs, *sems)` on an abstract machine
+    with one rank per coordinate of `mesh_shape` (dict axis -> size)
+    and run all sanitizer checks on the recorded communication graph.
+
+    Returns a list of :class:`Finding` (empty = clean).
+    """
+    machine = record_traces(fn, axis_sizes=mesh_shape, refs=refs,
+                            sems=sems, grid=grid)
+    return run_checks(machine, kernel=name or getattr(fn, "__name__", None))
+
+
+def analyze_spec(spec: KernelSpec) -> List[Finding]:
+    return analyze_kernel(spec.body, spec.axis_sizes, refs=spec.refs,
+                          sems=spec.sems, grid=spec.grid, name=spec.name)
+
+
+def sweep(names: Optional[Sequence[str]] = None,
+          mesh: Optional[Dict[str, int]] = None):
+    """Analyze every registered kernel (optionally restricted); yields
+    (kernel name, axis_sizes, findings)."""
+    for name, axis_sizes, spec in iter_specs(names, mesh):
+        yield name, axis_sizes, analyze_spec(spec)

@@ -1,0 +1,129 @@
+"""CLI sweep: sanitize every registered kernel across its meshes.
+
+    python -m triton_distributed_tpu.analysis              # full sweep
+    python -m triton_distributed_tpu.analysis --list
+    python -m triton_distributed_tpu.analysis -k allgather.ring
+    python -m triton_distributed_tpu.analysis --mesh tp=4
+    python -m triton_distributed_tpu.analysis --json out.json
+    python -m triton_distributed_tpu.analysis -k allreduce.chain \\
+        --dump-graph graph.dot
+
+Exit status: 0 = no findings, 1 = findings, 2 = usage error.
+`scripts/verify_tier1.sh` runs the full sweep as a gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import sys
+
+
+def _parse_mesh(text):
+    axes = {}
+    for part in text.split(","):
+        axis, _, size = part.partition("=")
+        if not size:
+            raise argparse.ArgumentTypeError(
+                f"mesh spec {text!r} must look like tp=4 or x=2,y=2")
+        axes[axis] = int(size)
+    return axes
+
+
+def main(argv=None) -> int:
+    from triton_distributed_tpu import analysis
+
+    parser = argparse.ArgumentParser(
+        prog="python -m triton_distributed_tpu.analysis",
+        description="Static comm-graph sanitizer sweep over registered "
+                    "kernels.")
+    parser.add_argument("-k", "--kernel", action="append", default=None,
+                        help="kernel name or glob (repeatable); default: "
+                             "all registered")
+    parser.add_argument("--mesh", type=_parse_mesh, default=None,
+                        help="override mesh shape, e.g. tp=4 or x=2,y=2")
+    parser.add_argument("--list", action="store_true",
+                        help="list registered kernels and exit")
+    parser.add_argument("--json", metavar="PATH", default=None,
+                        help="write findings as JSON (- for stdout)")
+    parser.add_argument("--dump-graph", metavar="PATH", default=None,
+                        help="write the comm graph (graphviz dot) of the "
+                             "first analyzed (kernel, mesh) and exit")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="print only findings and the final summary")
+    args = parser.parse_args(argv)
+
+    names = analysis.all_kernels()
+    if args.kernel:
+        selected = [n for n in names
+                    if any(fnmatch.fnmatch(n, pat) or n == pat
+                           for pat in args.kernel)]
+        if not selected:
+            print(f"no registered kernel matches {args.kernel}; "
+                  f"known: {', '.join(names)}", file=sys.stderr)
+            return 2
+        names = selected
+
+    if args.list:
+        from triton_distributed_tpu.analysis.registry import get_kernel
+        for n in names:
+            meshes = ", ".join(
+                ",".join(f"{a}={s}" for a, s in m.items())
+                for m in get_kernel(n).meshes)
+            print(f"{n:40s} {meshes}")
+        return 0
+
+    if args.dump_graph:
+        from triton_distributed_tpu.analysis.context import record_traces
+        from triton_distributed_tpu.analysis.graph import build_graph
+        from triton_distributed_tpu.analysis.registry import iter_specs
+        for _, _, spec in iter_specs(names, args.mesh):
+            machine = record_traces(spec.body, axis_sizes=spec.axis_sizes,
+                                    refs=spec.refs, sems=spec.sems,
+                                    grid=spec.grid)
+            with open(args.dump_graph, "w") as fh:
+                fh.write(build_graph(machine).to_dot())
+            print(f"wrote {args.dump_graph} for {spec.name}")
+            return 0
+        print("nothing analyzed", file=sys.stderr)
+        return 2
+
+    total = 0
+    swept = 0
+    rows = []
+    for name, axis_sizes, findings in analysis.sweep(names, args.mesh):
+        swept += 1
+        mesh_str = ",".join(f"{a}={s}" for a, s in axis_sizes.items())
+        if findings:
+            total += len(findings)
+            print(f"FAIL {name} [{mesh_str}]: {len(findings)} finding(s)")
+            for f in findings:
+                print(f"  {f}")
+        elif not args.quiet:
+            print(f"ok   {name} [{mesh_str}]")
+        rows.extend({
+            "kernel": name,
+            "mesh": axis_sizes,
+            "kind": f.kind.value,
+            "rank": list(f.rank) if f.rank is not None else None,
+            "sem": f.sem,
+            "ref": f.ref,
+            "message": f.message,
+        } for f in findings)
+
+    if args.json:
+        payload = json.dumps({"findings": rows, "swept": swept}, indent=2)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as fh:
+                fh.write(payload + "\n")
+
+    print(f"analysis sweep: {swept} (kernel, mesh) pairs, "
+          f"{total} finding(s)")
+    return 1 if total else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

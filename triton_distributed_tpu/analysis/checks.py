@@ -1,0 +1,459 @@
+"""The four sanitizer checks over recorded cross-rank traces.
+
+1. **Semaphore ledger** — per (rank, semaphore) signal/wait balance in
+   bytes (DMA sems) or counts (regular sems).  Positive residual at
+   kernel exit = leak (the "next launch hangs" bug: Pallas collective
+   semaphores are selected by `collective_id` and persist across
+   launches); negative = over-drain (the kernel itself cannot finish).
+2. **Deadlock** — the traces are executed on the abstract machine with
+   eager DMA delivery (the most permissive schedule: if it hangs here
+   it hangs everywhere).  A stuck fixpoint is classified into waits no
+   remaining op can ever satisfy vs. genuine cross-rank wait cycles.
+3. **Races** — vector clocks are threaded through the simulation: each
+   semaphore credit carries its producer's clock and every successful
+   wait joins the clocks of the credits it drained (this is exactly
+   the happens-before a TPU DMA semaphore provides).  A remote write
+   and a local access to an overlapping region with no ordering either
+   way is a race; a local write overlapping the source of a started
+   put whose send semaphore has not yet been drained is the
+   source-reuse race (`put` waits only for LOCAL completion — SHMEM
+   semantics, see `language.core.put`).
+4. **Shape/dtype symmetry** — one-sided puts with src/dst disagreement.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from triton_distributed_tpu.analysis.model import (
+    Finding,
+    FindingKind,
+    Machine,
+    Op,
+    overlaps,
+)
+
+__all__ = ["run_checks", "simulate", "SimResult"]
+
+BARRIER_SEM = "__barrier__"
+
+
+def _sem_str(semid) -> str:
+    rank, name, key = semid
+    body = name if not key else f"{name}[{','.join(map(str, key))}]"
+    return f"rank{rank}.{body}"
+
+
+def _ref_str(name, key) -> str:
+    return name if not key else f"{name}[{','.join(map(str, key))}]"
+
+
+# ---------------------------------------------------------------------------
+# 4. Shape / dtype symmetry
+# ---------------------------------------------------------------------------
+
+def check_symmetry(machine: Machine, kernel: Optional[str]) -> List[Finding]:
+    findings = {}
+    for rank, trace in sorted(machine.traces.items()):
+        for op in trace:
+            if op.kind != "put":
+                continue
+            if tuple(op.shape) != tuple(op.dst_shape):
+                key = ("shape", op.ref, op.key, op.dst_ref, op.dst_key)
+                findings.setdefault(key, Finding(
+                    FindingKind.SHAPE_MISMATCH,
+                    f"one-sided put {op.describe()}: src shape "
+                    f"{tuple(op.shape)} != dst shape {tuple(op.dst_shape)}",
+                    rank=rank, ref=op.ref, kernel=kernel))
+            if op.dtype is not None and op.dst_dtype is not None \
+                    and op.dtype != op.dst_dtype:
+                key = ("dtype", op.ref, op.key, op.dst_ref, op.dst_key)
+                findings.setdefault(key, Finding(
+                    FindingKind.DTYPE_MISMATCH,
+                    f"one-sided put {op.describe()}: src dtype {op.dtype} "
+                    f"!= dst dtype {op.dst_dtype}",
+                    rank=rank, ref=op.ref, kernel=kernel))
+    return list(findings.values())
+
+
+# ---------------------------------------------------------------------------
+# 1. Semaphore ledger
+# ---------------------------------------------------------------------------
+
+def _credit_targets(op: Op):
+    """(semid, amount) pairs an op credits."""
+    if op.kind == "put":
+        yield ((op.rank,) + op.sem, op.amount)          # send sem, source
+        yield ((op.peer,) + op.recv_sem, op.amount)     # recv sem, dest
+    elif op.kind == "copy":
+        yield ((op.rank,) + op.sem, op.amount)
+    elif op.kind == "signal":
+        yield ((op.peer,) + op.sem, op.amount)
+
+
+def check_ledger(machine: Machine, kernel: Optional[str]) -> List[Finding]:
+    credits: Dict[tuple, int] = collections.Counter()
+    drains: Dict[tuple, int] = collections.Counter()
+    for _, trace in sorted(machine.traces.items()):
+        for op in trace:
+            for semid, amount in _credit_targets(op):
+                credits[semid] += amount
+            if op.kind == "wait":
+                drains[(op.rank,) + op.sem] += op.amount
+
+    findings = []
+    for semid in sorted(set(credits) | set(drains)):
+        bal = credits[semid] - drains[semid]
+        if bal == 0:
+            continue
+        rank, name = semid[0], semid[1]
+        if name == BARRIER_SEM:
+            findings.append(Finding(
+                FindingKind.BARRIER_MISMATCH,
+                f"barrier semaphore imbalance on {_sem_str(semid)}: "
+                f"{credits[semid]} arrivals vs {drains[semid]} awaited "
+                f"(mismatched barrier participation or count)",
+                rank=rank, sem=name, kernel=kernel))
+        elif bal > 0:
+            findings.append(Finding(
+                FindingKind.SEM_LEAK,
+                f"semaphore {_sem_str(semid)} leaks {bal} at kernel exit "
+                f"({credits[semid]} credited, {drains[semid]} drained): "
+                f"the next launch sharing this semaphore inherits stale "
+                f"credits",
+                rank=rank, sem=name, kernel=kernel))
+        else:
+            findings.append(Finding(
+                FindingKind.SEM_OVERDRAIN,
+                f"semaphore {_sem_str(semid)} over-drained by {-bal} "
+                f"({credits[semid]} credited, {drains[semid]} awaited): "
+                f"a wait consumes credits that are never produced",
+                rank=rank, sem=name, kernel=kernel))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 2. + 3. Simulation: eager schedule, vector clocks, deadlock, races
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SimResult:
+    completed: bool
+    #: next-unexecuted op index per rank
+    stopped_at: Dict[tuple, int]
+    #: vector clock per executed (rank, pos)
+    op_vc: Dict[Tuple[tuple, int], tuple]
+    #: put op id -> vector clock of the wait that fully drained its
+    #: RECV-semaphore credit (= the earliest point the data is known
+    #: delivered).  A put absent here was never awaited.
+    delivered: Dict[int, tuple]
+    #: credit-match edges ((producer rank, pos), (waiting rank, pos))
+    #: — the cross/intra-rank happens-before the FIFO matching
+    #: established; `analysis.graph` renders these.
+    sem_edges: List[Tuple[Tuple[tuple, int], Tuple[tuple, int]]]
+    findings: List[Finding]
+
+
+class _SemState:
+    __slots__ = ("counter", "queue")
+
+    def __init__(self):
+        self.counter = 0
+        # FIFO of [amount_left, vc, op]
+        self.queue = collections.deque()
+
+
+def simulate(machine: Machine, kernel: Optional[str] = None) -> SimResult:
+    ranks = sorted(machine.traces)
+    rank_ix = {r: i for i, r in enumerate(ranks)}
+    nr = len(ranks)
+    clocks = {r: [0] * nr for r in ranks}
+    idx = {r: 0 for r in ranks}
+    sems: Dict[tuple, _SemState] = collections.defaultdict(_SemState)
+    op_vc: Dict[Tuple[tuple, int], tuple] = {}
+    delivered: Dict[int, tuple] = {}
+    sem_edges: List[Tuple[Tuple[tuple, int], Tuple[tuple, int]]] = []
+    findings: List[Finding] = []
+    race_seen = set()
+
+    # src-reuse tracking: per rank, puts started but not yet send-drained.
+    unflushed: Dict[tuple, List[Op]] = {r: [] for r in ranks}
+    flushed_ops = set()  # ids of puts whose send credit fully drained
+    pending_delivery: List[Op] = []  # recv credits drained by current wait
+
+    def tick(r):
+        clocks[r][rank_ix[r]] += 1
+
+    def check_src_reuse(r, op):
+        # `op` is a local write on rank r (write op / copy dst); any
+        # still-in-flight put whose SOURCE overlaps is being clobbered.
+        wref, wkey = ((op.dst_ref, op.dst_key) if op.kind == "copy"
+                      else (op.ref, op.key))
+        for put in unflushed[r]:
+            if put.ref == wref and overlaps(put.key, wkey):
+                key = ("src_reuse", r, put.ref, put.key, wkey)
+                if key not in race_seen:
+                    race_seen.add(key)
+                    findings.append(Finding(
+                        FindingKind.RACE_SRC_REUSE,
+                        f"{_ref_str(wref, wkey)} is overwritten while "
+                        f"`{put.describe()}` is still in flight (no "
+                        f"wait_send drained the transfer): the DMA may "
+                        f"read the new data",
+                        rank=r, ref=wref, kernel=kernel))
+
+    def execute(r, op):
+        if op.kind == "wait":
+            semid = (r,) + op.sem
+            state = sems[semid]
+            if state.counter < op.amount:
+                return False
+            state.counter -= op.amount
+            need = op.amount
+            while need > 0 and state.queue:
+                credit = state.queue[0]
+                take = min(need, credit[0])
+                credit[0] -= take
+                need -= take
+                if credit[2] is not None:
+                    sem_edges.append(((credit[2].rank, credit[2].pos),
+                                      (r, op.pos)))
+                # join the producer's clock: this is the HB edge a
+                # semaphore wait provides.
+                clocks[r] = [max(a, b) for a, b in zip(clocks[r], credit[1])]
+                if credit[0] == 0:
+                    state.queue.popleft()
+                    if credit[2] is not None and credit[2].kind == "put":
+                        if credit[3] == "send":
+                            # fully drained send credit -> src reusable
+                            flushed_ops.add(id(credit[2]))
+                        elif credit[3] == "recv":
+                            # fully drained recv credit -> data known
+                            # delivered from this wait onward (stamped
+                            # below once the wait's clock is final)
+                            pending_delivery.append(credit[2])
+            unflushed[r] = [p for p in unflushed[r]
+                            if id(p) not in flushed_ops]
+            tick(r)
+            vc = tuple(clocks[r])
+            op_vc[(r, op.pos)] = vc
+            while pending_delivery:
+                delivered[id(pending_delivery.pop())] = vc
+            return True
+
+        tick(r)
+        vc = tuple(clocks[r])
+        op_vc[(r, op.pos)] = vc
+        if op.kind == "put":
+            send_id = (r,) + op.sem
+            recv_id = (op.peer,) + op.recv_sem
+            sems[send_id].counter += op.amount
+            sems[send_id].queue.append([op.amount, vc, op, "send"])
+            sems[recv_id].counter += op.amount
+            sems[recv_id].queue.append([op.amount, vc, op, "recv"])
+            unflushed[r].append(op)
+        elif op.kind == "copy":
+            semid = (r,) + op.sem
+            sems[semid].counter += op.amount
+            sems[semid].queue.append([op.amount, vc, op, "copy"])
+            check_src_reuse(r, op)
+        elif op.kind == "signal":
+            semid = (op.peer,) + op.sem
+            sems[semid].counter += op.amount
+            sems[semid].queue.append([op.amount, vc, op, "signal"])
+        elif op.kind == "write":
+            check_src_reuse(r, op)
+        return True
+
+    # Greedy round-robin to fixpoint: each pass runs every rank as far
+    # as it can go.  Eager delivery (credits land at put start) makes
+    # this the most permissive schedule — anything blocked at the
+    # fixpoint is blocked under every schedule.
+    progress = True
+    while progress:
+        progress = False
+        for r in ranks:
+            trace = machine.traces[r]
+            while idx[r] < len(trace):
+                if not execute(r, trace[idx[r]]):
+                    break
+                idx[r] += 1
+                progress = True
+
+    completed = all(idx[r] >= len(machine.traces[r]) for r in ranks)
+    if not completed:
+        findings.extend(
+            _classify_stuck(machine, idx, sems, kernel))
+    return SimResult(completed=completed, stopped_at=idx, op_vc=op_vc,
+                     delivered=delivered, sem_edges=sem_edges,
+                     findings=findings)
+
+
+def _classify_stuck(machine, idx, sems, kernel) -> List[Finding]:
+    """At a stuck fixpoint, split blocked waits into never-satisfiable
+    (no remaining op credits the semaphore enough) vs. a cross-rank
+    wait cycle, and name the participants."""
+    ranks = sorted(machine.traces)
+    blocked = {r: machine.traces[r][idx[r]] for r in ranks
+               if idx[r] < len(machine.traces[r])}
+
+    # Remaining (unexecuted) credits per semid, and who holds them.
+    future: Dict[tuple, int] = collections.Counter()
+    holders: Dict[tuple, set] = collections.defaultdict(set)
+    for r in ranks:
+        for op in machine.traces[r][idx[r]:]:
+            for semid, amount in _credit_targets(op):
+                future[semid] += amount
+                holders[semid].add(r)
+
+    findings = []
+    waits_for: Dict[tuple, set] = {}
+    for r, op in sorted(blocked.items()):
+        semid = (r,) + op.sem
+        shortfall = op.amount - sems[semid].counter
+        name = op.sem[0]
+        if future[semid] < shortfall:
+            kind = (FindingKind.BARRIER_MISMATCH if name == BARRIER_SEM
+                    else FindingKind.UNSATISFIED_WAIT)
+            findings.append(Finding(
+                kind,
+                f"`{op.describe()}` at trace position {op.pos} blocks "
+                f"forever: {shortfall} more needed on {_sem_str(semid)} "
+                f"but remaining program credits only {future[semid]}",
+                rank=r, sem=name, kernel=kernel))
+        else:
+            waits_for[r] = holders[semid] - {r}
+
+    if waits_for:
+        # Every contributor is itself blocked (the scheduler ran to a
+        # fixpoint), so any wait-for edge set here is a deadlock.
+        chain = "; ".join(
+            f"rank{r} blocked on `{blocked[r].describe()}` "
+            f"(satisfiable only by {sorted(waits_for[r])})"
+            for r in sorted(waits_for))
+        findings.append(Finding(
+            FindingKind.DEADLOCK,
+            f"cross-rank happens-before cycle: {chain}",
+            rank=sorted(waits_for)[0], kernel=kernel))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 3b. Remote-write vs local-access races (vector-clock comparison)
+# ---------------------------------------------------------------------------
+
+def _vc_leq(a, b) -> bool:
+    return all(x <= y for x, y in zip(a, b))
+
+
+def check_races(machine: Machine, sim: SimResult,
+                kernel: Optional[str]) -> List[Finding]:
+    # Memory events from the executed prefix.  remote[q] = writes INTO
+    # rank q's memory by a peer's put; local[q] = rank q's own accesses.
+    remote = collections.defaultdict(list)   # q -> (addr, vc, op)
+    local = collections.defaultdict(list)    # q -> (addr, vc, op, is_write)
+    for r, trace in sorted(machine.traces.items()):
+        for op in trace[:sim.stopped_at[r]]:
+            vc = sim.op_vc[(r, op.pos)]
+            if op.kind == "put":
+                local[r].append(((op.ref, op.key), vc, op, False))
+                remote[op.peer].append(
+                    ((op.dst_ref, op.dst_key), vc, op))
+            elif op.kind == "copy":
+                local[r].append(((op.ref, op.key), vc, op, False))
+                local[r].append(((op.dst_ref, op.dst_key), vc, op, True))
+            elif op.kind == "read":
+                local[r].append(((op.ref, op.key), vc, op, False))
+            elif op.kind == "write":
+                local[r].append(((op.ref, op.key), vc, op, True))
+
+    # Ordering rules (delivery-based — a flag signal issued after a
+    # put's START must not imply the DMA has LANDED; only draining the
+    # put's recv semaphore does):
+    #   remote write W happens-before local access E  iff  W's recv
+    #     credit was fully drained by a wait D with VC(D) <= VC(E);
+    #   E happens-before W  iff  VC(E) <= VC(W.start) (the put could
+    #     not have begun before E).
+    delivered = sim.delivered
+
+    def w_before(w_op, vc):
+        d = delivered.get(id(w_op))
+        return d is not None and _vc_leq(d, vc)
+
+    findings = {}
+    for q in sorted(remote):
+        for (w_addr, w_vc, w_op) in remote[q]:
+            for (a_addr, a_vc, a_op, is_write) in local.get(q, ()):
+                if a_addr[0] != w_addr[0]:
+                    continue
+                if not overlaps(a_addr[1], w_addr[1]):
+                    continue
+                if w_before(w_op, a_vc) or _vc_leq(a_vc, w_vc):
+                    continue
+                kind = (FindingKind.RACE_WRITE_CONFLICT if is_write
+                        else FindingKind.RACE_READ_BEFORE_WAIT)
+                verb = "written" if is_write else "read"
+                key = (kind, q, a_addr, w_addr, w_op.rank)
+                findings.setdefault(key, Finding(
+                    kind,
+                    f"{_ref_str(*a_addr)} is {verb} on rank{q} without "
+                    f"ordering against remote write `{w_op.describe()}` "
+                    f"from rank{w_op.rank} (no wait_recv on "
+                    f"{_ref_str(*w_op.recv_sem)} intervenes)",
+                    rank=q, ref=a_addr[0], kernel=kernel))
+            # remote-remote: two puts landing in overlapping regions
+            # (same source rank included — two DMAs from one chip may
+            # complete out of order; only receiver-side drains order
+            # them).
+            for (w2_addr, w2_vc, w2_op) in remote[q]:
+                if w2_op is w_op:
+                    continue
+                if w_addr[0] != w2_addr[0]:
+                    continue
+                if not overlaps(w_addr[1], w2_addr[1]):
+                    continue
+                if w_before(w_op, w2_vc) or w_before(w2_op, w_vc):
+                    continue
+                pair = tuple(sorted([(w_op.rank, w_op.pos),
+                                     (w2_op.rank, w2_op.pos)]))
+                key = (FindingKind.RACE_WRITE_CONFLICT, q, w_addr[0], pair)
+                findings.setdefault(key, Finding(
+                    FindingKind.RACE_WRITE_CONFLICT,
+                    f"unordered remote writes into rank{q}."
+                    f"{_ref_str(*w_addr)}: `{w_op.describe()}` from "
+                    f"rank{w_op.rank} vs `{w2_op.describe()}` from "
+                    f"rank{w2_op.rank}",
+                    rank=q, ref=w_addr[0], kernel=kernel))
+    return list(findings.values())
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+def run_checks(machine: Machine,
+               kernel: Optional[str] = None) -> List[Finding]:
+    """Run all four checks over the recorded traces; returns findings
+    ordered roughly most-severe-first."""
+    findings: List[Finding] = []
+    findings.extend(check_symmetry(machine, kernel))
+    sim = simulate(machine, kernel)
+    findings.extend(sim.findings)            # deadlock / unsatisfied
+    findings.extend(check_ledger(machine, kernel))
+    findings.extend(check_races(machine, sim, kernel))
+    order = {
+        FindingKind.DEADLOCK: 0,
+        FindingKind.UNSATISFIED_WAIT: 1,
+        FindingKind.BARRIER_MISMATCH: 2,
+        FindingKind.SEM_OVERDRAIN: 3,
+        FindingKind.SEM_LEAK: 4,
+        FindingKind.RACE_READ_BEFORE_WAIT: 5,
+        FindingKind.RACE_SRC_REUSE: 6,
+        FindingKind.RACE_WRITE_CONFLICT: 7,
+        FindingKind.SHAPE_MISMATCH: 8,
+        FindingKind.DTYPE_MISMATCH: 9,
+    }
+    findings.sort(key=lambda f: order.get(f.kind, 99))
+    return findings

@@ -1,0 +1,475 @@
+"""Abstract machine model for the comm-graph sanitizer.
+
+The sanitizer replays a kernel body on an abstract N-rank machine: no
+TPU, no `pallas_call` — the `language.core` primitives (and the raw
+`pltpu` DMA/semaphore ops they wrap) are shimmed by recording versions
+(see `analysis.context`).  This module defines what gets recorded:
+
+- :class:`AbstractRef` / :class:`AbstractSem` — stand-ins for Pallas
+  memory and semaphore refs.  Refs are *named*, and the same name on
+  two ranks denotes the symmetric (SPMD) buffer — exactly the Pallas
+  contract that every rank runs one program with one scratch layout,
+  which is what makes a `recv_sem` passed to a remote copy meaningful
+  on the destination chip.
+- :class:`Op` — one recorded communication event (put start, local
+  copy start, semaphore wait/drain, semaphore signal, memory read,
+  memory write) in a rank's program-order trace.
+- :class:`Finding` — a structured defect report, classified by
+  :class:`FindingKind` (the mutation-corpus tests pin one kind per
+  seeded defect class).
+
+Reference framing: Triton-distributed's hardest bugs are mis-paired
+signal/wait and barrier mismatches that hang the whole job; SHMEM
+communication verifiers catch these by checking the *communication
+footprint*, not the arithmetic.  This model records that footprint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "AbstractRef",
+    "AbstractSem",
+    "Finding",
+    "FindingKind",
+    "Machine",
+    "Op",
+    "overlaps",
+]
+
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+class FindingKind(enum.Enum):
+    #: Semaphore has a positive balance at kernel exit: the *next*
+    #: launch using the same (collective) semaphore inherits stale
+    #: credits — the classic "second run hangs/corrupts" bug.
+    SEM_LEAK = "sem_leak"
+    #: More value waited than ever signaled (double-wait, wrong count):
+    #: the kernel cannot terminate on real hardware.
+    SEM_OVERDRAIN = "sem_overdrain"
+    #: Cross-rank happens-before cycle: a set of ranks each blocked on
+    #: a wait only another blocked rank could satisfy.
+    DEADLOCK = "deadlock"
+    #: A wait no peer (and no local op) ever satisfies.
+    UNSATISFIED_WAIT = "unsatisfied_wait"
+    #: Mismatched `barrier_all` participation or count (a ledger or
+    #: wait defect on the global barrier semaphore).
+    BARRIER_MISMATCH = "barrier_mismatch"
+    #: Local access to a remotely-written region with no intervening
+    #: `wait_recv` establishing delivery.
+    RACE_READ_BEFORE_WAIT = "race_read_before_wait"
+    #: Source buffer reused (overwritten) while a `put_nbi` from it is
+    #: still in flight — no `wait_send` drained the transfer first.
+    RACE_SRC_REUSE = "race_src_reuse"
+    #: Two unordered writes (remote/remote or remote/local) to an
+    #: overlapping region.
+    RACE_WRITE_CONFLICT = "race_write_conflict"
+    #: One-sided put where src and dst shapes disagree.
+    SHAPE_MISMATCH = "shape_mismatch"
+    #: One-sided put where src and dst dtypes disagree.
+    DTYPE_MISMATCH = "dtype_mismatch"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One structured defect report from the sanitizer."""
+
+    kind: FindingKind
+    message: str
+    #: Rank coordinates the finding anchors to (None = whole program).
+    rank: Optional[Tuple[int, ...]] = None
+    #: Semaphore name (+index) involved, if any.
+    sem: Optional[str] = None
+    #: Ref name (+index) involved, if any.
+    ref: Optional[str] = None
+    kernel: Optional[str] = None
+
+    def __str__(self) -> str:
+        loc = []
+        if self.kernel:
+            loc.append(self.kernel)
+        if self.rank is not None:
+            loc.append(f"rank{tuple(self.rank)}")
+        where = "@".join(loc)
+        return f"[{self.kind.value}] {where}: {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# Index keys
+# ---------------------------------------------------------------------------
+
+def _norm_one(ix) -> Any:
+    """Normalize one index element to a hashable, comparable token."""
+    if isinstance(ix, slice):
+        if ix == slice(None):
+            return ("all",)
+        start = 0 if ix.start is None else int(ix.start)
+        if ix.stop is None:
+            return ("sl", start, None)
+        return ("sl", start, int(ix.stop))
+    # pl.ds(start, size) -> object with .start/.size in current jax;
+    # duck-type so the shim works across versions.
+    if hasattr(ix, "start") and hasattr(ix, "size"):
+        return ("ds", int(ix.start), int(ix.size))
+    return int(ix)  # concrete scalar (python int / numpy / jax array)
+
+
+def normalize_key(idx) -> Tuple:
+    parts = idx if isinstance(idx, tuple) else (idx,)
+    out = []
+    for p in parts:
+        if p is Ellipsis:
+            break  # trailing "rest of the ref"
+        out.append(_norm_one(p))
+    # Trailing full slices select everything — drop them so `x.at[i]`
+    # and `x.at[i, :]` share a key.
+    while out and out[-1] == ("all",):
+        out.pop()
+    return tuple(out)
+
+
+def _elem_overlaps(a, b) -> bool:
+    if a == ("all",) or b == ("all",):
+        return True
+    a_rng = _as_range(a)
+    b_rng = _as_range(b)
+    if a_rng is None or b_rng is None:
+        return True  # unknown extent: conservative
+    (a0, a1), (b0, b1) = a_rng, b_rng
+    return a0 < b1 and b0 < a1
+
+
+def _as_range(e):
+    if isinstance(e, int):
+        return (e, e + 1)
+    if isinstance(e, tuple):
+        if e[0] == "ds":
+            return (e[1], e[1] + e[2])
+        if e[0] == "sl" and e[2] is not None:
+            return (e[1], e[2])
+    return None
+
+
+def overlaps(key_a: Tuple, key_b: Tuple) -> bool:
+    """True if two normalized index keys can address common elements.
+
+    Keys are positional paths from the same base ref; a shorter key is
+    a superset of any extension of it (whole-ref key () overlaps
+    everything).
+    """
+    for a, b in zip(key_a, key_b):
+        if not _elem_overlaps(a, b):
+            return False
+    return True
+
+
+def _key_str(name: str, key: Tuple) -> str:
+    if not key:
+        return name
+    return f"{name}[{','.join(str(k) for k in key)}]"
+
+
+# ---------------------------------------------------------------------------
+# Abstract refs and semaphores
+# ---------------------------------------------------------------------------
+
+class _AtIndexer:
+    __slots__ = ("_ref",)
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def __getitem__(self, idx):
+        return self._ref._view(idx)
+
+
+class AbstractRef:
+    """Recording stand-in for a Pallas memory ref.
+
+    Supports the access surface the kernels use: `.at[...]` views,
+    `ref[...]` reads (recorded; returns the spec-provided value or
+    zeros), `ref[...] = v` writes (recorded), `.shape` / `.dtype`.
+    """
+
+    def __init__(self, machine: "Machine", name: str, shape: Tuple[int, ...],
+                 dtype, key: Tuple = (), value: Optional[np.ndarray] = None):
+        self._machine = machine
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.key = key
+        self._value = value
+
+    # -- views ----------------------------------------------------------
+    @property
+    def at(self):
+        return _AtIndexer(self)
+
+    def _view(self, idx) -> "AbstractRef":
+        key = normalize_key(idx)
+        shape = list(self.shape)
+        consumed = 0
+        for k in key:
+            if isinstance(k, int):
+                shape.pop(consumed)
+            elif isinstance(k, tuple) and k[0] == "ds":
+                shape[consumed] = k[2]
+                consumed += 1
+            elif isinstance(k, tuple) and k[0] == "sl" and k[2] is not None:
+                shape[consumed] = k[2] - k[1]
+                consumed += 1
+            else:
+                consumed += 1
+        value = None
+        if self._value is not None:
+            try:
+                value = self._value[_concrete_index(idx)]
+            except Exception:
+                value = None
+        return AbstractRef(self._machine, self.name, tuple(shape),
+                           self.dtype, self.key + key, value)
+
+    # -- data access ----------------------------------------------------
+    @staticmethod
+    def _is_whole(idx) -> bool:
+        return idx is Ellipsis or (isinstance(idx, tuple) and idx == ())
+
+    def __getitem__(self, idx):
+        view = self if self._is_whole(idx) else self._view(idx)
+        self._machine.record_read(view)
+        if view._value is not None:
+            return np.asarray(view._value)
+        return np.zeros(view.shape, view.dtype)
+
+    def __setitem__(self, idx, value):
+        view = self if self._is_whole(idx) else self._view(idx)
+        del value
+        self._machine.record_write(view)
+
+    # -- geometry -------------------------------------------------------
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64)) * self.dtype.itemsize
+
+    def describe(self) -> str:
+        return _key_str(self.name, self.key)
+
+    def __repr__(self):
+        return f"AbstractRef({self.describe()}, {self.shape}, {self.dtype})"
+
+
+def _concrete_index(idx):
+    parts = idx if isinstance(idx, tuple) else (idx,)
+    out = []
+    for p in parts:
+        if isinstance(p, slice) or p is Ellipsis:
+            out.append(p)
+        elif hasattr(p, "start") and hasattr(p, "size"):
+            out.append(slice(int(p.start), int(p.start) + int(p.size)))
+        else:
+            out.append(int(p))
+    return tuple(out)
+
+
+class AbstractSem:
+    """Recording stand-in for a (possibly shaped) semaphore ref."""
+
+    def __init__(self, name: str, shape: Tuple[int, ...] = (),
+                 key: Tuple = ()):
+        self.name = name
+        self.shape = tuple(shape)
+        self.key = key
+
+    @property
+    def at(self):
+        return _AtIndexer(self)
+
+    def _view(self, idx) -> "AbstractSem":
+        return AbstractSem(self.name, (), self.key + normalize_key(idx))
+
+    def instance(self) -> Tuple[str, Tuple]:
+        return (self.name, self.key)
+
+    def describe(self) -> str:
+        return _key_str(self.name, self.key)
+
+    def __repr__(self):
+        return f"AbstractSem({self.describe()})"
+
+
+# ---------------------------------------------------------------------------
+# Recorded ops
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Op:
+    """One recorded event in a rank's program-order trace.
+
+    kind:
+      - "put":    one-sided DMA start.  Credits `amount` to the send
+                  sem on `rank` and to the recv sem on `peer`; reads
+                  `ref`+`key` locally, writes `dst_ref`+`dst_key` on
+                  `peer`.
+      - "copy":   local async-copy start.  Credits `amount` to `sem`
+                  on `rank`; reads src, writes dst locally.
+      - "wait":   blocking drain of `amount` from `sem` on `rank`.
+      - "signal": non-blocking credit of `amount` to `sem` on `peer`
+                  (peer == rank for chip-local signals).
+      - "read" / "write": local memory access to `ref`+`key`.
+    """
+
+    kind: str
+    rank: Tuple[int, ...]
+    pos: int
+    sem: Optional[Tuple[str, Tuple]] = None
+    amount: int = 0
+    peer: Optional[Tuple[int, ...]] = None
+    # primary (local) memory operand
+    ref: Optional[str] = None
+    key: Tuple = ()
+    shape: Tuple[int, ...] = ()
+    dtype: Optional[np.dtype] = None
+    # destination memory operand (put/copy)
+    dst_ref: Optional[str] = None
+    dst_key: Tuple = ()
+    dst_shape: Tuple[int, ...] = ()
+    dst_dtype: Optional[np.dtype] = None
+    recv_sem: Optional[Tuple[str, Tuple]] = None
+
+    def describe(self) -> str:
+        if self.kind == "put":
+            return (f"put {_key_str(self.ref, self.key)} -> "
+                    f"rank{self.peer}.{_key_str(self.dst_ref, self.dst_key)}")
+        if self.kind == "copy":
+            return (f"copy {_key_str(self.ref, self.key)} -> "
+                    f"{_key_str(self.dst_ref, self.dst_key)}")
+        if self.kind == "wait":
+            return f"wait {_key_str(*self.sem)} x{self.amount}"
+        if self.kind == "signal":
+            return f"signal rank{self.peer}.{_key_str(*self.sem)} +{self.amount}"
+        return f"{self.kind} {_key_str(self.ref, self.key)}"
+
+
+# ---------------------------------------------------------------------------
+# Recording machine
+# ---------------------------------------------------------------------------
+
+class Machine:
+    """Per-analysis recording state: the abstract N-rank machine.
+
+    One replay of the kernel body per (rank, grid step) appends ops to
+    `traces[rank]`; the checks in `analysis.checks` then consume the
+    assembled cross-rank graph.
+    """
+
+    def __init__(self, axis_names: Tuple[str, ...],
+                 axis_sizes: Tuple[int, ...], grid: Tuple[int, ...] = ()):
+        self.axis_names = tuple(axis_names)
+        self.axis_sizes = tuple(int(s) for s in axis_sizes)
+        self.grid = tuple(int(g) for g in grid)
+        self.traces = {}
+        self.current_rank: Optional[Tuple[int, ...]] = None
+        self.grid_point: Tuple[int, ...] = ()
+        self._scoped_counter = 0
+
+    # -- rank bookkeeping ----------------------------------------------
+    def all_ranks(self):
+        import itertools
+        return list(itertools.product(*[range(s) for s in self.axis_sizes]))
+
+    def set_rank(self, rank: Tuple[int, ...]):
+        self.current_rank = tuple(rank)
+        self.traces.setdefault(self.current_rank, [])
+
+    def axis_index(self, axis: str) -> int:
+        return self.current_rank[self.axis_names.index(axis)]
+
+    def axis_size(self, axis: str) -> int:
+        return self.axis_sizes[self.axis_names.index(axis)]
+
+    def resolve_device_id(self, device_id) -> Tuple[int, ...]:
+        """MESH-dict (the `peer_id` convention) or flat logical id →
+        absolute rank coordinates."""
+        if device_id is None:
+            return self.current_rank
+        if isinstance(device_id, dict):
+            coords = list(self.current_rank)
+            for axis, ix in device_id.items():
+                coords[self.axis_names.index(axis)] = int(ix)
+            return tuple(coords)
+        if isinstance(device_id, (tuple, list)):
+            return tuple(int(i) for i in device_id)
+        flat = int(device_id)
+        coords = []
+        for size in reversed(self.axis_sizes):
+            coords.append(flat % size)
+            flat //= size
+        return tuple(reversed(coords))
+
+    # -- recording ------------------------------------------------------
+    def _append(self, op: Op):
+        trace = self.traces[self.current_rank]
+        op.pos = len(trace)
+        trace.append(op)
+
+    def record_put(self, src: AbstractRef, dst: AbstractRef,
+                   send_sem: AbstractSem, recv_sem: AbstractSem,
+                   device_id) -> Op:
+        peer = self.resolve_device_id(device_id)
+        op = Op(kind="put", rank=self.current_rank, pos=0,
+                sem=send_sem.instance(), amount=src.nbytes, peer=peer,
+                ref=src.name, key=src.key, shape=src.shape,
+                dtype=src.dtype,
+                dst_ref=dst.name, dst_key=dst.key, dst_shape=dst.shape,
+                dst_dtype=dst.dtype, recv_sem=recv_sem.instance())
+        self._append(op)
+        return op
+
+    def record_copy_start(self, src: AbstractRef, dst: AbstractRef,
+                          sem: AbstractSem):
+        self._append(Op(kind="copy", rank=self.current_rank, pos=0,
+                        sem=sem.instance(), amount=src.nbytes,
+                        ref=src.name, key=src.key, shape=src.shape,
+                        dtype=src.dtype, dst_ref=dst.name,
+                        dst_key=dst.key, dst_shape=dst.shape,
+                        dst_dtype=dst.dtype))
+
+    def record_wait(self, sem: AbstractSem, amount: int):
+        self._append(Op(kind="wait", rank=self.current_rank, pos=0,
+                        sem=sem.instance(), amount=int(amount)))
+
+    def record_signal(self, sem: AbstractSem, amount: int, device_id):
+        self._append(Op(kind="signal", rank=self.current_rank, pos=0,
+                        sem=sem.instance(), amount=int(amount),
+                        peer=self.resolve_device_id(device_id)))
+
+    def record_read(self, ref: AbstractRef):
+        self._append(Op(kind="read", rank=self.current_rank, pos=0,
+                        ref=ref.name, key=ref.key, shape=ref.shape,
+                        dtype=ref.dtype))
+
+    def record_write(self, ref: AbstractRef):
+        self._append(Op(kind="write", rank=self.current_rank, pos=0,
+                        ref=ref.name, key=ref.key, shape=ref.shape,
+                        dtype=ref.dtype))
+
+    def fresh_scoped_name(self, base: str) -> str:
+        self._scoped_counter += 1
+        return f"__scoped{self._scoped_counter}_{base}"
+
+    def reset_scoped_names(self):
+        """Reset the scoped-scratch counter at the start of each
+        (rank, grid step) replay: allocation order is deterministic in
+        the kernel body, so per-replay numbering gives every rank the
+        SAME name for the same `run_scoped` scratch — without this, a
+        rank-1 semaphore would never match the name a rank-0 put
+        credits, and correct kernels would report false deadlocks."""
+        self._scoped_counter = 0

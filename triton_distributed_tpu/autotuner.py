@@ -22,7 +22,7 @@ import functools
 import json
 import os
 import time
-from typing import Any, Callable, Iterable, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 

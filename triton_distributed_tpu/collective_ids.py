@@ -53,13 +53,42 @@ GEMM_RS_BWD = 26
 SP_FLASH_DECODE = 27
 
 _FIRST_USER_ID = 64
+#: Mosaic collective ids index a small table of global barrier
+#: semaphores; keep user allocation well inside a conservative bound
+#: so exhaustion is a clear Python error at allocation time, not an
+#: opaque Mosaic failure at compile time.
+_MAX_IDS = 1024
 _user_ids = itertools.count(_FIRST_USER_ID)
+_allocated: set = set()
 
 
 def allocate() -> int:
     """Reserve a fresh collective id for a user kernel (never collides
-    with the built-ins above or earlier allocations)."""
-    return next(_user_ids)
+    with the built-ins above or earlier allocations).
+
+    Raises RuntimeError on id-space exhaustion and guards against the
+    two silent-corruption paths: a duplicate grant (the registry
+    handing out an id twice) and a user id colliding with a built-in —
+    either would make two concurrent kernels share a barrier
+    semaphore and cross-talk.
+    """
+    cid = next(_user_ids)
+    if cid >= _MAX_IDS:
+        raise RuntimeError(
+            f"collective-id space exhausted: user ids run from "
+            f"{_FIRST_USER_ID} to {_MAX_IDS - 1} and all are taken. "
+            f"Reuse ids across sequential kernels (only CONCURRENT "
+            f"kernels need distinct ids) instead of allocating per "
+            f"launch.")
+    builtin = set(builtin_ids().values())
+    if cid in _allocated or cid in builtin:
+        raise RuntimeError(
+            f"collective id {cid} already in use "
+            f"({'built-in' if cid in builtin else 'allocated earlier'}): "
+            f"two concurrent kernels sharing a barrier semaphore "
+            f"silently cross-talk")
+    _allocated.add(cid)
+    return cid
 
 
 def builtin_ids() -> dict:

@@ -303,3 +303,65 @@ def all_gather(x, ctx: AllGatherContext):
         interpret=interpret,
     )(x)
     return unpad_lanes(out.reshape(world * m, n), n_orig)
+
+
+# ---------------------------------------------------------------------------
+# Comm-sanitizer registration (analysis.registry; docs/analysis.md).
+# Specs mirror the pallas_call sites above — a drifted spec fails the
+# `python -m triton_distributed_tpu.analysis` sweep loudly.
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis.registry import (  # noqa: E402
+    KernelSpec,
+    RefSpec,
+    SemSpec,
+    register_comm_kernel,
+    single_axis,
+)
+
+
+@register_comm_kernel("allgather.ring", meshes=({"tp": 2}, {"tp": 4}))
+def _analysis_ring(axis_sizes):
+    axis, world = single_axis(axis_sizes)
+    m, n = 8, 128
+    return KernelSpec(
+        name="allgather.ring",
+        body=functools.partial(_ring_ag_kernel, axis, world, None, False),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (m, n), jnp.float32),
+              RefSpec("o", (world, m, n), jnp.float32)],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv", (world,))],
+    )
+
+
+@register_comm_kernel("allgather.push_all", meshes=({"tp": 2}, {"tp": 4}))
+def _analysis_push_all(axis_sizes):
+    axis, world = single_axis(axis_sizes)
+    m, n = 8, 128
+    return KernelSpec(
+        name="allgather.push_all",
+        body=functools.partial(_push_all_ag_kernel, axis, world, None,
+                               False),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (m, n), jnp.float32),
+              RefSpec("o", (world, m, n), jnp.float32)],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv", (world,))],
+    )
+
+
+@register_comm_kernel("allgather.bidir_ring", meshes=({"tp": 4},))
+def _analysis_bidir(axis_sizes):
+    axis, world = single_axis(axis_sizes)
+    if world <= 2:
+        raise ValueError("bidir ring needs world > 2")
+    m, n = 8, 128
+    return KernelSpec(
+        name="allgather.bidir_ring",
+        body=functools.partial(_bidir_ring_ag_kernel, axis, world, None,
+                               False),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (2, m // 2, n), jnp.float32),
+              RefSpec("o", (world, 2, m // 2, n), jnp.float32)],
+        sems=[SemSpec("local"), SemSpec("send", (2,)),
+              SemSpec("recv", (world, 2))],
+    )

@@ -578,3 +578,66 @@ def ag_gemm_ppermute(a_shard, b, axis: str):
     for src, val in outs:
         full = jax.lax.dynamic_update_slice(full, val, (src * m, 0))
     return full.astype(a_shard.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Comm-sanitizer registration (analysis.registry; docs/analysis.md).
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis.registry import (  # noqa: E402
+    KernelSpec,
+    RefSpec,
+    SemSpec,
+    register_comm_kernel,
+    single_axis,
+)
+
+
+def _ag_gemm_spec(axis_sizes, method: str):
+    axis, world = single_axis(axis_sizes)
+    m, n, k = 8, 128, 128
+    ctx = AllGatherGEMMContext(axis=axis, world_size=world)
+    kernel = (_ag_gemm_ll_kernel if method == "ll"
+              else _ag_gemm_fused_kernel)
+    return KernelSpec(
+        name=f"ag_gemm.{method}",
+        body=functools.partial(kernel, ctx, m, n, k),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (m, k), jnp.bfloat16),
+              RefSpec("b", (k, n), jnp.bfloat16),
+              RefSpec("gathered", (world, m, k), jnp.bfloat16),
+              RefSpec("out", (world, m, n), jnp.bfloat16)],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv", (world,))],
+    )
+
+
+@register_comm_kernel("ag_gemm.fused", meshes=({"tp": 2}, {"tp": 4}))
+def _analysis_ag_gemm_fused(axis_sizes):
+    return _ag_gemm_spec(axis_sizes, "fused")
+
+
+@register_comm_kernel("ag_gemm.ll", meshes=({"tp": 2}, {"tp": 4}))
+def _analysis_ag_gemm_ll(axis_sizes):
+    return _ag_gemm_spec(axis_sizes, "ll")
+
+
+@register_comm_kernel("ag_gemm.w8a8", meshes=({"tp": 4},))
+def _analysis_ag_gemm_w8a8(axis_sizes):
+    from triton_distributed_tpu.kernels.quantized import Int8MatmulConfig
+
+    axis, world = single_axis(axis_sizes)
+    m, n, k = 8, 128, 128
+    ctx = AllGatherGEMMContext(axis=axis, world_size=world)
+    cfg = Int8MatmulConfig().resolve(m, n, k)
+    return KernelSpec(
+        name="ag_gemm.w8a8",
+        body=functools.partial(_ag_gemm_w8a8_kernel, ctx, cfg, m, n, k),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (m, k), jnp.int8),
+              RefSpec("b", (k, n), jnp.int8),
+              RefSpec("sa", (world, m, 1), jnp.float32),
+              RefSpec("sb", (1, n), jnp.float32),
+              RefSpec("gathered", (world, m, k), jnp.int8),
+              RefSpec("out", (world, m, n), jnp.bfloat16)],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv", (world,))],
+    )

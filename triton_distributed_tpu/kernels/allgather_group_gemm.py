@@ -301,3 +301,34 @@ def gated_silu(gate_up):
     gate_up: (..., 2*n) → (..., n)."""
     gate, up = jnp.split(gate_up, 2, axis=-1)
     return jax.nn.silu(gate.astype(jnp.float32)).astype(gate.dtype) * up
+
+
+# ---------------------------------------------------------------------------
+# Comm-sanitizer registration (analysis.registry; docs/analysis.md).
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis.registry import (  # noqa: E402
+    KernelSpec,
+    RefSpec,
+    SemSpec,
+    register_comm_kernel,
+    single_axis,
+)
+
+
+@register_comm_kernel("ag_group_gemm.ring", meshes=({"ep": 2}, {"ep": 4}))
+def _analysis_ag_group_gemm(axis_sizes):
+    axis, world = single_axis(axis_sizes)
+    e, cap, n, k = 4, 8, 128, 128
+    ctx = AGGroupGEMMContext(axis=axis, world_size=world, num_experts=e)
+    return KernelSpec(
+        name="ag_group_gemm.ring",
+        body=functools.partial(_ag_group_gemm_kernel, ctx, cap, n, k,
+                               False),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (e, cap, k), jnp.bfloat16),
+              RefSpec("b", (e, k, n), jnp.bfloat16),
+              RefSpec("gathered", (world, e, cap, k), jnp.bfloat16),
+              RefSpec("out", (world, e, cap, n), jnp.bfloat16)],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv", (world,))],
+    )

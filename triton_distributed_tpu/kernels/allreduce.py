@@ -383,3 +383,69 @@ def all_reduce(x, ctx: AllReduceContext):
         interpret=interpret,
     )(x)
     return unpad_lanes(out, n_orig)
+
+
+# ---------------------------------------------------------------------------
+# Comm-sanitizer registration (analysis.registry; docs/analysis.md).
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis.registry import (  # noqa: E402
+    KernelSpec,
+    RefSpec,
+    SemSpec,
+    register_comm_kernel,
+    single_axis,
+)
+
+
+@register_comm_kernel("allreduce.one_shot", meshes=({"tp": 2}, {"tp": 4}))
+def _analysis_one_shot(axis_sizes):
+    axis, world = single_axis(axis_sizes)
+    m, n = 8, 128
+    ctx = AllReduceContext(axis=axis, world_size=world)
+    return KernelSpec(
+        name="allreduce.one_shot",
+        body=functools.partial(_one_shot_kernel, ctx, m, n),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (m, n), jnp.float32),
+              RefSpec("o", (m, n), jnp.float32),
+              RefSpec("rbuf", (world, m, n), jnp.float32)],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv", (world,))],
+    )
+
+
+@register_comm_kernel("allreduce.two_shot", meshes=({"tp": 2}, {"tp": 4}))
+def _analysis_two_shot(axis_sizes):
+    axis, world = single_axis(axis_sizes)
+    mc, n = 8, 128
+    ctx = AllReduceContext(axis=axis, world_size=world)
+    return KernelSpec(
+        name="allreduce.two_shot",
+        body=functools.partial(_two_shot_kernel, ctx, mc, n),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (world, mc, n), jnp.float32),
+              RefSpec("o", (world, mc, n), jnp.float32),
+              RefSpec("rbuf", (world, mc, n), jnp.float32)],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("bcast_send"),
+              SemSpec("recv", (world,)), SemSpec("bcast", (world,))],
+    )
+
+
+@register_comm_kernel("allreduce.chain", meshes=({"tp": 2}, {"tp": 4}))
+def _analysis_chain(axis_sizes):
+    axis, world = single_axis(axis_sizes)
+    if world < 2:
+        raise ValueError("chain needs world >= 2")
+    m, n = 8, 128
+    P = _chain_chunks(m)
+    mc = m // P
+    ctx = AllReduceContext(axis=axis, world_size=world)
+    return KernelSpec(
+        name="allreduce.chain",
+        body=functools.partial(_chain_kernel, ctx, P, mc, n),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (P, mc, n), jnp.float32),
+              RefSpec("o", (P, mc, n), jnp.float32),
+              RefSpec("staging", (P, mc, n), jnp.float32)],
+        sems=[SemSpec("send"), SemSpec("red", (P,)), SemSpec("bcast", (P,))],
+    )

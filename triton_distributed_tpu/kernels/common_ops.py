@@ -86,3 +86,49 @@ def broadcast(x, root, axis: str, world_size: int, *,
             has_side_effects=True, collective_id=collective_id),
         interpret=default_interpret(interpret),
     )(x, root_arr)
+
+
+# ---------------------------------------------------------------------------
+# Comm-sanitizer registration (analysis.registry; docs/analysis.md).
+# ---------------------------------------------------------------------------
+
+import numpy as _np  # noqa: E402
+
+from triton_distributed_tpu.analysis.registry import (  # noqa: E402
+    KernelSpec,
+    RefSpec,
+    SemSpec,
+    register_comm_kernel,
+    single_axis,
+)
+
+
+@register_comm_kernel("common_ops.barrier", meshes=({"tp": 2}, {"tp": 4}))
+def _analysis_barrier(axis_sizes):
+    axis, _ = single_axis(axis_sizes)
+    m, n = 8, 128
+    return KernelSpec(
+        name="common_ops.barrier",
+        body=functools.partial(_barrier_kernel, axis),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (m, n), jnp.float32),
+              RefSpec("o", (m, n), jnp.float32)],
+        sems=[SemSpec("sem")],
+    )
+
+
+@register_comm_kernel("common_ops.broadcast", meshes=({"tp": 2}, {"tp": 4}))
+def _analysis_broadcast(axis_sizes):
+    axis, world = single_axis(axis_sizes)
+    m, n = 8, 128
+    return KernelSpec(
+        name="common_ops.broadcast",
+        body=functools.partial(_broadcast_kernel, axis, world),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (m, n), jnp.float32),
+              # The broadcast root steers the comm pattern: analyze
+              # with a concrete root (0) in the SMEM scalar.
+              RefSpec("root", (1,), _np.int32, value=_np.zeros(1, _np.int32)),
+              RefSpec("o", (m, n), jnp.float32)],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv")],
+    )

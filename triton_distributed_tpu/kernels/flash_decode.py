@@ -299,3 +299,40 @@ def sp_flash_decode(q, k_shard, v_shard, kv_len_local, axis: str, *,
     outs = gathered[..., :d]
     lses = gathered[..., d]
     return combine_partials(outs, lses).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Comm-sanitizer registration (analysis.registry; docs/analysis.md).
+# The decode kernel itself is pure compute; the distributed step is a
+# one-shot push allgather of the packed (out, lse) payload under the
+# FLASH_DECODE_AG collective id — register that footprint (the padded
+# f32 payload row the composition actually ships).
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis.registry import (  # noqa: E402
+    KernelSpec,
+    RefSpec,
+    SemSpec,
+    register_comm_kernel,
+    single_axis,
+)
+
+
+@register_comm_kernel("flash_decode.partials_ag",
+                      meshes=({"sp": 2}, {"sp": 4}))
+def _analysis_flash_decode_ag(axis_sizes):
+    from triton_distributed_tpu.kernels.allgather import (
+        _push_all_ag_kernel)
+
+    axis, world = single_axis(axis_sizes)
+    b, h, d = 1, 2, 64
+    dp = d + 1 + ((-(d + 1)) % 128)   # lane-padded out+lse row
+    return KernelSpec(
+        name="flash_decode.partials_ag",
+        body=functools.partial(_push_all_ag_kernel, axis, world, None,
+                               False),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("payload", (b * h, dp), jnp.float32),
+              RefSpec("gathered", (world, b * h, dp), jnp.float32)],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv", (world,))],
+    )

@@ -381,3 +381,52 @@ def gemm_rs_ppermute(a, b, axis: str):
         acc = acc + jnp.dot(chunk_of(c), b,
                             preferred_element_type=jnp.float32)
     return acc.astype(a.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Comm-sanitizer registration (analysis.registry; docs/analysis.md).
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis.registry import (  # noqa: E402
+    KernelSpec,
+    RefSpec,
+    SemSpec,
+    register_comm_kernel,
+    single_axis,
+)
+
+
+@register_comm_kernel("gemm_rs.fused", meshes=({"tp": 2}, {"tp": 4}))
+def _analysis_gemm_rs_fused(axis_sizes):
+    axis, world = single_axis(axis_sizes)
+    mc, n, k = 8, 128, 128
+    ctx = GEMMReduceScatterContext(axis=axis, world_size=world)
+    return KernelSpec(
+        name="gemm_rs.fused",
+        body=functools.partial(_gemm_rs_fused_kernel, ctx, mc, n, k),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("a", (world, mc, k), jnp.bfloat16),
+              RefSpec("b", (k, n), jnp.bfloat16),
+              RefSpec("out", (mc, n), jnp.bfloat16),
+              RefSpec("rbuf", (world, mc, n), jnp.bfloat16),
+              RefSpec("stage", (2, mc, n), jnp.bfloat16)],
+        sems=[SemSpec("send", (2,)), SemSpec("recv", (world,))],
+    )
+
+
+@register_comm_kernel("gemm_rs.ll", meshes=({"tp": 2}, {"tp": 4}))
+def _analysis_gemm_rs_ll(axis_sizes):
+    axis, world = single_axis(axis_sizes)
+    mc, n, k = 8, 128, 128
+    ctx = GEMMReduceScatterContext(axis=axis, world_size=world)
+    return KernelSpec(
+        name="gemm_rs.ll",
+        body=functools.partial(_gemm_rs_ll_kernel, ctx, mc, n, k),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("a", (world, mc, k), jnp.bfloat16),
+              RefSpec("b", (k, n), jnp.bfloat16),
+              RefSpec("out", (mc, n), jnp.bfloat16),
+              RefSpec("rbuf", (world, mc, n), jnp.bfloat16),
+              RefSpec("cstage", (world, mc, n), jnp.bfloat16)],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv", (world,))],
+    )

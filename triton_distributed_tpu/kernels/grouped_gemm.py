@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from triton_distributed_tpu.kernels.matmul import MatmulConfig, emit_matmul
+from triton_distributed_tpu.kernels.matmul import MatmulConfig
 from triton_distributed_tpu.utils.platform import (
     SCOPED_VMEM_LIMIT,
     default_interpret,

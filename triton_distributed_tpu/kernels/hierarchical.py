@@ -300,3 +300,59 @@ def hierarchical_all_to_all(send_tokens, send_counts,
     if has_scale:
         return recv_tokens, recv_counts, to_global(rs, ns)
     return recv_tokens, recv_counts
+
+
+# ---------------------------------------------------------------------------
+# Comm-sanitizer registration (analysis.registry; docs/analysis.md).
+# The hierarchical ops compose XLA DCN collectives (outside the
+# sanitizer's scope — XLA verifies its own collectives) around the ICI
+# Pallas stage; what needs pinning is that ICI stage under the
+# HIERARCHICAL collective id and the ici-axis mesh.
+# ---------------------------------------------------------------------------
+
+import functools as _functools  # noqa: E402
+
+from triton_distributed_tpu.analysis.registry import (  # noqa: E402
+    KernelSpec,
+    RefSpec,
+    SemSpec,
+    register_comm_kernel,
+    single_axis,
+)
+
+
+@register_comm_kernel("hierarchical.ici_allgather",
+                      meshes=({"ici": 2}, {"ici": 4}))
+def _analysis_hier_ag(axis_sizes):
+    from triton_distributed_tpu.kernels.allgather import _ring_ag_kernel
+
+    axis, world = single_axis(axis_sizes)
+    dcn, m, n = 2, 8, 128   # ICI stage carries dcn*m rows per device
+    return KernelSpec(
+        name="hierarchical.ici_allgather",
+        body=_functools.partial(_ring_ag_kernel, axis, world, None, False),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (dcn * m, n), jnp.float32),
+              RefSpec("o", (world, dcn * m, n), jnp.float32)],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv", (world,))],
+    )
+
+
+@register_comm_kernel("hierarchical.ici_reduce_scatter",
+                      meshes=({"ici": 2}, {"ici": 4}))
+def _analysis_hier_rs(axis_sizes):
+    from triton_distributed_tpu.kernels.reduce_scatter import (
+        _scatter_reduce_kernel)
+
+    axis, world = single_axis(axis_sizes)
+    m, n = 8, 128
+    ctx = ReduceScatterContext(axis=axis, world_size=world)
+    return KernelSpec(
+        name="hierarchical.ici_reduce_scatter",
+        body=_functools.partial(_scatter_reduce_kernel, ctx, m, n),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (world, m, n), jnp.float32),
+              RefSpec("out", (m, n), jnp.float32),
+              RefSpec("rbuf", (world, m, n), jnp.float32)],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv", (world,))],
+    )

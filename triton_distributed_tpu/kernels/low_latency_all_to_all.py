@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -237,3 +237,59 @@ def all_to_all_post_process(recv_tokens, recv_counts, cap: int):
         jnp.where(valid, dest, world * cap)
     ].set(flat, mode="drop")
     return out, counts.sum()
+
+
+# ---------------------------------------------------------------------------
+# Comm-sanitizer registration (analysis.registry; docs/analysis.md).
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis.registry import (  # noqa: E402
+    KernelSpec,
+    RefSpec,
+    SemSpec,
+    register_comm_kernel,
+    single_axis,
+)
+
+
+def _a2a_spec(axis_sizes, has_scale: bool):
+    axis, world = single_axis(axis_sizes)
+    cap, hidden, ns = 8, 128, 128
+    ctx = AllToAllContext(axis=axis, world_size=world,
+                          max_tokens_per_rank=cap, hidden=hidden)
+    refs = [RefSpec("send", (world, cap, hidden), jnp.bfloat16),
+            RefSpec("counts", (world, 128), jnp.int32)]
+    if has_scale:
+        refs.append(RefSpec("scale", (world, cap, ns), jnp.float32))
+    refs += [RefSpec("recv", (world, cap, hidden), jnp.bfloat16),
+             RefSpec("rcounts", (world, 128), jnp.int32)]
+    if has_scale:
+        refs.append(RefSpec("rscale", (world, cap, ns), jnp.float32))
+
+    if has_scale:
+        def body(send, counts, scale, recv, rcounts, rscale, *sems):
+            _a2a_kernel(ctx, True, send, counts, scale, recv, rcounts,
+                        rscale, *sems)
+    else:
+        def body(send, counts, recv, rcounts, *sems):
+            _a2a_kernel(ctx, False, send, counts, None, recv, rcounts,
+                        None, *sems)
+
+    return KernelSpec(
+        name=f"all_to_all.{'scaled' if has_scale else 'plain'}",
+        body=body,
+        axis_sizes=axis_sizes,
+        refs=refs,
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("tok", (world,)),
+              SemSpec("cnt", (world,)), SemSpec("scl", (world,))],
+    )
+
+
+@register_comm_kernel("all_to_all.plain", meshes=({"ep": 2}, {"ep": 4}))
+def _analysis_a2a(axis_sizes):
+    return _a2a_spec(axis_sizes, has_scale=False)
+
+
+@register_comm_kernel("all_to_all.scaled", meshes=({"ep": 4},))
+def _analysis_a2a_scaled(axis_sizes):
+    return _a2a_spec(axis_sizes, has_scale=True)

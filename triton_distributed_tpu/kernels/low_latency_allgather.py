@@ -26,7 +26,7 @@ NUMA-aware 2D split maps to ICI-slice × DCN).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -95,3 +95,39 @@ def fast_allgather_packed(tensors: Sequence[jnp.ndarray],
         outs.append(flat.reshape((world * t.shape[0],) + t.shape[1:]))
         off += size
     return outs
+
+
+# ---------------------------------------------------------------------------
+# Comm-sanitizer registration (analysis.registry; docs/analysis.md).
+# `fast_allgather` is the one-shot push kernel under the LL_ALLGATHER
+# collective id — register it as its own sweep entry so the id's
+# communication footprint is pinned even though the body is shared.
+# ---------------------------------------------------------------------------
+
+import functools as _functools  # noqa: E402
+
+from triton_distributed_tpu.analysis.registry import (  # noqa: E402
+    KernelSpec,
+    RefSpec,
+    SemSpec,
+    register_comm_kernel,
+    single_axis,
+)
+
+
+@register_comm_kernel("ll_allgather.push", meshes=({"tp": 2}, {"tp": 4}))
+def _analysis_ll_push(axis_sizes):
+    from triton_distributed_tpu.kernels.allgather import (
+        _push_all_ag_kernel)
+
+    axis, world = single_axis(axis_sizes)
+    m, n = 1, 128   # decode-path payloads: a handful of rows
+    return KernelSpec(
+        name="ll_allgather.push",
+        body=_functools.partial(_push_all_ag_kernel, axis, world, None,
+                                False),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (m, n), jnp.bfloat16),
+              RefSpec("o", (world, m, n), jnp.bfloat16)],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv", (world,))],
+    )

@@ -437,3 +437,85 @@ def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
         interpret=default_interpret(ctx.interpret),
     )(*operands)
     return res[0]
+
+
+# ---------------------------------------------------------------------------
+# Comm-sanitizer registration (analysis.registry; docs/analysis.md).
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis.registry import (  # noqa: E402
+    KernelSpec,
+    RefSpec,
+    SemSpec,
+    register_comm_kernel,
+    single_axis,
+)
+
+
+def _moe_rs_common(axis_sizes):
+    axis, world = single_axis(axis_sizes)
+    e, cap, mc, n, k = 4, 8, 8, 128, 128
+    ctx = MoEReduceRSContext(axis=axis, world_size=world,
+                             num_experts=e, topk=2)
+    return ctx, world, e, cap, mc, n, k
+
+
+@register_comm_kernel("moe_reduce_rs.fused", meshes=({"ep": 2}, {"ep": 4}))
+def _analysis_moe_fused(axis_sizes):
+    ctx, world, e, cap, mc, n, k = _moe_rs_common(axis_sizes)
+    return KernelSpec(
+        name="moe_reduce_rs.fused",
+        body=functools.partial(_moe_rs_fused_kernel, ctx, e, cap, mc, n,
+                               k, False),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("buckets", (world, e, cap, k), jnp.bfloat16),
+              RefSpec("w", (e, k, n), jnp.bfloat16),
+              RefSpec("cmat", (world, e, mc, cap), jnp.bfloat16),
+              RefSpec("out", (mc, n), jnp.bfloat16),
+              RefSpec("rbuf", (world, mc, n), jnp.bfloat16),
+              RefSpec("acc", (mc, n), jnp.float32),
+              RefSpec("obf", (2, mc, n), jnp.bfloat16)],
+        sems=[SemSpec("send", (2,)), SemSpec("recv", (world,))],
+    )
+
+
+@register_comm_kernel("moe_reduce_rs.two_phase", meshes=({"ep": 4},))
+def _analysis_moe_2p(axis_sizes):
+    ctx, world, e, cap, mc, n, k = _moe_rs_common(axis_sizes)
+    return KernelSpec(
+        name="moe_reduce_rs.two_phase",
+        body=functools.partial(_moe_rs_fused_kernel_2p, ctx, e, cap, mc,
+                               n, k, False),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("buckets", (world, e, cap, k), jnp.bfloat16),
+              RefSpec("w", (e, k, n), jnp.bfloat16),
+              RefSpec("cmat", (world, e, mc, cap), jnp.bfloat16),
+              RefSpec("out", (mc, n), jnp.bfloat16),
+              RefSpec("rbuf", (world, mc, n), jnp.bfloat16),
+              RefSpec("gstage", (e, cap, n), jnp.bfloat16),
+              RefSpec("cstage", (2, mc, n), jnp.bfloat16)],
+        sems=[SemSpec("send", (2,)), SemSpec("recv", (world,))],
+    )
+
+
+@register_comm_kernel("moe_reduce_rs.w8a8", meshes=({"ep": 4},))
+def _analysis_moe_q(axis_sizes):
+    from triton_distributed_tpu.kernels.grouped_gemm import SCALE_LANES
+
+    ctx, world, e, cap, mc, n, k = _moe_rs_common(axis_sizes)
+    return KernelSpec(
+        name="moe_reduce_rs.w8a8",
+        body=functools.partial(_moe_rs_fused_kernel_q, ctx, e, cap, mc,
+                               n, k, False),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("buckets", (world, e, cap, k), jnp.int8),
+              RefSpec("w", (e, k, n), jnp.int8),
+              RefSpec("sa", (world, e, cap, SCALE_LANES), jnp.float32),
+              RefSpec("sw", (e, 1, n), jnp.float32),
+              RefSpec("cmat", (world, e, mc, cap), jnp.bfloat16),
+              RefSpec("out", (mc, n), jnp.bfloat16),
+              RefSpec("rbuf", (world, mc, n), jnp.bfloat16),
+              RefSpec("gstage", (e, cap, n), jnp.bfloat16),
+              RefSpec("cstage", (2, mc, n), jnp.bfloat16)],
+        sems=[SemSpec("send", (2,)), SemSpec("recv", (world,))],
+    )

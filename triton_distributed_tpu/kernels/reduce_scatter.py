@@ -321,3 +321,53 @@ def reduce_scatter(x, ctx: ReduceScatterContext):
         interpret=interpret,
     )(xr)
     return unpad_lanes(out, n_orig)
+
+
+# ---------------------------------------------------------------------------
+# Comm-sanitizer registration (analysis.registry; docs/analysis.md).
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis.registry import (  # noqa: E402
+    KernelSpec,
+    RefSpec,
+    SemSpec,
+    register_comm_kernel,
+    single_axis,
+)
+
+
+@register_comm_kernel("reduce_scatter.scatter_reduce",
+                      meshes=({"tp": 2}, {"tp": 4}))
+def _analysis_scatter_reduce(axis_sizes):
+    axis, world = single_axis(axis_sizes)
+    m, n = 8, 128
+    ctx = ReduceScatterContext(axis=axis, world_size=world)
+    return KernelSpec(
+        name="reduce_scatter.scatter_reduce",
+        body=functools.partial(_scatter_reduce_kernel, ctx, m, n),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (world, m, n), jnp.float32),
+              RefSpec("out", (m, n), jnp.float32),
+              RefSpec("rbuf", (world, m, n), jnp.float32)],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv", (world,))],
+    )
+
+
+@register_comm_kernel("reduce_scatter.ring", meshes=({"tp": 2}, {"tp": 4}))
+def _analysis_ring_rs(axis_sizes):
+    axis, world = single_axis(axis_sizes)
+    if world < 2:
+        raise ValueError("ring needs world >= 2")
+    m, n = 8, 128
+    ctx = ReduceScatterContext(axis=axis, world_size=world)
+    return KernelSpec(
+        name="reduce_scatter.ring",
+        body=functools.partial(_ring_rs_kernel, ctx, m, n),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (world, m, n), jnp.float32),
+              RefSpec("out", (m, n), jnp.float32),
+              RefSpec("staging", (2, m, n), jnp.float32),
+              RefSpec("accum", (2, m, n), jnp.float32)],
+        sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv", (2,)),
+              SemSpec("ack")],
+    )

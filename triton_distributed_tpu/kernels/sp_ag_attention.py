@@ -729,3 +729,52 @@ def sp_ag_attention_gather(q, k_shard, v_shard, axis: str, *,
     return flash_attention(q, k_full, v_full, causal=True, scale=scale,
                            kv_offset=my * s_loc, block_q=block_q,
                            block_k=block_k, interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# Comm-sanitizer registration (analysis.registry; docs/analysis.md).
+# ---------------------------------------------------------------------------
+
+import numpy as _np  # noqa: E402
+
+from triton_distributed_tpu.analysis.registry import (  # noqa: E402
+    KernelSpec,
+    RefSpec,
+    SemSpec,
+    register_comm_kernel,
+    single_axis,
+)
+
+
+@register_comm_kernel("sp_ag_attention.fused", meshes=({"sp": 2}, {"sp": 4}))
+def _analysis_sp_ag_fused(axis_sizes):
+    axis, world = single_axis(axis_sizes)
+    b, h, hkv, s_loc, d = 1, 2, 2, 16, 64
+    block_q = block_k = 16
+    lrows = _lse_rows(s_loc, min(block_q, s_loc))
+
+    def qoff(coords):
+        # Per-rank global query offset — rank-dependent SMEM scalar.
+        return _np.asarray([coords[axis] * s_loc], _np.int32)
+
+    return KernelSpec(
+        name="sp_ag_attention.fused",
+        body=functools.partial(_sp_ag_attn_fused_kernel, axis, world,
+                               d ** -0.5, block_q, block_k, h // hkv,
+                               b, h, hkv, s_loc, d),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("qoff", (1,), _np.int32, value=qoff),
+              RefSpec("base", (1,), _np.int32,
+                      value=_np.zeros(1, _np.int32)),
+              RefSpec("q", (b, h, s_loc, d), jnp.bfloat16),
+              RefSpec("k", (b, hkv, s_loc, d), jnp.bfloat16),
+              RefSpec("v", (b, hkv, s_loc, d), jnp.bfloat16),
+              RefSpec("o", (b, h, s_loc, d), jnp.bfloat16),
+              RefSpec("lse", (b, h, lrows, LSE_W), jnp.float32),
+              RefSpec("kbuf", (world, b, hkv, s_loc, d), jnp.bfloat16),
+              RefSpec("vbuf", (world, b, hkv, s_loc, d), jnp.bfloat16),
+              RefSpec("sto", (2, b, h, s_loc, d), jnp.float32),
+              RefSpec("stl", (2, b, h, lrows, LSE_W), jnp.float32)],
+        sems=[SemSpec("local"), SemSpec("ksend"), SemSpec("vsend"),
+              SemSpec("krecv", (world,)), SemSpec("vrecv", (world,))],
+    )

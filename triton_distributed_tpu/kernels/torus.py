@@ -823,3 +823,94 @@ def all_reduce_torus(x, ctx: TorusContext):
     chunk = reduce_scatter_torus(xp, ctx)          # (mp / world, n)
     full = all_gather_torus(chunk, ag_ctx)         # (mp, n)
     return full[:m] if pad else full
+
+
+# ---------------------------------------------------------------------------
+# Comm-sanitizer registration (analysis.registry; docs/analysis.md).
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis.registry import (  # noqa: E402
+    KernelSpec,
+    RefSpec,
+    SemSpec,
+    register_comm_kernel,
+)
+
+
+def _torus_ctx(axis_sizes):
+    if len(axis_sizes) < 2:
+        raise ValueError("torus kernels need a multi-axis mesh")
+    axes = tuple(axis_sizes)
+    sizes = tuple(axis_sizes[a] for a in axes)
+    ctx = TorusContext(axes=axes, sizes=sizes)
+    return ctx, axes, sizes
+
+
+_TORUS_MESHES = ({"x": 2, "y": 2}, {"x": 2, "y": 4},
+                 {"x": 2, "y": 2, "z": 2})
+
+
+@register_comm_kernel("torus.allgather", meshes=_TORUS_MESHES)
+def _analysis_torus_ag(axis_sizes):
+    ctx, axes, sizes = _torus_ctx(axis_sizes)
+    nd = len(sizes)
+    L = 2 * nd
+    ms, n = 8, 128
+    maxw = max(sizes)
+    return KernelSpec(
+        name="torus.allgather",
+        body=functools.partial(_torus_ag_kernel, ctx, axes, sizes),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (L, ms, n), jnp.float32),
+              RefSpec("o", sizes + (L, ms, n), jnp.float32)],
+        sems=[SemSpec("local", (L,)), SemSpec("send", (L,)),
+              SemSpec("phase", (nd, L, maxw))],
+    )
+
+
+@register_comm_kernel("torus.reduce_scatter", meshes=_TORUS_MESHES)
+def _analysis_torus_rs(axis_sizes):
+    ctx, axes, sizes = _torus_ctx(axis_sizes)
+    nd = len(sizes)
+    L = 2 * nd
+    ms, n = 8, 128
+    maxw = max(sizes)
+    refs = [RefSpec("x", sizes + (L, ms, n), jnp.float32),
+            RefSpec("out", (L, ms, n), jnp.float32)]
+    # Per stage t: the (s_t, a_t) staging pair, plus mid_t for t<nd-1
+    # (mirrors the out_shape list in `reduce_scatter_torus`).
+    for t in range(nd):
+        slab = (maxw,) * (nd - 1 - t) + (ms, n)
+        refs.append(RefSpec(f"s{t}", (L, 2) + slab, jnp.float32))
+        refs.append(RefSpec(f"a{t}", (L, 2) + slab, jnp.float32))
+        if t < nd - 1:
+            refs.append(RefSpec(f"mid{t}", (L,) + slab, jnp.float32))
+    return KernelSpec(
+        name="torus.reduce_scatter",
+        body=functools.partial(_torus_rs_kernel, ctx, axes, sizes, ms, n),
+        axis_sizes=axis_sizes,
+        refs=refs,
+        sems=[SemSpec("send", (L,)), SemSpec("stage", (nd, L, 2)),
+              SemSpec("ack", (nd * L,))],
+    )
+
+
+@register_comm_kernel("torus.ag_gemm", meshes=({"x": 2, "y": 2},))
+def _analysis_torus_ag_gemm(axis_sizes):
+    ctx, axes, sizes = _torus_ctx(axis_sizes)
+    nd = len(sizes)
+    L = 2 * nd
+    ms, n, k = 8, 128, 128
+    maxw = max(sizes)
+    return KernelSpec(
+        name="torus.ag_gemm",
+        body=functools.partial(_ag_gemm_torus_kernel, ctx, axes, sizes,
+                               ms, n, k),
+        axis_sizes=axis_sizes,
+        refs=[RefSpec("x", (L, ms, k), jnp.bfloat16),
+              RefSpec("b", (k, n), jnp.bfloat16),
+              RefSpec("g", sizes + (L, ms, k), jnp.bfloat16),
+              RefSpec("out", sizes + (L, ms, n), jnp.bfloat16)],
+        sems=[SemSpec("local", (L,)), SemSpec("send", (L,)),
+              SemSpec("phase", (nd, L, maxw))],
+    )

@@ -21,7 +21,6 @@ allgather, put-based all_to_all), so no capability is lost.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -17,7 +17,7 @@ reverses the exchange and applies the topk-weighted sum.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -15,7 +15,7 @@ standard contiguous layout each rank owns rows
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -14,7 +14,7 @@ reference's `dist_triton_fwd`.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

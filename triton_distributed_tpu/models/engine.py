@@ -12,7 +12,6 @@ zero Python/dispatch overhead — the XLA equivalent of graph replay.
 
 from __future__ import annotations
 
-import functools
 import time
 from typing import Optional
 
@@ -21,7 +20,6 @@ import jax.numpy as jnp
 
 from triton_distributed_tpu.models.qwen import Qwen3
 from triton_distributed_tpu.models.utils import sample_token
-from triton_distributed_tpu.utils.debug import logger
 from triton_distributed_tpu.utils.profiling import group_profile
 
 

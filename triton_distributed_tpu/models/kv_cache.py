@@ -12,7 +12,7 @@ place — the role CUDA graphs + in-place writes play in the reference.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp

@@ -16,12 +16,11 @@ pair maintains.
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.kernels.matmul import MatmulConfig
 from triton_distributed_tpu.layers.tp_attn import TPAttention, rms_norm

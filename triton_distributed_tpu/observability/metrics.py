@@ -31,7 +31,7 @@ import math
 import os
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 
 def observability_enabled() -> bool:

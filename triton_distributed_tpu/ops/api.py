@@ -10,7 +10,6 @@ inside their own shard_map.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
