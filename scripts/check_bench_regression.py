@@ -8,6 +8,13 @@ metrics registry — see ``observability.bench_record``); the committed
 results and a fresh run therefore share one schema, and rows are
 matched on their identity fields (everything except the measurements).
 
+Each fresh row is additionally scored against the rolling anomaly
+baselines (``observability.anomaly``, persisted beside the autotuner
+cache by ``bench_record``): a row can pass the 10% committed-baseline
+gate and still be a multi-sigma outlier against what this machine
+usually does — the z column catches that.  Output is a markdown
+summary (table + verdict) so CI logs and PR comments read the same.
+
 Usage:
     python benchmark/bench_ag_gemm.py > /tmp/fresh/ag_gemm.json
     python scripts/check_bench_regression.py --fresh /tmp/fresh
@@ -46,6 +53,8 @@ MEASUREMENT_FIELDS = {
     # latency "us" + p50/p99 fields; these ride along.
     "useful_tokens", "speedup_vs_serial", "continuous_beats_serial",
     "machine_drift_suspected", "makespan_spread",
+    # Anomaly-baseline outputs attached by bench_record.
+    "anomaly_z", "anomaly",
 }
 #: Fields that may hold the latency to compare, in preference order.
 LATENCY_FIELDS = ("us", "ms", "ms_per_step")
@@ -90,6 +99,30 @@ def latency_of(rec: dict):
     return None, None
 
 
+def anomaly_store(path):
+    """Best-effort rolling-baseline lookup (None when the package or
+    the baselines file is unavailable — the gate must run anywhere)."""
+    try:
+        from triton_distributed_tpu.observability.anomaly import (
+            BaselineStore)
+        store = BaselineStore(path)
+        return store if len(store) else None
+    except Exception:
+        return None
+
+
+def anomaly_z_of(store, rec, us):
+    if store is None or us is None:
+        return None
+    try:
+        from triton_distributed_tpu.observability.anomaly import (
+            key_for_bench)
+        z = store.zscore(key_for_bench(rec), us)
+        return round(z, 2) if z is not None else None
+    except Exception:
+        return None
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--fresh", required=True,
@@ -101,9 +134,17 @@ def main() -> int:
                         "benchmark", "results"),
                     help="committed results dir (default: "
                          "benchmark/results)")
+    ap.add_argument("--baselines", default=None,
+                    help="rolling anomaly-baselines JSON (default: "
+                         "$TDT_ANOMALY_BASELINES or "
+                         ".anomaly_baselines.json)")
     ap.add_argument("--threshold", type=float, default=0.10,
                     help="flag regressions slower than baseline by "
                          "more than this fraction (default 0.10)")
+    ap.add_argument("--z-threshold", type=float, default=3.0,
+                    help="flag rows whose anomaly z-score exceeds "
+                         "this (informational unless the ratio gate "
+                         "also fires)")
     args = ap.parse_args()
 
     base = {identity(r): r for r in load_rows(args.baseline)}
@@ -112,8 +153,10 @@ def main() -> int:
         print(f"check_bench_regression: nothing to compare "
               f"({len(base)} baseline rows, {len(fresh)} fresh rows)")
         return 2
+    store = anomaly_store(args.baselines)
 
-    compared = regressions = unmatched = 0
+    compared = regressions = unmatched = anomalies = 0
+    table = []  # markdown rows for every flagged check
     for rec in fresh:
         old = base.get(identity(rec))
         if old is None:
@@ -126,6 +169,11 @@ def main() -> int:
         if new_v is None or old_v is None:
             continue
         compared += 1
+        z = (rec.get("anomaly_z")
+             if isinstance(rec.get("anomaly_z"), (int, float))
+             else anomaly_z_of(store, rec, new_v))
+        if z is not None and abs(z) > args.z_threshold:
+            anomalies += 1
         # Gate the primary latency AND the tail (p99) when both rows
         # carry it — a kernel can hold its mean while its p99 blows
         # out, and serving SLOs live at the tail.
@@ -138,21 +186,53 @@ def main() -> int:
         row_regressed = False
         for cf, o_v, n_v in checks:
             slower = n_v / o_v - 1.0
-            tag = "REGRESSION" if slower > args.threshold else "ok"
-            if slower > args.threshold or slower < -args.threshold:
-                print(f"[{tag:>10}] {rec.get('bench')}: {cf} "
-                      f"{o_v:.1f} -> {n_v:.1f} ({slower:+.1%} vs "
-                      f"baseline) "
-                      f"{json.dumps(dict(identity(rec)))[:120]}")
+            flagged = (slower > args.threshold
+                       or slower < -args.threshold
+                       or (z is not None
+                           and abs(z) > args.z_threshold))
+            if flagged:
+                verdict = ("REGRESSION" if slower > args.threshold
+                           else "anomaly" if (z is not None
+                                              and abs(z)
+                                              > args.z_threshold)
+                           else "faster")
+                # Identity dims so a flagged row names its shape
+                # point, not just its bench family.
+                dims = ", ".join(
+                    f"{k}={v}" for k, v in
+                    ((k, json.loads(v)) for k, v in identity(rec))
+                    if k not in ("bench", "method"))[:80]
+                table.append(
+                    f"| {rec.get('bench')} | {cf} | {o_v:.1f} "
+                    f"| {n_v:.1f} | {slower:+.1%} "
+                    f"| {z if z is not None else '-'} "
+                    f"| {verdict} | {dims or '-'} |")
             if slower > args.threshold:
                 row_regressed = True
         if row_regressed:
             regressions += 1
 
-    print(f"check_bench_regression: {compared} rows compared, "
+    # Markdown summary: CI logs and PR comments read the same thing.
+    print("## Bench regression check")
+    print()
+    verdict = ("FAIL" if regressions else
+               "OK (with anomalies)" if anomalies else "OK")
+    print(f"**{verdict}** — {compared} row(s) compared, "
+          f"{regressions} regression(s) beyond "
+          f"{args.threshold:.0%}, {anomalies} rolling-baseline "
+          f"anomal(ies) beyond z={args.z_threshold:g}, "
           f"{unmatched} unmatched (new shape points or identity "
-          f"drift), {regressions} regression(s) beyond "
-          f"{args.threshold:.0%}")
+          f"drift).")
+    if store is not None:
+        print(f"Rolling baselines: `{store.path}` "
+              f"({len(store)} key(s)).")
+    if table:
+        print()
+        print("| bench | field | committed | fresh | delta | z "
+              "| verdict | identity |")
+        print("|---|---|---|---|---|---|---|---|")
+        for row in table:
+            print(row)
     if compared == 0:
         return 2
     return 1 if regressions else 0

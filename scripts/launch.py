@@ -98,6 +98,47 @@ def _rank_health_lines(hb_dir):
     return lines
 
 
+def _run_doctor(dirs):
+    """Invoke the incident doctor over the run's artifact directories
+    after a failed exit.  Subprocess for the same reason as the trace
+    merge (the package imports jax); the report lands next to the
+    artifacts and its verdict is echoed to stderr."""
+    dirs = [d for d in dict.fromkeys(dirs) if d and os.path.isdir(d)]
+    if not dirs:
+        return
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (REPO_ROOT + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    try:
+        res = subprocess.run(
+            [sys.executable, "-m",
+             "triton_distributed_tpu.observability.doctor",
+             *dirs, "-q"],
+            env=env, capture_output=True, text=True, timeout=180)
+        report = os.path.join(dirs[0], "incident_report.md")
+        if res.returncode == 0:
+            print(f"launch: incident report -> {report}",
+                  file=sys.stderr, flush=True)
+            # Surface the one-line verdict without re-dumping the
+            # whole report into a log that already has backtraces.
+            try:
+                with open(os.path.join(dirs[0],
+                                       "incident_report.json")) as f:
+                    print("launch: doctor verdict: "
+                          + json.load(f).get("verdict", ""),
+                          file=sys.stderr, flush=True)
+            except (OSError, ValueError):
+                pass
+        else:
+            out = (res.stdout + res.stderr).strip()
+            if out:
+                print(f"launch: doctor failed: {out[-500:]}",
+                      file=sys.stderr, flush=True)
+    except (OSError, subprocess.SubprocessError) as e:
+        print(f"launch: doctor failed: {e}", file=sys.stderr,
+              flush=True)
+
+
 def _merge_traces(trace_dir):
     """Merge per-rank traces after the group exits.  Subprocess (the
     package imports jax — keep the launcher light), same CLI a human
@@ -287,6 +328,11 @@ def main() -> int:
         # report may have scrolled past a long worker backtrace).
         for line in health_lines[-1:]:
             print(line, file=sys.stderr, flush=True)
+    if rc != 0:
+        # Watchdog fired (124) or a rank died nonzero: turn whatever
+        # artifacts the run left (flight dumps, traces, heartbeats)
+        # into one incident report, automatically.
+        _run_doctor([args.flight_dir, args.trace_dir, hb_dir])
     return rc
 
 

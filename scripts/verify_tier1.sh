@@ -15,7 +15,11 @@
 #    ZERO findings — a leaked semaphore or unmatched wait in a shipped
 #    collective fails tier-1 before any TPU sees it;
 #  - a trace-export smoke run (span -> Chrome trace -> timeline merge
-#    -> Prometheus render) guards the observability runtime on CPU.
+#    -> Prometheus render) guards the observability runtime on CPU;
+#  - a doctor smoke over the seeded incident corpus
+#    (tests/data/incidents): every scenario's report must match its
+#    committed golden byte-for-byte in structure — silent report
+#    drift fails tier-1.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -102,6 +106,29 @@ echo "$smoke_log" | tail -5
 if [ "$smoke_rc" -ne 0 ]; then
     echo "TRACE_SMOKE=FAILED"
     [ "$rc" -eq 0 ] && rc=1
+fi
+
+# Doctor smoke: run the incident doctor over every seeded scenario
+# and fail on drift from the committed golden reports.  Reports are
+# deterministic by construction ("now" = newest artifact timestamp),
+# so any diff is a real behavior change in links/anomaly/doctor.
+doctor_rc=0
+for scenario in stalled_rank sem_leak slow_link clean; do
+    if ! JAX_PLATFORMS=cpu python -m \
+            triton_distributed_tpu.observability.doctor \
+            "tests/data/incidents/$scenario" -q \
+            --json "/tmp/_t1_doctor_${scenario}.json" \
+            --md "/tmp/_t1_doctor_${scenario}.md" \
+            --check "tests/data/incidents/$scenario/report.golden.json"
+    then
+        echo "DOCTOR_SMOKE=FAILED ($scenario)"
+        doctor_rc=1
+    fi
+done
+if [ "$doctor_rc" -ne 0 ]; then
+    [ "$rc" -eq 0 ] && rc=1
+else
+    echo "DOCTOR_SMOKE=ok"
 fi
 
 # Serving smoke: continuous-batching scheduler end-to-end on CPU —

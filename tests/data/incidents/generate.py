@@ -1,0 +1,319 @@
+#!/usr/bin/env python
+"""Regenerate the seeded 4-rank incident corpus.
+
+Four scenarios, each a directory of the artifacts a real failed
+``scripts/launch.py`` run leaves behind (per-rank Chrome traces,
+flight-recorder dumps, heartbeats, and — for ``sem_leak`` — a
+pre-computed static-analysis findings file):
+
+- ``stalled_rank``: rank 2 wedges mid-decode inside an
+  ``all_reduce[one_shot]``; its heartbeat goes stale while peers stay
+  fresh; its trace file is truncated mid-write (salvage path).
+- ``sem_leak``: every rank hangs on a second ``all_gather[ring]``
+  launch; the static findings file carries the SEM_LEAK that predicts
+  it.
+- ``slow_link``: nobody stalls, but rank 3 is the consistent
+  straggler, one occurrence is a 4.5x latency anomaly, and an
+  ``ag_gemm`` / ``all_reduce`` pair contend on link ``tp:2>3``.
+- ``clean``: a healthy run — the doctor must say so.
+
+Everything is deterministic (fixed base timestamp, no randomness), so
+``report.golden.json`` files can gate drift in CI.  Run from anywhere:
+
+    python tests/data/incidents/generate.py
+
+The goldens are NOT rewritten here — regenerate them explicitly with
+``--write-goldens`` (which runs the doctor; requires the package on
+PYTHONPATH) after an intentional report-schema change.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+#: Fixed epoch for every artifact timestamp (2023-11-14T22:13:20Z).
+T0 = 1_700_000_000.0
+WORLD = 4
+AXIS = "tp"
+
+SCENARIOS = ("stalled_rank", "sem_leak", "slow_link", "clean")
+
+
+def _write(scenario: str, name: str, payload, truncate_at=None):
+    d = os.path.join(HERE, scenario)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, name)
+    text = json.dumps(payload, indent=1)
+    if truncate_at is not None:
+        text = text[:int(len(text) * truncate_at)]
+    with open(path, "w") as f:
+        f.write(text)
+    return path
+
+
+def span(name, ts_s, dur_us, rank, args=None):
+    """One Chrome complete event (µs timestamps, like tracing.py)."""
+    return {"name": name, "ph": "X", "cat": "span",
+            "ts": round(ts_s * 1e6, 3), "dur": round(dur_us, 3),
+            "pid": rank, "tid": 1, "args": args or {}}
+
+
+def trace(rank, events, world=WORLD):
+    return {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": rank,
+             "args": {"name": f"rank {rank}"}},
+            {"ph": "M", "name": "process_sort_index", "pid": rank,
+             "args": {"sort_index": rank}},
+        ] + events,
+        "displayTimeUnit": "ms",
+        "metadata": {"schema": 1, "rank": rank, "world": world,
+                     "pid": 4000 + rank, "clock": "unix-us",
+                     "clock_base_unix": T0,
+                     "export_unix_time": T0 + 20.0},
+    }
+
+
+def heartbeat(rank, unix_time, step, last_span, open_spans,
+              serving=None):
+    hb = {"schema": 1, "rank": rank, "pid": 4000 + rank,
+          "unix_time": round(unix_time, 3), "step": step,
+          "last_span": last_span, "open_spans": open_spans}
+    if serving is not None:
+        hb["serving"] = serving
+    return hb
+
+
+def event(op, rank, ts, *, method=None, world=WORLD, shape=None,
+          dtype="bfloat16", bytes_moved=0, estimate_us=None,
+          measured_us=None, axis=AXIS, **extra):
+    """A KernelEvent.to_dict()-shaped record (schema 1)."""
+    return {"schema": 1, "ts": round(ts, 6), "rank": rank,
+            "kind": "collective", "op": op, "method": method,
+            "axis": axis, "world": world,
+            "shape": list(shape) if shape else None, "dtype": dtype,
+            "bytes_moved": bytes_moved, "flops": 0,
+            "estimate_us": estimate_us, "measured_us": measured_us,
+            "config": None, "extra": extra}
+
+
+def metrics_snapshot(rank, counters=None):
+    return {
+        "counters": {"events_total{kind=\"collective\","
+                     "op=\"all_reduce\"}": 40.0, **(counters or {})},
+        "gauges": {},
+        "histograms": {},
+        "meta": {"rank": rank, "world": WORLD,
+                 "unix_time": T0 + 14.0, "schema": 1},
+    }
+
+
+def flight(rank, unix_time, events, open_spans=(), counters=None,
+           heartbeat_body=None):
+    return {"schema": 1, "rank": rank, "pid": 4000 + rank,
+            "unix_time": round(unix_time, 3), "reason": "signal-15",
+            "events": events,
+            "metrics": metrics_snapshot(rank, counters),
+            "open_spans": list(open_spans),
+            "heartbeat": heartbeat_body or {}}
+
+
+# ---------------------------------------------------------------------------
+# Scenarios
+# ---------------------------------------------------------------------------
+
+def gen_stalled_rank():
+    """Rank 2 wedges at decode step 7 inside all_reduce[one_shot]."""
+    s = "stalled_rank"
+    ar_bytes = 3 * 65536  # (world-1) x 64 KiB chunks
+    for rank in range(WORLD):
+        stalled = rank == 2
+        nsteps = 8 if stalled else 10
+        spans = [span("engine.decode_step",
+                      T0 + k * 1.0 + rank * 0.0003, 2000 + 10 * rank,
+                      rank, {"step": k})
+                 for k in range(nsteps)]
+        spans.append(span("serve", T0, (nsteps + 1) * 1e6, rank,
+                          {"open": True}))
+        # Rank 2 died mid-export: truncate its trace mid-array so the
+        # merge has to salvage (timeline_truncated_ranks == [2]).
+        _write(s, f"trace-rank-{rank}.json", trace(rank, spans),
+               truncate_at=0.6 if stalled else None)
+
+        evs = [event("all_gather", rank, T0 + 5.0 + 0.001 * rank,
+                     method="ring", shape=(512, 1024),
+                     bytes_moved=3 * 1048576, estimate_us=180.0,
+                     hops="ring"),
+               event("all_reduce", rank,
+                     (T0 + 7.0 if stalled else T0 + 9.0)
+                     + 0.001 * rank,
+                     method="one_shot", shape=(256, 256),
+                     bytes_moved=ar_bytes, estimate_us=25.0,
+                     hops="all_pairs", pending_sem="recv_sem")]
+        hb_time = T0 + 9.0 if stalled else T0 + 14.0 + 0.05 * rank
+        hb = heartbeat(rank, hb_time, 7 if stalled else 9,
+                       "engine.decode_step",
+                       ["serve", "engine.decode_step"],
+                       serving={"serving_queue_depth": 3.0,
+                                "serving_active_slots": 2.0})
+        _write(s, f"heartbeat-rank-{rank}.json", hb)
+        _write(s, f"flight-rank-{rank}.json",
+               flight(rank, T0 + 14.3, evs,
+                      open_spans=[{"name": "engine.decode_step",
+                                   "ts": hb_time, "dur": None,
+                                   "tid": 1, "depth": 1,
+                                   "attrs": {"step": 7 if stalled
+                                             else 9}}],
+                      heartbeat_body=hb))
+
+
+def gen_sem_leak():
+    """Second all_gather[ring] launch hangs on leaked credits; the
+    static findings file names the semaphore."""
+    s = "sem_leak"
+    for rank in range(WORLD):
+        evs = [event("all_gather", rank, T0 + 2.0 + 0.001 * rank,
+                     method="ring", shape=(512, 1024),
+                     bytes_moved=3 * 1048576, estimate_us=180.0,
+                     hops="ring", launch=1),
+               event("all_gather", rank, T0 + 4.0 + 0.001 * rank,
+                     method="ring", shape=(512, 1024),
+                     bytes_moved=3 * 1048576, estimate_us=180.0,
+                     hops="ring", launch=2)]
+        # Rank 0 hits the poisoned wait first; everyone wedges within
+        # ~the same second (collective), ages 6.5..6.2 s at dump time.
+        hb_time = T0 + 4.5 + 0.1 * rank
+        hb = heartbeat(rank, hb_time, 1, "bench.allgather",
+                       ["bench.allgather"])
+        _write(s, f"heartbeat-rank-{rank}.json", hb)
+        _write(s, f"flight-rank-{rank}.json",
+               flight(rank, T0 + 11.0, evs,
+                      open_spans=[{"name": "bench.allgather",
+                                   "ts": hb_time, "dur": None,
+                                   "tid": 1, "depth": 0,
+                                   "attrs": {}}],
+                      heartbeat_body=hb))
+    _write(s, "analysis-findings.json", {
+        "findings": [{
+            "kernel": "allgather.ring",
+            "mesh": {"tp": 4},
+            "kind": "sem_leak",
+            "rank": [0],
+            "sem": "recv_sems[1]",
+            "ref": None,
+            "message": "semaphore recv_sems[1] holds +1 credit at "
+                       "kernel exit: the next launch using this "
+                       "collective id inherits it and hangs",
+        }],
+        "swept": 1,
+    })
+
+
+def gen_slow_link():
+    """No stall; rank 3 consistently last, one 4.5x anomaly, and
+    ag_gemm / all_reduce contending on link tp:2>3."""
+    s = "slow_link"
+    for rank in range(WORLD):
+        spans = []
+        for k in range(8):
+            # Rank 3 enters each allreduce ~1.5 ms late (the ranks it
+            # keeps waiting accrue barrier_wait); occurrence 5 on rank
+            # 3 is also a 9 ms outlier against a ~2 ms population.
+            late = 1500.0 if rank == 3 else 100.0 * rank
+            dur = 9000.0 if (rank == 3 and k == 5) else 2000.0 + 8 * k
+            spans.append(span("allreduce.ring",
+                              T0 + k * 0.5 + late * 1e-6, dur, rank,
+                              {"step": k}))
+        _write(s, f"trace-rank-{rank}.json", trace(rank, spans))
+
+        # Measured occurrences: the decode allreduce lands while an
+        # ag_gemm ring transfer still holds the same outbound links.
+        evs = [event("ag_gemm", rank, T0 + 5.0,
+                     method="fused", shape=(512, 2048, 1024),
+                     bytes_moved=(5 if rank == 2 else 3) * 2097152,
+                     measured_us=5000.0, estimate_us=4000.0,
+                     hops="ring"),
+               event("all_reduce", rank, T0 + 5.002,
+                     method="ring", shape=(128, 1024),
+                     bytes_moved=3 * 262144, measured_us=3000.0,
+                     estimate_us=2500.0, hops="ring")]
+        counters = ({"events_dropped": 3.0} if rank == 1 else None)
+        hb = heartbeat(rank, T0 + 8.0 + 0.01 * rank, 7,
+                       "allreduce.ring", [])
+        _write(s, f"heartbeat-rank-{rank}.json", hb)
+        _write(s, f"flight-rank-{rank}.json",
+               flight(rank, T0 + 8.1, evs, counters=counters,
+                      heartbeat_body=hb))
+
+
+def gen_clean():
+    s = "clean"
+    for rank in range(WORLD):
+        spans = [span("engine.decode_step", T0 + k * 0.5 + 50e-6 * rank,
+                      2000.0 + 5 * rank, rank, {"step": k})
+                 for k in range(6)]
+        _write(s, f"trace-rank-{rank}.json", trace(rank, spans))
+        evs = [event("all_reduce", rank, T0 + 1.0,
+                     method="one_shot", shape=(256, 256),
+                     bytes_moved=3 * 65536, estimate_us=25.0,
+                     hops="all_pairs")]
+        hb = heartbeat(rank, T0 + 3.0 + 0.01 * rank, 5,
+                       "engine.decode_step", [])
+        _write(s, f"heartbeat-rank-{rank}.json", hb)
+        _write(s, f"flight-rank-{rank}.json",
+               flight(rank, T0 + 3.1, evs, heartbeat_body=hb))
+
+
+def generate(clean_first: bool = True):
+    for scenario in SCENARIOS:
+        d = os.path.join(HERE, scenario)
+        if clean_first and os.path.isdir(d):
+            for name in os.listdir(d):
+                if name != "report.golden.json":
+                    os.remove(os.path.join(d, name))
+    gen_stalled_rank()
+    gen_sem_leak()
+    gen_slow_link()
+    gen_clean()
+    return [os.path.join(HERE, sc) for sc in SCENARIOS]
+
+
+def write_goldens():
+    from triton_distributed_tpu.observability import doctor
+    for scenario in SCENARIOS:
+        d = os.path.join(HERE, scenario)
+        report = doctor.diagnose([d])
+        assert report is not None, scenario
+        with open(os.path.join(d, "report.golden.json"), "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+        # diagnose() itself writes nothing; drop any stray doctor
+        # outputs from manual runs so the corpus stays canonical.
+        for name in (doctor.REPORT_JSON, doctor.REPORT_MD,
+                     "anomaly_baselines.json"):
+            p = os.path.join(d, name)
+            if os.path.exists(p):
+                os.remove(p)
+        print(f"golden: {scenario}: {report['verdict']}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--write-goldens", action="store_true",
+                    help="also run the doctor and rewrite "
+                         "report.golden.json for every scenario")
+    args = ap.parse_args(argv)
+    dirs = generate()
+    print(f"generated {len(dirs)} scenario(s) under {HERE}")
+    if args.write_goldens:
+        write_goldens()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

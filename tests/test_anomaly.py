@@ -1,0 +1,206 @@
+"""Anomaly baselines: rolling statistics, persistence round-trip,
+bench_record integration, timeline occurrence flagging, and the
+straggler ranking's materiality floor."""
+
+import json
+import os
+
+import pytest
+
+from triton_distributed_tpu.observability.anomaly import (
+    Baseline,
+    BaselineStore,
+    MIN_SAMPLES,
+    WINDOW,
+    event_key,
+    flag_occurrences,
+    key_for_bench,
+    observe_bench,
+    straggler_ranking,
+)
+
+
+class TestBaseline:
+    def test_welford_matches_population(self):
+        b = Baseline()
+        xs = [100.0, 102.0, 98.0, 101.0, 99.0, 100.0]
+        for x in xs:
+            b.update(x)
+        assert b.n == len(xs)
+        assert b.mean == pytest.approx(sum(xs) / len(xs))
+        mean = sum(xs) / len(xs)
+        var = sum((x - mean) ** 2 for x in xs) / (len(xs) - 1)
+        assert b.var == pytest.approx(var)
+
+    def test_no_z_until_min_samples(self):
+        b = Baseline()
+        for _ in range(MIN_SAMPLES - 1):
+            b.update(100.0)
+        assert b.zscore(500.0) is None
+        b.update(100.0)
+        assert b.zscore(500.0) is not None
+
+    def test_spread_floor_prevents_jitter_pages(self):
+        # A perfectly-tight baseline must not turn a 1% wiggle into a
+        # huge z: the floor is 2% of the mean.
+        b = Baseline()
+        for _ in range(10):
+            b.update(100.0)
+        z = b.zscore(101.0)
+        assert z == pytest.approx(1.0 / 2.0, rel=0.01)
+
+    def test_ewma_rebaselines_after_window(self):
+        b = Baseline()
+        for _ in range(WINDOW):
+            b.update(100.0)
+        for _ in range(5 * WINDOW):
+            b.update(200.0)  # hardware drifted
+        assert b.mean == pytest.approx(200.0, rel=0.05)
+
+    def test_roundtrip_list(self):
+        b = Baseline()
+        for x in (10.0, 20.0, 30.0):
+            b.update(x)
+        b2 = Baseline.from_list(b.to_list())
+        assert b2.n == 3 and b2.mean == pytest.approx(b.mean)
+
+
+class TestStore:
+    def test_persist_roundtrip(self, tmp_path):
+        path = str(tmp_path / "baselines.json")
+        store = BaselineStore(path)
+        key = event_key("all_reduce", "one_shot", (256, 256), 4)
+        for x in (100.0, 101.0, 99.0, 100.5, 99.5, 100.0):
+            store.observe(key, x)
+        assert store.save() == path
+
+        fresh = BaselineStore(path)
+        z = fresh.zscore(key, 150.0)
+        assert z is not None and z > 3.0
+        assert fresh.zscore(key, 100.0) == pytest.approx(0.0, abs=0.5)
+        # schema sanity: sorted keys, [n, mean, m2] rows
+        raw = json.load(open(path))
+        assert raw["schema"] == 1
+        assert key in raw["baselines"]
+
+    def test_merge_on_save_keeps_other_writers(self, tmp_path):
+        path = str(tmp_path / "baselines.json")
+        a, b = BaselineStore(path), BaselineStore(path)
+        for _ in range(6):
+            a.observe("ka", 10.0)
+            b.observe("kb", 20.0)
+        a.save()
+        b.save()  # must not drop ka
+        fresh = BaselineStore(path)
+        assert set(fresh.keys()) >= {"ka", "kb"}
+
+    def test_torus_mesh_keys_distinct(self):
+        flat = event_key("all_gather", "ring", (8, 128), 16)
+        torus = event_key("all_gather_torus", "torus", (8, 128), 16,
+                          sizes=(4, 4))
+        assert flat != torus and "4x4" in torus
+
+    def test_bench_key(self):
+        rec = {"bench": "ag_gemm", "method": "fused", "M": 4096,
+               "K": 1024, "N": 2048, "world": 4}
+        assert key_for_bench(rec) == (
+            "ag_gemm|fused|M=4096,K=1024,N=2048|w4")
+
+    def test_bench_key_separates_size_sweeps(self):
+        # nbytes/S sweeps must not collapse into one mixed baseline.
+        a = key_for_bench({"bench": "allreduce", "method": "one_shot",
+                           "world": 4, "nbytes": 1 << 20})
+        b = key_for_bench({"bench": "allreduce", "method": "one_shot",
+                           "world": 4, "nbytes": 1 << 24})
+        assert a != b
+
+
+class TestBenchIntegration:
+    def test_observe_bench_flags_counter(self, tmp_path, monkeypatch):
+        from triton_distributed_tpu.observability.metrics import (
+            get_registry)
+        store = BaselineStore(str(tmp_path / "b.json"))
+        rec = {"bench": "allreduce", "method": "one_shot",
+               "world": 4, "nbytes": 1 << 20}
+        for _ in range(8):
+            assert observe_bench(rec, 100.0, store=store,
+                                 persist=False) in (None,
+                                                    pytest.approx(0.0))
+        get_registry().clear()
+        z = observe_bench(rec, 400.0, store=store, persist=False)
+        assert z > 3.0
+        snap = get_registry().snapshot()
+        assert snap["counters"][
+            'anomaly_flags_total{op="allreduce"}'] == 1.0
+
+    def test_bench_record_attaches_anomaly_z(self, tmp_path,
+                                             monkeypatch):
+        monkeypatch.setenv("TDT_ANOMALY_BASELINES",
+                           str(tmp_path / "bl.json"))
+        # Fresh global store bound to the tmp path.
+        import triton_distributed_tpu.observability.anomaly as an
+        monkeypatch.setattr(an, "_STORE", None)
+        from triton_distributed_tpu.observability import bench_record
+        rec = {"bench": "toy_bench", "world": 1, "us": 100.0}
+        for _ in range(7):
+            bench_record(dict(rec), print_line=False)
+        out = bench_record(dict(rec, us=500.0), print_line=False)
+        assert out["anomaly_z"] > 3.0 and out["anomaly"] is True
+        assert os.path.exists(str(tmp_path / "bl.json"))
+
+
+class TestTimelineFlags:
+    def test_flag_occurrences_within_merge(self):
+        rows = []
+        for k in range(8):
+            durs = {0: 2000.0, 1: 2010.0, 2: 1990.0, 3: 2005.0}
+            if k == 5:
+                durs[3] = 9000.0
+            rows.append({"name": "allreduce.ring", "occurrence": k,
+                         "durs_us": durs})
+        store = BaselineStore(os.devnull)  # never loads anything
+        store._loaded = True
+        flags = flag_occurrences(rows, ranks=4, store=store)
+        assert len(flags) == 1
+        f = flags[0]
+        assert (f["rank"], f["occurrence"]) == (3, 5)
+        assert f["z"] > 3.0 and f["source"] == "merge"
+
+    def test_flag_occurrences_against_persisted(self, tmp_path):
+        store = BaselineStore(str(tmp_path / "b.json"))
+        from triton_distributed_tpu.observability.anomaly import (
+            span_key)
+        for _ in range(10):
+            store.observe(span_key("decode", 2), 1000.0)
+        rows = [{"name": "decode", "occurrence": 0,
+                 "durs_us": {0: 1000.0, 1: 5000.0}}]
+        flags = flag_occurrences(rows, ranks=2, store=store)
+        assert [f["rank"] for f in flags] == [1]
+        assert flags[0]["source"] == "baseline"
+
+
+class TestStragglerRanking:
+    def _report(self, mean_skew_us):
+        return {"spans": {"step": {
+            "occurrences": 10, "straggler_rank": 3,
+            "straggler_fraction": 1.0, "mean_skew_us": mean_skew_us,
+            "max_skew_us": mean_skew_us * 2,
+            "last_counts": {"3": 10},
+            "barrier_wait_us": {"0": 3 * mean_skew_us * 10,
+                                "1": 3 * mean_skew_us * 10},
+        }}}
+
+    def test_material_straggler_ranked_with_blame(self):
+        flights = {3: {"events": [{
+            "op": "all_reduce", "kind": "collective",
+            "method": "ring", "axis": "tp", "world": 4, "rank": 3,
+            "bytes_moved": 1024,
+            "extra": {"hops": "ring", "pending_sem": "recv_sem"},
+        }]}}
+        ranking = straggler_ranking(self._report(2000.0), flights)
+        assert ranking[0]["rank"] == 3
+        assert ranking[0]["blamed_link"] == "tp:3>0"
+        assert ranking[0]["blamed_sem"] == "recv_sem"
+
+    def test_jitter_skew_filtered(self):
+        assert straggler_ranking(self._report(100.0)) == []

@@ -1,0 +1,213 @@
+"""ICI link attribution: topology arithmetic, hop-pattern → link
+mapping (1D ring / 2D torus / 3-axis hierarchical), byte conservation,
+contention detection, and the registry-backed tracker."""
+
+import pytest
+
+from triton_distributed_tpu.observability.events import KernelEvent
+from triton_distributed_tpu.observability.links import (
+    LinkTracker,
+    TorusTopology,
+    detect_contention,
+    link_label,
+    links_for_event,
+    links_global,
+    parse_link,
+)
+from triton_distributed_tpu.observability.metrics import MetricsRegistry
+
+
+def ev(op="all_gather", *, hops, world=4, axis="tp", nbytes=1 << 20,
+       rank=0, method=None, ts=0.0, measured_us=None,
+       estimate_us=None, **extra):
+    extra["hops"] = hops
+    return KernelEvent(kind="collective", op=op, method=method,
+                       axis=axis, world=world, bytes_moved=nbytes,
+                       rank=rank, ts=ts, measured_us=measured_us,
+                       estimate_us=estimate_us, extra=extra)
+
+
+# ---------------------------------------------------------------------------
+# Topology
+# ---------------------------------------------------------------------------
+
+class TestTopology:
+    def test_1d_ring(self):
+        t = TorusTopology({"tp": 4})
+        assert t.world == 4
+        assert t.coords(2) == (2,)
+        assert t.neighbor(3, "tp", +1) == 0          # wraparound
+        assert t.neighbor(0, "tp", -1) == 3
+        assert len(t.links()) == 8                   # 4 ranks x 2 dirs
+
+    def test_2d_torus(self):
+        t = TorusTopology({"x": 2, "y": 4})
+        assert t.world == 8
+        # first axis major (hierarchical.py's g = x * 4 + y)
+        assert t.rank_of((1, 2)) == 6
+        assert t.coords(6) == (1, 2)
+        assert t.neighbor(6, "y", +1) == 7
+        assert t.neighbor(6, "x", +1) == 2
+
+    def test_route_dimension_ordered(self):
+        t = TorusTopology({"x": 2, "y": 4})
+        # x corrected first, then y along the shorter wrap direction.
+        assert t.route(0, 7) == [("x", 0, 4), ("y", 4, 7)]
+        # distance-2 on y: two hops, ties break toward +1.
+        assert t.route(0, 2) == [("y", 0, 1), ("y", 1, 2)]
+
+    def test_bisection(self):
+        t = TorusTopology({"tp": 4})
+        cut = t.bisection_links()
+        # mid-plane + wrap seam, both directions: 0<->3 and 1<->2.
+        assert set(cut) == {("tp", 0, 3), ("tp", 3, 0),
+                            ("tp", 1, 2), ("tp", 2, 1)}
+
+    def test_labels_roundtrip(self):
+        assert link_label(("tp", 0, 1)) == "tp:0>1"
+        assert parse_link("dcn:3>0") == ("dcn", 3, 0)
+
+
+# ---------------------------------------------------------------------------
+# Hop patterns
+# ---------------------------------------------------------------------------
+
+class TestHopPatterns:
+    def test_ring_single_link(self):
+        lk = links_for_event(ev(hops="ring", rank=1, nbytes=999))
+        assert lk == {("tp", 1, 2): 999}
+
+    def test_bidir_ring_splits(self):
+        lk = links_for_event(ev(hops="bidir_ring", rank=0,
+                                nbytes=1000))
+        assert lk == {("tp", 0, 1): 500, ("tp", 0, 3): 500}
+
+    def test_chain_endpoints(self):
+        # Rank 0 only sends up; the last rank only sends down.
+        lk0 = links_for_event(ev(hops="chain", rank=0, nbytes=100))
+        assert lk0 == {("tp", 0, 1): 50}
+        lk3 = links_for_event(ev(hops="chain", rank=3, nbytes=100))
+        assert lk3 == {("tp", 3, 2): 50}
+
+    def test_all_pairs_routes_through_ring(self):
+        # 4-ring, 300 bytes/peer: the distance-2 peer's chunk crosses
+        # two links (dimension-ordered, tie toward +1).
+        lk = links_for_event(ev(hops="all_pairs", rank=0,
+                                nbytes=3 * 300))
+        assert lk == {("tp", 0, 1): 600, ("tp", 1, 2): 300,
+                      ("tp", 0, 3): 300}
+
+    def test_pairs_direct_no_intermediate(self):
+        lk = links_for_event(ev(hops="pairs_direct", rank=0,
+                                nbytes=3 * 300, axis="dcn"))
+        assert lk == {("dcn", 0, 1): 300, ("dcn", 0, 2): 300,
+                      ("dcn", 0, 3): 300}
+
+    def test_torus_2d_multilane(self):
+        e = ev(op="all_gather_torus", hops="torus", world=8,
+               nbytes=4000, rank=0, axes=["x", "y"], sizes=[2, 4])
+        lk = links_for_event(e)
+        # 2 axes x 2 directions = 4 lanes, 1000 bytes each; on the
+        # size-2 x axis both directions reach the same neighbor.
+        assert sum(lk.values()) == 4000
+        assert lk[("x", 0, 4)] == 2000          # +1 and -1 coincide
+        assert lk[("y", 0, 1)] == 1000
+        assert lk[("y", 0, 3)] == 1000
+
+    def test_hierarchical_3axis_dcn_phase(self):
+        # 3-axis hierarchical event: DCN fabric pairs only (the ICI
+        # phase is a separate inner event).  Rank 6 distinguishes the
+        # DCN-major convention (6 // ici_size = slice 1) from a
+        # modulo mix-up (6 % 4 would claim slice 2).
+        e = ev(op="hier_all_reduce", hops="hierarchical", world=16,
+               nbytes=600, axes=["dcn", "x", "y"], sizes=[4, 2, 2],
+               dcn_axis="dcn", dcn_size=4, ici_size=4, rank=6)
+        lk = links_for_event(e)
+        assert lk == {("dcn", 1, 0): 200, ("dcn", 1, 2): 200,
+                      ("dcn", 1, 3): 200}
+        assert all(a == "dcn" for a, _, _ in lk)
+
+    def test_root_only_scaled_to_expected_share(self):
+        # Broadcast: every rank emits the root's-eye event, but only
+        # one rank actually sends — per-rank attribution is scaled by
+        # 1/world so the global sum equals ONE fan-out.
+        e = ev(op="broadcast", hops="pairs_direct", world=4,
+               nbytes=3 * 400, root_only=True)
+        assert sum(links_global(e).values()) == 3 * 100 * 4
+
+    def test_world1_and_none_empty(self):
+        assert links_for_event(ev(hops="ring", world=1)) == {}
+        assert links_for_event(ev(hops="none")) == {}
+        assert links_for_event(ev(hops="ring", nbytes=0)) == {}
+
+    def test_global_conserves_bytes(self):
+        e = ev(hops="bidir_ring", nbytes=1000, world=4)
+        g = links_global(e)
+        assert sum(g.values()) == 4 * 1000
+        # SPMD symmetry: every directed ring link carries equal load.
+        assert len(set(g.values())) == 1
+
+    def test_unknown_pattern_not_dropped(self):
+        lk = links_for_event(ev(hops="mystery", rank=2, nbytes=77))
+        assert sum(lk.values()) == 77
+
+
+# ---------------------------------------------------------------------------
+# Contention + tracker
+# ---------------------------------------------------------------------------
+
+class TestContention:
+    def test_overlapping_ops_shared_link(self):
+        a = ev(op="ag_gemm", hops="ring", rank=2, ts=100.0,
+               measured_us=5000.0)
+        b = ev(op="all_reduce", hops="ring", rank=2, ts=100.002,
+               measured_us=3000.0)
+        recs = detect_contention([a, b])
+        assert len(recs) == 1
+        assert recs[0]["ops"] == ["ag_gemm", "all_reduce"]
+        assert recs[0]["links"] == ["tp:2>3"]
+        assert recs[0]["overlap_s"] == pytest.approx(0.003)
+
+    def test_disjoint_links_no_contention(self):
+        a = ev(op="ag_gemm", hops="ring", rank=0, ts=100.0,
+               measured_us=5000.0)
+        b = ev(op="all_reduce", hops="ring", rank=2, ts=100.001,
+               measured_us=5000.0)
+        assert detect_contention([a, b]) == []
+
+    def test_same_op_never_contends(self):
+        a = ev(op="all_reduce", hops="ring", rank=1, ts=1.0,
+               measured_us=9000.0)
+        b = ev(op="all_reduce", hops="ring", rank=1, ts=1.001,
+               measured_us=9000.0)
+        assert detect_contention([a, b]) == []
+
+    def test_tracker_counters_and_gauges(self):
+        reg = MetricsRegistry()
+        tracker = LinkTracker(registry=reg)
+        tracker.attribute(ev(hops="ring", rank=0, nbytes=4096,
+                             ts=50.0, measured_us=1000.0))
+        tracker.attribute(ev(op="gemm_rs", hops="ring", rank=0,
+                             nbytes=1024, ts=50.0005,
+                             measured_us=1000.0))
+        snap = reg.snapshot()
+        key = 'ici_link_bytes_total{axis="tp",link="tp:0>1"}'
+        assert snap["counters"][key] == 4096 + 1024
+        assert snap["counters"][
+            'ici_link_contention_total{link="tp:0>1"}'] == 1
+        assert tracker.contentions[0]["ops"] == ["all_gather",
+                                                 "gemm_rs"]
+        tracker.update_gauges(now=50.001)
+        util = reg.gauge("ici_link_utilization", link="tp:0>1")
+        assert util.value > 0
+
+    def test_trace_time_events_never_contend_live(self):
+        # No measured_us: compilation-time emissions must not claim
+        # two collectives ran concurrently.
+        reg = MetricsRegistry()
+        tracker = LinkTracker(registry=reg)
+        tracker.attribute(ev(hops="ring", rank=0, ts=50.0,
+                             estimate_us=500.0))
+        tracker.attribute(ev(op="gemm_rs", hops="ring", rank=0,
+                             ts=50.0001, estimate_us=500.0))
+        assert tracker.contentions == []

@@ -576,8 +576,14 @@ def test_launcher_timeout_names_stalled_rank(tmp_path):
     must say WHICH rank stalled and what its last span was (read from
     heartbeats) instead of a bare timeout."""
     trace_dir = tmp_path / "traces"
+    # 12 s watchdog: worker startup (interpreter + jax + distributed
+    # init, x2 concurrently) can exceed 6 s on a loaded 2-core CI box,
+    # and a watchdog that fires before the ranks arm their heartbeats
+    # reports "no heartbeats" instead of the stalled rank.  Staleness
+    # is relative to the 0.2 s interval, so the longer run only makes
+    # rank 1's silence more clear-cut.
     res = _run_launcher(
-        ["--trace-dir", str(trace_dir), "--timeout", "6"],
+        ["--trace-dir", str(trace_dir), "--timeout", "12"],
         WORKER_STALL, tmp_path,
         env_extra={"TDT_HEARTBEAT_INTERVAL": "0.2"})
     assert res.returncode == 124, (res.returncode, res.stdout,

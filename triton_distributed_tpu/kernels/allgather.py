@@ -252,6 +252,10 @@ def all_gather(x, ctx: AllGatherContext):
     method = ctx.resolve_method(x.size * x.dtype.itemsize)
 
     # Launch-metadata event (fires once per traced specialization).
+    # The method name IS the ICI schedule, so the hop-pattern
+    # annotation link attribution needs derives from it
+    # (instrument.hops_for_method): ring/bidir_ring push to the ±1
+    # neighbors, push_all DMAs a chunk straight to each peer.
     from triton_distributed_tpu.observability import record_collective
     record_collective("all_gather", axis=ctx.axis, world=world,
                       method=method, shape=x.shape, dtype=x.dtype,

@@ -277,6 +277,10 @@ def ag_gemm(a_shard, b, ctx, return_gathered: bool = False):
     method = ctx.resolve_method(m, a_shard.dtype, k=k, n=n)
 
     # Launch-metadata event (fires once per traced specialization).
+    # The hop pattern link attribution needs derives from the method
+    # (instrument.hops_for_method): the fused ring circulates A-chunks
+    # over +1 neighbor links (overlapped with the GEMM); the ll method
+    # one-shot-pushes the shard to every peer up front.
     from triton_distributed_tpu.observability import record_overlap_gemm
     record_overlap_gemm("ag_gemm", axis=ctx.axis, world=world,
                         method=method, m=m, n=n, k=k,

@@ -143,6 +143,21 @@ def ag_group_gemm(buckets, expert_weights, ctx: AGGroupGEMMContext,
     assert e == e2 == ctx.num_experts and k == k2
     has_counts = counts is not None
 
+    # Launch-metadata event: the expert buckets ride the +1 ring while
+    # the grouped GEMM consumes each held chunk.
+    from triton_distributed_tpu.observability import (
+        emit_kernel_event, estimate_compute_us)
+    emit_kernel_event(
+        "ag_group_gemm", kind="fused_gemm", method="ring",
+        axis=ctx.axis, world=world, shape=(e, cap, k, n),
+        dtype=buckets.dtype,
+        bytes_moved=((world - 1) * e * cap * k * buckets.dtype.itemsize
+                     if world > 1 else 0),
+        flops=2 * world * e * cap * k * n,
+        estimate_us=estimate_compute_us(2 * world * e * cap * k * n,
+                                        buckets.dtype),
+        hops="ring" if world > 1 else "none")
+
     # Lane-align K (see `matmul.pad_contraction_lanes`; the K-padded
     # gathered buffer is an internal staging output, never returned).
     buckets, expert_weights, k = pad_contraction_lanes(
@@ -244,6 +259,20 @@ def ag_group_gemm_w8a8(buckets, expert_weights_q, w_scales,
         f"int8 buckets need 32-row-aligned capacity, got {cap}")
     out_dtype = out_dtype or buckets.dtype
     has_counts = counts is not None
+
+    # Launch-metadata event: int8 buckets on the +1 ring (half the
+    # ICI bytes of the bf16 path).
+    from triton_distributed_tpu.observability import (
+        emit_kernel_event, estimate_compute_us)
+    emit_kernel_event(
+        "ag_group_gemm_w8a8", kind="fused_gemm", method="ring",
+        axis=ctx.axis, world=world, shape=(e, cap, k, n),
+        dtype=jnp.int8,
+        bytes_moved=((world - 1) * e * cap * k if world > 1 else 0),
+        flops=2 * world * e * cap * k * n,
+        estimate_us=estimate_compute_us(2 * world * e * cap * k * n,
+                                        jnp.int8),
+        hops="ring" if world > 1 else "none")
 
     buckets_q, sa = quantize_sym(buckets, axis=-1)   # (E,cap,k)i8,(E,cap)
     buckets_q, expert_weights_q, k = pad_contraction_lanes(

@@ -264,6 +264,10 @@ def all_reduce(x, ctx: AllReduceContext):
         # Emitted only for methods that run their own kernel/collective
         # here — the RING compose delegates to reduce_scatter +
         # all_gather, which emit their own events (no double counting).
+        # The hop pattern link attribution needs derives from the
+        # method (instrument.hops_for_method): one/two-shot DMA chunks
+        # straight to every peer; the chain reduces up the line and
+        # broadcasts back down it.
         from triton_distributed_tpu.observability import (
             record_collective)
         record_collective("all_reduce", axis=ctx.axis, world=world,

@@ -43,6 +43,12 @@ def barrier_all_on_axis(x, axis: str, *, collective_id: int = cids.BARRIER,
 
     Reference: `barrier_all_on_stream` (`common_ops.py:209-240`).
     """
+    # Launch-metadata event: semaphore-only (no payload bytes), but
+    # doctor/flight views need to see a rank was in a barrier.
+    from triton_distributed_tpu.observability import emit_kernel_event
+    emit_kernel_event("barrier_all", kind="collective", axis=axis,
+                      world=jax.lax.axis_size(axis), shape=x.shape,
+                      dtype=x.dtype, hops="none")
     return pl.pallas_call(
         functools.partial(_barrier_kernel, axis),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
@@ -70,6 +76,17 @@ def broadcast(x, root, axis: str, world_size: int, *,
     Call inside shard_map; `root` may be traced."""
     if world_size <= 1:
         return x
+    # Launch-metadata event.  Only the root actually sends (world-1
+    # pushes, routed over the ICI torus — hence all_pairs, not the
+    # DCN-fabric pairs_direct); rank-symmetric trace-time emission
+    # can't know the traced root, so root_only scales the bytes to
+    # the expected per-rank share.
+    from triton_distributed_tpu.observability import emit_kernel_event
+    emit_kernel_event(
+        "broadcast", kind="collective", axis=axis, world=world_size,
+        shape=x.shape, dtype=x.dtype,
+        bytes_moved=(world_size - 1) * x.size * x.dtype.itemsize,
+        hops="all_pairs", root_only=True)
     root_arr = jnp.asarray(root, jnp.int32).reshape(1)
     return pl.pallas_call(
         functools.partial(_broadcast_kernel, axis, world_size),

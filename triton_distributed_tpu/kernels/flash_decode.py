@@ -279,6 +279,16 @@ def sp_flash_decode(q, k_shard, v_shard, kv_len_local, axis: str, *,
     # Empty shards (kv_len 0) have lse = -inf ⇒ zero weight.
     lse = jnp.where(kv_len_local[:, None] > 0, lse, NEG_INF)
 
+    # Marker event for the composition: the inner all_gather emits the
+    # byte-carrying event (bytes_moved=0 here — no double counting on
+    # the link counters), but doctor/flight views see the decode step
+    # as one op with its collective id.
+    from triton_distributed_tpu.observability import emit_kernel_event
+    emit_kernel_event("sp_flash_decode", kind="collective",
+                      method="push_all", axis=axis, world=world,
+                      shape=(b, h, d), dtype=q.dtype,
+                      delegates="all_gather", hops="none")
+
     ag_ctx = AllGatherContext(axis=axis, world_size=world,
                               method=AllGatherMethod.PUSH_ALL,
                               collective_id=collective_id,

@@ -243,6 +243,10 @@ def gemm_rs(a, b, ctx):
     method = ctx.resolve_method(mc, a.dtype, k=k, n=n)
 
     # Launch-metadata event (fires once per traced specialization).
+    # The hop pattern link attribution needs derives from the method
+    # (instrument.hops_for_method): the fused ring forwards partial
+    # chunks over +1 neighbor links; ll pushes each reduced chunk
+    # straight to its owner.
     from triton_distributed_tpu.observability import record_overlap_gemm
     record_overlap_gemm("gemm_rs", axis=ctx.axis, world=world,
                         method=method, m=mc, n=n, k=k, dtype=a.dtype,

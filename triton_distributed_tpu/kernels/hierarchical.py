@@ -156,6 +156,24 @@ def create_hierarchical_context(mesh, ici_axis: str, dcn_axis: str,
 # AllGather 2D  (reference: inter-node 2D ring, allgather.py:293)
 # ---------------------------------------------------------------------------
 
+def _record_dcn_phase(op: str, ctx: HierarchicalContext, shape, dtype,
+                      dcn_bytes: int):
+    """Launch-metadata event for the DCN stage of a two-level
+    collective.  The ICI stage delegates to the Pallas kernels, which
+    emit their own (intra-phase) events — only the inter-slice bytes
+    are recorded here, so link counters never double-count.  The
+    ``hierarchical`` hop pattern maps onto direct (fabric) DCN pairs
+    in observability/links.py."""
+    from triton_distributed_tpu.observability import emit_kernel_event
+    emit_kernel_event(
+        op, kind="collective", method="hier_dcn",
+        axis=(ctx.dcn_axis, ctx.ici_axis), world=ctx.world_size,
+        shape=shape, dtype=dtype, bytes_moved=int(dcn_bytes),
+        hops="hierarchical", phase="dcn",
+        dcn_axis=ctx.dcn_axis, dcn_size=ctx.dcn_size,
+        ici_axis=ctx.ici_axis, ici_size=ctx.ici_size)
+
+
 def all_gather_2d(x, ctx: HierarchicalContext):
     """Gather row shards over both levels.
 
@@ -164,6 +182,10 @@ def all_gather_2d(x, ctx: HierarchicalContext):
     Output: the full (world * m, n) array, replicated.
     """
     m, n = x.shape
+    # DCN bytes: the (m, n) shard crosses to each of the other
+    # dcn_size-1 slices once.
+    _record_dcn_phase("hier_all_gather", ctx, x.shape, x.dtype,
+                      (ctx.dcn_size - 1) * m * n * x.dtype.itemsize)
     # DCN stage first: each shard crosses DCN exactly once (m rows per
     # device) — same-ICI-position devices gather across slices.
     xd = jax.lax.all_gather(x, ctx.dcn_axis, tiled=False)  # (dcn, m, n)
@@ -189,6 +211,10 @@ def reduce_scatter_2d(x, ctx: HierarchicalContext):
     mt, n = x.shape
     assert mt % world == 0, (x.shape, world)
     m = mt // world
+    # DCN bytes: after the ICI stage this device holds dcn_size
+    # slice-reduced chunks; scatter-reduce ships all but its own.
+    _record_dcn_phase("hier_reduce_scatter", ctx, x.shape, x.dtype,
+                      (ctx.dcn_size - 1) * m * n * x.dtype.itemsize)
     xr = x.reshape(ctx.dcn_size, ctx.ici_size, m, n)
     # ICI stage first: partials meet inside the slice before anything
     # crosses DCN.  Chunk by ICI position → this device keeps the
@@ -215,6 +241,12 @@ def all_reduce_2d(x, ctx: HierarchicalContext):
     m, n = x.shape
     ici = ctx.ici_size
     pad = (-m) % ici
+    # DCN bytes: the psum on the 1/ici chunk — ring RS+AG on the
+    # already-reduced rows, ~2x the chunk across slices.
+    _record_dcn_phase(
+        "hier_all_reduce", ctx, x.shape, x.dtype,
+        2 * (ctx.dcn_size - 1) * ((m + pad) // ici)
+        * n * x.dtype.itemsize // max(ctx.dcn_size, 1))
     xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
     chunk = reduce_scatter(xp, ctx._rs_ctx())         # (mp / ici, n)
     chunk = jax.lax.psum(chunk, ctx.dcn_axis)
@@ -256,6 +288,11 @@ def hierarchical_all_to_all(send_tokens, send_counts,
     _, cap, hidden = send_tokens.shape
     assert send_tokens.shape[0] == world, (send_tokens.shape, world)
     has_scale = send_scales is not None
+    # DCN bytes: stage 1 ships every non-local-slice destination block
+    # (ici blocks per remote slice) across DCN once.
+    _record_dcn_phase(
+        "hier_all_to_all", ctx, send_tokens.shape, send_tokens.dtype,
+        (dcn - 1) * ici * cap * hidden * send_tokens.dtype.itemsize)
 
     # ---- stage 1: DCN hop to the destination slice's proxy ----------
     t1 = _stage1_dcn(send_tokens.reshape(dcn, ici, cap, hidden), ctx)

@@ -144,6 +144,15 @@ def fast_all_to_all(send_tokens, send_counts, ctx: AllToAllContext,
     cap, hidden = send_tokens.shape[1], send_tokens.shape[2]
     has_scale = send_scales is not None
 
+    # Launch-metadata event: one capacity-padded block DMAed straight
+    # to each peer (dimension-ordered over the torus).
+    from triton_distributed_tpu.observability import record_collective
+    record_collective(
+        "all_to_all", axis=ctx.axis, world=world, method=ctx.method,
+        shape=tuple(send_tokens.shape), dtype=send_tokens.dtype,
+        payload_bytes=cap * hidden * send_tokens.dtype.itemsize,
+        hops="all_pairs", scaled=has_scale)
+
     if ctx.method == "xla":
         a2a = functools.partial(jax.lax.all_to_all, axis_name=ctx.axis,
                                 split_axis=0, concat_axis=0,

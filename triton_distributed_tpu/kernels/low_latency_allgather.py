@@ -81,6 +81,15 @@ def fast_allgather_packed(tensors: Sequence[jnp.ndarray],
     (world * m_i, n_i).
     """
     world = ctx.world_size
+    # Marker event: the packed exchange delegates to all_gather (which
+    # emits the byte-carrying event); this records that the transfer
+    # was one packed push, not len(tensors) separate ones.
+    from triton_distributed_tpu.observability import emit_kernel_event
+    emit_kernel_event("fast_allgather_packed", kind="collective",
+                      method="push_all", axis=ctx.axis, world=world,
+                      dtype=tensors[0].dtype if tensors else None,
+                      n_tensors=len(tensors), delegates="all_gather",
+                      hops="none")
     flats = [t.reshape(1, -1) for t in tensors]
     sizes = [f.shape[1] for f in flats]
     payload = jnp.concatenate(flats, axis=1)

@@ -416,7 +416,10 @@ def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
             dtype=out_dtype, bytes_moved=comm_bytes, flops=flops,
             estimate_us=estimate_compute_us(
                 flops, jnp.int8 if quantized else out_dtype),
-            config=ctx.gemm)
+            config=ctx.gemm,
+            # Link attribution: the RS epilogue ships each reduced
+            # chunk straight to its owner rank (one-sided puts).
+            hops="all_pairs" if world > 1 else "none")
 
     res = pl.pallas_call(
         kern,

@@ -263,6 +263,10 @@ def reduce_scatter(x, ctx: ReduceScatterContext):
 
     # Launch-metadata event (fires once per traced specialization).
     from triton_distributed_tpu.observability import record_collective
+    # The hop pattern link attribution needs derives from the method
+    # (instrument.hops_for_method): the ring circulates chunks over +1
+    # neighbor links; scatter_reduce pushes one chunk straight to each
+    # peer (dimension-ordered over the torus).
     record_collective("reduce_scatter", axis=ctx.axis, world=world,
                       method=method, shape=x.shape, dtype=x.dtype,
                       payload_bytes=m * x.shape[1] * x.dtype.itemsize)

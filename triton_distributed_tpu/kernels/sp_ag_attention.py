@@ -98,6 +98,15 @@ def _ring_attend(q, k_shard, v_shard, axis: str, attend_chunk):
     s_loc = q.shape[2]
     perm = [(i, (i + 1) % world) for i in range(world)]
 
+    # Launch-metadata event (once per traced specialization): the KV
+    # shard pair rides the +1 ring for world-1 steps.
+    from triton_distributed_tpu.observability import record_collective
+    record_collective(
+        "sp_ring_attention", axis=axis, world=world, method="ring",
+        shape=tuple(q.shape), dtype=q.dtype,
+        payload_bytes=(k_shard.size * k_shard.dtype.itemsize
+                       + v_shard.size * v_shard.dtype.itemsize))
+
     def chunk(kv, src):
         k_c, v_c = kv
         # queries at global offset my*s_loc; kv chunk at src*s_loc.
@@ -494,6 +503,15 @@ def sp_ag_attention_fused(q, k_shard, v_shard, axis: str, *,
             return_lse=True, block_q=block_q, block_k=block_k,
             interpret=interpret)
         return (out, lse) if return_lse else out
+
+    # Launch-metadata event: the fused kernel's KV chunks ride the +1
+    # ring, overlapped with the flash consumer.
+    from triton_distributed_tpu.observability import record_collective
+    record_collective(
+        "sp_ag_attention_fused", axis=axis, world=world, method="fused",
+        shape=tuple(q.shape), dtype=q.dtype,
+        payload_bytes=(k_shard.size * k_shard.dtype.itemsize
+                       + v_shard.size * v_shard.dtype.itemsize))
 
     qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
     base = jnp.asarray(kv_base, jnp.int32).reshape(1)

@@ -323,10 +323,15 @@ def all_gather_torus(x, ctx: TorusContext):
         # Degenerate tori delegate to all_gather, which emits its own
         # launch-metadata event.
         from triton_distributed_tpu.observability import record_collective
+        # Hop annotation: the torus schedule keeps all 2·nd per-axis
+        # lanes busy concurrently (axes/sizes let link attribution
+        # rebuild the exact torus).
         record_collective("all_gather_torus", axis=ctx.axes, world=world,
                           method=method, shape=x.shape, dtype=x.dtype,
                           payload_bytes=x.size * x.dtype.itemsize,
-                          sizes=sizes if len(sizes) > 1 else None)
+                          sizes=sizes if len(sizes) > 1 else None,
+                          hops="torus" if len(sizes) > 1 else "ring",
+                          axes=axes)
     if method == "xla":
         return jax.lax.all_gather(x, ctx.axes, tiled=True)
     if len(axes) == 1:
@@ -565,7 +570,9 @@ def reduce_scatter_torus(x, ctx: TorusContext):
         record_collective("reduce_scatter_torus", axis=ctx.axes,
                           world=world, method=method, shape=x.shape,
                           dtype=x.dtype, payload_bytes=chunk_bytes,
-                          sizes=sizes if len(sizes) > 1 else None)
+                          sizes=sizes if len(sizes) > 1 else None,
+                          hops="torus" if len(sizes) > 1 else "ring",
+                          axes=axes)
     if method == "xla":
         return jax.lax.psum_scatter(
             x.reshape(world, mt0 // world, -1), ctx.axes,
@@ -804,12 +811,14 @@ def all_reduce_torus(x, ctx: TorusContext):
         # counting).
         from triton_distributed_tpu.observability import (
             record_collective)
-        _, _sizes = ctx.active()
+        _axes, _sizes = ctx.active()
         record_collective("all_reduce_torus", axis=ctx.axes,
                           world=world, method=method, shape=x.shape,
                           dtype=x.dtype,
                           payload_bytes=x.size * x.dtype.itemsize,
-                          sizes=_sizes if len(_sizes) > 1 else None)
+                          sizes=_sizes if len(_sizes) > 1 else None,
+                          hops="torus" if len(_sizes) > 1 else "ring",
+                          axes=_axes)
         return jax.lax.psum(x, ctx.axes)
     m, n = x.shape
     pad = (-m) % world

@@ -9,6 +9,23 @@ is host-side (the device hot path is untouched); the global opt-out is
 ``TDT_OBSERVABILITY=0``.
 """
 
+from triton_distributed_tpu.observability.anomaly import (  # noqa: F401
+    Baseline,
+    BaselineStore,
+    flag_occurrences,
+    get_baseline_store,
+    straggler_ranking,
+)
+from triton_distributed_tpu.observability.links import (  # noqa: F401
+    LinkTracker,
+    TorusTopology,
+    detect_contention,
+    get_link_tracker,
+    hot_links,
+    link_label,
+    links_for_event,
+    links_global,
+)
 from triton_distributed_tpu.observability.audit import (  # noqa: F401
     AuditRow,
     audit_events,

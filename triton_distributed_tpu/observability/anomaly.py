@@ -1,0 +1,423 @@
+"""Anomaly detection: rolling per-(kernel, shape, mesh) latency
+baselines, z-score flagging of slow occurrences, and a
+consistent-straggler ranking that names the rank *and* what it was
+blocked on.
+
+The perf-model audit (:mod:`.audit`) judges measurements against an
+*analytic* expectation — trustworthy to a factor.  Baselines here are
+*empirical*: every measured occurrence of a (op, method, shape, world)
+key updates a rolling mean/variance, persisted beside the autotuner
+cache, so the next run — or the next occurrence within this run — can
+be judged against what this machine actually did before, to a
+z-score rather than a factor.
+
+Rolling statistics: exact Welford up to ``WINDOW`` samples, then an
+EWMA with ``alpha = 2/(WINDOW+1)`` so drifting hardware re-baselines
+itself instead of flagging forever.
+
+Consumers:
+
+- :func:`.audit.bench_record` attaches ``anomaly_z`` to every bench
+  line and bumps ``anomaly_flags_total`` past ``Z_THRESHOLD``;
+- the timeline merge flags slow span occurrences cross-rank
+  (:func:`flag_occurrences`);
+- the doctor ranks consistent stragglers with
+  :func:`straggler_ranking`, blaming the link / semaphore the flight
+  dumps show the rank stuck on.
+
+Opt-out follows the subsystem switch: with ``TDT_OBSERVABILITY=0``
+nothing here is constructed (callers bail out before reaching us).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import math
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+#: Persisted beside the autotuner cache (both default to the CWD —
+#: `autotuner.DEFAULT_CACHE` is ".autotune_cache.json").
+DEFAULT_BASELINES = ".anomaly_baselines.json"
+ENV_BASELINES = "TDT_ANOMALY_BASELINES"
+
+#: |z| above which an occurrence is flagged.
+Z_THRESHOLD = 3.0
+#: Baselines younger than this many samples never flag (no stable
+#: variance to judge against yet).
+MIN_SAMPLES = 5
+#: Welford → EWMA switchover.
+WINDOW = 64
+
+BASELINE_SCHEMA = 1
+
+
+class Baseline:
+    """Rolling mean/variance of one key's latency (µs)."""
+
+    __slots__ = ("n", "mean", "m2")
+
+    def __init__(self, n: int = 0, mean: float = 0.0, m2: float = 0.0):
+        self.n = int(n)
+        self.mean = float(mean)
+        self.m2 = float(m2)
+
+    @property
+    def var(self) -> float:
+        if self.n < 2:
+            return 0.0
+        return self.m2 / (self.n - 1)
+
+    @property
+    def std(self) -> float:
+        return math.sqrt(self.var)
+
+    def zscore(self, x: float) -> Optional[float]:
+        """z of ``x`` against the baseline; None until the baseline
+        has ``MIN_SAMPLES`` and a usable spread.  The spread floor
+        (2% of mean) keeps a suspiciously-tight baseline from turning
+        scheduler jitter into a 50-sigma page."""
+        if self.n < MIN_SAMPLES:
+            return None
+        floor = 0.02 * abs(self.mean)
+        std = max(self.std, floor, 1e-9)
+        return (x - self.mean) / std
+
+    def update(self, x: float) -> None:
+        x = float(x)
+        if self.n < WINDOW:
+            self.n += 1
+            d = x - self.mean
+            self.mean += d / self.n
+            self.m2 += d * (x - self.mean)
+        else:
+            alpha = 2.0 / (WINDOW + 1)
+            d = x - self.mean
+            self.mean += alpha * d
+            # EWMA of squared deviation, scaled so .var keeps its
+            # n-1 normalisation roughly comparable.
+            self.m2 += alpha * (d * d * (self.n - 1) - self.m2)
+
+    def to_list(self) -> list:
+        return [self.n, round(self.mean, 4), round(self.m2, 4)]
+
+    @classmethod
+    def from_list(cls, row) -> "Baseline":
+        return cls(*row)
+
+
+def event_key(op, method=None, shape=None, world=1,
+              sizes=None) -> str:
+    """Stable baseline key.  ``sizes`` (torus axis sizes) folds the
+    mesh shape in so a 4x4 torus and a flat 16-ring keep separate
+    baselines."""
+    shape_s = ("x".join(str(int(s)) for s in shape)
+               if shape else "-")
+    mesh_s = ("x".join(str(int(s)) for s in sizes)
+              if sizes else str(int(world)))
+    return f"{op}|{method or '-'}|{shape_s}|w{mesh_s}"
+
+
+def key_for_event(ev) -> str:
+    extra = getattr(ev, "extra", None) or {}
+    return event_key(ev.op, ev.method, ev.shape, ev.world,
+                     sizes=extra.get("sizes"))
+
+
+#: Bench-line fields that size the work: every one present joins the
+#: baseline key, so size sweeps (nbytes rows, S sweeps, batch dims)
+#: keep one baseline PER POINT instead of collapsing into a mixed
+#: population with meaningless variance.
+_BENCH_SIZE_FIELDS = ("M", "K", "N", "B", "H", "D", "S", "E", "cap",
+                      "nbytes", "rows", "seq", "s", "block_k",
+                      "offered_load", "n_requests")
+
+
+def key_for_bench(rec: dict) -> str:
+    dims = ",".join(f"{f}={int(rec[f])}" for f in _BENCH_SIZE_FIELDS
+                    if isinstance(rec.get(f), (int, float))
+                    and not isinstance(rec.get(f), bool))
+    return (f"{rec.get('bench', 'bench')}|{rec.get('method') or '-'}"
+            f"|{dims or '-'}|w{int(rec.get('world', 1) or 1)}")
+
+
+def span_key(name: str, ranks: int) -> str:
+    """Baseline key for a timeline span name (cross-rank merge)."""
+    return f"span:{name}|w{int(ranks)}"
+
+
+class BaselineStore:
+    """Thread-safe keyed collection of :class:`Baseline`s with
+    merge-on-save JSON persistence (same discipline as the autotuner
+    cache: two ranks saving concurrently must not drop each other's
+    keys)."""
+
+    def __init__(self, path: Optional[str] = None):
+        if path is None:
+            path = os.environ.get(ENV_BASELINES, DEFAULT_BASELINES)
+        self.path = path
+        self._lock = threading.RLock()
+        self._baselines: Dict[str, Baseline] = {}
+        self._loaded = False
+
+    # -- persistence ----------------------------------------------------
+
+    def _read_file(self) -> Dict[str, Baseline]:
+        try:
+            with open(self.path) as f:
+                raw = json.load(f)
+            return {k: Baseline.from_list(v)
+                    for k, v in raw.get("baselines", {}).items()}
+        except Exception:
+            return {}
+
+    def load(self) -> "BaselineStore":
+        with self._lock:
+            if not self._loaded:
+                disk = self._read_file()
+                for k, b in disk.items():
+                    self._baselines.setdefault(k, b)
+                self._loaded = True
+        return self
+
+    def save(self) -> Optional[str]:
+        """Merge-save: re-read, prefer in-memory (newer) entries,
+        atomic replace.  Returns the path or None on failure (disk
+        trouble must never break a bench)."""
+        try:
+            with self._lock:
+                merged = self._read_file()
+                merged.update(self._baselines)
+                payload = {
+                    "schema": BASELINE_SCHEMA,
+                    "baselines": {k: b.to_list()
+                                  for k, b in sorted(merged.items())},
+                }
+                d = os.path.dirname(os.path.abspath(self.path))
+                os.makedirs(d, exist_ok=True)
+                tmp = f"{self.path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(payload, f, indent=1)
+                os.replace(tmp, self.path)
+            return self.path
+        except OSError:
+            return None
+
+    # -- observation ----------------------------------------------------
+
+    def get(self, key: str) -> Optional[Baseline]:
+        with self._lock:
+            self.load()
+            return self._baselines.get(key)
+
+    def zscore(self, key: str, us: float) -> Optional[float]:
+        b = self.get(key)
+        return b.zscore(float(us)) if b is not None else None
+
+    def observe(self, key: str, us: float) -> Optional[float]:
+        """Score ``us`` against the *pre-update* baseline, then roll
+        it in.  Returns the z (None while the baseline is warming)."""
+        with self._lock:
+            self.load()
+            b = self._baselines.get(key)
+            if b is None:
+                b = self._baselines[key] = Baseline()
+            z = b.zscore(float(us))
+            b.update(float(us))
+            return z
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            self.load()
+            return sorted(self._baselines)
+
+    def __len__(self) -> int:
+        with self._lock:
+            self.load()
+            return len(self._baselines)
+
+
+_STORE: Optional[BaselineStore] = None
+_STORE_LOCK = threading.Lock()
+
+
+def get_baseline_store() -> BaselineStore:
+    global _STORE
+    with _STORE_LOCK:
+        if _STORE is None:
+            _STORE = BaselineStore()
+        return _STORE
+
+
+#: Minimum seconds between on-observe saves: a bench sweep emitting
+#: hundreds of lines must not pay a full read-merge-rewrite of the
+#: baselines file per line (an atexit flush catches the tail).
+SAVE_INTERVAL_S = 5.0
+
+_LAST_SAVE = 0.0
+_FLUSH_ARMED = False
+
+
+def _arm_atexit_flush(store: BaselineStore) -> None:
+    global _FLUSH_ARMED
+    if not _FLUSH_ARMED:
+        _FLUSH_ARMED = True
+        atexit.register(store.save)
+
+
+def observe_bench(rec: dict, us: float, *, store=None,
+                  persist: bool = True) -> Optional[float]:
+    """`bench_record`'s hook: score + roll one bench measurement,
+    bump ``anomaly_flags_total`` past the threshold, persist (saves
+    are throttled to once per ``SAVE_INTERVAL_S``; an atexit flush
+    writes whatever the throttle deferred)."""
+    from triton_distributed_tpu.observability.metrics import get_registry
+    global _LAST_SAVE
+    store = get_baseline_store() if store is None else store
+    key = key_for_bench(rec)
+    z = store.observe(key, us)
+    if z is not None and abs(z) > Z_THRESHOLD:
+        get_registry().counter(
+            "anomaly_flags_total",
+            op=str(rec.get("bench", "bench"))).inc()
+    if persist:
+        now = time.monotonic()
+        if now - _LAST_SAVE >= SAVE_INTERVAL_S or not _LAST_SAVE:
+            store.save()
+            _LAST_SAVE = now
+        else:
+            _arm_atexit_flush(store)
+    return z
+
+
+# ---------------------------------------------------------------------------
+# Timeline integration: slow occurrences + consistent stragglers
+# ---------------------------------------------------------------------------
+
+def flag_occurrences(rows: Sequence[dict], ranks: int,
+                     store: Optional[BaselineStore] = None,
+                     threshold: float = Z_THRESHOLD) -> List[dict]:
+    """Flag anomalously slow (span, occurrence, rank) durations.
+
+    ``rows``: :func:`.timeline.skew_rows` output (µs durations per
+    rank per occurrence).  Scoring is two-tier: the persisted span
+    baseline when one exists, else the within-merge population of the
+    same span name (>= ``MIN_SAMPLES`` durations).  Every duration
+    also rolls into the persisted baseline so repeated merges learn.
+    """
+    store = get_baseline_store() if store is None else store
+    # Within-merge population per span name (rows without per-rank
+    # durations contribute nothing and are never flagged).
+    by_name: Dict[str, List[float]] = {}
+    for row in rows:
+        durs = row.get("durs_us")
+        if durs:
+            by_name.setdefault(row["name"], []).extend(
+                float(d) for d in durs.values())
+    # Per-name population stats, computed once (not per row — a merge
+    # can hold thousands of occurrences of one span name).
+    pop_stats: Dict[str, tuple] = {}
+    for name, pop in by_name.items():
+        mean = sum(pop) / len(pop)
+        var = (sum((d - mean) ** 2 for d in pop) / (len(pop) - 1)
+               if len(pop) > 1 else 0.0)
+        pop_stats[name] = (len(pop), mean,
+                           max(math.sqrt(var), 0.02 * abs(mean), 1e-9))
+    flags: List[dict] = []
+    for row in rows:
+        durs = row.get("durs_us")
+        if not durs:
+            continue
+        name = row["name"]
+        key = span_key(name, ranks)
+        pop_n, pop_mean, pop_std = pop_stats[name]
+        for rank, dur in durs.items():
+            dur = float(dur)
+            z = store.zscore(key, dur)
+            source = "baseline"
+            if z is None and pop_n >= MIN_SAMPLES:
+                z = (dur - pop_mean) / pop_std
+                source = "merge"
+            if z is not None and z > threshold:
+                flags.append({
+                    "name": name,
+                    "occurrence": row.get("occurrence", 0),
+                    "rank": int(rank),
+                    "dur_us": round(dur, 3),
+                    "z": round(z, 2),
+                    "source": source,
+                })
+    # Roll every duration into the persisted span baselines.
+    for name, durs in sorted(by_name.items()):
+        key = span_key(name, ranks)
+        for d in durs:
+            store.observe(key, d)
+    flags.sort(key=lambda f: -f["z"])
+    return flags
+
+
+#: Spans whose mean cross-rank skew is below this never indict a
+#: straggler — µs-scale jitter is scheduler noise, not a slow rank.
+MIN_STRAGGLER_SKEW_US = 500.0
+
+
+def straggler_ranking(report: dict,
+                      flights: Optional[Dict[int, dict]] = None,
+                      top: int = 4,
+                      min_skew_us: float = MIN_STRAGGLER_SKEW_US
+                      ) -> List[dict]:
+    """Rank ranks by how much barrier wait they cost everyone else.
+
+    ``report``: :func:`.timeline.straggler_report` output.  For each
+    rank: the total wait its lateness charged other ranks (summed over
+    span names where it is the consistent straggler and the skew is
+    material), the spans it strangled, and — when per-rank flight
+    dumps are supplied — the link and semaphore its last in-flight
+    event blames.
+    """
+    from triton_distributed_tpu.observability import links as _links
+
+    cost: Dict[int, float] = {}
+    spans_by_rank: Dict[int, List[str]] = {}
+    for name, agg in report.get("spans", {}).items():
+        straggler = int(agg.get("straggler_rank", -1))
+        if straggler < 0:
+            continue
+        if float(agg.get("mean_skew_us", 0.0)) < min_skew_us:
+            continue
+        paid = sum(agg.get("barrier_wait_us", {}).values())
+        cost[straggler] = cost.get(straggler, 0.0) + paid
+        spans_by_rank.setdefault(straggler, []).append(name)
+    ranking = []
+    for rank, paid in sorted(cost.items(),
+                             key=lambda kv: (-kv[1], kv[0])):
+        row = {
+            "rank": rank,
+            "barrier_wait_charged_us": round(paid, 3),
+            "spans": sorted(spans_by_rank.get(rank, [])),
+            "blamed_link": None,
+            "blamed_sem": None,
+        }
+        flight = (flights or {}).get(rank)
+        if flight:
+            evs = flight.get("events") or []
+            last = evs[-1] if evs else None
+            if last:
+                extra = last.get("extra") or {}
+                row["blamed_sem"] = extra.get("pending_sem")
+                row["last_op"] = last.get("op")
+                try:
+                    from triton_distributed_tpu.observability.events \
+                        import KernelEvent
+                    lks = _links.links_for_event(
+                        KernelEvent.from_dict(last))
+                    if lks:
+                        hot = max(sorted(lks), key=lambda k: lks[k])
+                        row["blamed_link"] = _links.link_label(hot)
+                except Exception:
+                    pass
+        ranking.append(row)
+    return ranking[:top]

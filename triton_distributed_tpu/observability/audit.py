@@ -181,6 +181,17 @@ def bench_record(rec: dict, *, print_line: bool = True) -> dict:
         if est is not None:
             rec["estimate_us"] = round(est, 1)
             rec["model_deviation"] = round(float(us) / est, 3)
+        # Empirical twin of the analytic audit: score against the
+        # rolling baseline for this (bench, shape, method, world) and
+        # roll the measurement in (persisted beside the autotune
+        # cache — see observability/anomaly.py).
+        from triton_distributed_tpu.observability.anomaly import (
+            Z_THRESHOLD, observe_bench)
+        z = observe_bench(rec, float(us))
+        if z is not None:
+            rec["anomaly_z"] = round(z, 2)
+            if abs(z) > Z_THRESHOLD:
+                rec["anomaly"] = True
         ev = emit_kernel_event(
             _BENCH_OPS.get(rec.get("bench"), rec.get("bench", "bench")),
             kind="bench", method=rec.get("method"),

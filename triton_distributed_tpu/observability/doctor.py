@@ -1,0 +1,755 @@
+"""Incident doctor: one command that turns a failed run's artifact
+directory into a root-cause report.
+
+Diagnosing a stall today means hand-correlating four artifact
+families — per-rank Chrome traces (:mod:`.tracing`), flight-recorder
+dumps (:mod:`.recorder`), heartbeat files (:mod:`.exporter`), and
+metrics JSON — plus, when the failing kernel is registered with the
+static sanitizer, PR 4's comm graph.  The doctor ingests all of them
+and answers, in one markdown/JSON report:
+
+- what was **in flight** on each rank (open span, last kernel event,
+  logical step, serving load);
+- **who stalled first** (heartbeat staleness, oldest last-activity);
+- the **pending semaphore** at stall time (flight-dump annotation or
+  the static analysis' finding);
+- whether the **static comm graph** says that wait *could* hang
+  (a finding names the defect; a clean graph means the wait is
+  statically matched, so the hang has a runtime cause — peer death or
+  link failure);
+- which **ICI links were hot** (per-link byte attribution over the
+  flight events, plus contention between overlapping collectives);
+- **anomalies and stragglers** from the merged timeline
+  (:mod:`.anomaly`), with the blamed link/semaphore.
+
+Usage::
+
+    python -m triton_distributed_tpu.observability.doctor ARTIFACT_DIR
+    python -m triton_distributed_tpu.observability.doctor DIR --json -
+    python -m triton_distributed_tpu.observability.doctor DIR \
+        --check tests/data/incidents/stalled_rank/report.golden.json
+
+``scripts/launch.py`` invokes it automatically when the watchdog fires
+(exit 124) or a rank exits nonzero.  Reports are deterministic given
+the artifacts ("now" is the newest artifact timestamp, not the wall
+clock), so golden reports can gate CI (`scripts/verify_tier1.sh`).
+
+Exit status: 0 report written, 2 usage/no artifacts, 3 golden drift.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from triton_distributed_tpu.observability.exporter import (
+    STALE_INTERVALS,
+)
+
+REPORT_SCHEMA = 1
+REPORT_JSON = "incident_report.json"
+REPORT_MD = "incident_report.md"
+
+#: (op, method) -> analysis-registry kernel name, so the doctor can
+#: replay the running kernel on the abstract machine.  None matches
+#: any method.
+_OP_TO_KERNEL = {
+    ("all_gather", "ring"): "allgather.ring",
+    ("all_gather", "bidir_ring"): "allgather.bidir_ring",
+    ("all_gather", "push_all"): "allgather.push_all",
+    ("reduce_scatter", "ring"): "reduce_scatter.ring",
+    ("reduce_scatter", "scatter_reduce"):
+        "reduce_scatter.scatter_reduce",
+    ("all_reduce", "one_shot"): "allreduce.one_shot",
+    ("all_reduce", "two_shot"): "allreduce.two_shot",
+    ("all_reduce", "chain"): "allreduce.chain",
+    ("ag_gemm", "fused"): "ag_gemm.fused",
+    ("ag_gemm", "ll"): "ag_gemm.ll",
+    ("ag_gemm_w8a8", "fused"): "ag_gemm.w8a8",
+    ("gemm_rs", "fused"): "gemm_rs.fused",
+    ("gemm_rs", "ll"): "gemm_rs.ll",
+    ("all_gather_torus", None): "torus.allgather",
+    ("reduce_scatter_torus", None): "torus.reduce_scatter",
+    ("moe_reduce_rs_fused", "fused"): "moe_reduce_rs.fused",
+    ("moe_reduce_rs_fused", "two_phase"): "moe_reduce_rs.two_phase",
+    ("moe_reduce_rs_fused", "w8a8"): "moe_reduce_rs.w8a8",
+    ("all_to_all", "auto"): "all_to_all.plain",
+    ("sp_ag_attention_fused", "fused"): "sp_ag_attention.fused",
+    ("sp_ring_attention", "ring"): "sp_ag_attention.fused",
+    ("sp_flash_decode", "push_all"): "flash_decode.partials_ag",
+    ("ag_group_gemm", "ring"): "ag_group_gemm.ring",
+    ("fast_allgather_packed", "push_all"): "ll_allgather.push",
+    ("barrier_all", None): "common_ops.barrier",
+    ("broadcast", None): "common_ops.broadcast",
+}
+
+
+def kernel_for_event(ev: dict) -> Optional[str]:
+    op, method = ev.get("op"), ev.get("method")
+    return (_OP_TO_KERNEL.get((op, method))
+            or _OP_TO_KERNEL.get((op, None)))
+
+
+# ---------------------------------------------------------------------------
+# Artifact discovery / loading
+# ---------------------------------------------------------------------------
+
+def _rank_of(path: str) -> Optional[int]:
+    m = re.search(r"rank-(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _load_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class Artifacts:
+    """Everything salvageable from one or more artifact directories."""
+
+    def __init__(self, dirs: Sequence[str]):
+        self.dirs = [os.path.abspath(d) for d in dirs]
+        self.traces: List[dict] = []
+        self.trace_files: List[str] = []
+        self.flights: Dict[int, dict] = {}
+        self.heartbeats: Dict[int, dict] = {}
+        self.metrics: Dict[int, dict] = {}
+        self.static_findings: Optional[dict] = None
+        self._discover()
+
+    def _glob(self, pattern: str) -> List[str]:
+        out = []
+        for d in self.dirs:
+            out += glob.glob(os.path.join(d, pattern))
+            out += glob.glob(os.path.join(d, "heartbeats", pattern))
+        return sorted(set(out))
+
+    def _discover(self) -> None:
+        from triton_distributed_tpu.observability.timeline import (
+            load_trace)
+        for p in self._glob("trace-rank-*.json"):
+            try:
+                self.traces.append(load_trace(p))
+                self.trace_files.append(p)
+            except (OSError, ValueError):
+                continue
+        for p in self._glob("flight-rank-*.json"):
+            d = _load_json(p)
+            if d is not None:
+                self.flights[int(d.get("rank", _rank_of(p) or 0))] = d
+        for p in self._glob("heartbeat-rank-*.json"):
+            d = _load_json(p)
+            if d is not None:
+                self.heartbeats[
+                    int(d.get("rank", _rank_of(p) or 0))] = d
+        for p in self._glob("metrics-rank-*.json"):
+            d = _load_json(p)
+            if d is not None:
+                rank = d.get("meta", {}).get("rank", _rank_of(p) or 0)
+                self.metrics[int(rank)] = d
+        for p in self._glob("analysis-findings.json"):
+            d = _load_json(p)
+            if d is not None:
+                self.static_findings = d
+                break
+
+    def empty(self) -> bool:
+        return not (self.traces or self.flights or self.heartbeats
+                    or self.metrics)
+
+    def ranks(self) -> List[int]:
+        from triton_distributed_tpu.observability.timeline import (
+            trace_rank)
+        ranks = set(self.flights) | set(self.heartbeats) | set(
+            self.metrics)
+        ranks |= {trace_rank(tr, i) for i, tr in enumerate(self.traces)}
+        return sorted(ranks)
+
+    def newest_timestamp(self) -> float:
+        """The report's deterministic "now": the newest timestamp any
+        artifact carries (never the wall clock, so re-running the
+        doctor over the same directory reproduces the report)."""
+        ts = [0.0]
+        for hb in self.heartbeats.values():
+            ts.append(float(hb.get("unix_time", 0.0)))
+        for fl in self.flights.values():
+            ts.append(float(fl.get("unix_time", 0.0)))
+            for ev in fl.get("events", []):
+                ts.append(float(ev.get("ts", 0.0)))
+        for tr in self.traces:
+            for e in tr.get("traceEvents", []):
+                if e.get("ph") == "X":
+                    ts.append((float(e.get("ts", 0.0))
+                               + float(e.get("dur") or 0.0)) * 1e-6)
+        return max(ts)
+
+    def metrics_for(self, rank: int) -> Optional[dict]:
+        """Registry snapshot for a rank: standalone export if present,
+        else the one embedded in its flight dump."""
+        if rank in self.metrics:
+            return self.metrics[rank]
+        fl = self.flights.get(rank)
+        return fl.get("metrics") if fl else None
+
+
+# ---------------------------------------------------------------------------
+# Analysis passes
+# ---------------------------------------------------------------------------
+
+def _counter(snapshot: Optional[dict], name: str) -> float:
+    if not snapshot:
+        return 0.0
+    total = 0.0
+    for key, v in snapshot.get("counters", {}).items():
+        if key == name or key.startswith(name + "{"):
+            total += v
+    return total
+
+
+def build_rank_table(art: Artifacts, now: float,
+                     interval: float) -> Dict[str, dict]:
+    table: Dict[str, dict] = {}
+    for rank in art.ranks():
+        hb = art.heartbeats.get(rank, {})
+        fl = art.flights.get(rank, {})
+        snap = art.metrics_for(rank)
+        age = (round(now - float(hb["unix_time"]), 3)
+               if hb.get("unix_time") else None)
+        events = fl.get("events", [])
+        last_ev = events[-1] if events else None
+        row = {
+            "heartbeat_age_s": age,
+            "stale": (age is not None
+                      and age > STALE_INTERVALS * interval),
+            "step": hb.get("step"),
+            "last_span": hb.get("last_span"),
+            "open_spans": hb.get("open_spans",
+                                 [s.get("name") for s in
+                                  fl.get("open_spans", [])]),
+            "last_event": ({
+                "op": last_ev.get("op"),
+                "method": last_ev.get("method"),
+                "age_s": round(now - float(last_ev.get("ts", 0.0)), 3),
+            } if last_ev else None),
+            "dropped_spans": int(_counter(snap, "trace_dropped_spans")),
+            "dropped_events": int(_counter(snap, "events_dropped")),
+        }
+        if hb.get("serving"):
+            row["serving"] = hb["serving"]
+        table[str(rank)] = row
+    return table
+
+
+def detect_stall(art: Artifacts, rank_table: Dict[str, dict]
+                 ) -> dict:
+    stalled = sorted(int(r) for r, row in rank_table.items()
+                     if row["stale"])
+    first = None
+    if stalled:
+        # The stalest heartbeat stopped beating first — that rank
+        # wedged while its peers kept going (until they blocked on it).
+        first = max(stalled,
+                    key=lambda r:
+                    rank_table[str(r)]["heartbeat_age_s"] or 0.0)
+    pending_sem = None
+    in_flight = None
+    open_span = None
+    if first is not None:
+        row = rank_table[str(first)]
+        open_span = (row["open_spans"][-1] if row.get("open_spans")
+                     else row.get("last_span"))
+        fl = art.flights.get(first, {})
+        events = fl.get("events", [])
+        if events:
+            in_flight = events[-1]
+            pending_sem = (in_flight.get("extra") or {}).get(
+                "pending_sem")
+    return {
+        "stalled_ranks": stalled,
+        "first_stalled_rank": first,
+        "open_span": open_span,
+        "pending_sem": pending_sem,
+        "in_flight_op": ({"op": in_flight.get("op"),
+                          "method": in_flight.get("method"),
+                          "world": in_flight.get("world")}
+                         if in_flight else None),
+        "in_flight_event": in_flight,
+    }
+
+
+def run_static_analysis(art: Artifacts, stall: dict,
+                        kernel: Optional[str] = None,
+                        mesh: Optional[Dict[str, int]] = None,
+                        enabled: bool = True) -> Optional[dict]:
+    """Consult PR 4's comm-graph sanitizer for the in-flight kernel:
+    a pre-computed ``analysis-findings.json`` in the artifact dir wins
+    (it captures the *deployed* kernel); otherwise replay the mapped
+    registry kernel live at the incident's mesh."""
+    ev = stall.get("in_flight_event")
+    if not enabled or (ev is None and art.static_findings is None
+                       and kernel is None):
+        return None
+    out: dict = {"kernel": kernel, "mesh": mesh, "findings": [],
+                 "source": None}
+    if art.static_findings is not None:
+        rows = art.static_findings.get("findings", [])
+        out["findings"] = rows
+        out["source"] = "artifact"
+        if rows and out["kernel"] is None:
+            out["kernel"] = rows[0].get("kernel")
+    else:
+        if out["kernel"] is None and ev is not None:
+            out["kernel"] = kernel_for_event(ev)
+        if out["kernel"] is None:
+            return None
+        if out["mesh"] is None and ev is not None:
+            axis = str(ev.get("axis") or "tp")
+            extra = ev.get("extra") or {}
+            if extra.get("axes") and extra.get("sizes"):
+                out["mesh"] = dict(zip(extra["axes"],
+                                       (int(s)
+                                        for s in extra["sizes"])))
+            else:
+                out["mesh"] = {axis: int(ev.get("world", 2) or 2)}
+        try:
+            from triton_distributed_tpu import analysis
+            for name, axis_sizes, findings in analysis.sweep(
+                    [out["kernel"]], out["mesh"]):
+                out["mesh"] = axis_sizes
+                out["findings"] = [{
+                    "kernel": name,
+                    "kind": f.kind.value,
+                    "rank": list(f.rank) if f.rank is not None
+                    else None,
+                    "sem": f.sem,
+                    "ref": f.ref,
+                    "message": f.message,
+                } for f in findings]
+            out["source"] = "live"
+        except Exception as e:
+            out["source"] = f"unavailable ({type(e).__name__})"
+            return out
+    hangy = [f for f in out["findings"]
+             if f.get("kind") in ("deadlock", "unsatisfied_wait",
+                                  "sem_leak", "sem_overdrain",
+                                  "barrier_mismatch")]
+    if hangy:
+        f = hangy[0]
+        out["could_hang"] = True
+        out["verdict"] = (
+            f"static graph says this wait CAN hang: [{f.get('kind')}] "
+            f"{f.get('message')}")
+        if stall.get("pending_sem") is None and f.get("sem"):
+            stall["pending_sem"] = f["sem"]
+    elif out["source"] and not str(out["source"]).startswith(
+            "unavailable"):
+        out["could_hang"] = False
+        out["verdict"] = (
+            "static graph pairs every wait with a signal — a hang "
+            "here implies a runtime cause (peer death, link failure, "
+            "or a stale semaphore from an earlier aborted launch)")
+    return out
+
+
+def analyze_links(art: Artifacts) -> dict:
+    from triton_distributed_tpu.observability import links as _links
+    from triton_distributed_tpu.observability.events import KernelEvent
+
+    events = []
+    for rank in sorted(art.flights):
+        for ev in art.flights[rank].get("events", []):
+            try:
+                events.append(KernelEvent.from_dict(ev))
+            except (TypeError, KeyError):
+                continue
+    return {
+        "hot": _links.hot_links(events, top=5),
+        "contention": _links.detect_contention(events)[:10],
+    }
+
+
+def analyze_timeline(art: Artifacts, store) -> Tuple[dict, dict]:
+    """(straggler_report-with-anomalies, timeline summary)."""
+    from triton_distributed_tpu.observability import timeline as tl
+    if not art.traces:
+        return {}, {"merged": False, "truncated_ranks": []}
+    report = tl.straggler_report(art.traces, store=store)
+    summary = {
+        "merged": True,
+        "truncated_ranks": report.get("timeline_truncated_ranks", []),
+        "spans_compared": len(report.get("spans", {})),
+    }
+    return report, summary
+
+
+# ---------------------------------------------------------------------------
+# Report assembly
+# ---------------------------------------------------------------------------
+
+def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
+             mesh: Optional[Dict[str, int]] = None,
+             now: Optional[float] = None,
+             interval: Optional[float] = None,
+             static: bool = True) -> Optional[dict]:
+    """Build the full incident report dict (None when the directories
+    hold no artifacts at all)."""
+    from triton_distributed_tpu.observability.anomaly import (
+        BaselineStore, straggler_ranking)
+
+    art = Artifacts(dirs)
+    if art.empty():
+        return None
+    if interval is None:
+        try:
+            interval = float(os.environ.get("TDT_HEARTBEAT_INTERVAL",
+                                            "1.0"))
+        except ValueError:
+            interval = 1.0
+    now = art.newest_timestamp() if now is None else float(now)
+
+    rank_table = build_rank_table(art, now, interval)
+    stall = detect_stall(art, rank_table)
+    static_out = run_static_analysis(art, stall, kernel=kernel,
+                                     mesh=mesh, enabled=static)
+    link_out = analyze_links(art)
+    # Baselines pinned to the artifact dir: the report must not change
+    # with whatever ambient baseline file the operator's CWD holds.
+    store = BaselineStore(os.path.join(
+        art.dirs[0], "anomaly_baselines.json"))
+    straggler_rep, timeline_summary = analyze_timeline(art, store)
+    stragglers = straggler_ranking(straggler_rep, art.flights)
+    anomalies = straggler_rep.get("anomalies", [])
+
+    incompleteness = []
+    for rank, row in sorted(rank_table.items(), key=lambda kv:
+                            int(kv[0])):
+        if row["dropped_spans"]:
+            incompleteness.append(
+                f"rank {rank}: {row['dropped_spans']} span(s) "
+                "evicted from the trace ring — its timeline lane is "
+                "incomplete")
+        if row["dropped_events"]:
+            incompleteness.append(
+                f"rank {rank}: {row['dropped_events']} event(s) "
+                "evicted from the flight ring — oldest in-flight "
+                "context is lost")
+    for rank in timeline_summary.get("truncated_ranks", []):
+        incompleteness.append(
+            f"rank {rank}: trace file truncated (killed mid-write); "
+            "complete events were salvaged")
+
+    in_flight = stall.pop("in_flight_event", None)
+    report = {
+        "schema": REPORT_SCHEMA,
+        "now_unix": round(now, 3),
+        "heartbeat_interval_s": interval,
+        "ranks": art.ranks(),
+        "artifacts": {
+            "dirs": [os.path.basename(d.rstrip("/")) or d
+                     for d in art.dirs],
+            "traces": len(art.traces),
+            "flights": len(art.flights),
+            "heartbeats": len(art.heartbeats),
+            "metrics": len(art.metrics),
+            "static_findings_file": art.static_findings is not None,
+        },
+        "rank_table": rank_table,
+        "stall": stall,
+        "static": static_out,
+        "links": link_out,
+        "stragglers": stragglers,
+        "anomalies": anomalies[:10],
+        "timeline": timeline_summary,
+        "incompleteness": incompleteness,
+    }
+    report["verdict"] = _verdict(report, in_flight)
+    return report
+
+
+def _verdict(report: dict, in_flight: Optional[dict]) -> str:
+    stall = report["stall"]
+    static_out = report.get("static") or {}
+    hot = report["links"].get("hot") or []
+    hot_s = (f"; hottest link {hot[0]['link']} "
+             f"({hot[0]['bytes']} bytes: "
+             f"{', '.join(hot[0]['ops'])})" if hot else "")
+    if stall["first_stalled_rank"] is not None:
+        r = stall["first_stalled_rank"]
+        what = (f" inside {stall['open_span']!r}"
+                if stall.get("open_span") else "")
+        op_s = ""
+        if in_flight is not None:
+            op_s = (f" with {in_flight.get('op')}"
+                    f"[{in_flight.get('method')}] in flight")
+        sem_s = (f", blocked on semaphore {stall['pending_sem']!r}"
+                 if stall.get("pending_sem") else "")
+        verdict = (f"rank {r} stalled first{what}{op_s}{sem_s}")
+        if static_out.get("verdict"):
+            verdict += f". {static_out['verdict']}"
+        return verdict + hot_s + "."
+    stragglers = report.get("stragglers") or []
+    anomalies = report.get("anomalies") or []
+    contention = report["links"].get("contention") or []
+    if stragglers or anomalies or contention:
+        parts = ["no rank stalled"]
+        if stragglers:
+            s = stragglers[0]
+            link_s = (f" (blamed link {s['blamed_link']})"
+                      if s.get("blamed_link") else "")
+            parts.append(
+                f"rank {s['rank']} is the consistent straggler — it "
+                f"charged peers {s['barrier_wait_charged_us']:.0f}us "
+                f"of barrier wait over {', '.join(s['spans'])}"
+                f"{link_s}")
+        if anomalies:
+            a = anomalies[0]
+            parts.append(
+                f"slowest anomaly: {a['name']}#{a['occurrence']} on "
+                f"rank {a['rank']} (z={a['z']:+.1f})")
+        if contention:
+            c = contention[0]
+            parts.append(
+                f"contention between {' and '.join(c['ops'])} on "
+                f"link(s) {', '.join(c['links'])}")
+        return "; ".join(parts) + hot_s + "."
+    return ("no incident detected: heartbeats fresh, no anomalies, "
+            "no link contention" + hot_s + ".")
+
+
+# ---------------------------------------------------------------------------
+# Markdown rendering
+# ---------------------------------------------------------------------------
+
+def render_markdown(report: dict) -> str:
+    lines = ["# Incident report", ""]
+    lines += [f"**Verdict:** {report['verdict']}", ""]
+    a = report["artifacts"]
+    lines += [
+        f"Ranks {report['ranks']} — {a['traces']} trace(s), "
+        f"{a['flights']} flight dump(s), {a['heartbeats']} "
+        f"heartbeat(s), {a['metrics']} metrics export(s)"
+        + (", static findings file" if a["static_findings_file"]
+           else "") + ".", ""]
+
+    lines += ["## Ranks", "",
+              "| rank | beat age (s) | state | step | last span | "
+              "in-flight op | dropped |",
+              "|---|---|---|---|---|---|---|"]
+    for rank, row in sorted(report["rank_table"].items(),
+                            key=lambda kv: int(kv[0])):
+        ev = row.get("last_event") or {}
+        dropped = (f"{row['dropped_spans']}s/"
+                   f"{row['dropped_events']}e"
+                   if (row["dropped_spans"] or row["dropped_events"])
+                   else "-")
+        lines.append(
+            f"| {rank} "
+            f"| {row['heartbeat_age_s'] if row['heartbeat_age_s'] is not None else '-'} "
+            f"| {'STALLED' if row['stale'] else 'ok'} "
+            f"| {row['step'] if row['step'] is not None else '-'} "
+            f"| {row['last_span'] or '-'} "
+            f"| {ev.get('op', '-')}"
+            f"{'[' + ev['method'] + ']' if ev.get('method') else ''} "
+            f"| {dropped} |")
+    lines.append("")
+
+    stall = report["stall"]
+    if stall["first_stalled_rank"] is not None:
+        lines += ["## Stall", ""]
+        lines += [f"- stalled ranks: {stall['stalled_ranks']}",
+                  f"- first to stall: rank "
+                  f"{stall['first_stalled_rank']}",
+                  f"- open span at stall: {stall['open_span'] or '-'}",
+                  f"- pending semaphore: "
+                  f"{stall['pending_sem'] or 'unknown'}"]
+        if stall.get("in_flight_op"):
+            op = stall["in_flight_op"]
+            lines.append(f"- in flight: {op['op']}[{op['method']}] "
+                         f"world={op['world']}")
+        lines.append("")
+
+    static_out = report.get("static")
+    if static_out:
+        lines += ["## Static comm-graph check", ""]
+        lines += [f"- kernel: {static_out.get('kernel') or '-'} "
+                  f"(mesh {static_out.get('mesh') or '-'}, source "
+                  f"{static_out.get('source')})"]
+        for f in static_out.get("findings", [])[:5]:
+            lines.append(f"- [{f.get('kind')}] sem={f.get('sem')} "
+                         f"{f.get('message')}")
+        if static_out.get("verdict"):
+            lines.append(f"- **{static_out['verdict']}**")
+        lines.append("")
+
+    hot = report["links"].get("hot") or []
+    if hot:
+        lines += ["## Hot ICI links", "",
+                  "| link | bytes | ops |", "|---|---|---|"]
+        lines += [f"| {h['link']} | {h['bytes']} "
+                  f"| {', '.join(h['ops'])} |" for h in hot]
+        lines.append("")
+    contention = report["links"].get("contention") or []
+    if contention:
+        lines += ["## Link contention", ""]
+        lines += [f"- {' vs '.join(c['ops'])} shared "
+                  f"{', '.join(c['links'])} for {c['overlap_s']}s"
+                  for c in contention]
+        lines.append("")
+
+    if report.get("stragglers"):
+        lines += ["## Consistent stragglers", ""]
+        for s in report["stragglers"]:
+            blame = []
+            if s.get("blamed_link"):
+                blame.append(f"link {s['blamed_link']}")
+            if s.get("blamed_sem"):
+                blame.append(f"sem {s['blamed_sem']!r}")
+            lines.append(
+                f"- rank {s['rank']}: charged peers "
+                f"{s['barrier_wait_charged_us']:.0f}us over "
+                f"{', '.join(s['spans'])}"
+                + (f" — blamed {', '.join(blame)}" if blame else ""))
+        lines.append("")
+    if report.get("anomalies"):
+        lines += ["## Anomalies", ""]
+        lines += [f"- {a['name']}#{a['occurrence']} rank {a['rank']}: "
+                  f"{a['dur_us']:.0f}us (z={a['z']:+.1f}, "
+                  f"{a['source']})" for a in report["anomalies"]]
+        lines.append("")
+    if report.get("incompleteness"):
+        lines += ["## Incomplete data", ""]
+        lines += [f"- {note}" for note in report["incompleteness"]]
+        lines.append("")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Golden comparison (CI)
+# ---------------------------------------------------------------------------
+
+def compare_reports(report: dict, golden: dict) -> List[str]:
+    """Structural diff (path-labelled) between a fresh report and a
+    golden one; empty = no drift."""
+    diffs: List[str] = []
+
+    def walk(a, b, path):
+        if type(a) is not type(b):
+            diffs.append(f"{path}: type {type(a).__name__} != "
+                         f"{type(b).__name__}")
+        elif isinstance(a, dict):
+            for k in sorted(set(a) | set(b)):
+                if k not in a:
+                    diffs.append(f"{path}.{k}: missing in fresh")
+                elif k not in b:
+                    diffs.append(f"{path}.{k}: missing in golden")
+                else:
+                    walk(a[k], b[k], f"{path}.{k}")
+        elif isinstance(a, list):
+            if len(a) != len(b):
+                diffs.append(f"{path}: length {len(a)} != {len(b)}")
+            for i, (x, y) in enumerate(zip(a, b)):
+                walk(x, y, f"{path}[{i}]")
+        elif a != b:
+            diffs.append(f"{path}: {a!r} != {b!r}")
+
+    walk(report, golden, "report")
+    return diffs
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _parse_mesh(text):
+    axes = {}
+    for part in text.split(","):
+        axis, _, size = part.partition("=")
+        if not size:
+            raise argparse.ArgumentTypeError(
+                f"mesh spec {text!r} must look like tp=4 or x=2,y=2")
+        axes[axis] = int(size)
+    return axes
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m triton_distributed_tpu.observability.doctor",
+        description="Turn a failed run's artifact directory into one "
+                    "incident report (markdown + JSON).")
+    ap.add_argument("dirs", nargs="+",
+                    help="artifact directories (traces, flight dumps, "
+                         "heartbeats, metrics, analysis findings)")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the JSON report here (- for stdout); "
+                         "default <dir>/incident_report.json")
+    ap.add_argument("--md", default=None, metavar="PATH",
+                    help="write the markdown report here (- for "
+                         "stdout); default <dir>/incident_report.md")
+    ap.add_argument("--kernel", default=None,
+                    help="override the analysis-registry kernel to "
+                         "statically check")
+    ap.add_argument("--mesh", type=_parse_mesh, default=None,
+                    help="override the static-check mesh (tp=4)")
+    ap.add_argument("--now", type=float, default=None,
+                    help="override the report clock (default: newest "
+                         "artifact timestamp, for determinism)")
+    ap.add_argument("--no-static", action="store_true",
+                    help="skip the static comm-graph consult")
+    ap.add_argument("--check", default=None, metavar="GOLDEN",
+                    help="compare against a golden report JSON; exit "
+                         "3 on drift (CI gate)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the markdown on stdout")
+    args = ap.parse_args(argv)
+
+    report = diagnose(args.dirs, kernel=args.kernel, mesh=args.mesh,
+                      now=args.now, static=not args.no_static)
+    if report is None:
+        print(f"doctor: no artifacts found under {args.dirs}",
+              file=sys.stderr)
+        return 2
+
+    md = render_markdown(report)
+    json_path = args.json or os.path.join(args.dirs[0], REPORT_JSON)
+    md_path = args.md or os.path.join(args.dirs[0], REPORT_MD)
+    if json_path == "-":
+        print(json.dumps(report, indent=1))
+    else:
+        with open(json_path, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    if md_path == "-":
+        print(md)
+    else:
+        with open(md_path, "w") as f:
+            f.write(md + "\n")
+        if not args.quiet:
+            print(md)
+
+    if args.check:
+        golden = _load_json(args.check)
+        if golden is None:
+            print(f"doctor: cannot read golden {args.check}",
+                  file=sys.stderr)
+            return 2
+        diffs = compare_reports(report, golden)
+        if diffs:
+            print(f"doctor: report drifted from golden {args.check}:",
+                  file=sys.stderr)
+            for d in diffs[:20]:
+                print(f"  {d}", file=sys.stderr)
+            return 3
+        print(f"doctor: report matches golden {args.check}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,6 +140,12 @@ def emit_event(event: KernelEvent) -> Optional[KernelEvent]:
         get_flight_recorder)
     get_flight_recorder().record(event)
 
+    # ICI link attribution: events annotated with a hop pattern land
+    # their bytes on per-link counters (no-op without the annotation).
+    from triton_distributed_tpu.observability.links import (
+        maybe_attribute_links)
+    maybe_attribute_links(event)
+
     with _SINK_LOCK:
         for sink in _SINKS:
             sink.append(event)

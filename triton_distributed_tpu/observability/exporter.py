@@ -130,10 +130,19 @@ class MetricsServer:
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib API)
                 if self.path.startswith("/metrics"):
+                    # Utilization gauges are rolling-window derived:
+                    # refresh them at scrape time so the exposition
+                    # reflects the window ending *now*.
+                    from triton_distributed_tpu.observability.links \
+                        import refresh_link_gauges
+                    refresh_link_gauges()
                     body = prometheus_text(registry=reg).encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif self.path.startswith("/healthz"):
                     body = json.dumps(heartbeat_payload()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/links"):
+                    body = json.dumps(link_table(reg)).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)
@@ -193,9 +202,44 @@ def maybe_start_metrics_server() -> Optional[MetricsServer]:
         return _SERVER
 
 
+def link_table(registry: Optional[MetricsRegistry] = None) -> dict:
+    """JSON view of the per-link byte/contention counters and the
+    freshly-refreshed utilization gauges — the ``/links`` endpoint."""
+    from triton_distributed_tpu.observability.links import (
+        refresh_link_gauges)
+    refresh_link_gauges()
+    snap = (registry or get_registry()).snapshot()
+    links: Dict[str, dict] = {}
+
+    def _merge(kind, source, field):
+        for key, v in source.items():
+            name, labels = _split_key(key)
+            if name != kind:
+                continue
+            m = re.search(r'link="([^"]+)"', labels)
+            if m:
+                links.setdefault(m.group(1), {})[field] = v
+
+    _merge("ici_link_bytes_total", snap.get("counters", {}), "bytes")
+    _merge("ici_link_contention_total", snap.get("counters", {}),
+           "contentions")
+    _merge("ici_link_utilization", snap.get("gauges", {}),
+           "utilization")
+    return {"schema": 1, "rank": snap.get("meta", {}).get("rank", 0),
+            "links": dict(sorted(links.items()))}
+
+
 # ---------------------------------------------------------------------------
 # Heartbeat files
 # ---------------------------------------------------------------------------
+
+#: Serving-state gauges mirrored into the heartbeat body: a stalled
+#: rank's last beat then says what the scheduler was carrying when it
+#: stopped (doctor folds these into its rank table).
+_HEARTBEAT_GAUGES = ("serving_queue_depth", "serving_active_slots",
+                     "serving_slot_occupancy",
+                     "serving_kv_bytes_in_use")
+
 
 def heartbeat_payload() -> dict:
     """What this rank is doing right now: last/open spans, logical
@@ -204,7 +248,7 @@ def heartbeat_payload() -> dict:
     from triton_distributed_tpu.observability import tracing
     tracer = tracing.get_tracer()
     last = tracer.last_span()
-    return {
+    payload = {
         "schema": 1,
         "rank": _process_index(),
         "pid": os.getpid(),
@@ -213,6 +257,12 @@ def heartbeat_payload() -> dict:
         "last_span": last.name if last is not None else None,
         "open_spans": [s.name for s in tracer.open_spans()],
     }
+    reg = get_registry()
+    serving = {name: v for name in _HEARTBEAT_GAUGES
+               if (v := reg.peek(name)) is not None}
+    if serving:
+        payload["serving"] = serving
+    return payload
 
 
 def heartbeat_path(directory: str, rank: Optional[int] = None) -> str:

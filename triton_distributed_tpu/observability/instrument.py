@@ -75,12 +75,50 @@ def collective_bytes_per_rank(op: str, payload_bytes: int, world: int,
     return (world - 1) * payload_bytes
 
 
+#: Default hop pattern per method (the method *is* the schedule); the
+#: emit sites override where the method name underdetermines routing
+#: (torus lanes, hierarchical phases).  See observability/links.py for
+#: the link-traversal semantics of each pattern.
+_METHOD_HOPS = {
+    "ring": "ring",
+    "bidir_ring": "bidir_ring",
+    "chain": "chain",
+    "push_all": "all_pairs",
+    "one_shot": "all_pairs",
+    "two_shot": "all_pairs",
+    "scatter_reduce": "all_pairs",
+    "ll": "all_pairs",
+    # XLA's collective on a torus runs a ring schedule; attributing it
+    # as one keeps the link counters comparable across methods.
+    "xla": "ring",
+    "fused": "ring",
+}
+
+
+def hops_for_method(method) -> str:
+    """Hop-pattern annotation for a method name (conservative "ring"
+    for anything unknown so bytes are never dropped)."""
+    return _METHOD_HOPS.get(
+        method.value if hasattr(method, "value") else method, "ring")
+
+
 def record_collective(op: str, *, axis, world: int, method, shape,
-                      dtype, payload_bytes: int, sizes=None, **extra):
-    """Emit the launch-metadata event for a standalone collective."""
+                      dtype, payload_bytes: int, sizes=None,
+                      hops=None, axes=None, **extra):
+    """Emit the launch-metadata event for a standalone collective.
+
+    ``hops``: the kernel's hop-pattern annotation (defaults from the
+    method); ``axes``/``sizes``: torus axis names and sizes for
+    multi-axis events, so link attribution can rebuild the topology.
+    """
     if not observability_enabled():
         return None
     method_s = method.value if hasattr(method, "value") else method
+    if world > 1:
+        extra["hops"] = hops or hops_for_method(method_s)
+        if axes is not None and sizes is not None:
+            extra["axes"] = [str(a) for a in axes]
+            extra["sizes"] = [int(s) for s in sizes]
     return emit_kernel_event(
         op, kind="collective", method=method_s, axis=str(axis),
         world=world, shape=shape, dtype=dtype,
@@ -129,7 +167,8 @@ def estimate_overlap_gemm_us(op: str, m: int, n: int, k: int,
 
 
 def record_overlap_gemm(op: str, *, axis, world: int, method, m: int,
-                        n: int, k: int, dtype, config=None, **extra):
+                        n: int, k: int, dtype, config=None, hops=None,
+                        **extra):
     """Emit the launch-metadata event for ag_gemm / gemm_rs (and the
     MoE fused epilogue, which passes its own flops/bytes via extra)."""
     if not observability_enabled():
@@ -137,6 +176,8 @@ def record_overlap_gemm(op: str, *, axis, world: int, method, m: int,
     method_s = method.value if hasattr(method, "value") else method
     chunk_bytes = (m * (k if op.startswith("ag_gemm") else n)
                    * _itemsize(dtype))
+    if world > 1:
+        extra["hops"] = hops or hops_for_method(method_s)
     return emit_kernel_event(
         op, kind="fused_gemm", method=method_s, axis=str(axis),
         world=world, shape=(m, n, k), dtype=dtype,

@@ -1,0 +1,502 @@
+"""ICI link attribution: which physical torus links does a collective
+stress, and how hard.
+
+The paper's overlap claim makes the ICI links the contended resource —
+an ``ag_gemm`` compute stream and a serving decode's allreduce that
+*look* independent in a kernel-level trace may be fighting over the
+same directed link.  PR 1's :class:`~.events.KernelEvent` records what
+ran; this module maps each event onto the set of **directed ICI links**
+it traverses, producing per-link byte counters, a link-utilization
+gauge surface for the Prometheus exporter, and contention records when
+overlapping collectives share a link.
+
+The mapping is driven by the **hop pattern** each kernel annotates at
+event-emit time (``extra["hops"]``) — the emit site knows its schedule,
+so no heuristic reverse-engineering from op names is needed:
+
+=================  ========================================================
+pattern            link traversal (per emitting rank)
+=================  ========================================================
+``ring``           all bytes leave on the +1 neighbor link of the axis
+``bidir_ring``     half the bytes to +1, half to -1
+``chain``          open-chain reduce+broadcast: half up (+1, except the
+                   last rank), half down (-1, except rank 0)
+``all_pairs``      one chunk per peer, routed dimension-ordered over the
+                   torus (one-shot push / two-shot collectives)
+``pairs_direct``   one chunk per peer over a direct (switched) link —
+                   DCN between slices, which is a fabric, not a torus
+``torus``          multi-axis torus schedule: bytes split evenly over the
+                   2·ndim bidirectional per-axis lanes
+``hierarchical``   DCN phase of a two-level collective (the ICI phase is
+                   a separately-emitted inner event): ``pairs_direct``
+                   on the DCN axis
+``none``           no ICI traffic (world == 1 / pure compute)
+=================  ========================================================
+
+Cost discipline: with ``TDT_OBSERVABILITY=0`` nothing here is ever
+constructed — :func:`attribute_event` is only reached from
+:func:`~.events.emit_event`, which bails out first, and the module
+keeps no state until the first enabled event arrives.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: A directed physical link: (axis, src_rank, dst_rank) where the rank
+#: numbering is flat over the event's mesh (row-major, first axis
+#: major — the `hierarchical.py` ``g = dcn * ici_size + ici`` order).
+Link = Tuple[str, int, int]
+
+#: Hop patterns with no ICI traffic to attribute.
+NO_LINK_PATTERNS = ("none", "")
+
+#: Two measured events on one link closer than this are "overlapping"
+#: for the live contention counter (doctor runs the exact interval
+#: check offline over the flight ring).
+CONTENTION_WINDOW_S = 0.050
+
+
+def link_label(link: Link) -> str:
+    """Stable human/Prometheus label: ``tp:0>1``."""
+    axis, src, dst = link
+    return f"{axis}:{src}>{dst}"
+
+
+def parse_link(label: str) -> Link:
+    axis, _, pair = label.partition(":")
+    src, _, dst = pair.partition(">")
+    return (axis, int(src), int(dst))
+
+
+class TorusTopology:
+    """Rank ↔ coordinate arithmetic for an N-axis torus.
+
+    ``axis_sizes``: ordered ``{axis_name: size}`` — first axis major
+    (matches ``hierarchical.py``'s global-rank convention and
+    ``analysis.model.Machine.resolve_device_id``).
+    """
+
+    def __init__(self, axis_sizes: Dict[str, int]):
+        if not axis_sizes:
+            raise ValueError("topology needs at least one axis")
+        self.axis_names: Tuple[str, ...] = tuple(axis_sizes)
+        self.sizes: Tuple[int, ...] = tuple(
+            int(s) for s in axis_sizes.values())
+        if any(s < 1 for s in self.sizes):
+            raise ValueError(f"bad axis sizes {axis_sizes}")
+        self.world = 1
+        for s in self.sizes:
+            self.world *= s
+
+    def coords(self, rank: int) -> Tuple[int, ...]:
+        coords = []
+        for size in reversed(self.sizes):
+            coords.append(rank % size)
+            rank //= size
+        return tuple(reversed(coords))
+
+    def rank_of(self, coords: Sequence[int]) -> int:
+        rank = 0
+        for c, size in zip(coords, self.sizes):
+            rank = rank * size + (c % size)
+        return rank
+
+    def neighbor(self, rank: int, axis: str, delta: int) -> int:
+        """Rank one hop along ``axis`` (wraparound torus)."""
+        ai = self.axis_names.index(axis)
+        coords = list(self.coords(rank))
+        coords[ai] = (coords[ai] + delta) % self.sizes[ai]
+        return self.rank_of(coords)
+
+    def links(self) -> List[Link]:
+        """Every directed neighbor link of the torus (both directions;
+        a size-2 axis has one physical cable but two directed lanes)."""
+        out: List[Link] = []
+        for axis, size in zip(self.axis_names, self.sizes):
+            if size < 2:
+                continue
+            for r in range(self.world):
+                for delta in (+1, -1):
+                    dst = self.neighbor(r, axis, delta)
+                    if dst != r:
+                        out.append((axis, r, dst))
+        # dedup (size-2 axes produce the same directed pair twice)
+        return sorted(set(out))
+
+    def route(self, src: int, dst: int) -> List[Link]:
+        """Dimension-ordered minimal route src → dst: correct each
+        axis in declaration order, taking the shorter wrap direction
+        (ties go +1, the hardware's convention for even splits)."""
+        hops: List[Link] = []
+        cur = src
+        cc, dc = list(self.coords(src)), self.coords(dst)
+        for ai, axis in enumerate(self.axis_names):
+            size = self.sizes[ai]
+            while cc[ai] != dc[ai]:
+                fwd = (dc[ai] - cc[ai]) % size
+                bwd = (cc[ai] - dc[ai]) % size
+                delta = +1 if fwd <= bwd else -1
+                nxt = self.neighbor(cur, axis, delta)
+                hops.append((axis, cur, nxt))
+                cur = nxt
+                cc[ai] = (cc[ai] + delta) % size
+        return hops
+
+    def bisection_links(self, axis: Optional[str] = None) -> List[Link]:
+        """Directed links crossing the mid-plane of ``axis`` (default:
+        the first axis) — the denominator of a bisection-bandwidth
+        estimate.  A wrapped torus crosses at the seam too."""
+        axis = axis or self.axis_names[0]
+        ai = self.axis_names.index(axis)
+        size = self.sizes[ai]
+        half = size // 2
+        out = []
+        for (a, src, dst) in self.links():
+            if a != axis:
+                continue
+            s, d = self.coords(src)[ai], self.coords(dst)[ai]
+            if (s < half) != (d < half):
+                out.append((a, src, dst))
+        return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# Event → topology / links
+# ---------------------------------------------------------------------------
+
+def topology_for_event(event) -> Optional[TorusTopology]:
+    """Build the event's torus from its annotations: multi-axis events
+    carry ``extra["axes"]``/``extra["sizes"]`` (the torus emit sites);
+    single-axis events are a ring of ``world`` on ``event.axis``."""
+    extra = getattr(event, "extra", None) or {}
+    axes, sizes = extra.get("axes"), extra.get("sizes")
+    if axes and sizes and len(axes) == len(sizes):
+        return TorusTopology(dict(zip(axes, (int(s) for s in sizes))))
+    world = int(getattr(event, "world", 1) or 1)
+    if world <= 1:
+        return None
+    axis = getattr(event, "axis", None) or "ici"
+    return TorusTopology({str(axis): world})
+
+
+def _split(total: int, parts: int) -> int:
+    return total // parts if parts > 0 else 0
+
+
+def links_for_event(event, rank: Optional[int] = None
+                    ) -> Dict[Link, int]:
+    """{directed link: bytes} that **this rank's** share of the
+    collective pushes onto each ICI link, per the event's hop-pattern
+    annotation.  Empty when the event moves no ICI bytes."""
+    extra = getattr(event, "extra", None) or {}
+    pattern = extra.get("hops")
+    nbytes = int(getattr(event, "bytes_moved", 0) or 0)
+    if not pattern or pattern in NO_LINK_PATTERNS or nbytes <= 0:
+        return {}
+    topo = topology_for_event(event)
+    if topo is None or topo.world <= 1:
+        return {}
+    rank = int(getattr(event, "rank", 0) if rank is None else rank)
+    rank %= topo.world
+    world = topo.world
+    out: Dict[Link, int] = {}
+
+    def add(link: Link, b: int) -> None:
+        if b > 0 and link[1] != link[2]:
+            out[link] = out.get(link, 0) + b
+
+    if pattern == "ring":
+        axis = topo.axis_names[0]
+        add((axis, rank, topo.neighbor(rank, axis, +1)), nbytes)
+    elif pattern == "bidir_ring":
+        axis = topo.axis_names[0]
+        add((axis, rank, topo.neighbor(rank, axis, +1)), nbytes // 2)
+        add((axis, rank, topo.neighbor(rank, axis, -1)),
+            nbytes - nbytes // 2)
+    elif pattern == "chain":
+        # Open-chain reduce (toward rank world-1) + broadcast (back):
+        # each direction carries ~half the per-rank bytes.
+        axis = topo.axis_names[0]
+        half = nbytes // 2
+        if rank != world - 1:
+            add((axis, rank, topo.neighbor(rank, axis, +1)), half)
+        if rank != 0:
+            add((axis, rank, topo.neighbor(rank, axis, -1)),
+                nbytes - half)
+    elif pattern in ("all_pairs", "pairs_direct"):
+        chunk = _split(nbytes, world - 1)
+        # root_only (broadcast): only ONE rank actually sends, but
+        # trace-time emission is rank-symmetric and cannot know the
+        # traced root — scale to the expected per-rank share so the
+        # global sum equals exactly one fan-out, not world of them.
+        if extra.get("root_only"):
+            chunk //= world
+        for peer in range(world):
+            if peer == rank:
+                continue
+            if pattern == "pairs_direct":
+                axis = topo.axis_names[0]
+                add((axis, rank, peer), chunk)
+            else:
+                for hop in topo.route(rank, peer):
+                    add(hop, chunk)
+    elif pattern in ("torus", "torus_multilane"):
+        lanes = [(axis, delta)
+                 for axis, size in zip(topo.axis_names, topo.sizes)
+                 if size > 1 for delta in (+1, -1)]
+        if not lanes:
+            return {}
+        per_lane = _split(nbytes, len(lanes))
+        for i, (axis, delta) in enumerate(lanes):
+            b = per_lane if i < len(lanes) - 1 else (
+                nbytes - per_lane * (len(lanes) - 1))
+            add((axis, rank, topo.neighbor(rank, axis, delta)), b)
+    elif pattern == "hierarchical":
+        # DCN phase only: the ICI phase is a separately-emitted inner
+        # event (no double counting).  DCN is a fabric → direct pairs.
+        # Slice index follows the DCN-major global-rank convention
+        # (hierarchical.py: g = dcn_index * ici_size + ici_index).
+        dcn_axis = extra.get("dcn_axis") or topo.axis_names[0]
+        dcn_size = int(extra.get("dcn_size") or topo.sizes[0])
+        if dcn_size > 1:
+            ici_size = int(extra.get("ici_size")
+                           or max(world // dcn_size, 1))
+            slice_rank = (rank // ici_size) % dcn_size
+            chunk = _split(nbytes, dcn_size - 1)
+            for peer in range(dcn_size):
+                if peer != slice_rank:
+                    add((str(dcn_axis), slice_rank, peer), chunk)
+    else:
+        # Unknown annotation: attribute conservatively to the +1 ring
+        # link so bytes are never silently dropped from the counters.
+        axis = topo.axis_names[0]
+        add((axis, rank, topo.neighbor(rank, axis, +1)), nbytes)
+    return out
+
+
+def links_global(event, topo: Optional[TorusTopology] = None
+                 ) -> Dict[Link, int]:
+    """Whole-collective view: sum :func:`links_for_event` over every
+    rank of the event's mesh (SPMD symmetry — each rank runs the same
+    schedule from its own coordinates)."""
+    topo = topo or topology_for_event(event)
+    if topo is None:
+        return {}
+    out: Dict[Link, int] = {}
+    for r in range(topo.world):
+        for link, b in links_for_event(event, rank=r).items():
+            out[link] = out.get(link, 0) + b
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Contention: overlapping collectives sharing a link
+# ---------------------------------------------------------------------------
+
+def _event_interval(event) -> Tuple[float, float]:
+    """[start, end) seconds for overlap tests: measured duration when
+    the host timed it, the model estimate otherwise (trace-time events
+    with neither get a zero-length interval and never overlap)."""
+    ts = float(getattr(event, "ts", 0.0) or 0.0)
+    dur_us = (getattr(event, "measured_us", None)
+              or getattr(event, "estimate_us", None) or 0.0)
+    return ts, ts + float(dur_us) * 1e-6
+
+
+def detect_contention(events: Sequence, rank: Optional[int] = None
+                      ) -> List[dict]:
+    """Offline contention scan (doctor / tests): for every pair of
+    events from **different ops** whose time intervals overlap and
+    whose link sets intersect, one record naming the shared links.
+
+    ``events``: KernelEvents (or anything duck-typed like one).
+    """
+    timed = []
+    for ev in events:
+        t0, t1 = _event_interval(ev)
+        if t1 <= t0:
+            continue
+        lks = links_for_event(ev, rank=rank)
+        if lks:
+            timed.append((t0, t1, ev, set(lks)))
+    timed.sort(key=lambda t: t[0])
+    records: List[dict] = []
+    for i, (a0, a1, ea, la) in enumerate(timed):
+        for b0, b1, eb, lb in timed[i + 1:]:
+            if b0 >= a1:
+                break
+            if ea.op == eb.op:
+                continue
+            shared = la & lb
+            if shared:
+                records.append({
+                    "ops": sorted((ea.op, eb.op)),
+                    "links": sorted(link_label(l) for l in shared),
+                    "overlap_s": round(min(a1, b1) - b0, 6),
+                })
+    return records
+
+
+# ---------------------------------------------------------------------------
+# Live tracker (registry-backed)
+# ---------------------------------------------------------------------------
+
+class LinkTracker:
+    """Per-link byte counters + rolling utilization + live contention.
+
+    One process-global instance (:func:`get_link_tracker`) fed by
+    :func:`~.events.emit_event`; tests may construct private trackers
+    around private registries.
+    """
+
+    #: Rolling utilization window (seconds).
+    WINDOW_S = 10.0
+
+    def __init__(self, registry=None):
+        from triton_distributed_tpu.observability.metrics import (
+            get_registry)
+        self._reg = registry or get_registry()
+        self._lock = threading.Lock()
+        #: link -> (last_op, interval_end) for the live contention check
+        self._last: Dict[Link, Tuple[str, float]] = {}
+        #: recent (ts, link, bytes) for windowed utilization
+        self._recent: List[Tuple[float, Link, int]] = []
+        self.contentions: List[dict] = []
+
+    def attribute(self, event) -> Dict[Link, int]:
+        """Account one event's per-rank link bytes; returns the map."""
+        lks = links_for_event(event)
+        if not lks:
+            return {}
+        t0, t1 = _event_interval(event)
+        now = t0 or time.time()
+        # Trace-time events (no host measurement) fire back-to-back
+        # during jit compilation — only measured occurrences can claim
+        # two collectives actually ran concurrently on a link.
+        measured = getattr(event, "measured_us", None) is not None
+        with self._lock:
+            for link, b in lks.items():
+                self._reg.counter("ici_link_bytes_total",
+                                  axis=link[0],
+                                  link=link_label(link)).inc(b)
+                self._recent.append((now, link, b))
+                if not measured:
+                    continue
+                last = self._last.get(link)
+                if (last is not None and last[0] != event.op
+                        and now < last[1] + CONTENTION_WINDOW_S):
+                    self._reg.counter(
+                        "ici_link_contention_total",
+                        link=link_label(link)).inc()
+                    self.contentions.append({
+                        "link": link_label(link),
+                        "ops": sorted((last[0], event.op)),
+                        "ts": now,
+                    })
+                self._last[link] = (event.op, max(t1, now))
+            cutoff = now - self.WINDOW_S
+            if self._recent and self._recent[0][0] < cutoff:
+                self._recent = [r for r in self._recent
+                                if r[0] >= cutoff]
+        return lks
+
+    def window_bytes(self, now: Optional[float] = None
+                     ) -> Dict[Link, int]:
+        now = time.time() if now is None else now
+        cutoff = now - self.WINDOW_S
+        out: Dict[Link, int] = {}
+        with self._lock:
+            for ts, link, b in self._recent:
+                if ts >= cutoff:
+                    out[link] = out.get(link, 0) + b
+        return out
+
+    def update_gauges(self, now: Optional[float] = None) -> None:
+        """Refresh ``ici_link_utilization`` gauges: fraction of one
+        direction's bandwidth the last window's bytes would fill
+        (rough — the point is relative heat, not absolute truth)."""
+        bw = _link_bytes_per_s()
+        denom = bw * self.WINDOW_S
+        for link, b in self.window_bytes(now).items():
+            self._reg.gauge("ici_link_utilization",
+                            link=link_label(link)).set(
+                round(b / denom, 12) if denom else 0.0)
+
+
+def _link_bytes_per_s() -> float:
+    """Per-direction link bandwidth from the perf model's table;
+    conservative v5e default when no device is reachable."""
+    try:
+        from triton_distributed_tpu.kernels.comm_perf_model import (
+            get_ici_spec)
+        return get_ici_spec().link_gbps * 1e9
+    except Exception:
+        return 50e9
+
+
+_TRACKER: Optional[LinkTracker] = None
+_TRACKER_LOCK = threading.Lock()
+
+
+def get_link_tracker() -> LinkTracker:
+    global _TRACKER
+    with _TRACKER_LOCK:
+        if _TRACKER is None:
+            _TRACKER = LinkTracker()
+        return _TRACKER
+
+
+def maybe_attribute_links(event) -> None:
+    """Hook :func:`~.events.emit_event` calls for every event.  Cheap
+    bail-out for the (vast) majority of events with no hop annotation
+    — the tracker is not even constructed until one arrives."""
+    extra = getattr(event, "extra", None)
+    if not extra:
+        return
+    pattern = extra.get("hops")
+    if not pattern or pattern in NO_LINK_PATTERNS:
+        return
+    try:
+        get_link_tracker().attribute(event)
+    except Exception:
+        # Attribution is forensics; it must never break the op.
+        pass
+
+
+def refresh_link_gauges() -> None:
+    """Exporter hook: update utilization gauges just before a scrape.
+    No-op (no tracker construction) when nothing was ever attributed."""
+    with _TRACKER_LOCK:
+        tracker = _TRACKER
+    if tracker is not None:
+        tracker.update_gauges()
+
+
+# ---------------------------------------------------------------------------
+# Reporting helpers (doctor)
+# ---------------------------------------------------------------------------
+
+def hot_links(events: Sequence, top: int = 5,
+              per_rank: bool = True) -> List[dict]:
+    """Rank links by attributed bytes over a set of events (e.g. a
+    flight-recorder ring): [{link, bytes, ops}] hottest first.
+
+    ``per_rank``: attribute each event from its own emitting rank
+    (flight dumps from N ranks compose into the global picture);
+    False sums the SPMD-symmetric global view per event instead.
+    """
+    totals: Dict[Link, int] = {}
+    ops: Dict[Link, set] = {}
+    for ev in events:
+        lks = (links_for_event(ev) if per_rank else links_global(ev))
+        for link, b in lks.items():
+            totals[link] = totals.get(link, 0) + b
+            ops.setdefault(link, set()).add(ev.op)
+    rows = [{"link": link_label(link), "bytes": b,
+             "ops": sorted(ops[link])}
+            for link, b in totals.items()]
+    rows.sort(key=lambda r: (-r["bytes"], r["link"]))
+    return rows[:top]

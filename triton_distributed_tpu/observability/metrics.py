@@ -162,6 +162,14 @@ class MetricsRegistry:
     def counter(self, name: str, **labels) -> Counter:
         return self._get(Counter, name, labels)
 
+    def peek(self, name: str, **labels):
+        """Current value of a metric if it exists, else None — lookup
+        without registration (heartbeats must not create gauges on
+        ranks that never serve)."""
+        with self._lock:
+            m = self._metrics.get(_label_key(name, labels))
+            return None if m is None else m.snapshot()
+
     def gauge(self, name: str, **labels) -> Gauge:
         return self._get(Gauge, name, labels)
 

@@ -64,6 +64,13 @@ class FlightRecorder:
 
     def record(self, event) -> None:
         with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                # Overflow must not be silent: a flight dump from this
+                # ring lost its oldest events — count the evictions so
+                # doctor reports can flag the dump as incomplete.
+                from triton_distributed_tpu.observability.metrics \
+                    import get_registry
+                get_registry().counter("events_dropped").inc()
             self._ring.append(event)
 
     def events(self) -> list:

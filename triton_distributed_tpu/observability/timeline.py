@@ -37,6 +37,7 @@ import argparse
 import glob
 import json
 import os
+import re
 import sys
 from typing import Dict, List, Optional, Sequence
 
@@ -45,13 +46,66 @@ MERGED_NAME = "merged_trace.json"
 REPORT_NAME = "straggler_report.json"
 
 
-def load_trace(path: str) -> dict:
+def load_trace(path: str, salvage: bool = True) -> dict:
+    """Load one per-rank Chrome trace.
+
+    ``salvage``: a rank killed mid-write (SIGKILL between the watchdog
+    grace period and the atomic rename) leaves a truncated JSON file.
+    Rather than failing the whole merge, salvage every complete event
+    object from the partial ``traceEvents`` array and mark the trace
+    ``"truncated": True`` (the merge records the rank under
+    ``timeline_truncated_ranks``).  Raises only when nothing usable
+    can be recovered.
+    """
     with open(path) as f:
-        trace = json.load(f)
+        text = f.read()
+    try:
+        trace = json.loads(text)
+    except json.JSONDecodeError:
+        if not salvage:
+            raise
+        trace = _salvage_trace(text)
+        if trace is None:
+            raise ValueError(f"{path}: truncated beyond salvage "
+                             "(no complete traceEvents)")
+        trace["truncated"] = True
+        # Metadata is serialised after traceEvents, so a truncated
+        # file usually lost it — recover the rank from the filename.
+        trace.setdefault("metadata", {})
+        if "rank" not in trace["metadata"]:
+            m = re.search(r"trace-rank-(\d+)", os.path.basename(path))
+            if m:
+                trace["metadata"]["rank"] = int(m.group(1))
     if "traceEvents" not in trace:
         raise ValueError(f"{path}: not a Chrome trace "
                          "(no traceEvents)")
     return trace
+
+
+def _salvage_trace(text: str) -> Optional[dict]:
+    """Recover complete event objects from a truncated trace file:
+    find the ``traceEvents`` array and decode objects one by one until
+    the text runs out mid-object."""
+    m = re.search(r'"traceEvents"\s*:\s*\[', text)
+    if not m:
+        return None
+    dec = json.JSONDecoder()
+    pos = m.end()
+    events = []
+    while True:
+        while pos < len(text) and text[pos] in ", \t\r\n":
+            pos += 1
+        if pos >= len(text) or text[pos] == "]":
+            break
+        try:
+            obj, end = dec.raw_decode(text, pos)
+        except json.JSONDecodeError:
+            break  # mid-object truncation: keep what we have
+        events.append(obj)
+        pos = end
+    if not events:
+        return None
+    return {"traceEvents": events}
 
 
 def find_trace_files(directory: str) -> List[str]:
@@ -60,6 +114,13 @@ def find_trace_files(directory: str) -> List[str]:
 
 def trace_rank(trace: dict, default: int = 0) -> int:
     return int(trace.get("metadata", {}).get("rank", default))
+
+
+def truncated_ranks(traces: Sequence[dict]) -> List[int]:
+    """Ranks whose trace files were salvaged from a partial write —
+    their lanes on the merged timeline are incomplete."""
+    return sorted(trace_rank(tr, i) for i, tr in enumerate(traces)
+                  if tr.get("truncated"))
 
 
 def _span_events(trace: dict) -> List[dict]:
@@ -95,6 +156,7 @@ def merge_traces(traces: Sequence[dict]) -> dict:
             "ranks": sorted(ranks),
             "t0_unix_us": t0,
             "clock": "unix-us rebased to t0_unix_us",
+            "timeline_truncated_ranks": truncated_ranks(traces),
         },
     }
 
@@ -140,14 +202,19 @@ def skew_rows(traces: Sequence[dict]) -> List[dict]:
                     max(durs.values()) - min(durs.values()), 3),
                 "slowest_rank": max(durs, key=durs.get),
                 "starts_us": starts,
+                "durs_us": durs,
             })
     return rows
 
 
-def straggler_report(traces: Sequence[dict]) -> dict:
+def straggler_report(traces: Sequence[dict], store=None) -> dict:
     """Aggregate :func:`skew_rows` per span name: how often each rank
     arrived last, the consistent straggler (mode of last-arrivers),
-    and the barrier wait each other rank paid for it."""
+    and the barrier wait each other rank paid for it.  Slow-occurrence
+    anomalies (z-scored against rolling span baselines, falling back
+    to the within-merge population) ride along under ``anomalies``;
+    ``store`` overrides the process-global baseline store (doctor
+    pins it to the artifact directory for reproducible reports)."""
     rows = skew_rows(traces)
     per_name: Dict[str, dict] = {}
     for row in rows:
@@ -179,20 +246,33 @@ def straggler_report(traces: Sequence[dict]) -> dict:
                               for k, v in agg["last_counts"].items()}
         agg["barrier_wait_us"] = {
             str(k): v for k, v in agg["barrier_wait_us"].items()}
+    ranks = sorted({trace_rank(tr, i)
+                    for i, tr in enumerate(traces)})
+    from triton_distributed_tpu.observability.anomaly import (
+        flag_occurrences)
     return {
         "schema": 1,
-        "ranks": sorted({trace_rank(tr, i)
-                         for i, tr in enumerate(traces)}),
+        "ranks": ranks,
         "spans": per_name,
+        "timeline_truncated_ranks": truncated_ranks(traces),
+        "anomalies": flag_occurrences(rows, len(ranks), store=store),
     }
 
 
 def format_straggler_report(report: dict) -> str:
     spans = report.get("spans", {})
+    prefix = []
+    if report.get("timeline_truncated_ranks"):
+        prefix.append(
+            "NOTE: trace files for rank(s) "
+            f"{report['timeline_truncated_ranks']} were truncated "
+            "(rank killed mid-write); their lanes are incomplete")
     if not spans:
-        return ("straggler report: no span appeared on >= 2 ranks "
-                "(nothing to attribute)")
-    lines = [f"straggler report over ranks {report['ranks']}:"]
+        return "\n".join(prefix + [
+            "straggler report: no span appeared on >= 2 ranks "
+            "(nothing to attribute)"])
+    lines = prefix + [
+        f"straggler report over ranks {report['ranks']}:"]
     for name, agg in sorted(
             spans.items(),
             key=lambda kv: -kv[1]["max_skew_us"]):
@@ -204,6 +284,10 @@ def format_straggler_report(report: dict) -> str:
             f"max={agg['max_skew_us']:.0f}us")
         for rank, wait in sorted(agg["barrier_wait_us"].items()):
             lines.append(f"    rank {rank} waited {wait:.0f}us total")
+    for a in report.get("anomalies", [])[:10]:
+        lines.append(
+            f"  ANOMALY {a['name']}#{a['occurrence']} rank {a['rank']}:"
+            f" {a['dur_us']:.0f}us (z={a['z']:+.1f}, {a['source']})")
     return "\n".join(lines)
 
 

@@ -179,6 +179,13 @@ class SpanTracer:
                 stack.remove(s)
                 if not stack:
                     del self._open[s.tid]
+            if len(self._ring) == self._ring.maxlen:
+                # Overflow must not be silent: a timeline merged from
+                # this ring is missing the evicted span, and a doctor
+                # report built on it should say so.
+                from triton_distributed_tpu.observability.metrics \
+                    import get_registry
+                get_registry().counter("trace_dropped_spans").inc()
             self._ring.append(s)
 
     # -- inspection ------------------------------------------------------

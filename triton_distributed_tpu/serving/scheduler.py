@@ -309,8 +309,26 @@ class ContinuousBatchingScheduler:
         now = self.clock()
         reg = self._registry()
         if reg:
-            reg.histogram("serving_decode_step_ms").observe(
-                (time.perf_counter() - t0) * 1e3 / k)
+            step_ms = (time.perf_counter() - t0) * 1e3 / k
+            reg.histogram("serving_decode_step_ms").observe(step_ms)
+            # Rolling-baseline anomaly check on the serving hot path:
+            # a decode step that goes multi-sigma slow (a contended
+            # ICI link, a straggling rank) is counted AND dropped into
+            # the flight ring, so a later doctor report can line the
+            # slow step up against what else was on the links.  The
+            # store is memory-only here (no disk I/O per step).
+            from triton_distributed_tpu.observability.anomaly import (
+                Z_THRESHOLD, event_key, get_baseline_store)
+            z = get_baseline_store().observe(
+                event_key("serving.decode_step", None,
+                          (self.config.num_slots,), 1), step_ms * 1e3)
+            if z is not None and z > Z_THRESHOLD:
+                reg.counter("serving_decode_anomalies_total").inc()
+                from triton_distributed_tpu.observability.events \
+                    import emit_kernel_event
+                emit_kernel_event(
+                    "serving.decode_step", kind="engine",
+                    measured_us=step_ms * 1e3, anomaly_z=round(z, 2))
         retired = 0
         generated = 0
         rows = list(self._by_slot.items())
