@@ -82,6 +82,54 @@ def make_schedule(seed: int, n: int, load: float, buckets, vocab: int):
             for a, p in zip(arrivals, prompts)]
 
 
+def make_shared_prefix_schedule(seed: int, n: int, load: float,
+                                sys_len: int, vocab: int,
+                                suffix_lo: int = 2,
+                                suffix_hi: int = 4):
+    """Shared-system-prompt trace: every request is the SAME
+    ``sys_len``-token system prompt plus a short per-request suffix —
+    the workload radix prefix caching exists for.  Deterministic like
+    `make_schedule`."""
+    rng = np.random.default_rng(seed)
+    sysp = list(rng.integers(1, vocab, sys_len))
+    arrivals = np.cumsum(rng.exponential(1.0 / load, n))
+    prompts = [sysp + list(rng.integers(
+        1, vocab, int(rng.integers(suffix_lo, suffix_hi + 1))))
+        for _ in range(n)]
+    return [(float(a), p, int(rng.integers(0, 2 ** 31)))
+            for a, p in zip(arrivals, prompts)]
+
+
+def measure_peak_concurrency(model, params, args, buckets, layout,
+                             budget_bytes, n=64):
+    """Admitted-concurrency sweep: short requests, everyone eligible
+    at once, SAME KV byte budget for both layouts.  Slot admission
+    prices every request at max-context, so its peak is
+    budget/bytes_per_slot; page admission prices actual pages."""
+    from triton_distributed_tpu.serving import (
+        ContinuousBatchingScheduler, Request, SchedulerConfig)
+
+    sched = ContinuousBatchingScheduler(
+        model, params,
+        SchedulerConfig(num_slots=n, max_queue=n + 8,
+                        prefill_buckets=buckets,
+                        kv_layout=layout, page_size=args.page_size,
+                        kv_budget_bytes=budget_bytes),
+        clock=time.perf_counter)
+    reqs = [Request(prompt=[1 + (i % (args.vocab - 2)), 2, 3, 4],
+                    max_new_tokens=4, arrival_time=0.0)
+            for i in range(n)]
+    for r in reqs:
+        ok = sched.submit(r)
+        assert ok, r.reject_reason
+    peak = 0
+    while sched.has_work():
+        sched.step()
+        peak = max(peak, sched.slots.active_slots)
+    assert len(sched.finished) == n
+    return peak
+
+
 def useful_len(tokens, eos: int) -> int:
     """Tokens up to and including the first EOS (all, if none)."""
     for i, t in enumerate(tokens):
@@ -149,12 +197,14 @@ class SerialDriver:
 
 
 class ContinuousDriver:
-    def __init__(self, model, params, args, buckets):
+    def __init__(self, model, params, args, buckets, layout="slots",
+                 prefix_cache=True):
         from triton_distributed_tpu.serving import (
             ContinuousBatchingScheduler, Request, SchedulerConfig)
 
         self.Request = Request
         self.args = args
+        self.layout = layout
         # One clock everywhere: arrivals, TBT callbacks and the
         # scheduler's own timestamps all read perf_counter, so the
         # derived TTFT/makespan never mix clock epochs.
@@ -164,7 +214,10 @@ class ContinuousDriver:
                             max_queue=args.n_requests + 8,
                             prefill_buckets=buckets,
                             temperature=args.temperature,
-                            steps_per_sync=args.steps_per_sync),
+                            steps_per_sync=args.steps_per_sync,
+                            kv_layout=layout,
+                            page_size=args.page_size,
+                            prefix_cache=prefix_cache),
             clock=time.perf_counter)
         # Warm the per-bucket prefill/insert programs and the masked
         # step out of the measurement (prompt ids kept inside the
@@ -175,6 +228,31 @@ class ContinuousDriver:
                 for b in buckets]
         self.sched.run(warm)
         self.sched.finished.clear()
+        if layout == "paged":
+            # The run(warm) admissions may have taken the SUFFIX path
+            # for the larger buckets (the warm prompts share prefixes
+            # with each other through the radix cache), leaving the
+            # full-prefill and suffix programs of some buckets
+            # uncompiled — warm every per-bucket program DIRECTLY so
+            # no radix-dependent admission path pays a first-compile
+            # mid-measure.
+            import jax
+            import jax.numpy as jnp
+            for b in buckets:
+                ids = jnp.ones((1, b), jnp.int32)
+                _, row = self.sched._prefill(params, ids,
+                                             self.sched._row_cache(b))
+                jax.block_until_ready(row.ks[0])
+                if self.sched._prefill_suffix is not None:
+                    self.sched._prefill_suffix(
+                        params, ids, jnp.int32(args.page_size),
+                        self.sched._row_cache(b))
+
+    def _radix_stats(self):
+        radix = getattr(self.sched.slots, "radix", None)
+        if radix is None:
+            return (0, 0)
+        return (radix.hit_tokens, radix.miss_tokens)
 
     def measure(self, schedule):
         args = self.args
@@ -187,6 +265,7 @@ class ContinuousDriver:
                 _tbt.append(now - _last[req.request_id])
             _last[req.request_id] = now
 
+        h0, m0 = self._radix_stats()
         t0 = time.perf_counter()
         reqs = [self.Request(prompt=p, max_new_tokens=args.max_new,
                              seed=s, eos_token_ids=(args.eos,),
@@ -198,33 +277,48 @@ class ContinuousDriver:
         first_arrival = min(r.t_arrival for r in done)
         last_finish = max(r.t_finish for r in done)
         useful = sum(len(r.generated) for r in done)
-        return {"makespan_s": last_finish - first_arrival,
-                "useful_tokens": useful,
-                "ttft_s": [r.ttft for r in done], "tbt_s": tbt_s}
+        h1, m1 = self._radix_stats()
+        out = {"makespan_s": last_finish - first_arrival,
+               "useful_tokens": useful,
+               "ttft_s": [r.ttft for r in done], "tbt_s": tbt_s}
+        if (self.layout == "paged"
+                and getattr(self.sched.slots, "radix", None) is not None):
+            hit, miss = h1 - h0, m1 - m0
+            out["prefix_hit_rate"] = (hit / (hit + miss)
+                                      if hit + miss else 0.0)
+        return out
 
 
 def pool_runs(runs):
     """Combine a mode's ABBA repeats: samples pooled, throughput from
     summed makespans (tokens are schedule-deterministic, identical
     across repeats)."""
-    return {
+    out = {
         "tokens_per_s": (sum(r["useful_tokens"] for r in runs)
                          / sum(r["makespan_s"] for r in runs)),
         "useful_tokens": runs[0]["useful_tokens"],
         "ttft_s": [t for r in runs for t in r["ttft_s"]],
         "tbt_s": [t for r in runs for t in r["tbt_s"]],
     }
+    if any("prefix_hit_rate" in r for r in runs):
+        out["prefix_hit_rate"] = statistics.mean(
+            r.get("prefix_hit_rate", 0.0) for r in runs)
+    return out
 
 
-def emit(mode, load, args, res, extra=None):
+def emit(mode, load, args, res, extra=None, trace=None):
     from triton_distributed_tpu.observability import bench_record
 
     base = {"bench": "serving", "model": args.model, "mode": mode,
-            "slots": args.slots if mode == "continuous" else 1,
+            "slots": args.slots if mode != "serial" else 1,
             "n_requests": args.n_requests, "max_new": args.max_new,
             "load_rps": load}
-    if mode == "continuous":
+    if mode != "serial":
         base["steps_per_sync"] = args.steps_per_sync
+    if trace is not None:
+        # identity dimension: shared-prefix rows never match the
+        # default-trace rows in the regression gate
+        base["trace"] = trace
     for metric, samples in (("ttft", res["ttft_s"]),
                             ("tbt", res["tbt_s"])):
         us = [s * 1e6 for s in samples]
@@ -233,6 +327,9 @@ def emit(mode, load, args, res, extra=None):
         if metric == "tbt":
             rec["tokens_per_s"] = round(res["tokens_per_s"], 1)
             rec["useful_tokens"] = res["useful_tokens"]
+            if "prefix_hit_rate" in res:
+                rec["prefix_hit_rate"] = round(res["prefix_hit_rate"],
+                                               4)
             rec.update(extra or {})
         bench_record(rec)
 
@@ -258,14 +355,23 @@ def main():
     ap.add_argument("--vocab", type=int, default=31)
     ap.add_argument("--eos", type=int, default=3,
                     help="EOS id: streams end when sampling hits it")
+    ap.add_argument("--page-size", type=int, default=16,
+                    help="KV page size for the paged engine rows")
+    ap.add_argument("--sys-len", type=int, default=48,
+                    help="shared system-prompt length for the "
+                         "shared-prefix trace")
     args = ap.parse_args()
 
     buckets = tuple(int(b) for b in args.buckets.split(","))
+    # the shared-prefix trace needs a bucket covering sys_len + suffix
+    eng_buckets = tuple(sorted(set(buckets) | {
+        1 << (args.sys_len + 8 - 1).bit_length()}))
     if args.model == "toy":
         from triton_distributed_tpu.serving import ToyConfig, ToyModel
+        max_seq = max(eng_buckets) + args.max_new + 8
+        max_seq += (-max_seq) % args.page_size   # page-aligned
         model = ToyModel(ToyConfig(
-            vocab_size=args.vocab, hidden=32,
-            max_seq_len=max(buckets) + args.max_new + 8))
+            vocab_size=args.vocab, hidden=32, max_seq_len=max_seq))
         params = model.init_params(jax.random.key(args.seed))
     else:
         from jax.sharding import Mesh
@@ -278,21 +384,34 @@ def main():
         params = model.init_params(jax.random.key(args.seed))
 
     # Drivers (and their compiled programs) are built ONCE; per load
-    # the two modes are measured in ABBA order so slow machine drift
-    # (shared-host CPU throttling, minutes-scale — same lesson as
-    # bench_e2e_decode) cancels out of the paired speedup instead of
-    # biasing whichever mode ran last.
-    serial_drv = SerialDriver(model, params, args, buckets)
-    cont_drv = ContinuousDriver(model, params, args, buckets)
+    # the modes are measured in mirrored (ABCCBA) order so slow
+    # machine drift (shared-host CPU throttling, minutes-scale — same
+    # lesson as bench_e2e_decode) cancels out of the paired speedups
+    # instead of biasing whichever mode ran last.
+    serial_drv = SerialDriver(model, params, args, eng_buckets)
+    cont_drv = ContinuousDriver(model, params, args, eng_buckets)
+    # Default-trace paged driver runs WITHOUT the radix cache: the
+    # deterministic schedule repeats identical prompts across repeats
+    # and load points, so a persistent prefix cache would warm across
+    # runs and the "paged" rows would measure cache hits the offered
+    # workload doesn't contain.  The prefix cache gets its own driver
+    # and its own trace below.
+    paged_drv = ContinuousDriver(model, params, args, eng_buckets,
+                                 layout="paged", prefix_cache=False)
+    paged_prefix_drv = ContinuousDriver(model, params, args,
+                                        eng_buckets, layout="paged")
     for load in (float(x) for x in args.loads.split(",")):
         schedule = make_schedule(args.seed, args.n_requests, load,
                                  buckets, args.vocab)
-        runs = {"serial": [], "continuous": []}
-        for mode in ("serial", "continuous", "continuous", "serial"):
-            drv = serial_drv if mode == "serial" else cont_drv
+        runs = {"serial": [], "continuous": [], "paged": []}
+        for mode in ("serial", "continuous", "paged",
+                     "paged", "continuous", "serial"):
+            drv = {"serial": serial_drv, "continuous": cont_drv,
+                   "paged": paged_drv}[mode]
             runs[mode].append(drv.measure(schedule))
         serial = pool_runs(runs["serial"])
         cont = pool_runs(runs["continuous"])
+        paged = pool_runs(runs["paged"])
         speedup = cont["tokens_per_s"] / serial["tokens_per_s"]
         # The two same-mode repeats measure the same deterministic
         # workload seconds apart: a >1.5x makespan spread between them
@@ -303,14 +422,60 @@ def main():
             max(r["makespan_s"] for r in rs)
             / min(r["makespan_s"] for r in rs)
             for rs in runs.values())
+        drift = ({"machine_drift_suspected": True,
+                  "makespan_spread": round(spread, 2)}
+                 if spread > 1.5 else {})
         emit("serial", load, args, serial)
         emit("continuous", load, args, cont, extra={
             "speedup_vs_serial": round(speedup, 3),
             "continuous_beats_serial":
                 cont["tokens_per_s"] > serial["tokens_per_s"],
-            **({"machine_drift_suspected": True,
-                "makespan_spread": round(spread, 2)}
-               if spread > 1.5 else {})})
+            **drift})
+        emit("paged", load, args, paged, extra={
+            "speedup_vs_serial": round(
+                paged["tokens_per_s"] / serial["tokens_per_s"], 3),
+            "speedup_vs_slots": round(
+                paged["tokens_per_s"] / cont["tokens_per_s"], 3),
+            **drift})
+
+    # Shared-system-prompt trace: the radix prefix cache's workload.
+    # Paged vs slot engines in mirrored order; the paged rows carry
+    # the prefix hit rate (acceptance: > 0.9 — only the first arrival
+    # and the tiny per-request suffixes miss).
+    load = float(args.loads.split(",")[0])
+    schedule = make_shared_prefix_schedule(
+        args.seed, args.n_requests, load, args.sys_len, args.vocab)
+    runs = {"continuous": [], "paged": []}
+    for mode in ("continuous", "paged", "paged", "continuous"):
+        drv = cont_drv if mode == "continuous" else paged_prefix_drv
+        runs[mode].append(drv.measure(schedule))
+    cont = pool_runs(runs["continuous"])
+    paged = pool_runs(runs["paged"])
+    emit("continuous", load, args, cont, trace="shared_prefix")
+    emit("paged", load, args, paged, trace="shared_prefix", extra={
+        "speedup_vs_slots": round(
+            paged["tokens_per_s"] / cont["tokens_per_s"], 3),
+        "prefix_hit_gt_90": paged.get("prefix_hit_rate", 0) > 0.9,
+        "ttft_vs_slots": round(
+            statistics.mean(paged["ttft_s"])
+            / max(statistics.mean(cont["ttft_s"]), 1e-9), 3)})
+
+    # Page-vs-slot admitted-concurrency sweep on the SAME KV budget
+    # (the tentpole's capacity claim: >= 4x on short requests).
+    from triton_distributed_tpu.observability import bench_record
+    budget = 4 * model.create_cache(1).bytes_per_slot()
+    peaks = {}
+    for layout in ("slots", "paged"):
+        peaks[layout] = measure_peak_concurrency(
+            model, params, args, eng_buckets, layout, budget)
+    bench_record({"bench": "serving", "model": args.model,
+                  "metric": "concurrency", "budget_slots": 4,
+                  "max_concurrent_slots": peaks["slots"],
+                  "max_concurrent_paged": peaks["paged"],
+                  "concurrency_vs_slots": round(
+                      peaks["paged"] / max(peaks["slots"], 1), 2),
+                  "paged_4x_concurrency":
+                      peaks["paged"] >= 4 * peaks["slots"]})
 
 
 if __name__ == "__main__":

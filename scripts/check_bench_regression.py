@@ -53,6 +53,11 @@ MEASUREMENT_FIELDS = {
     # latency "us" + p50/p99 fields; these ride along.
     "useful_tokens", "speedup_vs_serial", "continuous_beats_serial",
     "machine_drift_suspected", "makespan_spread",
+    # Paged-KV serving rows (paged mode, shared-prefix trace,
+    # concurrency sweep).
+    "prefix_hit_rate", "prefix_hit_gt_90", "speedup_vs_slots",
+    "ttft_vs_slots", "max_concurrent_slots", "max_concurrent_paged",
+    "concurrency_vs_slots", "paged_4x_concurrency",
     # Anomaly-baseline outputs attached by bench_record.
     "anomaly_z", "anomaly",
 }

@@ -45,6 +45,44 @@ class TestCorpus:
         assert r["rank_table"]["2"]["serving"][
             "serving_queue_depth"] == 3.0
 
+    def test_page_pressure_reported(self, tmp_path):
+        """Heartbeats carrying the paged-KV serving gauges surface a
+        page-pressure section + verdict note; artifacts WITHOUT them
+        (the whole golden corpus) keep byte-identical reports."""
+        import glob as _glob
+        import shutil
+        dst = tmp_path / "incident"
+        shutil.copytree(os.path.join(CORPUS, "clean"), dst)
+        for f in _glob.glob(str(dst / "heartbeat-rank-*.json")):
+            with open(f) as fh:
+                hb = json.load(fh)
+            hb.setdefault("serving", {}).update({
+                "serving_kv_page_occupancy": 0.97,
+                "serving_kv_pages_free": 1,
+                "serving_kv_pages_used": 31,
+                "serving_prefix_cache_pages": 4})
+            with open(f, "w") as fh:
+                json.dump(hb, fh)
+        r = doctor.diagnose([str(dst)])
+        assert len(r["page_pressure"]) == 4
+        assert all(e["pressure"] for e in r["page_pressure"])
+        assert "KV page pressure" in r["verdict"]
+        assert "31" in r["verdict"] or "1 free" in r["verdict"]
+        md = doctor.render_markdown(r)
+        assert "## KV page pressure" in md and "PRESSURE" in md
+        # below the threshold: section present, no verdict escalation
+        for f in _glob.glob(str(dst / "heartbeat-rank-*.json")):
+            with open(f) as fh:
+                hb = json.load(fh)
+            hb["serving"]["serving_kv_page_occupancy"] = 0.5
+            with open(f, "w") as fh:
+                json.dump(hb, fh)
+        r2 = doctor.diagnose([str(dst)])
+        assert not any(e["pressure"] for e in r2["page_pressure"])
+        assert "KV page pressure" not in r2["verdict"]
+        # no page gauges at all -> no section key (golden stability)
+        assert "page_pressure" not in _diagnose("clean")
+
     def test_sem_leak_blames_static_finding(self):
         r = _diagnose("sem_leak")
         assert r["stall"]["first_stalled_rank"] == 0

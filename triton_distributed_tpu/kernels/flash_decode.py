@@ -232,6 +232,123 @@ def flash_decode(q, k_cache, v_cache, kv_len, *,
 
 
 
+def _paged_decode_kernel(nk, s_cache, scale, bk, quantized,
+                         compute_dtype, kvlen_ref, ptab_ref, *rest):
+    """Paged wrapper: the page table rides as a SECOND scalar-prefetch
+    operand consumed only by the BlockSpec index maps (the KV block
+    index becomes an indirection through it); the compute body is the
+    dense split-KV kernel unchanged — every page is a full block, so
+    the ragged-tail guards are statically off (s_cache % bk == 0)."""
+    _decode_kernel(nk, s_cache, scale, bk, quantized, compute_dtype,
+                   kvlen_ref, *rest)
+
+
+def flash_decode_paged(q, k_pool, v_pool, page_table, kv_len, *,
+                       k_scale=None, v_scale=None,
+                       scale: Optional[float] = None,
+                       interpret: Optional[bool] = None):
+    """Single-position GQA decode over a PAGED KV pool
+    (`models.kv_cache.PagedKVCache` layout).
+
+    q: (B, H, D); k_pool/v_pool: (P, Hkv, page, D) — ONE pool of
+    fixed-size pages shared by all sequences; page_table: (B, T) int32
+    mapping logical KV block j of row b to a physical page; kv_len:
+    (B,) int32 true filled lengths.  Returns (out (B, H, D),
+    lse (B, H)).
+
+    This is the dense split-KV kernel (`flash_decode`) with ONE
+    change: the KV BlockSpec's block index is an indirection through
+    the scalar-prefetched page table — ``(page_table[b, j], h, 0, 0)``
+    instead of ``(b, h, j, 0)`` — the same index-table idiom as
+    `flash_attention`'s packed causal schedule.  The split size IS the
+    page size, so the online-softmax body is reused unchanged.
+    Logical pages at or beyond a row's length should map to
+    `NULL_PAGE` (0): their scores are masked by ``kv_len`` (exact
+    zeros), and the repeated null-page fetch is cheap.
+
+    With ``k_scale``/``v_scale`` ((P, Hkv, page) f32 pools) the KV
+    pools are int8 — half the streaming bytes, dequantized in-kernel
+    exactly as the dense path.
+    """
+    b, h, d = q.shape
+    p, hkv, ps, _ = k_pool.shape
+    t = page_table.shape[1]
+    assert h % hkv == 0
+    g = h // hkv
+    quantized = k_scale is not None
+    assert quantized == (v_scale is not None)
+    if quantized:
+        assert k_pool.dtype == jnp.int8 and v_pool.dtype == jnp.int8
+    scale = scale if scale is not None else d ** -0.5
+    nk = t
+
+    def kv_spec():
+        return pl.BlockSpec(
+            (1, 1, ps, d),
+            lambda bb, hh, ki, kvlen, ptab: (ptab[bb, ki], hh, 0, 0),
+            memory_space=pltpu.VMEM)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d),
+                     lambda bb, hh, ki, *pre: (bb, hh, 0, 0),
+                     memory_space=pltpu.VMEM),
+        kv_spec(),
+        kv_spec(),
+    ]
+    operands = [q.reshape(b, hkv, g, d), k_pool, v_pool]
+    if quantized:
+        # (P, Hkv, 1, page) layout: same Mosaic-legal trailing
+        # (1, page) block as the dense path, indexed through the table.
+        sspec = pl.BlockSpec(
+            (1, 1, 1, ps),
+            lambda bb, hh, ki, kvlen, ptab: (ptab[bb, ki], hh, 0, 0),
+            memory_space=pltpu.VMEM)
+        in_specs += [sspec, sspec]
+        operands += [k_scale.astype(jnp.float32).reshape(p, hkv, 1, ps),
+                     v_scale.astype(jnp.float32).reshape(p, hkv, 1, ps)]
+
+    out, lse = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, nk, t * ps, scale, ps,
+                          quantized, q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hkv, g, 1), jnp.float32),
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, hkv, nk),
+            in_specs=in_specs,
+            out_specs=(
+                pl.BlockSpec((1, 1, g, d),
+                             lambda bb, hh, ki, *pre: (bb, hh, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, g, 1),
+                             lambda bb, hh, ki, *pre: (bb, hh, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, d), jnp.float32),
+            ],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            # Streams at most the mapped pages; worst case = T full
+            # pages per row (same bound as the dense kernel at S=T*ps).
+            flops=4 * b * h * t * ps * d,
+            bytes_accessed=(2 * b * hkv * t * ps * d
+                            * k_pool.dtype.itemsize),
+            transcendentals=b * h * t * ps,
+        ),
+        interpret=default_interpret(interpret),
+    )(kv_len.astype(jnp.int32), page_table.astype(jnp.int32),
+      *operands)
+    return out.reshape(b, h, d), lse.reshape(b, h)
+
+
 def combine_partials(outs, lses):
     """LSE-weighted combine of per-shard decode partials (reference
     inter-rank combine kernel, `flash_decode.py:482`).
@@ -252,6 +369,50 @@ def combine_partials(outs, lses):
     return (num / jnp.maximum(denom, 1e-30)[..., None]).astype(outs.dtype)
 
 
+def _sp_gather_combine(op_name: str, out, lse, kv_len_local, q,
+                       axis: str, collective_id: int,
+                       interpret: Optional[bool]):
+    """Shared distributed tail of both sp decode compositions: mask
+    empty shards, allgather the packed (out, lse) payload, LSE-combine.
+
+    The payload row is LANE-PADDED to a 128 multiple: Mosaic rejects
+    DMA slices of rank-3 blocks whose last dim isn't tile-aligned
+    (topology-compile catch at D+1 = 129).  The pad bytes are dead
+    weight on a KB-scale latency-bound transfer — irrelevant, and far
+    cheaper than a second AG for the 1-column lse."""
+    from triton_distributed_tpu.kernels.allgather import (
+        AllGatherContext, AllGatherMethod, all_gather)
+
+    world = jax.lax.axis_size(axis)
+    b, h, d = q.shape
+    # Empty shards (kv_len 0) have lse = -inf ⇒ zero weight.
+    lse = jnp.where(kv_len_local[:, None] > 0, lse, NEG_INF)
+
+    # Marker event for the composition: the inner all_gather emits the
+    # byte-carrying event (bytes_moved=0 here — no double counting on
+    # the link counters), but doctor/flight views see the decode step
+    # as one op with its collective id.
+    from triton_distributed_tpu.observability import emit_kernel_event
+    emit_kernel_event(op_name, kind="collective",
+                      method="push_all", axis=axis, world=world,
+                      shape=(b, h, d), dtype=q.dtype,
+                      delegates="all_gather", hops="none")
+
+    ag_ctx = AllGatherContext(axis=axis, world_size=world,
+                              method=AllGatherMethod.PUSH_ALL,
+                              collective_id=collective_id,
+                              interpret=interpret)
+    dp = d + 1 + ((-(d + 1)) % 128)
+    payload = jnp.zeros((b * h, dp), jnp.float32)
+    payload = payload.at[:, :d].set(
+        out.astype(jnp.float32).reshape(b * h, d))
+    payload = payload.at[:, d].set(lse.reshape(b * h))
+    gathered = all_gather(payload, ag_ctx)            # (world*B*H, dp)
+    gathered = gathered.reshape(world, b, h, dp)
+    return combine_partials(gathered[..., :d],
+                            gathered[..., d]).astype(q.dtype)
+
+
 def sp_flash_decode(q, k_shard, v_shard, kv_len_local, axis: str, *,
                     k_scale=None, v_scale=None,
                     scale: Optional[float] = None, block_k: int = 4096,
@@ -267,48 +428,34 @@ def sp_flash_decode(q, k_shard, v_shard, kv_len_local, axis: str, *,
     Pipeline = reference's: local split-KV kernel → LL allgather of
     (out, lse) (KB-scale, latency-bound: one-shot push) → LSE combine.
     """
-    from triton_distributed_tpu.kernels.allgather import (
-        AllGatherContext, AllGatherMethod, all_gather)
-
-    world = jax.lax.axis_size(axis)
-    b, h, d = q.shape
     out, lse = flash_decode(q, k_shard, v_shard, kv_len_local,
                             k_scale=k_scale, v_scale=v_scale,
                             scale=scale, block_k=block_k,
                             interpret=interpret)
-    # Empty shards (kv_len 0) have lse = -inf ⇒ zero weight.
-    lse = jnp.where(kv_len_local[:, None] > 0, lse, NEG_INF)
+    return _sp_gather_combine("sp_flash_decode", out, lse,
+                              kv_len_local, q, axis, collective_id,
+                              interpret)
 
-    # Marker event for the composition: the inner all_gather emits the
-    # byte-carrying event (bytes_moved=0 here — no double counting on
-    # the link counters), but doctor/flight views see the decode step
-    # as one op with its collective id.
-    from triton_distributed_tpu.observability import emit_kernel_event
-    emit_kernel_event("sp_flash_decode", kind="collective",
-                      method="push_all", axis=axis, world=world,
-                      shape=(b, h, d), dtype=q.dtype,
-                      delegates="all_gather", hops="none")
 
-    ag_ctx = AllGatherContext(axis=axis, world_size=world,
-                              method=AllGatherMethod.PUSH_ALL,
-                              collective_id=collective_id,
-                              interpret=interpret)
-    # Pack (out, lse) into one payload row per rank for a single LL
-    # AG, LANE-PADDED to a 128 multiple: Mosaic rejects DMA slices of
-    # rank-3 blocks whose last dim isn't tile-aligned (topology-
-    # compile catch at D+1 = 129).  The pad bytes are dead weight on a
-    # KB-scale latency-bound transfer — irrelevant, and far cheaper
-    # than a second AG for the 1-column lse.
-    dp = d + 1 + ((-(d + 1)) % 128)
-    payload = jnp.zeros((b * h, dp), jnp.float32)
-    payload = payload.at[:, :d].set(
-        out.astype(jnp.float32).reshape(b * h, d))
-    payload = payload.at[:, d].set(lse.reshape(b * h))
-    gathered = all_gather(payload, ag_ctx)            # (world*B*H, dp)
-    gathered = gathered.reshape(world, b, h, dp)
-    outs = gathered[..., :d]
-    lses = gathered[..., d]
-    return combine_partials(outs, lses).astype(q.dtype)
+def sp_flash_decode_paged(q, k_pool, v_pool, page_table, kv_len_local,
+                          axis: str, *, k_scale=None, v_scale=None,
+                          scale: Optional[float] = None,
+                          collective_id: int = cids.FLASH_DECODE_AG,
+                          interpret: Optional[bool] = None):
+    """Sequence-parallel distributed decode over PAGED local pools:
+    each rank holds a page pool + table covering its KV shard
+    (`kv_len_local` tokens valid).  Same pipeline as
+    `sp_flash_decode` — local paged split-KV kernel → one-shot push
+    allgather of the KB-scale (out, lse) payload → LSE-weighted
+    combine (shared `_sp_gather_combine` tail) — so the two differ
+    only in the local kernel's KV addressing."""
+    out, lse = flash_decode_paged(q, k_pool, v_pool, page_table,
+                                  kv_len_local, k_scale=k_scale,
+                                  v_scale=v_scale, scale=scale,
+                                  interpret=interpret)
+    return _sp_gather_combine("sp_flash_decode_paged", out, lse,
+                              kv_len_local, q, axis, collective_id,
+                              interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -316,7 +463,10 @@ def sp_flash_decode(q, k_shard, v_shard, kv_len_local, axis: str, *,
 # The decode kernel itself is pure compute; the distributed step is a
 # one-shot push allgather of the packed (out, lse) payload under the
 # FLASH_DECODE_AG collective id — register that footprint (the padded
-# f32 payload row the composition actually ships).
+# f32 payload row the composition actually ships).  The paged variant
+# ships the identical payload (paging changes only local KV
+# addressing), registered separately so a future divergence of either
+# composition is swept on its own.
 # ---------------------------------------------------------------------------
 
 from triton_distributed_tpu.analysis.registry import (  # noqa: E402
@@ -328,9 +478,7 @@ from triton_distributed_tpu.analysis.registry import (  # noqa: E402
 )
 
 
-@register_comm_kernel("flash_decode.partials_ag",
-                      meshes=({"sp": 2}, {"sp": 4}))
-def _analysis_flash_decode_ag(axis_sizes):
+def _partials_ag_spec(name: str, axis_sizes):
     from triton_distributed_tpu.kernels.allgather import (
         _push_all_ag_kernel)
 
@@ -338,7 +486,7 @@ def _analysis_flash_decode_ag(axis_sizes):
     b, h, d = 1, 2, 64
     dp = d + 1 + ((-(d + 1)) % 128)   # lane-padded out+lse row
     return KernelSpec(
-        name="flash_decode.partials_ag",
+        name=name,
         body=functools.partial(_push_all_ag_kernel, axis, world, None,
                                False),
         axis_sizes=axis_sizes,
@@ -346,3 +494,16 @@ def _analysis_flash_decode_ag(axis_sizes):
               RefSpec("gathered", (world, b * h, dp), jnp.float32)],
         sems=[SemSpec("local"), SemSpec("send"), SemSpec("recv", (world,))],
     )
+
+
+@register_comm_kernel("flash_decode.partials_ag",
+                      meshes=({"sp": 2}, {"sp": 4}))
+def _analysis_flash_decode_ag(axis_sizes):
+    return _partials_ag_spec("flash_decode.partials_ag", axis_sizes)
+
+
+@register_comm_kernel("flash_decode.paged_partials_ag",
+                      meshes=({"sp": 2}, {"sp": 4}))
+def _analysis_flash_decode_paged_ag(axis_sizes):
+    return _partials_ag_spec("flash_decode.paged_partials_ag",
+                             axis_sizes)

@@ -29,7 +29,10 @@ from triton_distributed_tpu.kernels.flash_attention import (
     attention_reference,
     flash_attention_diff,
 )
-from triton_distributed_tpu.kernels.flash_decode import flash_decode
+from triton_distributed_tpu.kernels.flash_decode import (
+    flash_decode,
+    flash_decode_paged,
+)
 from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
     GEMMReduceScatterContext,
     gemm_rs,
@@ -258,3 +261,65 @@ class TPAttention:
         out_x = self._out_proj(attn, x.dtype, params)
         scales = (k_sc, v_sc) if kv_scales is not None else None
         return out_x, (k_cache, v_cache), scales
+
+    def decode_paged(self, x, params, kv_pools, page_table, offset,
+                     kv_scales=None):
+        """Paged `decode`: the KV lives in a page pool
+        (`models.kv_cache.PagedKVCache` layout — (P, Hkv_loc, page, D)
+        per pool) addressed through ``page_table`` ((B, T) int32).
+        The new token's KV is scattered into
+        ``page_table[b, offset // page]`` at row ``offset % page``
+        (masked rows' NULL-mapped writes land in the reserved trash
+        page) and attention runs the page-table-indexed split-KV
+        kernel (`flash_decode_paged`).  Same projections, rope and
+        int8 quantize-on-write as the dense path."""
+        k_pool, v_pool = kv_pools
+        b = offset.shape[0]
+        ps = k_pool.shape[2]
+        qkv = self._project_qkv(x, params)          # (B, qkv_cols)
+        q, k, v = self._split_heads(qkv, b, 1)
+        if self.qk_norm:
+            q = rms_norm(q, params["q_norm"])
+            k = rms_norm(k, params["k_norm"])
+        cos, sin = rope_cos_sin(offset, self.head_dim, self.rope_theta)
+
+        def rope1(x_):  # x_: (B, H, 1, D); cos/sin: (B, D/2)
+            d2 = x_.shape[-1] // 2
+            c = cos[:, None, None, :].astype(jnp.float32)
+            s = sin[:, None, None, :].astype(jnp.float32)
+            x1, x2 = x_[..., :d2], x_[..., d2:]
+            return jnp.concatenate(
+                [x1 * c - x2 * s, x2 * c + x1 * s],
+                axis=-1).astype(x_.dtype)
+
+        q = rope1(q)
+        k = rope1(k)
+
+        assert (kv_scales is not None) == (k_pool.dtype == jnp.int8), (
+            "int8 pools require kv_scales (and float pools must not "
+            "pass them)")
+        bidx = jnp.arange(b)
+        phys = page_table[bidx, offset // ps]       # (B,)
+        within = offset % ps
+        k_sc = v_sc = None
+        if kv_scales is not None:
+            from triton_distributed_tpu.kernels.flash_decode import (
+                quantize_kv)
+
+            k_sc, v_sc = kv_scales
+            k, v, kscale_new, vscale_new = quantize_kv(k, v)
+            k_sc = k_sc.at[phys, :, within].set(kscale_new[:, :, 0])
+            v_sc = v_sc.at[phys, :, within].set(vscale_new[:, :, 0])
+        k_pool = k_pool.at[phys, :, within, :].set(
+            k[:, :, 0].astype(k_pool.dtype))
+        v_pool = v_pool.at[phys, :, within, :].set(
+            v[:, :, 0].astype(v_pool.dtype))
+
+        out, _ = flash_decode_paged(
+            q.reshape(b, self.h_loc, self.head_dim), k_pool, v_pool,
+            page_table, offset + 1, k_scale=k_sc, v_scale=v_sc,
+            interpret=self.interpret)
+        attn = out.reshape(b, self.h_loc * self.head_dim)
+        out_x = self._out_proj(attn, x.dtype, params)
+        scales = (k_sc, v_sc) if kv_scales is not None else None
+        return out_x, (k_pool, v_pool), scales

@@ -127,3 +127,163 @@ class KVCache:
         return dataclasses.replace(
             self, offset=jnp.broadcast_to(
                 jnp.asarray(value, jnp.int32), self.offset.shape))
+
+
+# ---------------------------------------------------------------------------
+# Paged layout
+# ---------------------------------------------------------------------------
+
+#: Physical page 0 is reserved as the NULL/trash page: unmapped page-
+#: table entries point at it, and writes that must be discarded (a
+#: shared prefix page the writer may not touch, a masked slot's frozen-
+#: offset write) are directed at it.  Its contents are garbage by
+#: design and are never read unmasked.
+NULL_PAGE = 0
+
+
+def pages_for(tokens: int, page_size: int) -> int:
+    """Pages needed to hold ``tokens`` KV positions."""
+    return -(-int(tokens) // int(page_size)) if tokens > 0 else 0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    """Page-table-indexed KV pool: the serving-scale layout.
+
+    Where `KVCache` pins ``batch × max_seq`` contiguous rows (every
+    admitted request pays full-length KV), this cache is ONE pool of
+    ``num_pages`` fixed-size pages plus a per-slot page table mapping
+    logical KV block ``j`` of slot ``b`` to a physical page.  A
+    sequence of length L pins only ``ceil(L / page_size)`` pages, and
+    two slots may map the SAME physical page (refcounted prefix
+    sharing — `serving.pages`).  This is PagedAttention's block-table
+    indirection in XLA-functional form: the pool and offsets are
+    donated through the jitted step exactly like `KVCache`, while the
+    page table itself is host-managed (a tiny (B, T) int32 array
+    re-shipped only when an allocation changes it).
+
+    Physical page `NULL_PAGE` (0) is reserved: unmapped table entries
+    and discarded writes land there, so allocation never recompiles
+    and masked rows can keep "writing" harmlessly.
+    """
+
+    ks: List[jnp.ndarray]          # per layer: (P, Hkv_loc, page, D)
+    vs: List[jnp.ndarray]
+    page_table: jnp.ndarray        # (B, T) int32 — physical page ids
+    offset: jnp.ndarray            # (B,) int32 — filled length
+    #: Per-token dequant scales (P, Hkv_loc, page) f32 per layer when
+    #: int8-quantized (same scheme as `KVCache.kss/vss`); None = float.
+    kss: Optional[List[jnp.ndarray]] = None
+    vss: Optional[List[jnp.ndarray]] = None
+    #: Tokens per page — static: it shapes the compiled programs.
+    page_size: int = dataclasses.field(
+        default=16, metadata=dict(static=True))
+
+    @property
+    def quantized(self) -> bool:
+        return self.kss is not None
+
+    @property
+    def num_pages(self) -> int:
+        return int(self.ks[0].shape[0])
+
+    @property
+    def pages_per_seq(self) -> int:
+        return int(self.page_table.shape[1])
+
+    @property
+    def batch(self) -> int:
+        return int(self.offset.shape[0])
+
+    @property
+    def max_seq(self) -> int:
+        """Logical sequence capacity of one slot (T × page_size)."""
+        return self.pages_per_seq * self.page_size
+
+    @classmethod
+    def create(cls, num_layers: int, num_pages: int, batch: int,
+               num_kv_heads: int, page_size: int, head_dim: int,
+               max_pages_per_seq: int, dtype=jnp.bfloat16,
+               quantized: bool = False):
+        """``num_pages`` INCLUDES the reserved null page 0 (usable
+        pages = num_pages - 1)."""
+        assert num_pages >= 2, "need >= 1 usable page beside NULL_PAGE"
+        shape = (num_pages, num_kv_heads, page_size, head_dim)
+        if quantized:
+            dtype = jnp.int8
+        return cls(
+            ks=[jnp.zeros(shape, dtype) for _ in range(num_layers)],
+            vs=[jnp.zeros(shape, dtype) for _ in range(num_layers)],
+            page_table=jnp.zeros((batch, max_pages_per_seq), jnp.int32),
+            offset=jnp.zeros((batch,), jnp.int32),
+            kss=([jnp.zeros(shape[:3], jnp.float32)
+                  for _ in range(num_layers)] if quantized else None),
+            vss=([jnp.zeros(shape[:3], jnp.float32)
+                  for _ in range(num_layers)] if quantized else None),
+            page_size=page_size,
+        )
+
+    def bytes_per_page(self) -> int:
+        """HBM bytes one physical page pins across all layers — the
+        unit the paged serving scheduler's admission budget is counted
+        in.  Unlike `KVCache.bytes_per_slot` (which prices a request
+        at max-context worst case), a request costs
+        ``pages_for(len) * bytes_per_page`` — its TRUE footprint."""
+        total = 0
+        for k, v in zip(self.ks, self.vs):
+            per_page = k.shape[1] * k.shape[2] * k.shape[3]
+            total += per_page * (k.dtype.itemsize + v.dtype.itemsize)
+        if self.quantized:
+            for ks_, vs_ in zip(self.kss, self.vss):
+                per_page = ks_.shape[1] * ks_.shape[2]
+                total += per_page * (ks_.dtype.itemsize
+                                     + vs_.dtype.itemsize)
+        return total
+
+    def set_layer(self, layer: int, k, v, kscale=None, vscale=None):
+        ks = list(self.ks)
+        vs = list(self.vs)
+        ks[layer] = k
+        vs[layer] = v
+        rep = dict(ks=ks, vs=vs)
+        if kscale is not None:
+            kss = list(self.kss)
+            vss = list(self.vss)
+            kss[layer] = kscale
+            vss[layer] = vscale
+            rep.update(kss=kss, vss=vss)
+        return dataclasses.replace(self, **rep)
+
+    def inc_offset(self, n: int = 1):
+        return dataclasses.replace(self, offset=self.offset + n)
+
+    def reset_slot(self, b):
+        """Zero slot ``b``'s offset.  The page-table row is host-
+        managed (`serving.pages.PagedKV.release` resets it to
+        NULL_PAGE before the next dispatch) — an offset of 0 already
+        masks every position."""
+        return dataclasses.replace(
+            self, offset=self.offset.at[b].set(0))
+
+    def set_offset(self, value):
+        return dataclasses.replace(
+            self, offset=jnp.broadcast_to(
+                jnp.asarray(value, jnp.int32), self.offset.shape))
+
+    def with_page_table(self, table):
+        """Rebind the page table (host mirror → device) without
+        touching the donated pool buffers."""
+        return dataclasses.replace(
+            self, page_table=jnp.asarray(table, jnp.int32))
+
+    def gather_logical(self, layer: int):
+        """Debug/test helper: reassemble the logical (B, Hkv, T*page,
+        D) view of ``layer`` through the page table.  NOT for the hot
+        path — decode reads through the table in-kernel."""
+        b = self.batch
+        k = self.ks[layer][self.page_table]    # (B, T, Hkv, page, D)
+        v = self.vs[layer][self.page_table]
+        k = jnp.moveaxis(k, 2, 1).reshape(b, k.shape[2], -1, k.shape[-1])
+        v = jnp.moveaxis(v, 2, 1).reshape(b, v.shape[2], -1, v.shape[-1])
+        return k, v

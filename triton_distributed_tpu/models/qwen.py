@@ -26,7 +26,7 @@ from triton_distributed_tpu.kernels.matmul import MatmulConfig
 from triton_distributed_tpu.layers.tp_attn import TPAttention, rms_norm
 from triton_distributed_tpu.layers.tp_mlp import TPMLP
 from triton_distributed_tpu.models.config import ModelConfig
-from triton_distributed_tpu.models.kv_cache import KVCache
+from triton_distributed_tpu.models.kv_cache import KVCache, PagedKVCache
 
 
 class Qwen3:
@@ -263,6 +263,42 @@ class Qwen3:
             cache = cache.set_offset(s)
         return logits, cache
 
+    def decode_paged_shard(self, params, tokens, cache):
+        """One PAGED decode step inside shard_map: the per-layer KV
+        pools are page-indexed (`models.kv_cache.PagedKVCache`,
+        KV heads sharded over tp like the dense cache), attention is
+        `flash_decode_paged`'s page-table-indirected split-KV kernel.
+        Mirrors `decode_shard` exactly otherwise."""
+        cfg = self.config
+        b = tokens.shape[0]
+        my = jax.lax.axis_index(self.axis)
+        b_loc = b // self.world
+        x = params["embed"][tokens]                 # (B, h)
+        x = jax.lax.dynamic_slice_in_dim(x, my * b_loc, b_loc, 0)
+
+        offset = cache.offset
+        for li, lp in enumerate(params["layers"]):
+            res = x
+            h = rms_norm(x, lp["ln1"], cfg.rms_norm_eps)
+            scales = ((cache.kss[li], cache.vss[li])
+                      if cache.quantized else None)
+            h, (nk, nv), nscales = self.attn.decode_paged(
+                h, lp["attn"], (cache.ks[li], cache.vs[li]),
+                cache.page_table, offset, kv_scales=scales)
+            cache = cache.set_layer(li, nk, nv,
+                                    *(nscales or (None, None)))
+            x = res + h
+            res = x
+            h = rms_norm(x, lp["ln2"], cfg.rms_norm_eps)
+            h = self.mlp(h, lp["mlp"])
+            x = res + h
+
+        x = rms_norm(x, params["ln_f"], cfg.rms_norm_eps)
+        x_full = jax.lax.all_gather(x, self.axis, tiled=True)  # (B, h)
+        logits = jnp.dot(x_full, params["lm_head"],
+                         preferred_element_type=jnp.float32)
+        return logits, cache.inc_offset(1)
+
     def decode_shard(self, params, tokens, cache: KVCache):
         """One decode step inside shard_map.  tokens: (B,) replicated.
         Returns (logits_local (B, V/world), cache)."""
@@ -334,6 +370,44 @@ class Qwen3:
             in_specs=(specs, P(None), self._cache_specs(None)),
             out_specs=(P(None, self.axis), self._cache_specs(None)),
             check_vma=False)
+
+    def _paged_cache_specs(self, page_size: int):
+        n = self.config.num_layers
+        q = self.config.quantize_kv_cache
+        # page_size is a pytree META field: the spec's must match the
+        # cache's for the shard_map treedefs to line up.
+        return PagedKVCache(
+            ks=[P(None, self.axis, None, None)] * n,
+            vs=[P(None, self.axis, None, None)] * n,
+            page_table=P(None, None),
+            offset=P(None),
+            kss=[P(None, self.axis, None)] * n if q else None,
+            vss=[P(None, self.axis, None)] * n if q else None,
+            page_size=page_size,
+        )
+
+    def make_paged_decode_fn(self, page_size: int = 16):
+        specs = self.param_specs()
+        cspecs = self._paged_cache_specs(page_size)
+
+        def fn(params, tokens, cache):
+            return self.decode_paged_shard(params, tokens, cache)
+
+        return jax.shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(specs, P(None), cspecs),
+            out_specs=(P(None, self.axis), cspecs),
+            check_vma=False)
+
+    def create_paged_cache(self, batch: int, num_pages: int,
+                           page_size: int, max_pages_per_seq: int):
+        cfg = self.config
+        # pool pages replicated in batch, KV heads sharded over tp —
+        # same head split as the dense cache, page axis shared.
+        return PagedKVCache.create(
+            cfg.num_layers, num_pages, batch, cfg.num_kv_heads,
+            page_size, cfg.head_dim, max_pages_per_seq, self.dtype,
+            quantized=cfg.quantize_kv_cache)
 
     def create_cache(self, batch: int, max_seq: Optional[int] = None):
         cfg = self.config

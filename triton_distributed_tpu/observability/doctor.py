@@ -446,6 +446,28 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
             f"rank {rank}: trace file truncated (killed mid-write); "
             "complete events were salvaged")
 
+    # Paged-KV pressure (serving gauges ride the heartbeats): a rank
+    # at ~full page occupancy is thrashing on eviction/preemption —
+    # name it, with the prefix-cache share so "cache bloat" and "real
+    # load" read differently.  Section (and verdict note) only exist
+    # when the paged gauges are present, so non-serving incidents'
+    # reports are byte-identical to before.
+    page_pressure = []
+    for rank, row in sorted(rank_table.items(),
+                            key=lambda kv: int(kv[0])):
+        sv = row.get("serving") or {}
+        occ = sv.get("serving_kv_page_occupancy")
+        if occ is None:
+            continue
+        page_pressure.append({
+            "rank": int(rank),
+            "page_occupancy": round(float(occ), 4),
+            "pages_free": sv.get("serving_kv_pages_free"),
+            "pages_used": sv.get("serving_kv_pages_used"),
+            "prefix_cache_pages": sv.get("serving_prefix_cache_pages"),
+            "pressure": float(occ) >= PAGE_PRESSURE_OCCUPANCY,
+        })
+
     in_flight = stall.pop("in_flight_event", None)
     report = {
         "schema": REPORT_SCHEMA,
@@ -470,8 +492,14 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
         "timeline": timeline_summary,
         "incompleteness": incompleteness,
     }
+    if page_pressure:
+        report["page_pressure"] = page_pressure
     report["verdict"] = _verdict(report, in_flight)
     return report
+
+
+#: Page occupancy at/above which doctor calls out KV page pressure.
+PAGE_PRESSURE_OCCUPANCY = 0.9
 
 
 def _verdict(report: dict, in_flight: Optional[dict]) -> str:
@@ -481,6 +509,13 @@ def _verdict(report: dict, in_flight: Optional[dict]) -> str:
     hot_s = (f"; hottest link {hot[0]['link']} "
              f"({hot[0]['bytes']} bytes: "
              f"{', '.join(hot[0]['ops'])})" if hot else "")
+    pressured = [e for e in report.get("page_pressure", [])
+                 if e["pressure"]]
+    if pressured:
+        worst = max(pressured, key=lambda e: e["page_occupancy"])
+        hot_s += (f"; KV page pressure on rank {worst['rank']} "
+                  f"({worst['page_occupancy']:.0%} of pages in use, "
+                  f"{worst['pages_free']} free)")
     if stall["first_stalled_rank"] is not None:
         r = stall["first_stalled_rank"]
         what = (f" inside {stall['open_span']!r}"
@@ -560,6 +595,20 @@ def render_markdown(report: dict) -> str:
             f"{'[' + ev['method'] + ']' if ev.get('method') else ''} "
             f"| {dropped} |")
     lines.append("")
+
+    pressure = report.get("page_pressure")
+    if pressure:
+        lines += ["## KV page pressure", "",
+                  "| rank | occupancy | used | free | prefix-cache "
+                  "| state |", "|---|---|---|---|---|---|"]
+        for e in pressure:
+            lines.append(
+                f"| {e['rank']} | {e['page_occupancy']:.0%} "
+                f"| {e['pages_used'] if e['pages_used'] is not None else '-'} "
+                f"| {e['pages_free'] if e['pages_free'] is not None else '-'} "
+                f"| {e['prefix_cache_pages'] if e['prefix_cache_pages'] is not None else '-'} "
+                f"| {'PRESSURE' if e['pressure'] else 'ok'} |")
+        lines.append("")
 
     stall = report["stall"]
     if stall["first_stalled_rank"] is not None:

@@ -235,10 +235,15 @@ def link_table(registry: Optional[MetricsRegistry] = None) -> dict:
 
 #: Serving-state gauges mirrored into the heartbeat body: a stalled
 #: rank's last beat then says what the scheduler was carrying when it
-#: stopped (doctor folds these into its rank table).
+#: stopped (doctor folds these into its rank table).  The paged-KV
+#: gauges ride along so doctor can call out page pressure (a rank
+#: thrashing on preemption/eviction) in incident reports.
 _HEARTBEAT_GAUGES = ("serving_queue_depth", "serving_active_slots",
                      "serving_slot_occupancy",
-                     "serving_kv_bytes_in_use")
+                     "serving_kv_bytes_in_use",
+                     "serving_kv_pages_free", "serving_kv_pages_used",
+                     "serving_kv_page_occupancy",
+                     "serving_prefix_cache_pages")
 
 
 def heartbeat_payload() -> dict:

@@ -1,22 +1,30 @@
 """Continuous-batching serving runtime.
 
 Orca-style iteration-level scheduling over a slot-partitioned KV
-cache: new requests join the RUNNING decode batch via in-flight
-bucketed prefill + slot insert instead of waiting for the batch to
-drain.  See docs/serving.md for architecture, slot lifecycle, metric
-names and the bucketing/recompile tradeoff.
+cache (``kv_layout="slots"``) or a paged, page-table-indexed KV pool
+with radix prefix reuse (``kv_layout="paged"``): new requests join
+the RUNNING decode batch via in-flight bucketed prefill + slot/page
+insert instead of waiting for the batch to drain.  See
+docs/serving.md for architecture, the paged allocator/prefix-cache
+mechanics, metric names and the bucketing/recompile tradeoff.
 """
 
 from triton_distributed_tpu.serving.engine_batched import (  # noqa: F401
     DEFAULT_PREFILL_BUCKETS,
     make_insert_fn,
     make_masked_step_fn,
+    make_paged_insert_fn,
     make_rollout_fn,
     make_step_fn,
     masked_sample,
     pad_prompt,
     pick_bucket,
     request_key,
+)
+from triton_distributed_tpu.serving.pages import (  # noqa: F401
+    PagedKV,
+    PagePool,
+    RadixCache,
 )
 from triton_distributed_tpu.serving.request import (  # noqa: F401
     FinishReason,

@@ -241,6 +241,67 @@ def make_insert_fn(donate: bool = True):
     return jax.jit(insert)
 
 
+def make_paged_insert_fn(donate: bool = True):
+    """``(pool_cache, keys, row_cache, key, slot, page_ids, offset) ->
+    (pool_cache, keys)`` — scatter a freshly prefilled single-row
+    dense cache (batch 1, max_seq = its length bucket) into physical
+    pages of the paged pool, set the slot's offset and PRNG key — one
+    dispatch per admission, one compiled program per (bucket,
+    pool-geometry).
+
+    ``page_ids`` is a (ceil(bucket / page_size),) int32 vector naming
+    the physical destination of each LOCAL page of the row cache;
+    entries equal to `NULL_PAGE` (0) discard that page's write into
+    the reserved trash page — this is how shared prefix pages (owned
+    by the radix cache, possibly mapped by other slots) are skipped
+    without recompiling.  The page TABLE is not touched here: it is
+    host-managed (`serving.pages.PagedKV`) and re-shipped wholesale
+    before the next dispatch.
+
+    The row cache may cover a page-aligned SUFFIX of the prompt (the
+    prefix-cache-aware prefill path): local page j then maps to
+    logical page ``start_page + j`` — the caller encodes that purely
+    in ``page_ids``, so this program is oblivious to sharing.
+    """
+
+    def insert(pool, keys, row: KVCache, key, slot, page_ids, offset):
+        ps = pool.page_size
+        bucket = int(row.ks[0].shape[2])
+        n_pages = -(-bucket // ps)
+
+        def scatter(dst_list, src_list, scales: bool):
+            out = []
+            for dst, src in zip(dst_list, src_list):
+                for j in range(n_pages):
+                    lo, hi = j * ps, min((j + 1) * ps, bucket)
+                    blk = (src[:, :, lo:hi] if scales
+                           else src[:, :, lo:hi, :])
+                    blk = blk.astype(dst.dtype)
+                    idx = ((page_ids[j], 0, 0) if scales
+                           else (page_ids[j], 0, 0, 0))
+                    dst = jax.lax.dynamic_update_slice(dst, blk, idx)
+                out.append(dst)
+            return out
+
+        rep = dict(ks=scatter(pool.ks, row.ks, False),
+                   vs=scatter(pool.vs, row.vs, False),
+                   offset=jax.lax.dynamic_update_slice(
+                       pool.offset,
+                       jnp.reshape(jnp.asarray(offset, jnp.int32), (1,)),
+                       (jnp.asarray(slot, jnp.int32),)))
+        if pool.quantized:
+            rep["kss"] = scatter(pool.kss, row.kss, True)
+            rep["vss"] = scatter(pool.vss, row.vss, True)
+        keys = jax.lax.dynamic_update_slice(
+            keys, key.astype(keys.dtype)[None, :],
+            (jnp.asarray(slot, jnp.int32), 0))
+        return dataclasses.replace(pool, **rep), keys
+
+    if donate:
+        return jax.jit(insert, donate_argnums=(0, 1))
+    return jax.jit(insert)
+
+
 # ---------------------------------------------------------------------------
 # Prefill bucketing
 # ---------------------------------------------------------------------------

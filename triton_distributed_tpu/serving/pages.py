@@ -1,0 +1,519 @@
+"""Paged KV management: page pool, radix prefix cache, slot manager.
+
+The serving-scale replacement for `serving.slots.SlotKV`.  Three
+host-side structures cooperate over one donated `PagedKVCache`:
+
+- `PagePool` — the physical allocator: a free list plus per-page
+  refcounts over ``num_pages`` fixed-size pages (page 0 reserved as
+  the NULL/trash page).  A request pins ``ceil(len / page_size)``
+  pages — its TRUE footprint — instead of `SlotKV`'s max-context
+  worst case, which is where the 4–8× admitted-concurrency headroom
+  on the same HBM budget comes from.
+
+- `RadixCache` — prefix sharing: a radix tree over page-granular
+  token chunks.  Full prompt pages are registered at admission;
+  later requests whose prompt starts with the same chunks map the
+  SAME physical pages (refcounted) instead of re-prefilling and
+  re-storing them.  Unreferenced nodes stay cached and are evicted
+  LRU, leaves first, when the pool runs dry.  Only pages strictly
+  below position ``s-1`` are ever shared: the serving insert
+  recomputes position ``s-1`` and decode writes from there on, so
+  every page a request can WRITE is private by construction
+  (copy-on-extend at page granularity — divergent tails never share).
+
+- `PagedKV` — the slot manager the scheduler drives: per-slot page
+  tables (host mirror, re-shipped to the device cache only when an
+  allocation changes it), incremental page allocation as sequences
+  grow (`ensure`), page-based admission/feasibility arithmetic, and
+  the jitted paged insert.  API mirrors `SlotKV` where the scheduler
+  needs it (`can_admit` / `insert_prefill` / `release` /
+  `active_mask` / occupancy properties).
+
+Invariant that makes mid-stream allocation safe: a request was only
+admitted if its WORST-CASE total pages fit the usable pool, and
+everything not referenced by a live request is evictable — so after
+evicting the radix cache and preempting down to one request, that
+request can always grow to its horizon.  The scheduler preempts
+newest-first when `ensure` fails (see `scheduler.ContinuousBatching
+Scheduler._preempt`).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.models.kv_cache import (
+    NULL_PAGE,
+    PagedKVCache,
+    pages_for,
+)
+from triton_distributed_tpu.serving.engine_batched import (
+    make_paged_insert_fn,
+)
+
+
+class PagePool:
+    """Free list + refcounts over physical pages 1..num_pages-1
+    (page `NULL_PAGE` is reserved and never allocated)."""
+
+    def __init__(self, num_pages: int):
+        assert num_pages >= 2, num_pages
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(1, num_pages))
+        self.refs = np.zeros(num_pages, np.int32)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def usable_pages(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def used_pages(self) -> int:
+        return self.usable_pages - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n pages with refcount 1, or None (caller evicts/preempts)."""
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        self.refs[ids] = 1
+        return ids
+
+    def incref(self, ids: Sequence[int]) -> None:
+        for i in ids:
+            self.refs[i] += 1
+
+    def decref(self, ids: Sequence[int]) -> None:
+        """Drop one reference; pages hitting refcount 0 return to the
+        free list.  (Radix-cached pages are kept alive by the tree's
+        OWN reference — eviction drops it.)"""
+        for i in ids:
+            self.refs[i] -= 1
+            assert self.refs[i] >= 0, (i, self.refs[i])
+            if self.refs[i] == 0:
+                self._free.append(i)
+
+
+class _RadixNode:
+    __slots__ = ("children", "parent", "chunk", "page", "refs",
+                 "last_use")
+
+    def __init__(self, parent, chunk: Tuple[int, ...], page: int):
+        self.children: Dict[Tuple[int, ...], "_RadixNode"] = {}
+        self.parent = parent
+        self.chunk = chunk
+        self.page = page
+        #: Live requests currently mapping this page (the tree's own
+        #: retention is NOT counted here — refs 0 means evictable).
+        self.refs = 0
+        self.last_use = 0
+
+
+class RadixCache:
+    """Page-granular radix tree: node = one full page of prompt
+    tokens, keyed by that page's token tuple under its parent.  The
+    tree holds one pool reference per cached page; live requests add
+    theirs via `acquire`.  `evict` frees LRU refcount-0 leaves."""
+
+    def __init__(self, pool: PagePool, page_size: int):
+        self.pool = pool
+        self.page_size = page_size
+        self._root = _RadixNode(None, (), NULL_PAGE)
+        self._clock = 0
+        self.cached_pages = 0          # total pages the tree retains
+        #: Pages at refcount 0 (evictable) — maintained incrementally
+        #: so the admission path never walks the tree.
+        self._idle_pages = 0
+        self.hit_tokens = 0
+        self.miss_tokens = 0
+        self.evicted_pages = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def match(self, tokens: Sequence[int]) -> List[_RadixNode]:
+        """Longest chain of cached full pages prefixing ``tokens``."""
+        ps = self.page_size
+        node, path = self._root, []
+        j = 0
+        while True:
+            chunk = tuple(tokens[j * ps:(j + 1) * ps])
+            if len(chunk) < ps:
+                break
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            path.append(child)
+            node = child
+            j += 1
+        return path
+
+    def acquire(self, path: Sequence[_RadixNode]) -> None:
+        t = self._tick()
+        for n in path:
+            if n.refs == 0:
+                self._idle_pages -= 1
+            n.refs += 1
+            n.last_use = t
+            self.pool.incref([n.page])
+
+    def release(self, path: Sequence[_RadixNode]) -> None:
+        t = self._tick()
+        for n in path:
+            n.refs -= 1
+            assert n.refs >= 0
+            if n.refs == 0:
+                self._idle_pages += 1
+            n.last_use = t
+            self.pool.decref([n.page])
+
+    def extend(self, parent_path: Sequence[_RadixNode],
+               tokens: Sequence[int], first_page: int,
+               page_ids: Sequence[int]) -> List[_RadixNode]:
+        """Register pages ``first_page .. first_page+len(page_ids)-1``
+        of ``tokens`` (already written, ownership transferred from the
+        caller's private allocation — the tree adds its own pool ref).
+        Returns the new nodes, ACQUIRED for the calling request (the
+        caller's original allocation ref becomes the request's)."""
+        ps = self.page_size
+        node = parent_path[-1] if parent_path else self._root
+        t = self._tick()
+        out = []
+        for i, page in enumerate(page_ids):
+            j = first_page + i
+            chunk = tuple(tokens[j * ps:(j + 1) * ps])
+            assert len(chunk) == ps, (j, len(chunk))
+            assert chunk not in node.children, "duplicate radix chain"
+            child = _RadixNode(node, chunk, page)
+            child.refs = 1            # the inserting request
+            child.last_use = t
+            node.children[chunk] = child
+            # tree retention ref (beyond the request's)
+            self.pool.incref([page])
+            self.cached_pages += 1
+            node = child
+            out.append(child)
+        return out
+
+    def evictable_pages(self) -> int:
+        """Pages the tree could free right now (refcount-0 nodes —
+        ancestors of a refs>0 node are themselves refs>0, so every
+        refs-0 subtree is fully evictable).  O(1): the counter is
+        maintained by acquire/release/evict, keeping the per-step
+        admission check off the tree."""
+        return self._idle_pages
+
+    def evict(self, need: int) -> int:
+        """Free up to ``need`` pages, LRU leaves first.  Returns how
+        many were freed.  One tree walk collects the evictable-leaf
+        frontier; freeing a leaf promotes its parent into the frontier
+        when it becomes an evictable leaf itself."""
+        frontier = []                      # (last_use, id, node)
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            if node.refs == 0 and not node.children:
+                heapq.heappush(frontier,
+                               (node.last_use, id(node), node))
+            stack.extend(node.children.values())
+        freed = 0
+        while freed < need and frontier:
+            _, _, victim = heapq.heappop(frontier)
+            parent = victim.parent
+            del parent.children[victim.chunk]
+            self.pool.decref([victim.page])
+            self.cached_pages -= 1
+            self._idle_pages -= 1
+            self.evicted_pages += 1
+            freed += 1
+            if (parent is not self._root and parent.refs == 0
+                    and not parent.children):
+                heapq.heappush(frontier,
+                               (parent.last_use, id(parent), parent))
+        return freed
+
+
+class PagedKV:
+    """Paged slot manager with radix prefix reuse — the `SlotKV`
+    analogue the scheduler drives in ``kv_layout="paged"`` mode.
+
+    ``num_pages`` counts USABLE pages (the reserved null page is added
+    internally).  When ``kv_budget_bytes`` is given instead, the pool
+    is sized to ``budget // bytes_per_page`` — admission arithmetic is
+    then in actual pages, so a rejection reason reflects what the
+    allocator can truly hold, not a max-context estimate.
+    """
+
+    def __init__(self, model, num_slots: int, max_seq: int,
+                 page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 kv_budget_bytes: Optional[int] = None,
+                 prefix_cache: bool = True):
+        self.page_size = ps = int(page_size)
+        self.max_seq = int(max_seq)
+        self.pages_per_seq = t = pages_for(self.max_seq, ps)
+        self.num_slots = int(num_slots)
+        # Size the pool: explicit pages > byte budget > slot-engine
+        # parity (every slot can reach max_seq simultaneously).
+        probe = model.create_paged_cache(1, 2, ps, 1)
+        self.bytes_per_page = probe.bytes_per_page()
+        del probe
+        if num_pages is None:
+            if kv_budget_bytes:
+                num_pages = int(kv_budget_bytes // self.bytes_per_page)
+            else:
+                num_pages = self.num_slots * t
+        self.usable_pages = int(num_pages)
+        if self.usable_pages < 1:
+            raise ValueError(
+                f"kv budget holds {self.usable_pages} pages — nothing "
+                f"is ever admittable")
+        self.kv_budget_bytes = self.usable_pages * self.bytes_per_page
+        self.cache: PagedKVCache = model.create_paged_cache(
+            self.num_slots, 1 + self.usable_pages, ps, t)
+        self.keys = jnp.zeros((self.num_slots, 2), jnp.uint32)
+        self.pool = PagePool(1 + self.usable_pages)
+        self.radix = (RadixCache(self.pool, ps) if prefix_cache
+                      else None)
+        self._free: List[int] = list(range(self.num_slots))
+        self._active = np.zeros(self.num_slots, bool)
+        #: Host mirror of the device page table — single source of
+        #: truth; `flush` re-ships it before a dispatch when dirty.
+        self._table = np.zeros((self.num_slots, t), np.int32)
+        self._dirty = True
+        #: Per-slot private page ids (allocation order = logical
+        #: order) and acquired radix path.
+        self._slot_pages: List[List[int]] = [[] for _ in
+                                             range(self.num_slots)]
+        self._slot_path: List[List[_RadixNode]] = [[] for _ in
+                                                   range(self.num_slots)]
+        #: Logical pages currently mapped per slot.
+        self._mapped = np.zeros(self.num_slots, np.int64)
+        self._insert = make_paged_insert_fn()
+
+    # -- occupancy / accounting -----------------------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_slots(self) -> int:
+        return self.num_slots - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.active_slots / self.num_slots
+
+    @property
+    def free_pages(self) -> int:
+        return self.pool.free_pages
+
+    @property
+    def used_pages(self) -> int:
+        return self.pool.used_pages
+
+    @property
+    def page_occupancy(self) -> float:
+        return self.used_pages / self.usable_pages
+
+    @property
+    def cached_prefix_pages(self) -> int:
+        return self.radix.cached_pages if self.radix else 0
+
+    @property
+    def bytes_in_use(self) -> int:
+        """TRUE bytes pinned (pages actually allocated) — not the
+        max-context estimate `SlotKV` reports."""
+        return self.used_pages * self.bytes_per_page
+
+    def _reclaimable(self) -> int:
+        return self.pool.free_pages + (
+            self.radix.evictable_pages() if self.radix else 0)
+
+    def feasible(self, prompt_len: int, max_new: int) -> bool:
+        """Could this request EVER run alone on an empty pool?  The
+        last generated token needs no KV write, so the horizon is
+        ``prompt_len + max_new - 1`` positions."""
+        horizon = prompt_len + max_new - 1
+        return (horizon <= self.max_seq
+                and pages_for(horizon, self.page_size)
+                <= self.usable_pages)
+
+    def can_admit(self, tokens: Optional[Sequence[int]] = None) -> bool:
+        """A slot is free and the pool (after evicting unreferenced
+        prefix pages) covers the request's PREFILL pages — growth is
+        incremental (`ensure`), with preemption as the safety valve.
+
+        Matched-chain pages at refcount 0 are NOT counted as
+        evictable: `insert_prefill` acquires the chain before
+        allocating, which pins exactly those pages — counting them
+        both as "shared, not needed" and "evictable headroom" would
+        admit a request the allocator then cannot serve."""
+        if not self._free:
+            return False
+        if tokens is None:
+            return self._reclaimable() >= 1
+        path = self.match_prefix(tokens)
+        need = pages_for(len(tokens), self.page_size) - len(path)
+        reclaim = self.pool.free_pages
+        if self.radix is not None:
+            on_path_idle = sum(1 for n in path if n.refs == 0)
+            reclaim += self.radix.evictable_pages() - on_path_idle
+        return reclaim >= need
+
+    # -- prefix cache ----------------------------------------------------
+
+    def match_prefix(self, tokens: Sequence[int]) -> List[_RadixNode]:
+        """Cached full pages prefixing ``tokens``, capped so every
+        page containing positions >= len(tokens)-1 stays private
+        (those get written: s-1 is recomputed at insert, generation
+        writes from s on)."""
+        if self.radix is None:
+            return []
+        path = self.radix.match(tokens)
+        cap = (len(tokens) - 1) // self.page_size
+        return path[:cap]
+
+    # -- allocation ------------------------------------------------------
+
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        if n == 0:
+            return []
+        ids = self.pool.alloc(n)
+        if ids is None and self.radix is not None:
+            self.radix.evict(n - self.pool.free_pages)
+            ids = self.pool.alloc(n)
+        return ids
+
+    def ensure(self, slot: int, need_positions: int) -> bool:
+        """Grow slot ``slot``'s mapping to cover KV positions
+        ``[0, need_positions)`` — called before every dispatch so the
+        decode write at ``offset`` always lands in a mapped private
+        page.  False = pool dry even after eviction (caller preempts).
+        """
+        need = min(pages_for(need_positions, self.page_size),
+                   self.pages_per_seq)
+        while self._mapped[slot] < need:
+            ids = self._alloc(1)
+            if not ids:
+                return False
+            j = int(self._mapped[slot])
+            self._table[slot, j] = ids[0]
+            self._slot_pages[slot].append(ids[0])
+            self._mapped[slot] = j + 1
+            self._dirty = True
+        return True
+
+    def flush(self) -> None:
+        """Re-ship the host page table to the device cache if any
+        allocation/release changed it since the last dispatch."""
+        if self._dirty:
+            self.cache = self.cache.with_page_table(self._table)
+            self._dirty = False
+
+    # -- lifecycle -------------------------------------------------------
+
+    def insert_prefill(self, row_cache, tokens: Sequence[int],
+                       prompt_len: int, key,
+                       shared_path: List[_RadixNode],
+                       row_start: int = 0) -> int:
+        """Claim a slot, map shared prefix pages + freshly allocated
+        private pages, scatter the prefilled row cache into the
+        private pages, set offset to ``prompt_len - 1`` and the slot
+        PRNG key.  ``row_cache`` covers prompt positions
+        ``[row_start, prompt_len)`` (``row_start = 0`` for a full
+        prefill, or the page-aligned shared-prefix length for the
+        suffix path).  Full prompt pages are registered into the
+        radix cache so later arrivals share them.  Returns the slot.
+        """
+        s = int(prompt_len)
+        ps = self.page_size
+        assert self._free, "insert_prefill without can_admit()"
+        assert row_start % ps == 0, row_start
+        c_pages = len(shared_path)
+        assert row_start <= c_pages * ps
+        total_pages = pages_for(s, ps)
+        # Acquire the shared chain BEFORE allocating: _alloc may evict
+        # refcount-0 radix pages, and the matched chain must not be
+        # among them.
+        if shared_path and self.radix is not None:
+            self.radix.acquire(shared_path)
+        priv = self._alloc(total_pages - c_pages)
+        assert priv is not None, "insert_prefill without can_admit()"
+        slot = self._free.pop(0)
+        # host table row: shared chain, then private pages, then NULL
+        row = np.full(self.pages_per_seq, NULL_PAGE, np.int32)
+        for j, node in enumerate(shared_path):
+            row[j] = node.page
+        for i, p in enumerate(priv):
+            row[c_pages + i] = p
+        self._table[slot] = row
+        self._mapped[slot] = total_pages
+        self._dirty = True
+        # physical destination of each LOCAL row page (NULL = discard:
+        # shared pages the row may not overwrite, pad-tail overflow)
+        bucket = int(row_cache.ks[0].shape[2])
+        n_row_pages = pages_for(bucket, ps)
+        page_ids = np.full(n_row_pages, NULL_PAGE, np.int32)
+        for j in range(n_row_pages):
+            g = row_start // ps + j
+            if c_pages <= g < total_pages:
+                page_ids[j] = row[g]
+        self.cache, self.keys = self._insert(
+            self.cache, self.keys, row_cache, key,
+            jnp.int32(slot), jnp.asarray(page_ids), jnp.int32(s - 1))
+        self._active[slot] = True
+        self._slot_pages[slot] = list(priv)
+        self._slot_path[slot] = list(shared_path)
+        # Register newly written FULL prompt pages (strictly below
+        # position s-1) so the next same-prefix arrival shares them.
+        if self.radix is not None:
+            sharable = (s - 1) // ps          # pages 0..sharable-1
+            n_new = sharable - c_pages
+            if n_new > 0:
+                new_pages = [row[c_pages + i] for i in range(n_new)]
+                nodes = self.radix.extend(shared_path, tokens, c_pages,
+                                          new_pages)
+                # ownership moved: the request now holds these via its
+                # radix path, not as private pages
+                self._slot_pages[slot] = list(priv[n_new:])
+                self._slot_path[slot] = list(shared_path) + nodes
+            self.radix.hit_tokens += c_pages * ps
+            self.radix.miss_tokens += s - c_pages * ps
+        return slot
+
+    def release(self, slot: int) -> None:
+        """Retire a slot: drop its radix references (pages stay cached
+        for future prefix hits), free its private pages, reset its
+        offset AND its page-table row to NULL — a masked row keeps
+        issuing (frozen-offset) writes, which must land in the trash
+        page, never in a page someone else may get."""
+        assert 0 <= slot < self.num_slots and slot not in self._free
+        if self._slot_path[slot] and self.radix is not None:
+            self.radix.release(self._slot_path[slot])
+        self.pool.decref(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self._slot_path[slot] = []
+        self._table[slot] = NULL_PAGE
+        self._mapped[slot] = 0
+        self._dirty = True
+        self.cache = self.cache.reset_slot(slot)
+        self._active[slot] = False
+        self._free.append(slot)
+
+    def active_mask(self) -> jnp.ndarray:
+        return jnp.asarray(self._active)
+
+    def snapshot_key(self, slot: int) -> np.ndarray:
+        """Device fetch of a slot's current PRNG key (preemption path
+        — the resumed request must continue its exact key chain)."""
+        return np.asarray(self.keys[slot]).copy()

@@ -67,6 +67,15 @@ class Request:
     reject_reason: Optional[RejectReason] = None
     #: Prefill length bucket the prompt was padded to at admission.
     bucket: Optional[int] = None
+    #: Preemption state (paged engine only): when the page pool runs
+    #: dry mid-stream the scheduler may evict this request and requeue
+    #: it.  ``resume_tokens`` = prompt + tokens generated so far (the
+    #: re-prefill recomputes their KV bit-identically), ``resume_key``
+    #: = the slot's PRNG key at eviction, so the resumed stream
+    #: continues the exact same sample chain.
+    resume_tokens: Optional[List[int]] = None
+    resume_key: Optional[object] = None
+    preemptions: int = 0
 
     # -- SLO timestamps (scheduler clock, seconds) ---------------------
     t_arrival: Optional[float] = None
@@ -132,4 +141,5 @@ class Request:
             "queue_wait_s": self.queue_wait,
             "ttft_s": self.ttft,
             "latency_s": self.latency,
+            "preemptions": self.preemptions,
         }

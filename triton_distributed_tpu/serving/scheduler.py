@@ -3,16 +3,23 @@
 Every `step()` is one scheduler iteration:
 
 1. **admit** — while the FIFO head has arrived, a slot is free and the
-   KV budget allows, run a bucketed single-row prefill and
-   `SlotKV.insert_prefill` it into the running decode batch (requests
+   KV budget (bytes for ``kv_layout="slots"``, actual PAGES for
+   ``"paged"``) allows, run a bucketed single-row prefill — or, on a
+   radix prefix-cache hit with a prefix-aware model, a suffix-only
+   prefill — and insert it into the running decode batch (requests
    join mid-flight; nobody waits for the batch to drain);
 2. **decode** — ONE jitted masked step for all slots
    (`engine_batched.make_masked_step_fn`); free/finished slots emit
-   the pad id and don't advance offsets or RNG keys;
+   the pad id and don't advance offsets or RNG keys.  Paged mode
+   first maps pages for the positions this dispatch writes
+   (`PagedKV.ensure`), preempting the newest request — resumed later,
+   bit-exactly — if the pool is dry even after LRU-evicting
+   unreferenced prefix pages;
 3. **retire** — the step's tokens are synced to host (the one
    unavoidable sync: EOS is data-dependent), appended, streamed via
    ``on_token``, and rows that hit EOS / ``max_new_tokens`` / the KV
-   horizon release their slot for the next joiner.
+   horizon release their slot (and, paged, their private pages —
+   prompt pages stay cached for future prefix hits).
 
 Backpressure is at `submit`: a bounded queue and static feasibility
 checks reject with a typed reason instead of queueing unservable work.
@@ -65,8 +72,28 @@ class SchedulerConfig:
     #: Decode-cache sequence capacity; None = model config's
     #: max_seq_len.
     max_seq: Optional[int] = None
-    #: Cap on KV bytes live slots may pin (None = all slots).
+    #: Cap on KV bytes live slots may pin (None = all slots).  In
+    #: paged mode this sizes the PAGE POOL (budget // bytes_per_page
+    #: usable pages) — admission then counts actual pages, not
+    #: max-context estimates.
     kv_budget_bytes: Optional[int] = None
+    #: KV layout: "slots" = one contiguous row of max_seq per request
+    #: (`serving.slots.SlotKV`); "paged" = page-table-indexed pool
+    #: with radix prefix sharing (`serving.pages.PagedKV`) — a request
+    #: pins only the pages it has actually filled, so admitted
+    #: concurrency on the same HBM budget is bounded by REAL usage.
+    kv_layout: str = "slots"
+    #: Tokens per KV page (paged mode).  For token-for-token equality
+    #: with the slot engine keep max_seq a multiple of this.
+    page_size: int = 16
+    #: Usable pages in the pool (paged mode); None = derived from
+    #: kv_budget_bytes, else slot-engine parity (num_slots pages to
+    #: max_seq each).
+    num_pages: Optional[int] = None
+    #: Radix prefix cache: requests sharing a prompt prefix share
+    #: refcounted pages; full prompt pages are cached after use and
+    #: evicted LRU under pressure (paged mode).
+    prefix_cache: bool = True
     pad_id: int = 0
     temperature: float = 0.0
     top_k: int = 0
@@ -104,11 +131,35 @@ class ContinuousBatchingScheduler:
         if not self.buckets:
             raise ValueError(
                 f"no prefill bucket fits max_seq={self.max_seq}")
-        self.slots = SlotKV(model.create_cache(cfg.num_slots,
-                                               max_seq=self.max_seq),
-                            cfg.kv_budget_bytes)
+        self.paged = cfg.kv_layout == "paged"
+        if self.paged:
+            if not (hasattr(model, "create_paged_cache")
+                    and hasattr(model, "make_paged_decode_fn")):
+                raise ValueError(
+                    f"{type(model).__name__} lacks the paged engine "
+                    f"contract (create_paged_cache / "
+                    f"make_paged_decode_fn)")
+            from triton_distributed_tpu.serving.pages import PagedKV
+            self.slots = PagedKV(
+                model, cfg.num_slots, max_seq=self.max_seq,
+                page_size=cfg.page_size, num_pages=cfg.num_pages,
+                kv_budget_bytes=cfg.kv_budget_bytes,
+                prefix_cache=cfg.prefix_cache)
+            decode_fn = model.make_paged_decode_fn(
+                page_size=cfg.page_size)
+            sfn = getattr(model, "make_prefill_suffix_fn", None)
+            self._prefill_suffix = (jax.jit(sfn())
+                                    if sfn is not None
+                                    and cfg.prefix_cache else None)
+        elif cfg.kv_layout == "slots":
+            self.slots = SlotKV(model.create_cache(cfg.num_slots,
+                                                   max_seq=self.max_seq),
+                                cfg.kv_budget_bytes)
+            decode_fn = model.make_decode_fn()
+            self._prefill_suffix = None
+        else:
+            raise ValueError(f"unknown kv_layout {cfg.kv_layout!r}")
         self._prefill = jax.jit(model.make_prefill_fn())
-        decode_fn = model.make_decode_fn()
         self._step = make_masked_step_fn(
             decode_fn, cfg.temperature, cfg.top_k, cfg.top_p,
             cfg.pad_id)
@@ -146,7 +197,14 @@ class ContinuousBatchingScheduler:
             # position max_seq-1 is the last writable KV row, and the
             # final token needs no KV write of its own.
             reason = RejectReason.EXCEEDS_KV_CAPACITY
-        elif self.slots.kv_budget_bytes < self.slots.bytes_per_slot:
+        elif self.paged and not self.slots.feasible(
+                req.prompt_len, req.max_new_tokens):
+            # page arithmetic: the request's horizon
+            # (prompt + max_new - 1 positions) costs more pages than
+            # the pool holds — it can never run, even alone.
+            reason = RejectReason.EXCEEDS_KV_CAPACITY
+        elif (not self.paged
+              and self.slots.kv_budget_bytes < self.slots.bytes_per_slot):
             # a budget below one slot can never admit anything —
             # queueing it would make drain() spin forever.
             reason = RejectReason.EXCEEDS_KV_CAPACITY
@@ -214,6 +272,18 @@ class ContinuousBatchingScheduler:
         reg = self._registry()
         while self._queue:
             req = self._queue.popleft()
+            if req.generated:
+                # A preempted-and-requeued request already streamed
+                # tokens: it finishes (partial output delivered), it
+                # isn't rejected.
+                req.state = RequestState.FINISHED
+                req.finish_reason = FinishReason.STOPPED
+                req.t_finish = self.clock()
+                if reg:
+                    reg.counter("serving_requests_completed_total",
+                                reason=FinishReason.STOPPED.value).inc()
+                self.finished.append(req)
+                continue
             req.state = RequestState.REJECTED
             req.reject_reason = RejectReason.STOPPED
             # Same accounting as the submit() reject path, so
@@ -231,36 +301,55 @@ class ContinuousBatchingScheduler:
             get_registry, observability_enabled)
         return get_registry() if observability_enabled() else None
 
+    def _can_admit_head(self) -> bool:
+        if not self.paged:
+            return self.slots.can_admit()
+        head = self._queue[0]
+        return self.slots.can_admit(head.resume_tokens or head.prompt)
+
+    def _row_cache(self, bucket: int):
+        # One reusable input row cache per bucket: prefill is
+        # functional (input untouched, output fully overwritten up
+        # to the bucket), so admissions don't re-zero HBM — the
+        # same point as Engine.serve's caller-provided cache.
+        row_in = self._row_caches.get(bucket)
+        if row_in is None:
+            row_in = self.model.create_cache(1, max_seq=bucket)
+            self._row_caches[bucket] = row_in
+        return row_in
+
     def _admit(self, now: float) -> int:
         from triton_distributed_tpu.observability import get_tracer
         n = 0
         while (self._queue and not self._stopped
                and self._queue[0].t_arrival <= now
-               and self.slots.can_admit()):
+               and self._can_admit_head()):
             req = self._queue.popleft()
-            bucket = pick_bucket(req.prompt_len, self.buckets)
-            assert bucket is not None  # submit() validated
-            ids, s = pad_prompt(req.prompt, bucket, self.config.pad_id)
-            # One reusable input row cache per bucket: prefill is
-            # functional (input untouched, output fully overwritten up
-            # to the bucket), so admissions don't re-zero HBM — the
-            # same point as Engine.serve's caller-provided cache.
-            row_in = self._row_caches.get(bucket)
-            if row_in is None:
-                row_in = self.model.create_cache(1, max_seq=bucket)
-                self._row_caches[bucket] = row_in
             reg = self._registry()
-            t0 = time.perf_counter()
-            _, row_cache = self._prefill(self.params, ids, row_in)
-            if reg:
-                # dispatch is async: block so the histogram records
-                # prefill compute, not dispatch (as Engine.serve does)
-                jax.block_until_ready(row_cache.ks[0])
-                reg.histogram("serving_prefill_ms").observe(
-                    (time.perf_counter() - t0) * 1e3)
-            slot = self.slots.insert_prefill(row_cache, s,
-                                             request_key(req.seed))
-            self._tokens[slot] = req.prompt[-1]
+            if self.paged:
+                admitted = self._admit_paged(req, now, reg)
+                if admitted is None:
+                    continue              # retired at admission
+                slot, bucket, tokens = admitted
+            else:
+                bucket = pick_bucket(req.prompt_len, self.buckets)
+                assert bucket is not None  # submit() validated
+                tokens = req.prompt
+                ids, s = pad_prompt(req.prompt, bucket,
+                                    self.config.pad_id)
+                row_in = self._row_cache(bucket)
+                t0 = time.perf_counter()
+                _, row_cache = self._prefill(self.params, ids, row_in)
+                if reg:
+                    # dispatch is async: block so the histogram
+                    # records prefill compute, not dispatch (as
+                    # Engine.serve does)
+                    jax.block_until_ready(row_cache.ks[0])
+                    reg.histogram("serving_prefill_ms").observe(
+                        (time.perf_counter() - t0) * 1e3)
+                slot = self.slots.insert_prefill(row_cache, s,
+                                                 request_key(req.seed))
+            self._tokens[slot] = tokens[-1]
             req.state = RequestState.RUNNING
             req.slot = slot
             req.bucket = bucket
@@ -279,6 +368,65 @@ class ContinuousBatchingScheduler:
             n += 1
         return n
 
+    def _admit_paged(self, req: Request, now: float, reg):
+        """Paged admission: radix prefix match, suffix-only prefill on
+        a hit (near-zero-cost shared system prompts), paged insert.
+        Returns (slot, bucket, tokens) or None when the request had to
+        be retired at admission (a resumed stream that no longer fits
+        any prefill bucket)."""
+        tokens = req.resume_tokens or req.prompt
+        s = len(tokens)
+        shared = self.slots.match_prefix(tokens)
+        c = len(shared) * self.config.page_size
+        key = (jnp.asarray(req.resume_key, jnp.uint32)
+               if req.resume_key is not None else request_key(req.seed))
+        bucket = row = row_start = None
+        if c > 0 and self._prefill_suffix is not None:
+            # Prefix hit with a prefix-aware model: prefill ONLY the
+            # private suffix — the shared pages are already in the
+            # pool.  This is the compute half of prefix sharing (the
+            # storage half — page reuse — works for any model).
+            bucket = pick_bucket(s - c, self.buckets)
+            if bucket is not None:
+                ids, _ = pad_prompt(tokens[c:], bucket,
+                                    self.config.pad_id)
+                t0 = time.perf_counter()
+                row = self._prefill_suffix(self.params, ids,
+                                           jnp.int32(c),
+                                           self._row_cache(bucket))
+                row_start = c
+        if row is None:
+            bucket = pick_bucket(s, self.buckets)
+            if bucket is None:
+                # Only reachable on resume (submit() checked the
+                # original prompt): prompt + generated outgrew every
+                # bucket — deliver what it has.  (The matched chain
+                # was never acquired — nothing to undo.)
+                req.state = RequestState.FINISHED
+                req.finish_reason = FinishReason.KV_CAPACITY
+                req.t_finish = now
+                if reg:
+                    reg.counter("serving_requests_completed_total",
+                                reason=FinishReason.KV_CAPACITY.value
+                                ).inc()
+                self.finished.append(req)
+                return None
+            ids, _ = pad_prompt(tokens, bucket, self.config.pad_id)
+            t0 = time.perf_counter()
+            _, row = self._prefill(self.params, ids,
+                                   self._row_cache(bucket))
+            row_start = 0
+        if reg:
+            jax.block_until_ready(row.ks[0])
+            reg.histogram("serving_prefill_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
+            reg.counter("serving_prefix_cache_hit_tokens_total").inc(c)
+            reg.counter("serving_prefix_cache_miss_tokens_total").inc(
+                s - c)
+        slot = self.slots.insert_prefill(row, tokens, s, key, shared,
+                                         row_start=row_start)
+        return slot, bucket, tokens
+
     def _block_size(self) -> int:
         """Steps for this dispatch: the configured block, unless some
         active row is within a block of its KV horizon (its offset may
@@ -294,9 +442,67 @@ class ContinuousBatchingScheduler:
                 return 1
         return k
 
+    def _prepare_pages(self, k: int) -> None:
+        """Paged mode, before a dispatch: every active slot must have
+        pages mapped for the ``k`` positions this dispatch writes.
+        The pool evicts unreferenced prefix pages on demand; if it is
+        STILL dry, preempt the most recently admitted request (its
+        pages fund the older ones; it resumes later, exactly — see
+        `Request.resume_tokens`).  Admission feasibility guarantees a
+        sole remaining request can always grow to its horizon."""
+        while True:
+            ok = True
+            for slot, req in list(self._by_slot.items()):
+                # Cap at the request's OWN horizon (what feasible()
+                # budgeted), not just max_seq: a block may over-
+                # generate up to k-1 positions past max_new, and
+                # those writes — whose tokens retire() discards —
+                # fall through the NULL page-table entries into the
+                # trash page.  Kept tokens only ever attend KV below
+                # the horizon, so this is exact.
+                need = min(req.prompt_len + len(req.generated) + k - 1,
+                           req.prompt_len + req.max_new_tokens - 1,
+                           self.max_seq)
+                if not self.slots.ensure(slot, need):
+                    ok = False
+                    break
+            if ok:
+                return
+            assert len(self._by_slot) > 1, (
+                "page pool cannot hold a sole feasible request — "
+                "allocator invariant broken")
+            victim = max(self._by_slot,
+                         key=lambda sl: (self._by_slot[sl].t_admitted,
+                                         self._by_slot[sl].request_id))
+            self._preempt(victim)
+
+    def _preempt(self, slot: int) -> None:
+        req = self._by_slot.pop(slot)
+        # The slot's PRNG key is the sample-chain state: snapshot it
+        # so the resumed stream continues bit-exactly.
+        req.resume_key = self.slots.snapshot_key(slot)
+        req.resume_tokens = list(req.prompt) + list(req.generated)
+        req.preemptions += 1
+        req.state = RequestState.QUEUED
+        req.slot = None
+        self.slots.release(slot)
+        self._tokens[slot] = self.config.pad_id
+        sp = self._spans.pop(slot, None)
+        if sp is not None:
+            sp.__exit__(None, None, None)
+        self._queue.appendleft(req)
+        reg = self._registry()
+        if reg:
+            reg.counter("serving_preemptions_total").inc()
+
     def _decode_step(self) -> int:
         t0 = time.perf_counter()
         k = self._block_size()
+        if self.paged:
+            self._prepare_pages(k)
+            if not self._by_slot:      # defensive: all preempted
+                return 0
+            self.slots.flush()
         fn = self._block_fn if k > 1 else self._step
         toks, cache, keys = fn(
             self.params, jnp.asarray(self._tokens), self.slots.cache,
@@ -405,3 +611,10 @@ class ContinuousBatchingScheduler:
         reg.gauge("serving_kv_bytes_in_use").set(self.slots.bytes_in_use)
         reg.gauge("serving_kv_budget_bytes").set(
             self.slots.kv_budget_bytes)
+        if self.paged:
+            reg.gauge("serving_kv_pages_free").set(self.slots.free_pages)
+            reg.gauge("serving_kv_pages_used").set(self.slots.used_pages)
+            reg.gauge("serving_kv_page_occupancy").set(
+                self.slots.page_occupancy)
+            reg.gauge("serving_prefix_cache_pages").set(
+                self.slots.cached_prefix_pages)

@@ -9,6 +9,16 @@ batching machinery (bucketed prefill, slot insert, masked step) on any
 host.  Position embeddings make the logits depend on absolute
 position, so a wrong slot offset or a consumed pad tail shows up as
 wrong tokens, not silence.
+
+The toy also implements the PAGED half of the contract
+(`create_paged_cache` / `make_paged_decode_fn` /
+`make_prefill_suffix_fn`), reading KV through a page table the same
+way `kernels.flash_decode.flash_decode_paged` does on TPU — so the
+paged scheduler, radix prefix cache and page allocator are exercised
+token-for-token against the slot engine on CPU.  Both dense and paged
+paths support the int8-quantized cache (per-token symmetric scales,
+`quantize_kv`): writes quantize, reads dequantize, so the two engines
+see bit-identical dequantized values.
 """
 
 from __future__ import annotations
@@ -19,7 +29,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from triton_distributed_tpu.models.kv_cache import KVCache
+from triton_distributed_tpu.models.kv_cache import KVCache, PagedKVCache
 
 
 @dataclasses.dataclass
@@ -28,6 +38,15 @@ class ToyConfig:
     hidden: int = 32
     max_seq_len: int = 128
     quantize_kv_cache: bool = False
+
+
+def _quantize_token(k, v):
+    """Per-token int8 quantization of one decode step's K/V (B, H):
+    returns int8 (B, 1, 1, H) + f32 scales (B, 1, 1) — the same
+    `quantize_kv` scheme the prefill write path uses."""
+    from triton_distributed_tpu.kernels.flash_decode import quantize_kv
+
+    return quantize_kv(k[:, None, None, :], v[:, None, None, :])
 
 
 class ToyModel:
@@ -56,6 +75,15 @@ class ToyModel:
             max_seq=max_seq or cfg.max_seq_len, head_dim=cfg.hidden,
             dtype=jnp.float32, quantized=cfg.quantize_kv_cache)
 
+    def create_paged_cache(self, batch: int, num_pages: int,
+                           page_size: int, max_pages_per_seq: int):
+        cfg = self.config
+        return PagedKVCache.create(
+            num_layers=1, num_pages=num_pages, batch=batch,
+            num_kv_heads=1, page_size=page_size,
+            head_dim=cfg.hidden, max_pages_per_seq=max_pages_per_seq,
+            dtype=jnp.float32, quantized=cfg.quantize_kv_cache)
+
     def make_prefill_fn(self):
         scale = self.config.hidden ** -0.5
 
@@ -76,6 +104,29 @@ class ToyModel:
 
         return prefill
 
+    def make_prefill_suffix_fn(self):
+        """Prefix-cache-aware prefill: compute KV for suffix positions
+        ``[start, start + S)`` of a prompt whose first ``start`` tokens
+        are already cached (their pages are shared via the radix
+        cache).  The toy's K/V at position i depend only on token i and
+        position i, so no attention over the prefix is needed; a
+        multi-layer model would attend its suffix queries over the
+        cached prefix KV here.  Returns the row cache with the suffix
+        KV at LOCAL positions [0, S) — the paged insert scatters local
+        pages to physical pages.  No logits: the serving insert path
+        recomputes position s-1 and never consumes prefill logits."""
+
+        def prefill_suffix(params, ids, start, cache: KVCache):
+            b, s = ids.shape
+            pos = jnp.asarray(start, jnp.int32) + jnp.arange(s)
+            x = params["embed"][ids] + params["pe"][pos][None]
+            k = x @ params["wk"]
+            v = x @ params["wv"]
+            cache = cache.write_prefill(0, k[:, None], v[:, None])
+            return cache.set_offset(s)
+
+        return prefill_suffix
+
     def make_decode_fn(self):
         scale = self.config.hidden ** -0.5
 
@@ -87,16 +138,95 @@ class ToyModel:
             v = x @ params["wv"]
             upd = lambda c, u, o: jax.lax.dynamic_update_slice(  # noqa: E731
                 c, u, (0, o, 0))
-            ks = jax.vmap(upd)(cache.ks[0], k[:, None, None, :], offset)
-            vs = jax.vmap(upd)(cache.vs[0], v[:, None, None, :], offset)
+            if cache.quantized:
+                kq, vq, ksn, vsn = _quantize_token(k, v)
+                ks = jax.vmap(upd)(cache.ks[0], kq, offset)
+                vs = jax.vmap(upd)(cache.vs[0], vq, offset)
+                upd2 = lambda c, u, o: jax.lax.dynamic_update_slice(  # noqa: E731
+                    c, u, (0, o))
+                kss = jax.vmap(upd2)(cache.kss[0], ksn, offset)
+                vss = jax.vmap(upd2)(cache.vss[0], vsn, offset)
+                kf = ks.astype(jnp.float32) * kss[..., None]
+                vf = vs.astype(jnp.float32) * vss[..., None]
+                cache = cache.set_layer(0, ks, vs, kss, vss)
+            else:
+                ks = jax.vmap(upd)(cache.ks[0], k[:, None, None, :],
+                                   offset)
+                vs = jax.vmap(upd)(cache.vs[0], v[:, None, None, :],
+                                   offset)
+                kf, vf = ks, vs
+                cache = cache.set_layer(0, ks, vs)
             smax = ks.shape[2]
             mask = jnp.arange(smax)[None, :] <= offset[:, None]
-            scores = jnp.einsum("bh,bsh->bs", q, ks[:, 0]) * scale
+            scores = jnp.einsum("bh,bsh->bs", q, kf[:, 0]) * scale
             att = jax.nn.softmax(
                 jnp.where(mask, scores, -jnp.inf), axis=-1)
-            out = jnp.einsum("bs,bsh->bh", att, vs[:, 0])
+            out = jnp.einsum("bs,bsh->bh", att, vf[:, 0])
             logits = out @ params["wo"]
-            cache = cache.set_layer(0, ks, vs)
+            return logits, cache.inc_offset(1)
+
+        return decode
+
+    def make_paged_decode_fn(self, page_size: int = 16):
+        """Decode through the page table: the new token's KV is
+        scattered into ``page_table[b, offset // page]`` at row
+        ``offset % page``, and attention gathers the pool back into
+        logical order.  Masked rows (frozen offsets, NULL-mapped
+        tables) write into the reserved null page — never read.
+
+        Token-for-token identical to `make_decode_fn` on the slot
+        cache when T × page_size equals the dense max_seq: the
+        attention sees the same values at the same logical positions,
+        masked positions contribute exactly 0 in both layouts.
+        """
+        scale = self.config.hidden ** -0.5
+
+        def decode(params, tokens, cache: PagedKVCache):
+            offset = cache.offset                       # (B,)
+            b = offset.shape[0]
+            ps = cache.page_size
+            x = params["embed"][tokens] + params["pe"][offset]
+            q = x @ params["wq"]
+            k = x @ params["wk"]
+            v = x @ params["wv"]
+            bidx = jnp.arange(b)
+            phys = cache.page_table[bidx, offset // ps]  # (B,)
+            within = offset % ps
+            if cache.quantized:
+                kq, vq, ksn, vsn = _quantize_token(k, v)
+                ks = cache.ks[0].at[phys, :, within, :].set(kq[:, :, 0])
+                vs = cache.vs[0].at[phys, :, within, :].set(vq[:, :, 0])
+                kss = cache.kss[0].at[phys, :, within].set(ksn[:, :, 0])
+                vss = cache.vss[0].at[phys, :, within].set(vsn[:, :, 0])
+                cache = dataclasses.replace(
+                    cache, ks=[ks], vs=[vs], kss=[kss], vss=[vss])
+                kseq = ks[cache.page_table]   # (B, T, Hkv, page, H)
+                vseq = vs[cache.page_table]
+                ksseq = kss[cache.page_table]  # (B, T, Hkv, page)
+                vsseq = vss[cache.page_table]
+                kf = (kseq.astype(jnp.float32)
+                      * ksseq[..., None])
+                vf = (vseq.astype(jnp.float32)
+                      * vsseq[..., None])
+            else:
+                ks = cache.ks[0].at[phys, :, within, :].set(
+                    k[:, None, :])
+                vs = cache.vs[0].at[phys, :, within, :].set(
+                    v[:, None, :])
+                cache = dataclasses.replace(cache, ks=[ks], vs=[vs])
+                kf = ks[cache.page_table]
+                vf = vs[cache.page_table]
+            # (B, T, Hkv, page, H) -> (B, Hkv, T*page, H)
+            h = kf.shape[-1]
+            kf = jnp.moveaxis(kf, 2, 1).reshape(b, 1, -1, h)
+            vf = jnp.moveaxis(vf, 2, 1).reshape(b, 1, -1, h)
+            smax = kf.shape[2]
+            mask = jnp.arange(smax)[None, :] <= offset[:, None]
+            scores = jnp.einsum("bh,bsh->bs", q, kf[:, 0]) * scale
+            att = jax.nn.softmax(
+                jnp.where(mask, scores, -jnp.inf), axis=-1)
+            out = jnp.einsum("bs,bsh->bh", att, vf[:, 0])
+            logits = out @ params["wo"]
             return logits, cache.inc_offset(1)
 
         return decode
