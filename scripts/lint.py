@@ -7,7 +7,14 @@ Enforces the core of the ruff.toml rule set with only the stdlib:
 - F401: unused imports (respects `# noqa` / `# noqa: F401` on the
         import line; `__init__.py` re-export facades are exempt, and
         `__graft_entry__.py`-style underscore names are kept);
-- F811: an import name rebound by a later import in the same scope.
+- F811: an import name rebound by a later import in the same scope;
+- F821: undefined names AT MODULE LEVEL (function bodies are scoped
+        territory ruff handles; the module-level subset is where a
+        broken refactor leaves a dangling reference that only fires
+        at import time on someone else's machine);
+- F841: locals assigned but never read inside a function, with the
+        conservative exemptions ruff defaults to (underscore names,
+        tuple unpacking, augmented assigns, `locals()`/`exec` users).
 
 Usage:  python scripts/lint.py [paths...]     (default: repo tree)
 Exit 0 = clean, 1 = findings.  `scripts/verify_tier1.sh` prefers
@@ -122,6 +129,218 @@ def lint_file(path: pathlib.Path) -> list[str]:
         problems.append(
             f"{path}:{again}: F811 import `{name}` shadows the import "
             f"on line {first}")
+
+    problems.extend(_f821_module_level(tree, path, lines))
+    problems.extend(_f841_unused_locals(tree, path, lines))
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# F821: undefined names at module level
+# ---------------------------------------------------------------------------
+
+#: Names the import machinery defines in every module namespace.
+_MODULE_DUNDERS = {
+    "__name__", "__file__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__annotations__", "__path__",
+    "__all__", "__version__",
+}
+
+
+def _bound_names(node) -> set:
+    """Every name a statement (and its nested scopes' HEADERS) binds
+    into the enclosing namespace."""
+    out = set()
+
+    def target_names(t):
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+
+    if isinstance(node, (ast.Import, ast.ImportFrom)):
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            out.add(alias.asname or alias.name.split(".")[0])
+    elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+        out.add(node.name)
+    elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        for t in getattr(node, "targets", None) or [node.target]:
+            target_names(t)
+    elif isinstance(node, (ast.For, ast.AsyncFor)):
+        target_names(node.target)
+    elif isinstance(node, (ast.With, ast.AsyncWith)):
+        for item in node.items:
+            if item.optional_vars is not None:
+                target_names(item.optional_vars)
+    elif isinstance(node, ast.ExceptHandler) and node.name:
+        out.add(node.name)
+    elif isinstance(node, ast.Global):
+        out.update(node.names)
+    return out
+
+
+def _f821_module_level(tree: ast.Module, path, lines) -> list[str]:
+    """Undefined names in code executed at module scope.  Order-blind
+    on purpose (all module bindings count, wherever they appear):
+    misses use-before-def but never false-positives on forward
+    references, which is the right trade for a fallback gate."""
+    import builtins
+
+    defined = set(dir(builtins)) | set(_MODULE_DUNDERS)
+
+    def collect(body):
+        for node in body:
+            defined.update(_bound_names(node))
+            # Recurse into module-level control flow, but never into
+            # function/class bodies (their scopes are ruff's job; a
+            # class body's bindings aren't module names anyway).
+            if isinstance(node, (ast.If, ast.For, ast.AsyncFor,
+                                 ast.While, ast.With, ast.AsyncWith,
+                                 ast.Try)):
+                for field in ("body", "orelse", "finalbody",
+                              "handlers"):
+                    for child in getattr(node, field, []) or []:
+                        if isinstance(child, ast.ExceptHandler):
+                            defined.update(_bound_names(child))
+                            collect(child.body)
+                        else:
+                            collect([child])
+
+    collect(tree.body)
+
+    problems = []
+    seen = set()
+
+    def scan_expr(node):
+        """Loads in a module-level expression; comprehension/lambda
+        locals are tracked as an extra defined set."""
+        extra = set()
+        for n in ast.walk(node):
+            if isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                              ast.GeneratorExp)):
+                for gen in n.generators:
+                    for t in ast.walk(gen.target):
+                        if isinstance(t, ast.Name):
+                            extra.add(t.id)
+            elif isinstance(n, ast.Lambda):
+                a = n.args
+                for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                            + ([a.vararg] if a.vararg else [])
+                            + ([a.kwarg] if a.kwarg else [])):
+                    extra.add(arg.arg)
+            elif isinstance(n, ast.NamedExpr):
+                if isinstance(n.target, ast.Name):
+                    extra.add(n.target.id)
+        for n in ast.walk(node):
+            if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                    and n.id not in defined and n.id not in extra
+                    and n.id not in seen):
+                if _noqa(lines, n.lineno, "F821"):
+                    continue
+                seen.add(n.id)
+                problems.append(
+                    f"{path}:{n.lineno}: F821 undefined name `{n.id}` "
+                    f"at module level")
+
+    def scan(body):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                for dec in node.decorator_list:
+                    scan_expr(dec)
+                continue  # inner scopes are out of the fallback's net
+            if isinstance(node, (ast.If, ast.While)):
+                scan_expr(node.test)
+                scan(node.body)
+                scan(node.orelse)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                scan_expr(node.iter)
+                scan(node.body)
+                scan(node.orelse)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    scan_expr(item.context_expr)
+                scan(node.body)
+            elif isinstance(node, ast.Try):
+                scan(node.body)
+                for h in node.handlers:
+                    if h.type is not None:
+                        scan_expr(h.type)
+                    scan(h.body)
+                scan(node.orelse)
+                scan(node.finalbody)
+            elif isinstance(node, (ast.Import, ast.ImportFrom,
+                                   ast.Global, ast.Nonlocal)):
+                continue
+            else:
+                scan_expr(node)
+
+    scan(tree.body)
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# F841: locals assigned but never used (function scope)
+# ---------------------------------------------------------------------------
+
+def _f841_unused_locals(tree: ast.Module, path, lines) -> list[str]:
+    problems = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # `locals()` / `exec` / `eval` make any name observable.
+        dynamic = any(
+            isinstance(n, ast.Name) and n.id in ("locals", "exec",
+                                                 "eval", "vars")
+            for n in ast.walk(fn))
+        if dynamic:
+            continue
+        declared = set()
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.Global, ast.Nonlocal)):
+                declared.update(n.names)
+        # Loads (and deletes) anywhere in the function subtree count
+        # as uses — including closures reading from nested defs.
+        used = {n.id for n in ast.walk(fn)
+                if isinstance(n, ast.Name)
+                and isinstance(n.ctx, (ast.Load, ast.Del))}
+        # Collect assignments from THIS function's scope only: nested
+        # defs are their own walk targets and class bodies bind class
+        # attributes, not locals.
+        scope_nodes = []
+        stack = list(fn.body)
+        while stack:
+            n = stack.pop()
+            scope_nodes.append(n)
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(n))
+        assigns = {}           # name -> first assignment lineno
+        for n in scope_nodes:
+            # Only simple single-Name targets: tuple unpacking and
+            # attribute/subscript targets are exempt (ruff default).
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                name = n.targets[0].id
+            elif isinstance(n, ast.AnnAssign) and n.value is not None \
+                    and isinstance(n.target, ast.Name):
+                name = n.target.id
+            else:
+                continue
+            if name.startswith("_") or name in declared:
+                continue
+            if name not in assigns or n.lineno < assigns[name]:
+                assigns[name] = n.lineno
+        for name, lineno in sorted(assigns.items(), key=lambda p: p[1]):
+            if name in used:
+                continue
+            if _noqa(lines, lineno, "F841"):
+                continue
+            problems.append(
+                f"{path}:{lineno}: F841 local `{name}` is assigned "
+                f"but never used")
     return problems
 
 

@@ -50,6 +50,30 @@ if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
 fi
 echo "ANALYSIS_SWEEP=ok"
 
+# Resource sanitizer sweep: every registered kernel — comm (replayed
+# run_scoped/emit_pipeline footprint) AND compute (captured
+# pallas_call geometry) — must fit VMEM, tile legally and keep every
+# block index in bounds, including page-table indirection
+# (docs/analysis.md "Resource sanitizer").
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu \
+        python -m triton_distributed_tpu.analysis --check resources -q
+then
+    echo "RESOURCE_SWEEP=FAILED"
+    exit 1
+fi
+echo "RESOURCE_SWEEP=ok"
+
+# Serving-state model check: exhaustive small-scope exploration of the
+# paged KV layer (refcounts, sharing, donation) must be clean
+# (docs/analysis.md "Serving model checker").
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+        python -m triton_distributed_tpu.analysis --check serving -q
+then
+    echo "SERVING_MODEL_CHECK=FAILED"
+    exit 1
+fi
+echo "SERVING_MODEL_CHECK=ok"
+
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 rm -f "$LOG"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

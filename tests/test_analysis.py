@@ -138,7 +138,6 @@ def test_run_scoped_scratch_names_are_spmd_symmetric():
 
 def test_value_refs_steer_control_flow():
     def rooted(x_ref, root_ref, o_ref, send, recv):
-        my = jax.lax.axis_index("tp")
         root = root_ref[0]
         dl.entry_barrier("tp", W)
         dl.emit_broadcast("tp", W, root, x_ref, o_ref, send, send, recv)

@@ -93,6 +93,68 @@ class TestCorpus:
         assert r["static"]["could_hang"] is True
         assert "sem_leak" in r["static"]["verdict"]
 
+    def test_resource_verdict_absent_by_default(self):
+        # Opt-in: golden reports must stay byte-identical, so the key
+        # simply doesn't exist unless --resources / a findings file
+        # asks for it.
+        assert "resources" not in _diagnose("stalled_rank")
+
+    def test_resource_verdict_on_stalled_rank(self):
+        r = doctor.diagnose(
+            [os.path.join(CORPUS, "stalled_rank")], resources=True)
+        res = r["resources"]
+        assert res["kernel"] == "allreduce.one_shot"
+        assert res["source"] == "live"
+        assert res["could_overflow"] is False
+        assert "resource sweep is clean" in res["verdict"]
+        assert res["verdict"] in r["verdict"]
+        md = doctor.render_markdown(r)
+        assert "## Static resource check" in md
+
+    def test_resource_verdict_multi_axis_mesh_from_event(self):
+        # Torus kernels register only at multi-axis meshes: the mesh
+        # must come from extra.axes/sizes (like the comm verdict), or
+        # the sweep analyzes nothing.
+        stall = {"in_flight_event": {
+            "op": "all_gather_torus", "method": None, "axis": "x",
+            "world": 4, "extra": {"axes": ["x", "y"],
+                                  "sizes": [2, 2]}}}
+        out = doctor.run_resource_analysis(
+            doctor.Artifacts([]), stall, enabled=True)
+        assert out["kernel"] == "torus.allgather"
+        assert out["mesh"] == {"x": 2, "y": 2}
+        assert out["source"] == "live"
+        assert out["could_overflow"] is False
+
+    def test_resource_verdict_never_clean_when_nothing_swept(self):
+        # A mesh the kernel's builder rejects must NOT read as a
+        # clean sweep.
+        stall = {"in_flight_event": {
+            "op": "all_gather", "method": "ring", "axis": "x",
+            "world": 4, "extra": {"axes": ["x", "y"],
+                                  "sizes": [2, 2]}}}
+        out = doctor.run_resource_analysis(
+            doctor.Artifacts([]), stall, enabled=True)
+        assert out["source"] == "unavailable (mesh not applicable)"
+        assert "could_overflow" not in out
+        assert "verdict" not in out
+
+    def test_resource_findings_file_enables_section(self, tmp_path):
+        import shutil
+        dst = tmp_path / "incident"
+        shutil.copytree(os.path.join(CORPUS, "stalled_rank"), dst)
+        rows = {"findings": [{
+            "kernel": "flash_decode.paged", "kind": "oob_block_index",
+            "ref": "in1",
+            "message": "block index 9 outside [0, 8] via page table",
+        }]}
+        (dst / "resource-findings.json").write_text(json.dumps(rows))
+        r = doctor.diagnose([str(dst)])
+        res = r["resources"]
+        assert res["source"] == "artifact"
+        assert res["could_overflow"] is True
+        assert "walk off its index/page tables" in res["verdict"]
+
     def test_slow_link_straggler_anomaly_contention(self):
         r = _diagnose("slow_link")
         assert r["stall"]["first_stalled_rank"] is None

@@ -401,7 +401,7 @@ def test_consuming_wait_violation_hazard(tp8_mesh):
 def test_consume_token_dataflow():
     """Row `consume_token`: ties a value to a completed wait via an
     optimization barrier (pure dataflow edge, value-preserving)."""
-    tok = dl.wait.__doc__  # doc exists
+    assert dl.wait.__doc__  # doc exists
     x = jnp.arange(8.0)
     y = dl.consume_token(x, ())
     assert_allclose(y, x, atol=0, rtol=0, name="consume_token")

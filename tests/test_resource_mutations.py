@@ -1,0 +1,229 @@
+"""Resource-sanitizer mutation corpus: seeded single-defect variants,
+one per defect class the VMEM/tiling/bounds interpreter and the
+serving-state model checker claim to catch (PR 4's corpus idiom).
+
+Kernel-side mutants issue a defective `pallas_call` under capture —
+including one built on the REAL `flash_decode_paged` with a corrupt
+page table (the OOB-through-page-table acceptance case).  Serving-side
+mutants subclass the model-checker harness with one scheduler-logic
+bug each — including the PagePool double-free acceptance case.  Every
+mutant must be caught with the RIGHT finding kind, and both clean
+bases must analyze clean (no false positives).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.analysis import resources as R
+from triton_distributed_tpu.analysis import serving_model as SM
+from triton_distributed_tpu.analysis.model import FindingKind
+
+
+# ---------------------------------------------------------------------------
+# Kernel-side mutants: defective pallas_call geometry under capture
+# ---------------------------------------------------------------------------
+
+def _launch(block, arr, grid, index_map, dtype=jnp.float32,
+            prefetch=()):
+    gs_kw = dict(
+        grid=grid,
+        in_specs=[pl.BlockSpec(block, index_map,
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(block, index_map,
+                               memory_space=pltpu.VMEM))
+    if prefetch:
+        gs = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=len(prefetch), **gs_kw)
+    else:
+        gs = pl.GridSpec(**gs_kw)
+    pl.pallas_call(lambda *refs: None,
+                   out_shape=jax.ShapeDtypeStruct(arr, dtype),
+                   grid_spec=gs)(*prefetch, jnp.zeros(arr, dtype))
+
+
+def kmut_vmem_overflow():
+    """Double-buffered 4k x 4k f32 blocks blow the 16 MiB default."""
+    _launch((4096, 4096), (8192, 8192), (2, 2),
+            lambda i, j, *pre: (i, j))
+
+
+def kmut_tiling_lane():
+    """Lane dim 192: neither a 128 multiple nor the whole operand."""
+    _launch((8, 192), (16, 384), (2, 2), lambda i, j, *pre: (i, j))
+
+
+def kmut_tiling_int8_sublane():
+    """48-row int8 blocks: int8 tiles are (32, 128) — the scale-row /
+    int8-layout rule from quantized.py."""
+    _launch((48, 128), (96, 256), (2, 2),
+            lambda i, j, *pre: (i, j), dtype=jnp.int8)
+
+
+def kmut_oob_grid_arithmetic():
+    """Classic off-by-one in the index map."""
+    _launch((8, 128), (16, 256), (2, 2),
+            lambda i, j, *pre: (i + 1, j))
+
+
+def kmut_oob_through_page_table():
+    """REAL `flash_decode_paged` with a corrupt page table: one entry
+    names physical page P of a P-page pool (the acceptance case —
+    'walked off its page table')."""
+    from triton_distributed_tpu.kernels.flash_decode import (
+        flash_decode_paged)
+
+    p, hkv, ps, d, t = 9, 2, 128, 128, 4
+    q = jnp.zeros((2, 4, d), jnp.float32)
+    pool = jnp.zeros((p, hkv, ps, d), jnp.float32)
+    table = np.zeros((2, t), np.int32)
+    table[0] = (3, 5, 0, 0)
+    table[1] = (8, 1, 2, p)      # p is one past the last page
+    flash_decode_paged(q, pool, pool, jnp.asarray(table),
+                       jnp.asarray([100, t * ps], jnp.int32),
+                       interpret=False)
+
+
+def kmut_smem_table_overflow():
+    """Three 8192-entry int32 prefetch tables: 96 KiB of SMEM against
+    the 48 KiB budget the packed schedule is capped by."""
+    _launch((8, 128), (16, 256), (2, 2),
+            lambda i, j, *pre: (i, j),
+            prefetch=(jnp.zeros((3, 8192), jnp.int32),))
+
+
+KERNEL_CORPUS = [
+    (kmut_vmem_overflow, FindingKind.VMEM_OVERFLOW),
+    (kmut_tiling_lane, FindingKind.TILING_ILLEGAL),
+    (kmut_tiling_int8_sublane, FindingKind.TILING_ILLEGAL),
+    (kmut_oob_grid_arithmetic, FindingKind.OOB_BLOCK_INDEX),
+    (kmut_oob_through_page_table, FindingKind.OOB_BLOCK_INDEX),
+    (kmut_smem_table_overflow, FindingKind.SMEM_OVERFLOW),
+]
+
+
+def _kernel_findings(mutant):
+    with R.capture_pallas_calls() as records:
+        mutant()
+    out = []
+    for rec in records:
+        out.extend(R.check_captured_call(rec, kernel=mutant.__name__))
+    return out
+
+
+@pytest.mark.parametrize("mutant,expected", KERNEL_CORPUS,
+                         ids=[fn.__name__ for fn, _ in KERNEL_CORPUS])
+def test_kernel_mutant_caught_with_right_kind(mutant, expected):
+    findings = _kernel_findings(mutant)
+    kinds = {f.kind for f in findings}
+    assert expected in kinds, (
+        f"{mutant.__name__}: expected {expected}, got "
+        + ("\n".join(str(f) for f in findings) or "no findings"))
+
+
+def test_kernel_clean_base_has_no_findings():
+    def base():
+        _launch((8, 128), (16, 256), (2, 2),
+                lambda i, j, *pre: (i, j))
+    assert _kernel_findings(base) == []
+
+
+# ---------------------------------------------------------------------------
+# Serving-side mutants: one scheduler-logic bug per harness subclass
+# ---------------------------------------------------------------------------
+
+class smut_pool_double_free(SM.ServingHarness):
+    """Retire decrefs the slot's private pages twice — the PagePool
+    double-free acceptance case."""
+
+    def _release_slot(self, slot):
+        pages = list(self.kv._slot_pages[slot])
+        self.kv.release(slot)
+        self.kv.pool.decref(pages)            # second decref
+
+
+class smut_release_leaks_pages(SM.ServingHarness):
+    """Retire forgets `pool.decref` on the private pages: they stay
+    pinned forever and the pool shrinks to nothing admittable."""
+
+    def _release_slot(self, slot):
+        kv = self.kv
+        if kv._slot_path[slot] and kv.radix is not None:
+            kv.radix.release(kv._slot_path[slot])
+        kv._slot_pages[slot] = []             # (missing) pool.decref
+        kv._slot_path[slot] = []
+        kv._table[slot] = 0
+        kv._mapped[slot] = 0
+        kv._dirty = True
+        kv.cache = kv.cache.reset_slot(slot)
+        kv._active[slot] = False
+        kv._free.append(slot)
+
+
+class smut_share_cap_off_by_one(SM.ServingHarness):
+    """Prefix matching shares pages up to ``len(tokens) // ps`` —
+    including the page holding position s-1, which the insert then
+    RE-WRITES while the radix tree (and possibly another slot) still
+    maps it."""
+
+    def _match_prefix(self, tokens):
+        kv = self.kv
+        if kv.radix is None:
+            return []
+        path = kv.radix.match(list(tokens))
+        return path[:len(tokens) // kv.page_size]   # not (len-1)//ps
+
+
+class smut_use_after_donate(SM.ServingHarness):
+    """The dispatch consumes the donated cache handle but the stale
+    handle is kept — the next flush/insert touches freed memory."""
+
+    def _dispatch(self):
+        cache = self.kv.cache
+        cache._use()
+        cache.donated = True
+        # (missing) self.kv.cache = cache.successor()
+
+
+SERVING_CORPUS = [
+    (smut_pool_double_free, FindingKind.DOUBLE_FREE),
+    (smut_release_leaks_pages, FindingKind.REFCOUNT_LEAK),
+    (smut_share_cap_off_by_one, FindingKind.WRITE_SHARED_PAGE),
+    (smut_use_after_donate, FindingKind.USE_AFTER_DONATE),
+]
+
+
+@pytest.mark.parametrize("mutant,expected", SERVING_CORPUS,
+                         ids=[c.__name__ for c, _ in SERVING_CORPUS])
+def test_serving_mutant_caught_with_right_kind(mutant, expected):
+    findings = SM.check_serving_model(harness_factory=mutant)
+    kinds = {f.kind for f in findings}
+    assert expected in kinds, (
+        f"{mutant.__name__}: expected {expected}, got "
+        + ("\n".join(str(f) for f in findings) or "no findings"))
+
+
+def test_serving_clean_base_has_no_findings():
+    assert SM.check_serving_model() == []
+
+
+def test_corpus_has_at_least_eight_defect_classes():
+    fns = [fn for fn, _ in KERNEL_CORPUS] + [c for c, _ in
+                                             SERVING_CORPUS]
+    assert len(fns) >= 8
+    assert len(set(fns)) == len(fns)
+    # the two acceptance cases are present by name
+    names = {f.__name__ for f in fns}
+    assert "kmut_oob_through_page_table" in names
+    assert "smut_pool_double_free" in names
+
+
+@pytest.mark.parametrize("mutant,expected", KERNEL_CORPUS,
+                         ids=[fn.__name__ for fn, _ in KERNEL_CORPUS])
+def test_kernel_mutant_findings_carry_location(mutant, expected):
+    for f in _kernel_findings(mutant):
+        assert f.kernel == mutant.__name__
+        assert f.message

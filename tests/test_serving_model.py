@@ -1,0 +1,117 @@
+"""Serving-state model checker: harness semantics, invariant audit,
+exploration machinery.  The mutation corpus lives in
+`test_resource_mutations.py`; the property fuzzer in
+`test_serving_fuzz.py`.
+"""
+
+import copy
+
+from triton_distributed_tpu.analysis import serving_model as SM
+from triton_distributed_tpu.analysis.model import FindingKind
+
+
+def _harness(**kw):
+    scope = SM.default_scope()
+    if kw:
+        scope = SM.ModelScope(requests=scope.requests, **kw)
+    return SM.ServingHarness(scope)
+
+
+def test_default_scope_explores_clean():
+    assert SM.check_serving_model() == []
+
+
+def test_audit_clean_initial_state():
+    h = _harness()
+    assert SM.audit_state(h) == []
+
+
+def test_admit_decode_retire_roundtrip_keeps_invariants():
+    h = _harness()
+    h.apply(("admit", 0))
+    assert h.active and not h.findings
+    assert SM.audit_state(h) == []
+    h.apply(("decode",))
+    assert SM.audit_state(h) == []
+    # request 0 wants 2 tokens; one more decode auto-retires it
+    h.apply(("decode",))
+    assert not h.active and h.done == [0]
+    assert SM.audit_state(h) == []
+    # prefix pages stay cached for the next same-prefix arrival
+    assert h.kv.radix.cached_pages >= 1
+
+
+def test_prefix_sharing_shares_physical_pages():
+    h = _harness()
+    h.apply(("admit", 0))          # prompt (1, 2, 3): caches page (1,2)
+    h.apply(("admit", 1))          # prompt (1, 2, 4): shares it
+    slots = sorted(h.active)
+    first_pages = [int(h.kv._table[s, 0]) for s in slots]
+    assert first_pages[0] == first_pages[1]
+    assert int(h.kv.pool.refs[first_pages[0]]) == 3  # 2 slots + tree
+    assert SM.audit_state(h) == []
+
+
+def test_decode_write_always_lands_private():
+    h = _harness()
+    h.apply(("admit", 0))
+    h.apply(("admit", 1))
+    h.apply(("decode",))
+    assert not [f for f in h.findings
+                if f.kind is FindingKind.WRITE_SHARED_PAGE]
+
+
+def test_preemption_path_keeps_invariants():
+    # A scope tight enough that decoding all three admitted requests
+    # must preempt: 2 slots, few pages.
+    h = _harness(num_slots=2, usable_pages=4, page_size=2, max_seq=12)
+    for rid in (0, 1):
+        if h.can_admit(rid):
+            h.apply(("admit", rid))
+    for _ in range(4):
+        if not h.active:
+            break
+        h.apply(("decode",))
+        assert SM.audit_state(h) == [], h.findings
+    assert not h.findings
+
+
+def test_evict_op_keeps_invariants():
+    h = _harness()
+    h.apply(("admit", 0))
+    h.apply(("decode",))
+    h.apply(("decode",))           # retires; pages stay radix-cached
+    assert h.kv.radix.cached_pages >= 1
+    h.apply(("evict",))
+    assert SM.audit_state(h) == []
+
+
+def test_fingerprint_stable_under_deepcopy():
+    h = _harness()
+    h.apply(("admit", 0))
+    assert copy.deepcopy(h).fingerprint() == h.fingerprint()
+
+
+def test_fingerprint_distinguishes_states():
+    h = _harness()
+    before = h.fingerprint()
+    h.apply(("admit", 0))
+    assert h.fingerprint() != before
+
+
+def test_exploration_respects_state_cap():
+    # Tiny cap: must terminate fast and still return (possibly empty).
+    out = SM.check_serving_model(max_states=5, max_depth=2)
+    assert out == []
+
+
+def test_donation_error_converted_to_finding():
+    class Stale(SM.ServingHarness):
+        def _dispatch(self):
+            self.kv.cache._use()
+            self.kv.cache.donated = True
+
+    findings = SM.check_serving_model(harness_factory=Stale)
+    msgs = [f.message for f in findings
+            if f.kind is FindingKind.USE_AFTER_DONATE]
+    assert msgs and "donated" in msgs[0]

@@ -159,7 +159,6 @@ def test_native_aot_decode_step_serving_loop(tmp_path):
     assert set(bundle.variants()) == {"b1", "b4"}
 
     # Call site: batch 4 — selection must pick "b4".
-    b = 4
     man = bundle.manifest["variants"]["b4"]
     p_leaves = jax.tree.leaves(params)
     args = [jnp.array([3, 7, 11, 42], jnp.int32)] + list(p_leaves)

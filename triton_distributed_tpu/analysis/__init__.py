@@ -48,6 +48,14 @@ from triton_distributed_tpu.analysis.registry import (
     iter_specs,
     register_comm_kernel,
 )
+from triton_distributed_tpu.analysis.resources import (
+    all_resource_kernels,
+    capture_pallas_calls,
+    check_captured_call,
+    check_replay_resources,
+    register_resource_kernel,
+    sweep_resources,
+)
 
 __all__ = [
     "AnalysisContext",
@@ -58,14 +66,31 @@ __all__ = [
     "RefSpec",
     "SemSpec",
     "all_kernels",
+    "all_resource_kernels",
     "analyze_kernel",
     "analyze_spec",
+    "capture_pallas_calls",
+    "check_captured_call",
+    "check_replay_resources",
+    "check_serving_model",
     "iter_specs",
     "record_traces",
     "register_comm_kernel",
+    "register_resource_kernel",
     "run_checks",
     "sweep",
+    "sweep_resources",
 ]
+
+
+def check_serving_model(*args, **kwargs):
+    """Lazy facade over `analysis.serving_model.check_serving_model`
+    (the serving layer imports jax-heavy modules; keep `analysis`
+    importable from kernel modules without a cycle)."""
+    from triton_distributed_tpu.analysis.serving_model import (
+        check_serving_model as _check)
+
+    return _check(*args, **kwargs)
 
 
 def analyze_kernel(fn, mesh_shape: Dict[str, int], *,

@@ -1,6 +1,9 @@
 """CLI sweep: sanitize every registered kernel across its meshes.
 
-    python -m triton_distributed_tpu.analysis              # full sweep
+    python -m triton_distributed_tpu.analysis              # comm sweep
+    python -m triton_distributed_tpu.analysis --check resources
+    python -m triton_distributed_tpu.analysis --check serving
+    python -m triton_distributed_tpu.analysis --check all
     python -m triton_distributed_tpu.analysis --list
     python -m triton_distributed_tpu.analysis -k allgather.ring
     python -m triton_distributed_tpu.analysis --mesh tp=4
@@ -8,8 +11,15 @@
     python -m triton_distributed_tpu.analysis -k allreduce.chain \\
         --dump-graph graph.dot
 
+``--check`` picks the analysis family: ``comm`` (default — the
+cross-rank comm-graph sanitizer), ``resources`` (the VMEM / tiling /
+block-index-bounds abstract interpreter over every registered kernel,
+comm AND compute), ``serving`` (the paged-serving refcount/donation
+model checker), or ``all``.
+
 Exit status: 0 = no findings, 1 = findings, 2 = usage error.
-`scripts/verify_tier1.sh` runs the full sweep as a gate.
+`scripts/verify_tier1.sh` runs the comm + resources sweeps and the
+serving model check as tier-1 gates.
 """
 
 from __future__ import annotations
@@ -38,6 +48,9 @@ def main(argv=None) -> int:
         prog="python -m triton_distributed_tpu.analysis",
         description="Static comm-graph sanitizer sweep over registered "
                     "kernels.")
+    parser.add_argument("--check", default="comm",
+                        choices=("comm", "resources", "serving", "all"),
+                        help="analysis family to run (default: comm)")
     parser.add_argument("-k", "--kernel", action="append", default=None,
                         help="kernel name or glob (repeatable); default: "
                              "all registered")
@@ -54,7 +67,10 @@ def main(argv=None) -> int:
                         help="print only findings and the final summary")
     args = parser.parse_args(argv)
 
-    names = analysis.all_kernels()
+    comm_names = analysis.all_kernels()
+    resource_names = (analysis.all_resource_kernels()
+                      if args.check in ("resources", "all") else [])
+    names = sorted(set(comm_names) | set(resource_names))
     if args.kernel:
         selected = [n for n in names
                     if any(fnmatch.fnmatch(n, pat) or n == pat
@@ -68,9 +84,12 @@ def main(argv=None) -> int:
     if args.list:
         from triton_distributed_tpu.analysis.registry import get_kernel
         for n in names:
-            meshes = ", ".join(
-                ",".join(f"{a}={s}" for a, s in m.items())
-                for m in get_kernel(n).meshes)
+            if n in comm_names:
+                meshes = ", ".join(
+                    ",".join(f"{a}={s}" for a, s in m.items())
+                    for m in get_kernel(n).meshes)
+            else:
+                meshes = "[capture]"
             print(f"{n:40s} {meshes}")
         return 0
 
@@ -92,25 +111,41 @@ def main(argv=None) -> int:
     total = 0
     swept = 0
     rows = []
-    for name, axis_sizes, findings in analysis.sweep(names, args.mesh):
-        swept += 1
-        mesh_str = ",".join(f"{a}={s}" for a, s in axis_sizes.items())
-        if findings:
-            total += len(findings)
-            print(f"FAIL {name} [{mesh_str}]: {len(findings)} finding(s)")
-            for f in findings:
-                print(f"  {f}")
-        elif not args.quiet:
-            print(f"ok   {name} [{mesh_str}]")
-        rows.extend({
-            "kernel": name,
-            "mesh": axis_sizes,
-            "kind": f.kind.value,
-            "rank": list(f.rank) if f.rank is not None else None,
-            "sem": f.sem,
-            "ref": f.ref,
-            "message": f.message,
-        } for f in findings)
+
+    def consume(label, results):
+        nonlocal total, swept
+        for name, axis_sizes, findings in results:
+            swept += 1
+            mesh_str = (",".join(f"{a}={s}"
+                                 for a, s in axis_sizes.items())
+                        or "single")
+            if findings:
+                total += len(findings)
+                print(f"FAIL {name} [{mesh_str}] ({label}): "
+                      f"{len(findings)} finding(s)")
+                for f in findings:
+                    print(f"  {f}")
+            elif not args.quiet:
+                print(f"ok   {name} [{mesh_str}] ({label})")
+            rows.extend({
+                "check": label,
+                "kernel": name,
+                "mesh": axis_sizes,
+                "kind": f.kind.value,
+                "rank": list(f.rank) if f.rank is not None else None,
+                "sem": f.sem,
+                "ref": f.ref,
+                "message": f.message,
+            } for f in findings)
+
+    if args.check in ("comm", "all"):
+        consume("comm", analysis.sweep(
+            [n for n in names if n in comm_names], args.mesh))
+    if args.check in ("resources", "all"):
+        consume("resources", analysis.sweep_resources(names, args.mesh))
+    if args.check in ("serving", "all"):
+        findings = analysis.check_serving_model()
+        consume("serving", [("serving.paged", {}, findings)])
 
     if args.json:
         payload = json.dumps({"findings": rows, "swept": swept}, indent=2)
@@ -120,8 +155,8 @@ def main(argv=None) -> int:
             with open(args.json, "w") as fh:
                 fh.write(payload + "\n")
 
-    print(f"analysis sweep: {swept} (kernel, mesh) pairs, "
-          f"{total} finding(s)")
+    print(f"analysis sweep [{args.check}]: {swept} (kernel, mesh) "
+          f"pairs, {total} finding(s)")
     return 1 if total else 0
 
 

@@ -194,12 +194,22 @@ def _shim_emit_pipeline(inner, *, grid=None, in_specs=None, out_specs=None,
                         **kw):
     del inner, grid, kw
     n_in = len(in_specs) if in_specs is not None else 0
+    specs = list(in_specs or ()) + list(out_specs or ())
 
     def run(*refs, **run_kw):
         del run_kw
         ins = refs[:n_in]
         outs = refs[n_in:]
         m = _machine()
+        # The pipeline's VMEM working set: one (double-buffered) block
+        # per spec — recorded for the resource sanitizer before the
+        # comm footprint (reads/writes) below.
+        for spec, r in zip(specs, refs):
+            shape = getattr(spec, "block_shape", None)
+            if shape is not None:
+                m.record_resource(
+                    "pipeline_block", shape,
+                    getattr(r, "dtype", None) or np.float32)
         for r in ins:
             if isinstance(r, AbstractRef):
                 m.record_read(r)
@@ -222,8 +232,10 @@ def _scratch_to_abstract(machine: Machine, base: str, obj):
             or "sem" in str(dtype).lower()
             or "SemaphoreType" in type(obj).__name__):
         return AbstractSem(name, shape)
-    return AbstractRef(machine, name, shape,
-                       np.dtype(dtype) if dtype is not None else np.float32)
+    np_dtype = np.dtype(dtype) if dtype is not None else np.float32
+    if "vmem" in space.lower() or not space:
+        machine.record_resource("scratch", shape, np_dtype)
+    return AbstractRef(machine, name, shape, np_dtype)
 
 
 def _shim_run_scoped(fn, *args, **kwargs):

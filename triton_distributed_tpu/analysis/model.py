@@ -76,6 +76,37 @@ class FindingKind(enum.Enum):
     SHAPE_MISMATCH = "shape_mismatch"
     #: One-sided put where src and dst dtypes disagree.
     DTYPE_MISMATCH = "dtype_mismatch"
+    # -- resource sanitizer (analysis.resources) -----------------------
+    #: Estimated VMEM working set (pipelined blocks double-buffered +
+    #: scratch) exceeds the kernel's vmem limit: Mosaic aborts the
+    #: launch, or the pipeline silently degrades.
+    VMEM_OVERFLOW = "vmem_overflow"
+    #: Block/scratch shape violates Mosaic tiling (lane dim not a 128
+    #: multiple, sublane not a multiple of the dtype's native rows).
+    TILING_ILLEGAL = "tiling_illegal"
+    #: A BlockSpec index map addresses a block outside its operand —
+    #: including indirection through a scalar-prefetched index/page
+    #: table entry (the "walked off the page table" bug).
+    OOB_BLOCK_INDEX = "oob_block_index"
+    #: Scalar-prefetch operands exceed the SMEM table budget.
+    SMEM_OVERFLOW = "smem_overflow"
+    # -- serving-state model checker (analysis.serving_model) ----------
+    #: A page's physical refcount exceeds what its holders (slots,
+    #: radix tree) account for, or a refcount-0 page never returned to
+    #: the free list — the pool shrinks until nothing is admittable.
+    REFCOUNT_LEAK = "refcount_leak"
+    #: A page freed while still referenced, freed twice, or driven to
+    #: a negative refcount — two requests end up writing one page.
+    DOUBLE_FREE = "double_free"
+    #: A KV write lands in a page mapped by the radix cache or another
+    #: slot (violates the pages-strictly-below-s-1 sharing invariant).
+    WRITE_SHARED_PAGE = "write_shared_page"
+    #: A KV write below the request's horizon falls through a NULL
+    #: page-table entry into the trash page — silently dropped KV.
+    NULL_PAGE_WRITE = "null_page_write"
+    #: A donated cache/keys buffer is used after the dispatch that
+    #: consumed it (XLA has already reused the memory).
+    USE_AFTER_DONATE = "use_after_donate"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -379,6 +410,13 @@ class Machine:
         self.current_rank: Optional[Tuple[int, ...]] = None
         self.grid_point: Tuple[int, ...] = ()
         self._scoped_counter = 0
+        #: Per-replay resource allocations for the resource sanitizer:
+        #: each entry is a list of ("scratch" | "pipeline_block",
+        #: shape, dtype) tuples recorded during ONE (rank, grid step)
+        #: replay — `analysis.resources.check_replay_resources`
+        #: consumes the per-replay peak.
+        self.resource_replays: list = []
+        self._current_resources: Optional[list] = None
 
     # -- rank bookkeeping ----------------------------------------------
     def all_ranks(self):
@@ -461,6 +499,16 @@ class Machine:
                         ref=ref.name, key=ref.key, shape=ref.shape,
                         dtype=ref.dtype))
 
+    def record_resource(self, kind: str, shape: Tuple[int, ...],
+                        dtype) -> None:
+        """Log one VMEM allocation (scoped scratch or pipeline block)
+        of the current (rank, grid step) replay."""
+        if self._current_resources is None:
+            self._current_resources = []
+            self.resource_replays.append(self._current_resources)
+        self._current_resources.append(
+            (kind, tuple(int(s) for s in shape), np.dtype(dtype)))
+
     def fresh_scoped_name(self, base: str) -> str:
         self._scoped_counter += 1
         return f"__scoped{self._scoped_counter}_{base}"
@@ -473,3 +521,6 @@ class Machine:
         rank-1 semaphore would never match the name a rank-0 put
         credits, and correct kernels would report false deadlocks."""
         self._scoped_counter = 0
+        # A new replay also starts a fresh resource accumulator (the
+        # VMEM peak is per launch, not summed across grid steps).
+        self._current_resources = None

@@ -19,6 +19,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu.analysis.resources import (
+    LANE,
+    max_prefetch_steps,
+)
 from triton_distributed_tpu.utils.platform import (
     SCOPED_VMEM_LIMIT as VMEM_LIMIT,
     default_interpret,
@@ -457,7 +461,7 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     block_q: int = 1024, block_k: int = 1024,
                     diag_sub: Optional[int] = None,
                     interpret: Optional[bool] = None,
-                    _max_packed_steps: int = 4096):
+                    _max_packed_steps: Optional[int] = None):
     """q: (B, H, Sq, D); k, v: (B, Hkv, Sk, D) → (B, H, Sq, D)
     [, lse (B, H, Sq)].
 
@@ -501,8 +505,14 @@ def flash_attention(q, k, v, *, causal: bool = True,
     # int32 entries each; above this, fall back to the rectangular
     # grid (whose skip bookkeeping is cheap relative to such long
     # sequences' compute anyway) rather than risk SMEM exhaustion and
-    # per-(shape, offset) table-rebuild cost.
-    max_packed_steps = _max_packed_steps  # 3 tables x 4 B -> 48 KiB
+    # per-(shape, offset) table-rebuild cost.  The cap is derived from
+    # the SAME SMEM budget the resource sanitizer checks
+    # (`analysis.resources.PREFETCH_SMEM_LIMIT`), so guard and
+    # analyzer cannot disagree about what fits.
+    # `is None`, not falsy: an explicit 0 means "never pack".
+    max_packed_steps = (max_prefetch_steps(3)
+                        if _max_packed_steps is None
+                        else _max_packed_steps)
     use_packed = (causal and isinstance(kv_offset, (int, np.integer))
                   and nq * ((nk + 1) // 2 + 1) <= max_packed_steps)
     if use_packed:
@@ -513,10 +523,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
         # SP/ring callers whose shard offsets are block multiples.
         sub_req = diag_sub
         # Hardware lane rule (ADVICE r5): a user/tuner-supplied sub
-        # that is not a 128 multiple would hit Mosaic's tiling check
-        # deep in compilation — fall back to the heuristic instead.
-        # Interpret mode (CPU tests) accepts any divisor.
-        if (sub_req and sub_req % 128 != 0
+        # that is not a lane-tile multiple would hit Mosaic's tiling
+        # check deep in compilation — fall back to the heuristic
+        # instead.  Interpret mode (CPU tests) accepts any divisor.
+        if (sub_req and sub_req % LANE != 0
                 and default_interpret(interpret) is False):
             sub_req = None
         diag_sub = 0
@@ -1068,3 +1078,44 @@ def attention_reference(q, k, v, *, causal: bool = True,
         s = jnp.where(kpos <= qpos, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, vf).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Resource-sanitizer registration (analysis.resources; docs/analysis.md).
+# The builders invoke the REAL host wrapper under capture, so the
+# analyzed grid/BlockSpecs/prefetch tables are the literal pallas_call
+# this module issues — a schedule or scratch change re-analyzes itself.
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis.resources import (  # noqa: E402
+    capture_pallas_calls,
+    register_resource_kernel,
+)
+
+
+def _fa_capture(sq, sk, *, causal=True, **kw):
+    q = jnp.zeros((1, 4, sq, 128), jnp.float32)
+    k = jnp.zeros((1, 2, sk, 128), jnp.float32)
+    with capture_pallas_calls() as records:
+        flash_attention(q, k, k, causal=causal, interpret=False, **kw)
+    return records
+
+
+@register_resource_kernel("flash_attention.packed")
+def _resource_fa_packed():
+    # Multi-step packed causal schedule: exercises the three int32
+    # prefetch tables and the static-diagonal flag path.
+    return _fa_capture(2048, 2048)
+
+
+@register_resource_kernel("flash_attention.single_diag")
+def _resource_fa_single_diag():
+    # One exact-diagonal block covers the whole problem.
+    return _fa_capture(1024, 1024)
+
+
+@register_resource_kernel("flash_attention.rect")
+def _resource_fa_rect():
+    # Non-causal rectangular grid with the skip-prefetch index map.
+    return _fa_capture(1024, 1024, causal=False, block_q=512,
+                       block_k=512)

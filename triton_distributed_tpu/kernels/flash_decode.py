@@ -507,3 +507,77 @@ def _analysis_flash_decode_ag(axis_sizes):
 def _analysis_flash_decode_paged_ag(axis_sizes):
     return _partials_ag_spec("flash_decode.paged_partials_ag",
                              axis_sizes)
+
+
+# ---------------------------------------------------------------------------
+# Resource-sanitizer registration (analysis.resources): the decode
+# kernels' pallas_call geometry captured from the real host wrappers.
+# The paged builders use a PERMUTED physical page table with NULL
+# (trash-page) tail entries — the layout a live PagedKV produces — so
+# the bounds proof covers the indirection `(ptab[b, j], h, 0, 0)`
+# including the reserved page-0 mapping.
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis.resources import (  # noqa: E402
+    capture_pallas_calls,
+    register_resource_kernel,
+)
+
+
+def _fd_capture(quantized: bool):
+    b, h, hkv, d, s = 2, 4, 2, 128, 8192
+    q = jnp.zeros((b, h, d), jnp.float32)
+    kv_len = jnp.asarray([100, s], jnp.int32)
+    if quantized:
+        kc = jnp.zeros((b, hkv, s, d), jnp.int8)
+        sc = jnp.ones((b, hkv, s), jnp.float32)
+        args = dict(k_scale=sc, v_scale=sc)
+    else:
+        kc = jnp.zeros((b, hkv, s, d), jnp.float32)
+        args = {}
+    with capture_pallas_calls() as records:
+        flash_decode(q, kc, kc, kv_len, interpret=False, **args)
+    return records
+
+
+def _fd_paged_capture(quantized: bool):
+    import numpy as np
+
+    b, h, hkv, d = 2, 4, 2, 128
+    p, ps, t = 9, 128, 4
+    q = jnp.zeros((b, h, d), jnp.float32)
+    kv_len = jnp.asarray([100, t * ps], jnp.int32)
+    table = np.zeros((b, t), np.int32)
+    table[0] = (3, 5, 0, 0)       # short row: NULL (trash) tail
+    table[1] = (8, 1, 2, 7)       # full row, permuted physical pages
+    if quantized:
+        pool = jnp.zeros((p, hkv, ps, d), jnp.int8)
+        sc = jnp.ones((p, hkv, ps), jnp.float32)
+        args = dict(k_scale=sc, v_scale=sc)
+    else:
+        pool = jnp.zeros((p, hkv, ps, d), jnp.float32)
+        args = {}
+    with capture_pallas_calls() as records:
+        flash_decode_paged(q, pool, pool, jnp.asarray(table), kv_len,
+                           interpret=False, **args)
+    return records
+
+
+@register_resource_kernel("flash_decode.dense")
+def _resource_fd_dense():
+    return _fd_capture(False)
+
+
+@register_resource_kernel("flash_decode.dense_int8")
+def _resource_fd_dense_int8():
+    return _fd_capture(True)
+
+
+@register_resource_kernel("flash_decode.paged")
+def _resource_fd_paged():
+    return _fd_paged_capture(False)
+
+
+@register_resource_kernel("flash_decode.paged_int8")
+def _resource_fd_paged_int8():
+    return _fd_paged_capture(True)

@@ -364,7 +364,6 @@ def gemm_rs_ppermute(a, b, axis: str):
     world = jax.lax.axis_size(axis)
     my = jax.lax.axis_index(axis)
     mt, _ = a.shape
-    n = b.shape[1]
     mc = mt // world
     ar = a.reshape(world, mc, -1)
     perm = [(i, (i + 1) % world) for i in range(world)]

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu.analysis import resources
 from triton_distributed_tpu.kernels.matmul import MatmulConfig
 from triton_distributed_tpu.utils.platform import (
     SCOPED_VMEM_LIMIT,
@@ -57,6 +58,16 @@ def grouped_matmul(a, b, config: Optional[MatmulConfig] = None,
     cfg = (config or MatmulConfig()).resolve(m, n, k)
     nk = pl.cdiv(k, cfg.block_k)
     grid = (e, pl.cdiv(m, cfg.block_m), pl.cdiv(n, cfg.block_n), nk)
+    # Hardware-only pre-flight (interpret mode has no VMEM ceiling).
+    interp = default_interpret(interpret)
+    if interp is False:
+        resources.check_vmem_fit(
+            "grouped_matmul",
+            [((1, cfg.block_m, cfg.block_k), a.dtype),
+             ((1, cfg.block_k, cfg.block_n), b.dtype),
+             ((1, cfg.block_m, cfg.block_n), out_dtype)],
+            [((min(cfg.block_m, m), min(cfg.block_n, n)),
+              jnp.float32)])
     return pl.pallas_call(
         functools.partial(_grouped_kernel, nk),
         out_shape=jax.ShapeDtypeStruct((e, m, n), out_dtype),
@@ -89,7 +100,7 @@ def grouped_matmul(a, b, config: Optional[MatmulConfig] = None,
             + e * m * n * jnp.dtype(out_dtype).itemsize,
             transcendentals=0,
         ),
-        interpret=default_interpret(interpret),
+        interpret=interp,
     )(a, b)
 
 
@@ -238,6 +249,17 @@ def grouped_matmul_w8a8(a_q, b_q, scale_a, scale_b, config=None,
     cfg = (config or Int8MatmulConfig()).resolve(m, n, k)
     nk = pl.cdiv(k, cfg.block_k)
     grid = (e, pl.cdiv(m, cfg.block_m), pl.cdiv(n, cfg.block_n), nk)
+    # Hardware-only pre-flight (interpret mode has no VMEM ceiling).
+    interp = default_interpret(interpret)
+    if interp is False:
+        resources.check_vmem_fit(
+            "grouped_matmul_w8a8",
+            [((1, cfg.block_m, cfg.block_k), jnp.int8),
+             ((1, cfg.block_k, cfg.block_n), jnp.int8),
+             ((1, cfg.block_m, SCALE_LANES), jnp.float32),
+             ((1, 1, cfg.block_n), jnp.float32),
+             ((1, cfg.block_m, cfg.block_n), out_dtype)],
+            [((min(cfg.block_m, m), min(cfg.block_n, n)), jnp.int32)])
     sa = jnp.broadcast_to(
         scale_a.astype(jnp.float32)[:, :, None], (e, m, SCALE_LANES))
     sb = scale_b.astype(jnp.float32).reshape(e, 1, n)
@@ -279,7 +301,7 @@ def grouped_matmul_w8a8(a_q, b_q, scale_a, scale_b, config=None,
             + e * m * n * jnp.dtype(out_dtype).itemsize,
             transcendentals=0,
         ),
-        interpret=default_interpret(interpret),
+        interpret=interp,
     )(a_q, b_q, sa, sb)
 
 
@@ -510,3 +532,28 @@ def emit_combine_matmul(cmat_ref, stage_ref, o_ref, *, num_experts, m,
         pipeline(cmat_ref, stage_ref, o_ref)
 
     pl.run_scoped(run, acc_ref=pltpu.VMEM((bm, bn), jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Resource-sanitizer registration (analysis.resources).
+# ---------------------------------------------------------------------------
+
+
+@resources.register_resource_kernel("grouped_gemm.grouped")
+def _resource_grouped():
+    a = jnp.zeros((4, 256, 512), jnp.bfloat16)
+    b = jnp.zeros((4, 512, 256), jnp.bfloat16)
+    with resources.capture_pallas_calls() as records:
+        grouped_matmul(a, b, interpret=False)
+    return records
+
+
+@resources.register_resource_kernel("grouped_gemm.w8a8")
+def _resource_grouped_w8a8():
+    a = jnp.zeros((4, 256, 512), jnp.int8)
+    b = jnp.zeros((4, 512, 256), jnp.int8)
+    sa = jnp.ones((4, 256), jnp.float32)
+    sb = jnp.ones((4, 256), jnp.float32)
+    with resources.capture_pallas_calls() as records:
+        grouped_matmul_w8a8(a, b, sa, sb, interpret=False)
+    return records

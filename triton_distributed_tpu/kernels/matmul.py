@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu.analysis import resources
 from triton_distributed_tpu.utils.platform import (
     SCOPED_VMEM_LIMIT as MATMUL_VMEM_LIMIT,
     default_interpret,
@@ -120,6 +121,20 @@ def matmul(a, b, config: Optional[MatmulConfig] = None,
     cfg = (config or MatmulConfig()).resolve(m, n, k)
     nk = pl.cdiv(k, cfg.block_k)
     grid = (pl.cdiv(m, cfg.block_m), pl.cdiv(n, cfg.block_n), nk)
+    # Shared-estimator pre-flight: a config whose working set cannot
+    # fit fails here with a readable message, not deep inside Mosaic.
+    # Hardware-only (same convention as flash_attention's lane guard):
+    # interpret mode has no VMEM ceiling.
+    interp = default_interpret(interpret)
+    if interp is False:
+        resources.check_vmem_fit(
+            "matmul",
+            [((cfg.block_m, cfg.block_k), a.dtype),
+             ((cfg.block_k, cfg.block_n), b.dtype),
+             ((cfg.block_m, cfg.block_n), out_dtype)],
+            [((min(cfg.block_m, m), min(cfg.block_n, n)),
+              jnp.float32)],
+            limit=MATMUL_VMEM_LIMIT)
     return pl.pallas_call(
         functools.partial(_matmul_kernel, nk),
         out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
@@ -151,7 +166,7 @@ def matmul(a, b, config: Optional[MatmulConfig] = None,
             + m * n * jnp.dtype(out_dtype).itemsize,
             transcendentals=0,
         ),
-        interpret=default_interpret(interpret),
+        interpret=interp,
     )(a, b)
 
 
@@ -265,13 +280,14 @@ def round_up_rows(m: int, dtype) -> int:
     Native tiling is (8, 128) for 4-byte, (16, 128) for 2-byte and
     (32, 128) for 1-byte elements — int8 rows must pad to 32 or the
     ring kernels' small-m shards force relayouts (or fail to compile)
-    on hardware."""
-    itemsize = jnp.dtype(dtype).itemsize
-    min_rows = {1: 32, 2: 16}.get(itemsize, 8)
+    on hardware.  The per-dtype multiple comes from the shared
+    resource estimator so the tiling the guards enforce is the tiling
+    the sanitizer checks."""
+    min_rows = resources.sublane_rows(jnp.dtype(dtype))
     return (m + min_rows - 1) // min_rows * min_rows
 
 
-def pad_lanes(x, multiple: int = 128):
+def pad_lanes(x, multiple: int = resources.LANE):
     """Zero-pad the LAST dim to a 128 multiple and return (padded,
     original_width).
 
@@ -315,3 +331,20 @@ def pad_contraction_lanes(a, b, axis_a: int = -1, axis_b: int = 0):
         a = jnp.pad(a, pa)
         b = jnp.pad(b, pb)
     return a, b, k + pad
+
+
+# ---------------------------------------------------------------------------
+# Resource-sanitizer registration (analysis.resources).
+# ---------------------------------------------------------------------------
+
+
+@resources.register_resource_kernel("matmul.blocked")
+def _resource_matmul():
+    records = []
+    for dtype in (jnp.float32, jnp.bfloat16):
+        a = jnp.zeros((512, 1024), dtype)
+        b = jnp.zeros((1024, 512), dtype)
+        with resources.capture_pallas_calls() as recs:
+            matmul(a, b, interpret=False)
+        records.extend(recs)
+    return records

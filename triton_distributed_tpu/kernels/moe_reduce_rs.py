@@ -376,7 +376,13 @@ def moe_reduce_rs_fused(buckets, expert_weights, combine_mats,
         # cannot fit the scoped-VMEM ceiling (ADVICE r5: large
         # mc·n chunks), fall back to the two-phase kernel that
         # stages through HBM instead of silently failing to compile.
-        scratch_bytes = (4 + 2 * out_dtype.itemsize) * mc * n
+        # The footprint comes from the SHARED estimator
+        # (`analysis.resources`) — the same arithmetic the resource
+        # sanitizer sweeps, so guard and analyzer cannot drift.
+        from triton_distributed_tpu.analysis.resources import (
+            scratch_footprint_bytes)
+        scratch_bytes = scratch_footprint_bytes(
+            [((mc, n), jnp.float32), ((2, mc, n), out_dtype)])
         if scratch_bytes > COMM_VMEM_LIMIT:
             kern = functools.partial(_moe_rs_fused_kernel_2p, ctx, e,
                                      cap, mc, n, k, has_counts)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu.analysis import resources
 from triton_distributed_tpu.kernels.matmul import _pick_block
 from triton_distributed_tpu.utils.platform import (
     SCOPED_VMEM_LIMIT,
@@ -43,12 +44,15 @@ class Int8MatmulConfig:
     block_k: int = 4096
 
     def resolve(self, m: int, n: int, k: int) -> "Int8MatmulConfig":
-        # int8 Mosaic native tiling is (32, 128): align block_m to 32
-        # (bf16's 8-row alignment would force relayouts on hardware).
+        # int8 Mosaic native tiling is (32, 128): align block_m to the
+        # shared estimator's int8 sublane rows (bf16's 8-row alignment
+        # would force relayouts on hardware) — the same constant the
+        # resource sanitizer's tiling check enforces.
+        rows = resources.sublane_rows(jnp.int8)
         return Int8MatmulConfig(
-            block_m=_pick_block(m, self.block_m, 32),
-            block_n=_pick_block(n, self.block_n, 128),
-            block_k=_pick_block(k, self.block_k, 128),
+            block_m=_pick_block(m, self.block_m, rows),
+            block_n=_pick_block(n, self.block_n, resources.LANE),
+            block_k=_pick_block(k, self.block_k, resources.LANE),
         )
 
 
@@ -100,6 +104,17 @@ def matmul_w8a8(a_q, b_q, scale_a, scale_b,
     cfg = (config or Int8MatmulConfig()).resolve(m, n, k)
     nk = pl.cdiv(k, cfg.block_k)
     grid = (pl.cdiv(m, cfg.block_m), pl.cdiv(n, cfg.block_n), nk)
+    # Hardware-only pre-flight (interpret mode has no VMEM ceiling).
+    interp = default_interpret(interpret)
+    if interp is False:
+        resources.check_vmem_fit(
+            "matmul_w8a8",
+            [((cfg.block_m, cfg.block_k), jnp.int8),
+             ((cfg.block_k, cfg.block_n), jnp.int8),
+             ((cfg.block_m, 1), jnp.float32),
+             ((1, cfg.block_n), jnp.float32),
+             ((cfg.block_m, cfg.block_n), out_dtype)],
+            [((min(cfg.block_m, m), min(cfg.block_n, n)), jnp.int32)])
     sa = scale_a.astype(jnp.float32).reshape(m, 1)
     sb = scale_b.astype(jnp.float32).reshape(1, n)
     return pl.pallas_call(
@@ -139,7 +154,7 @@ def matmul_w8a8(a_q, b_q, scale_a, scale_b,
             + m * n * jnp.dtype(out_dtype).itemsize,
             transcendentals=0,
         ),
-        interpret=default_interpret(interpret),
+        interpret=interp,
     )(a_q, b_q, sa, sb)
 
 
@@ -193,3 +208,21 @@ def matmul_quantized(a, b, config: Optional[Int8MatmulConfig] = None,
     b_q, sb = quantize_sym(b, axis=0)
     return matmul_w8a8(a_q, b_q, sa, sb, config=config,
                        out_dtype=a.dtype, interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# Resource-sanitizer registration (analysis.resources).  The captured
+# call includes the (block_m, 1) / (1, block_n) f32 scale-row blocks,
+# so the int8 scale-row layout is under the tiling check.
+# ---------------------------------------------------------------------------
+
+
+@resources.register_resource_kernel("quantized.w8a8")
+def _resource_w8a8():
+    a = jnp.zeros((256, 512), jnp.int8)
+    b = jnp.zeros((512, 256), jnp.int8)
+    sa = jnp.ones((256,), jnp.float32)
+    sb = jnp.ones((256,), jnp.float32)
+    with resources.capture_pallas_calls() as records:
+        matmul_w8a8(a, b, sa, sb, interpret=False)
+    return records

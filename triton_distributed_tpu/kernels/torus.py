@@ -594,7 +594,6 @@ def reduce_scatter_torus(x, ctx: TorusContext):
     if pad:
         xr = jnp.pad(xr, ((0, 0), (0, pad), (0, 0)))
     maxw = max(sizes)
-    scheds = lane_schedules(nd)
 
     # Out-buffer list mirrors the kernel's unpack: per stage t the
     # (s_t, a_t) staging pair (2 slots each), plus mid_t for t < nd-1.

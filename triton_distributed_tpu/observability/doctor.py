@@ -123,6 +123,7 @@ class Artifacts:
         self.heartbeats: Dict[int, dict] = {}
         self.metrics: Dict[int, dict] = {}
         self.static_findings: Optional[dict] = None
+        self.resource_findings: Optional[dict] = None
         self._discover()
 
     def _glob(self, pattern: str) -> List[str]:
@@ -159,6 +160,11 @@ class Artifacts:
             d = _load_json(p)
             if d is not None:
                 self.static_findings = d
+                break
+        for p in self._glob("resource-findings.json"):
+            d = _load_json(p)
+            if d is not None:
+                self.resource_findings = d
                 break
 
     def empty(self) -> bool:
@@ -359,6 +365,96 @@ def run_static_analysis(art: Artifacts, stall: dict,
     return out
 
 
+#: Resource-finding kinds that mean "this kernel could have corrupted
+#: or overflowed memory" (vs merely failing to compile).
+_RESOURCE_HANGY = ("vmem_overflow", "oob_block_index", "smem_overflow",
+                   "tiling_illegal")
+
+
+def run_resource_analysis(art: Artifacts, stall: dict,
+                          kernel: Optional[str] = None,
+                          mesh: Optional[Dict[str, int]] = None,
+                          enabled: bool = False) -> Optional[dict]:
+    """Consult the resource sanitizer (`analysis.resources`) for the
+    in-flight kernel: could it have overflowed VMEM or walked off its
+    page table?  Mirrors `run_static_analysis` (PR 5's comm-graph
+    verdict): a shipped ``resource-findings.json`` wins; otherwise the
+    mapped registry kernel is resource-analyzed live.  Opt-in
+    (``--resources`` / a findings file) so existing golden incident
+    reports stay byte-identical — the section key is simply absent."""
+    ev = stall.get("in_flight_event")
+    if not (enabled or art.resource_findings is not None):
+        return None
+    if ev is None and art.resource_findings is None and kernel is None:
+        return None
+    out: dict = {"kernel": kernel, "mesh": mesh, "findings": [],
+                 "source": None}
+    if art.resource_findings is not None:
+        rows = art.resource_findings.get("findings", [])
+        out["findings"] = rows
+        out["source"] = "artifact"
+        if rows and out["kernel"] is None:
+            out["kernel"] = rows[0].get("kernel")
+    else:
+        if out["kernel"] is None and ev is not None:
+            out["kernel"] = kernel_for_event(ev)
+        if out["kernel"] is None:
+            return None
+        if out["mesh"] is None and ev is not None:
+            # Same mesh derivation as run_static_analysis: multi-axis
+            # kernels (torus family) carry axes/sizes in extra — a
+            # fabricated single-axis mesh would make every builder
+            # reject it and a zero-pair sweep read as "clean".
+            axis = str(ev.get("axis") or "tp")
+            extra = ev.get("extra") or {}
+            if extra.get("axes") and extra.get("sizes"):
+                out["mesh"] = dict(zip(extra["axes"],
+                                       (int(s)
+                                        for s in extra["sizes"])))
+            else:
+                out["mesh"] = {axis: int(ev.get("world", 2) or 2)}
+        try:
+            from triton_distributed_tpu import analysis
+            swept = 0
+            for name, axis_sizes, findings in analysis.sweep_resources(
+                    [out["kernel"]], out["mesh"]):
+                swept += 1
+                out["mesh"] = axis_sizes
+                out["findings"] = [{
+                    "kernel": name,
+                    "kind": f.kind.value,
+                    "ref": f.ref,
+                    "message": f.message,
+                } for f in findings]
+            if swept == 0:
+                # Builder rejected the derived mesh: nothing was
+                # analyzed — never report that as "clean".
+                out["source"] = "unavailable (mesh not applicable)"
+                return out
+            out["source"] = "live"
+        except Exception as e:
+            out["source"] = f"unavailable ({type(e).__name__})"
+            return out
+    bad = [f for f in out["findings"]
+           if f.get("kind") in _RESOURCE_HANGY]
+    if bad:
+        f = bad[0]
+        out["could_overflow"] = True
+        out["verdict"] = (
+            f"resource sanitizer says this kernel CAN overflow VMEM "
+            f"or walk off its index/page tables: [{f.get('kind')}] "
+            f"{f.get('message')}")
+    elif out["source"] and not str(out["source"]).startswith(
+            "unavailable"):
+        out["could_overflow"] = False
+        out["verdict"] = (
+            "resource sweep is clean — VMEM fits, tiling is legal and "
+            "every block index (including page-table indirection) "
+            "stays in bounds; an overflow here implies a runtime "
+            "cause (corrupted table, stale autotune config)")
+    return out
+
+
 def analyze_links(art: Artifacts) -> dict:
     from triton_distributed_tpu.observability import links as _links
     from triton_distributed_tpu.observability.events import KernelEvent
@@ -398,7 +494,8 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
              mesh: Optional[Dict[str, int]] = None,
              now: Optional[float] = None,
              interval: Optional[float] = None,
-             static: bool = True) -> Optional[dict]:
+             static: bool = True,
+             resources: bool = False) -> Optional[dict]:
     """Build the full incident report dict (None when the directories
     hold no artifacts at all)."""
     from triton_distributed_tpu.observability.anomaly import (
@@ -419,6 +516,8 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
     stall = detect_stall(art, rank_table)
     static_out = run_static_analysis(art, stall, kernel=kernel,
                                      mesh=mesh, enabled=static)
+    resource_out = run_resource_analysis(art, stall, kernel=kernel,
+                                         mesh=mesh, enabled=resources)
     link_out = analyze_links(art)
     # Baselines pinned to the artifact dir: the report must not change
     # with whatever ambient baseline file the operator's CWD holds.
@@ -494,6 +593,10 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
     }
     if page_pressure:
         report["page_pressure"] = page_pressure
+    # Key absent unless the resource consult ran (opt-in / findings
+    # file) — golden incident reports stay byte-identical.
+    if resource_out is not None:
+        report["resources"] = resource_out
     report["verdict"] = _verdict(report, in_flight)
     return report
 
@@ -529,6 +632,9 @@ def _verdict(report: dict, in_flight: Optional[dict]) -> str:
         verdict = (f"rank {r} stalled first{what}{op_s}{sem_s}")
         if static_out.get("verdict"):
             verdict += f". {static_out['verdict']}"
+        resource_out = report.get("resources") or {}
+        if resource_out.get("verdict"):
+            verdict += f". {resource_out['verdict']}"
         return verdict + hot_s + "."
     stragglers = report.get("stragglers") or []
     anomalies = report.get("anomalies") or []
@@ -636,6 +742,19 @@ def render_markdown(report: dict) -> str:
                          f"{f.get('message')}")
         if static_out.get("verdict"):
             lines.append(f"- **{static_out['verdict']}**")
+        lines.append("")
+
+    resource_out = report.get("resources")
+    if resource_out:
+        lines += ["## Static resource check", ""]
+        lines += [f"- kernel: {resource_out.get('kernel') or '-'} "
+                  f"(mesh {resource_out.get('mesh') or '-'}, source "
+                  f"{resource_out.get('source')})"]
+        for f in resource_out.get("findings", [])[:5]:
+            lines.append(f"- [{f.get('kind')}] ref={f.get('ref')} "
+                         f"{f.get('message')}")
+        if resource_out.get("verdict"):
+            lines.append(f"- **{resource_out['verdict']}**")
         lines.append("")
 
     hot = report["links"].get("hot") or []
@@ -752,6 +871,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "artifact timestamp, for determinism)")
     ap.add_argument("--no-static", action="store_true",
                     help="skip the static comm-graph consult")
+    ap.add_argument("--resources", action="store_true",
+                    help="also consult the static resource sanitizer "
+                         "(VMEM/tiling/bounds) for the in-flight "
+                         "kernel; a shipped resource-findings.json "
+                         "enables this automatically")
     ap.add_argument("--check", default=None, metavar="GOLDEN",
                     help="compare against a golden report JSON; exit "
                          "3 on drift (CI gate)")
@@ -760,7 +884,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     report = diagnose(args.dirs, kernel=args.kernel, mesh=args.mesh,
-                      now=args.now, static=not args.no_static)
+                      now=args.now, static=not args.no_static,
+                      resources=args.resources)
     if report is None:
         print(f"doctor: no artifacts found under {args.dirs}",
               file=sys.stderr)

@@ -256,7 +256,8 @@ class PagedKV:
                  page_size: int = 16,
                  num_pages: Optional[int] = None,
                  kv_budget_bytes: Optional[int] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 insert_fn=None):
         self.page_size = ps = int(page_size)
         self.max_seq = int(max_seq)
         self.pages_per_seq = t = pages_for(self.max_seq, ps)
@@ -297,7 +298,11 @@ class PagedKV:
                                                    range(self.num_slots)]
         #: Logical pages currently mapped per slot.
         self._mapped = np.zeros(self.num_slots, np.int64)
-        self._insert = make_paged_insert_fn()
+        # `insert_fn` is an injection seam for the serving-state model
+        # checker / fuzz harness (`analysis.serving_model`): the real
+        # host-side page accounting runs against a recording insert
+        # and a stub cache, no jit, no device arrays.
+        self._insert = insert_fn or make_paged_insert_fn()
 
     # -- occupancy / accounting -----------------------------------------
 
