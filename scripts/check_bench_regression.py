@@ -60,6 +60,12 @@ MEASUREMENT_FIELDS = {
     "concurrency_vs_slots", "paged_4x_concurrency",
     # Anomaly-baseline outputs attached by bench_record.
     "anomaly_z", "anomaly",
+    # Closed-loop paired bench (bench_closed_loop.py): the chosen
+    # method + its modeled cost are outputs (static rows are gated
+    # for EXACT parity separately — see closed_loop_checks), as are
+    # the paired-summary statistics.
+    "chosen", "modeled_us", "flips", "mean_speedup", "min_speedup",
+    "max_speedup", "closed_loop_never_worse",
 }
 #: Fields that may hold the latency to compare, in preference order.
 LATENCY_FIELDS = ("us", "ms", "ms_per_step")
@@ -126,6 +132,50 @@ def anomaly_z_of(store, rec, us):
         return round(z, 2) if z is not None else None
     except Exception:
         return None
+
+
+def closed_loop_checks(fresh, base) -> tuple:
+    """Gates specific to the paired closed-loop bench
+    (`benchmark/bench_closed_loop.py`):
+
+    - ``mode: "static"`` rows are what a bus-disabled run produces —
+      pure analytic model output — so they must match the committed
+      results EXACTLY (``chosen`` method AND ``modeled_us``).  Any
+      drift means static selection behavior changed, the one thing
+      the closed loop must never do;
+    - every fresh ``paired`` summary must report
+      ``closed_loop_never_worse`` — the loop may only flip a choice
+      when the flip wins under the scenario's ground truth.
+
+    Returns ``(n_checked, failures)``."""
+    fails = []
+    checked = 0
+    for rec in fresh:
+        if rec.get("bench") != "closed_loop":
+            continue
+        if rec.get("mode") == "static":
+            old = base.get(identity(rec))
+            if old is None:
+                continue   # new sweep point: generic unmatched path
+            checked += 1
+            for field in ("chosen", "modeled_us"):
+                if rec.get(field) != old.get(field):
+                    fails.append(
+                        f"closed_loop static drift "
+                        f"({rec.get('chooser')}, "
+                        f"{rec.get('scenario')}, "
+                        f"nbytes={rec.get('nbytes')}): {field} "
+                        f"{old.get(field)!r} -> {rec.get(field)!r}")
+        elif rec.get("mode") == "paired":
+            checked += 1
+            if not rec.get("closed_loop_never_worse"):
+                fails.append(
+                    f"closed_loop regression: paired sweep "
+                    f"({rec.get('chooser')}, {rec.get('scenario')}) "
+                    f"reports a flip that LOSES under its own "
+                    f"ground truth (min_speedup="
+                    f"{rec.get('min_speedup')})")
+    return checked, fails
 
 
 def main() -> int:
@@ -217,10 +267,12 @@ def main() -> int:
         if row_regressed:
             regressions += 1
 
+    cl_checked, cl_fails = closed_loop_checks(fresh, base)
+
     # Markdown summary: CI logs and PR comments read the same thing.
     print("## Bench regression check")
     print()
-    verdict = ("FAIL" if regressions else
+    verdict = ("FAIL" if regressions or cl_fails else
                "OK (with anomalies)" if anomalies else "OK")
     print(f"**{verdict}** — {compared} row(s) compared, "
           f"{regressions} regression(s) beyond "
@@ -238,9 +290,16 @@ def main() -> int:
         print("|---|---|---|---|---|---|---|---|")
         for row in table:
             print(row)
-    if compared == 0:
+    if cl_checked:
+        print()
+        print(f"Closed-loop gate: {cl_checked} row(s) checked "
+              f"(bus-disabled exact parity + never-worse), "
+              f"{len(cl_fails)} failure(s).")
+        for f in cl_fails:
+            print(f"- {f}")
+    if compared == 0 and cl_checked == 0:
         return 2
-    return 1 if regressions else 0
+    return 1 if regressions or cl_fails else 0
 
 
 if __name__ == "__main__":

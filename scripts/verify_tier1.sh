@@ -19,7 +19,11 @@
 #  - a doctor smoke over the seeded incident corpus
 #    (tests/data/incidents): every scenario's report must match its
 #    committed golden byte-for-byte in structure — silent report
-#    drift fails tier-1.
+#    drift fails tier-1;
+#  - a closed-loop smoke (synthetic contended bus -> method flip,
+#    SLO deferral, schema-valid decisions.jsonl, doctor
+#    Control-decisions section) plus the paired closed-loop bench
+#    gate (bus-disabled rows exactly match the committed results).
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -266,6 +270,108 @@ paged_rc=$?
 echo "$paged_log" | tail -3
 if [ "$paged_rc" -ne 0 ]; then
     echo "PAGED_SMOKE=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
+# Closed-loop smoke: a serving run against a synthetic contended-bus
+# fixture with SLO admission armed must (1) write a schema-valid
+# decisions.jsonl, (2) flip a method choice vs static selection, and
+# (3) render a doctor "Control decisions" section — while the golden
+# incident corpus (no decisions artifact) stayed byte-identical in
+# the DOCTOR_SMOKE above.
+closed_log=$(JAX_PLATFORMS=cpu python - <<'EOF' 2>&1
+import json, os, tempfile
+os.environ["TDT_ANOMALY_BASELINES"] = os.path.join(
+    tempfile.mkdtemp(prefix="tdt-cl-b-"), "baselines.json")
+import jax
+from triton_distributed_tpu.kernels.comm_perf_model import (
+    torus_beats_single_axis)
+from triton_distributed_tpu.observability import feedback
+from triton_distributed_tpu.observability.anomaly import (
+    WINDOW, BaselineStore, event_key)
+from triton_distributed_tpu.observability.doctor import (
+    diagnose, render_markdown)
+from triton_distributed_tpu.serving import (
+    ContinuousBatchingScheduler, Request, SchedulerConfig, ToyConfig,
+    ToyModel)
+
+d = tempfile.mkdtemp(prefix="tdt-cl-")
+feedback.set_decision_log(os.path.join(d, "decisions-rank-0.jsonl"))
+
+# (a) seeded contention flips a method choice, recorded
+hot = feedback.synthetic_bus(link_utilization={"x:0>1": 0.85,
+                                               "x:1>2": 0.85})
+flipped = any(
+    torus_beats_single_axis(1 << e, (4, 4))
+    != torus_beats_single_axis(1 << e, (4, 4), axes=("x", "y"),
+                               bus=hot)
+    for e in range(8, 24))
+assert flipped, "contended bus never changed a method choice"
+
+# (c) SLO admission defers against a seeded slow-step baseline
+store = BaselineStore(os.environ["TDT_ANOMALY_BASELINES"])
+for _ in range(WINDOW):
+    store.observe(event_key("serving.decode_step", None, (3,), 1),
+                  50_000.0)
+model = ToyModel(ToyConfig(vocab_size=61, hidden=16, max_seq_len=64))
+params = model.init_params(jax.random.key(0))
+class Clock:
+    t = 0.0
+clock = Clock()
+sched = ContinuousBatchingScheduler(
+    model, params,
+    SchedulerConfig(num_slots=3, prefill_buckets=(8, 16),
+                    slo_tbt_ms=10.0),
+    clock=lambda: clock.t,
+    clock_advance=lambda dt: setattr(clock, "t", clock.t + dt),
+    bus=feedback.synthetic_bus(store=store, clock=lambda: clock.t,
+                               ts=0.0))
+done = sched.run([Request(prompt=[1 + i, 2, 3], max_new_tokens=2,
+                          arrival_time=0.0) for i in range(3)])
+assert len(done) == 3 and all(len(r.generated) == 2 for r in done)
+feedback.set_decision_log(None)
+
+# decisions.jsonl: present, schema-valid, carries both consumers
+rows = feedback.load_decisions(os.path.join(d,
+                                            "decisions-rank-0.jsonl"))
+assert rows, "no decisions recorded"
+for row in rows:
+    problems = feedback.validate_decision(row)
+    assert not problems, (problems, row)
+consumers = {r["consumer"] for r in rows}
+assert {"comm.method_select", "serving.admission"} <= consumers
+
+# doctor replays them into a Control-decisions section
+with open(os.path.join(d, "heartbeat-rank-0.json"), "w") as f:
+    json.dump({"schema": 1, "rank": 0, "pid": 1,
+               "unix_time": max(r["ts"] for r in rows) + 1.0,
+               "step": 1, "last_span": None, "open_spans": []}, f)
+report = diagnose([d])
+assert report.get("decisions", {}).get("count") == len(rows)
+assert "## Control decisions" in render_markdown(report)
+print("CLOSED_LOOP_SMOKE=ok")
+EOF
+)
+closed_rc=$?
+echo "$closed_log" | tail -3
+if [ "$closed_rc" -ne 0 ]; then
+    echo "CLOSED_LOOP_SMOKE=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
+# Closed-loop bench gate: the paired static-vs-closed-loop bench is
+# deterministic model output — re-run it and require (1) the
+# bus-disabled (static) rows EXACTLY match the committed results and
+# (2) every recorded flip wins under its own ground truth.
+if JAX_PLATFORMS=cpu python benchmark/bench_closed_loop.py \
+        --out /tmp/_t1_closed_loop.json > /dev/null \
+   && python scripts/check_bench_regression.py \
+        --fresh /tmp/_t1_closed_loop.json \
+        --baselines /tmp/_t1_nonexistent_baselines.json > /dev/null
+then
+    echo "CLOSED_LOOP_BENCH=ok"
+else
+    echo "CLOSED_LOOP_BENCH=FAILED"
     [ "$rc" -eq 0 ] && rc=1
 fi
 

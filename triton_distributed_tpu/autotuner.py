@@ -41,6 +41,11 @@ class _Entry:
     #: Full (time_s, config) ranking, fastest first — lets callers
     #: re-examine finalists whose margin is within measurement noise.
     ranking: list = dataclasses.field(default_factory=list)
+    #: Closed-loop staleness marker ({"z", "ts"}), persisted beside
+    #: the disk entry: a winner whose live latency drifted multi-sigma
+    #: off its baseline is demoted to its second-best until a re-tune
+    #: lands (observability.feedback; None = trusted).
+    stale: Any = None
 
 
 class ContextualAutotuner:
@@ -86,6 +91,20 @@ class ContextualAutotuner:
         #: stale entries naturally (no repr match → re-tune).
         self.cache_path = cache_path
         self._disk = self._load_disk() if cache_path else {}
+        #: Optional feedback bus (`observability.feedback.SignalBus`):
+        #: on cache hits the tuner asks it whether the cached winner's
+        #: live latency has drifted multi-sigma off its rolling
+        #: baseline.  None = consult the ambient bus (armed by
+        #: TDT_CLOSED_LOOP=1); with neither, hits behave exactly as
+        #: before.
+        self.bus = None
+        #: Run staleness-triggered re-tunes synchronously instead of
+        #: on a daemon thread (tests / latency-insensitive callers).
+        self.retune_inline = False
+        #: Keys whose staleness has already been acted on this
+        #: process (don't re-demote per call) / re-tunes in flight.
+        self._stale_handled: set = set()
+        self._retunes_inflight: set = set()
 
     def _device_key(self) -> str:
         d = jax.devices()[0]
@@ -157,7 +176,10 @@ class ContextualAutotuner:
                    if r in by_repr]
         if not ranking or rec.get("best") not in by_repr:
             return None
-        return _Entry(by_repr[rec["best"]], ranking[0][0], ranking)
+        # The persisted staleness marker (closed-loop invalidation)
+        # rides along so the demotion survives a process restart.
+        return _Entry(by_repr[rec["best"]], ranking[0][0], ranking,
+                      stale=rec.get("stale"))
 
     @staticmethod
     def _default_key(*args, **kwargs):
@@ -328,58 +350,228 @@ class ContextualAutotuner:
                 if reg is not None:
                     reg.counter("autotune_cache_hits_total",
                                 level="disk").inc()
+        if key in self.cache:
+            # Closed loop: a cache hit is only as good as the winner
+            # still performing — consult the anomaly baselines before
+            # trusting it (no-op without a bus / observability).
+            self._check_winner_health(key, args, kwargs)
         if key not in self.cache:
-            from triton_distributed_tpu.observability import span
-            t_tune0 = time.perf_counter()
-            results = []
-            for i, cfg in enumerate(self.configs):
-                try:
-                    # One runtime span per candidate trial: the tuning
-                    # wall time becomes attributable per-config on the
-                    # cross-rank timeline (a candidate that compiles
-                    # slowly on one rank shows up as that rank's span).
-                    with span("autotune.trial", op=self._fn_id(),
-                              config=repr(cfg), index=i):
-                        t = self._bench_one(cfg, args, kwargs)
-                    results.append((t, i))
-                    self._log(f"{key}: config[{i}]={cfg} -> {t*1e3:.3f} ms")
-                except Exception as e:  # config invalid on this hw
-                    self._log(f"{key}: config[{i}]={cfg} FAILED: {e}")
-            if not results:
-                raise RuntimeError(
-                    f"autotune: every config failed for key {key}")
-            results.sort()
-            best_idx = self._agree(results[0][1])
-            ranking = [(t, self.configs[i]) for t, i in results]
-            self.cache[key] = _Entry(self.configs[best_idx], results[0][0],
-                                     ranking)
-            logger.info("autotune %s: best=%s (%.3f ms)", key,
-                        self.configs[best_idx], results[0][0] * 1e3)
-            if reg is not None:
-                wall_s = time.perf_counter() - t_tune0
-                reg.counter("autotune_cache_misses_total").inc()
-                reg.histogram("autotune_tuning_seconds").observe(wall_s)
-                from triton_distributed_tpu.observability import (
-                    emit_kernel_event)
-                emit_kernel_event(
-                    # Plain function identity as the op (like every
-                    # other emitter): the device kind already rides in
-                    # the snapshot meta — a device-prefixed op would
-                    # explode label cardinality.
-                    self._fn_id(), kind="autotune",
-                    measured_us=results[0][0] * 1e6,
-                    config=repr(self.configs[best_idx]),
-                    tuning_wall_s=round(wall_s, 3),
-                    n_configs=len(self.configs),
-                    n_failed=len(self.configs) - len(results))
-            if self.cache_path:
-                self._disk[f"{self._device_key()}|{key}"] = {
-                    "best": repr(self.configs[best_idx]),
-                    "ranking": [[t, repr(c)] for t, c in ranking],
-                    "candidates": self._candidates_repr(),
-                }
-                self._save_disk()
+            self.cache[key] = self._tune_now(key, args, kwargs)
         return self._config_fn(self.cache[key].config)(*args, **kwargs)
+
+    def _tune_now(self, key, args, kwargs) -> _Entry:
+        """Benchmark every candidate and persist the winner (the
+        former __call__ miss path, shared with background re-tunes)."""
+        from triton_distributed_tpu.observability import span
+        reg = self._metrics()
+        t_tune0 = time.perf_counter()
+        results = []
+        for i, cfg in enumerate(self.configs):
+            try:
+                # One runtime span per candidate trial: the tuning
+                # wall time becomes attributable per-config on the
+                # cross-rank timeline (a candidate that compiles
+                # slowly on one rank shows up as that rank's span).
+                with span("autotune.trial", op=self._fn_id(),
+                          config=repr(cfg), index=i):
+                    t = self._bench_one(cfg, args, kwargs)
+                results.append((t, i))
+                self._log(f"{key}: config[{i}]={cfg} -> {t*1e3:.3f} ms")
+            except Exception as e:  # config invalid on this hw
+                self._log(f"{key}: config[{i}]={cfg} FAILED: {e}")
+        if not results:
+            raise RuntimeError(
+                f"autotune: every config failed for key {key}")
+        results.sort()
+        best_idx = self._agree(results[0][1])
+        ranking = [(t, self.configs[i]) for t, i in results]
+        entry = _Entry(self.configs[best_idx], results[0][0], ranking)
+        logger.info("autotune %s: best=%s (%.3f ms)", key,
+                    self.configs[best_idx], results[0][0] * 1e3)
+        if reg is not None:
+            wall_s = time.perf_counter() - t_tune0
+            reg.counter("autotune_cache_misses_total").inc()
+            reg.histogram("autotune_tuning_seconds").observe(wall_s)
+            from triton_distributed_tpu.observability import (
+                emit_kernel_event)
+            emit_kernel_event(
+                # Plain function identity as the op (like every
+                # other emitter): the device kind already rides in
+                # the snapshot meta — a device-prefixed op would
+                # explode label cardinality.
+                self._fn_id(), kind="autotune",
+                measured_us=results[0][0] * 1e6,
+                config=repr(self.configs[best_idx]),
+                tuning_wall_s=round(wall_s, 3),
+                n_configs=len(self.configs),
+                n_failed=len(self.configs) - len(results))
+        if self.cache_path:
+            # A fresh tune rewrites the disk entry WITHOUT any stale
+            # marker — re-tuning is how an invalidated key heals.
+            self._disk[f"{self._device_key()}|{key}"] = {
+                "best": repr(self.configs[best_idx]),
+                "ranking": [[t, repr(c)] for t, c in ranking],
+                "candidates": self._candidates_repr(),
+            }
+            self._save_disk()
+        return entry
+
+    # -- closed-loop staleness (observability.feedback) ------------------
+
+    def winner_baseline_key(self, config) -> str:
+        """The anomaly-baseline key runtime measurements of ``config``
+        roll into (see :meth:`observe_runtime`) and the staleness
+        check reads."""
+        from triton_distributed_tpu.observability.anomaly import (
+            event_key)
+        return event_key(f"autotune:{self._fn_id()}",
+                         method=repr(config),
+                         world=jax.device_count())
+
+    def _observe_store(self):
+        """The baseline store runtime observations roll into — the
+        SAME store the staleness check reads through the bus, so a
+        tuner wired to a private bus/store keeps a coherent loop
+        (writing to the global store while reading a private one
+        would leave invalidation silently inert)."""
+        from triton_distributed_tpu.observability import feedback
+        bus = self.bus if self.bus is not None else (
+            feedback.ambient_bus())
+        if bus is not None:
+            store = bus.read().store
+            if store is not None:
+                return store
+        from triton_distributed_tpu.observability.anomaly import (
+            get_baseline_store)
+        return get_baseline_store()
+
+    def observe_runtime(self, key, us: float):
+        """Roll one measured runtime of the cached winner for ``key``
+        into its rolling baseline — the feed the staleness check
+        consumes.  Callers with a host-side latency for the tuned op
+        (serving loops, bench drivers) call this; returns the z-score
+        (None while warming) like ``BaselineStore.observe``."""
+        entry = self.cache.get(key)
+        if entry is None:
+            return None
+        return self._observe_store().observe(
+            self.winner_baseline_key(entry.config), float(us))
+
+    def _check_winner_health(self, key, args, kwargs) -> None:
+        """On a cache hit: demote a winner whose live latency is
+        SUSTAINED multi-sigma slow (or whose disk entry carries a
+        persisted stale marker) to the second-best config, and
+        schedule a background re-tune.  Exactly a no-op when
+        observability is off or no bus (explicit or ambient) exists —
+        the degradation contract is today's static behavior."""
+        from triton_distributed_tpu.observability.metrics import (
+            observability_enabled)
+        if not observability_enabled() or key in self._stale_handled:
+            return
+        from triton_distributed_tpu.observability import feedback
+        bus = self.bus if self.bus is not None else (
+            feedback.ambient_bus())
+        if bus is None:
+            return
+        entry = self.cache[key]
+        from triton_distributed_tpu.observability.anomaly import (
+            SUSTAINED_N, Z_THRESHOLD)
+        stale = entry.stale          # persisted marker from disk
+        if stale is None:
+            z = bus.read().sustained_z(
+                self.winner_baseline_key(entry.config))
+            if z is None or z < Z_THRESHOLD:
+                return
+            stale = {"z": round(float(z), 2), "ts": round(time.time(), 3),
+                     "sustained_n": SUSTAINED_N}
+        self._stale_handled.add(key)
+        self._invalidate(key, entry, stale, args, kwargs)
+
+    def _invalidate(self, key, entry: _Entry, stale: dict,
+                    args, kwargs) -> None:
+        from triton_distributed_tpu.observability import feedback
+        fallback_reason = None
+        choice = entry.config
+        if len(entry.ranking) > 1:
+            t2, choice = entry.ranking[1]
+            self.cache[key] = _Entry(choice, t2, entry.ranking,
+                                     stale=stale)
+        else:
+            # Nothing to fall back to: keep the winner, but say so.
+            fallback_reason = "no_second_best"
+            self.cache[key] = dataclasses.replace(entry, stale=stale)
+        # Persist the marker beside the disk entry so the demotion
+        # survives a process restart (the re-tune clears it).
+        dkey = f"{self._device_key()}|{key}"
+        if self.cache_path and dkey in self._disk:
+            self._disk[dkey]["stale"] = stale
+            self._save_disk()
+        reg = self._metrics()
+        if reg is not None:
+            reg.counter("autotune_invalidations_total").inc()
+        self._log(f"{key}: winner {entry.config} marked stale "
+                  f"(z={stale.get('z')}), using {choice}")
+        feedback.record_decision(feedback.DecisionEvent(
+            consumer="autotune.invalidate", op=self._fn_id(),
+            choice=repr(choice),
+            candidates=[{"name": repr(c),
+                         "score_us": round(t * 1e6, 3)}
+                        for t, c in entry.ranking[:6]]
+            or [{"name": repr(entry.config)}],
+            inputs={"stale": stale,
+                    "baseline_key": self.winner_baseline_key(
+                        entry.config)},
+            fallback=fallback_reason))
+        self._schedule_retune(key, args, kwargs)
+
+    def _schedule_retune(self, key, args, kwargs) -> None:
+        """Background re-tune of an invalidated key.  Single-process
+        only — the distributed winner agreement is a collective and
+        must not run off the main control flow — and never under
+        ``TDT_OBSERVABILITY=0`` (the caller already gates on it)."""
+        from triton_distributed_tpu.observability import feedback
+        if jax.process_count() > 1:
+            feedback.record_decision(feedback.DecisionEvent(
+                consumer="autotune.retune", op=self._fn_id(),
+                choice="skipped", inputs={"key": str(key)},
+                fallback="multiprocess"))
+            return
+        if key in self._retunes_inflight:
+            return
+        self._retunes_inflight.add(key)
+        if self.retune_inline:
+            self._retune(key, args, kwargs)
+            return
+        import threading
+        threading.Thread(target=self._retune,
+                         args=(key, args, kwargs),
+                         name="tdt-autotune-retune",
+                         daemon=True).start()
+
+    def _retune(self, key, args, kwargs) -> None:
+        from triton_distributed_tpu.observability import feedback
+        try:
+            entry = self._tune_now(key, args, kwargs)
+            self.cache[key] = entry
+            self._stale_handled.discard(key)
+            feedback.record_decision(feedback.DecisionEvent(
+                consumer="autotune.retune", op=self._fn_id(),
+                choice=repr(entry.config),
+                candidates=[{"name": repr(c),
+                             "score_us": round(t * 1e6, 3)}
+                            for t, c in entry.ranking[:6]],
+                inputs={"trigger": "staleness", "key": str(key)}))
+        except Exception as e:
+            # A failed background re-tune leaves the second-best
+            # fallback in place — never crash the serving thread.
+            self._log(f"{key}: background re-tune failed: {e}")
+            feedback.record_decision(feedback.DecisionEvent(
+                consumer="autotune.retune", op=self._fn_id(),
+                choice="failed", inputs={"key": str(key),
+                                         "error": str(e)},
+                fallback=type(e).__name__))
+        finally:
+            self._retunes_inflight.discard(key)
 
 
 DEFAULT_CACHE = ".autotune_cache.json"

@@ -71,17 +71,23 @@ class AllGatherContext:
     straggler: Optional[tuple] = None
     for_correctness: bool = False
 
-    def resolve_method(self, nbytes_per_shard: int) -> AllGatherMethod:
+    def resolve_method(self, nbytes_per_shard: int,
+                       bus=None) -> AllGatherMethod:
         """Auto-select like `get_auto_all_gather_method`
         (`allgather.py:57-72`), driven by the analytic ICI perf model
         rather than a fixed byte cutoff: one-shot push wins while
         latency-bound, the ring wins once its single-hop transfers
-        beat the push's multi-hop link contention."""
+        beat the push's multi-hop link contention.  ``bus``: optional
+        feedback bus (`observability.feedback`) whose live link heat
+        shifts the crossover; absent/empty/stale ⇒ the static choice,
+        bit-identically."""
         if self.method != AllGatherMethod.AUTO:
             return self.method
         from triton_distributed_tpu.kernels.comm_perf_model import (
             one_shot_beats_ring)
-        if one_shot_beats_ring(nbytes_per_shard, self.world_size):
+        if one_shot_beats_ring(nbytes_per_shard, self.world_size,
+                               axis=self.axis, bus=bus,
+                               op="all_gather"):
             return AllGatherMethod.PUSH_ALL
         return AllGatherMethod.RING
 

@@ -89,10 +89,14 @@ class AllGatherGEMMContext:
     LL_MAX_GATHERED_ROWS = 256
 
     def resolve_method(self, m: int, dtype, k: Optional[int] = None,
-                       n: Optional[int] = None) -> str:
+                       n: Optional[int] = None, bus=None) -> str:
         """Pick xla / ll / fused.  With K and N known, the choice is
         model-driven with hysteresis (`choose_ll_or_fused`); otherwise
-        the shape-only decode threshold decides."""
+        the shape-only decode threshold decides.  ``bus``: optional
+        feedback bus (`observability.feedback`) whose live link heat
+        shifts the crossover — under contention from a concurrent
+        collective on the axis the overlap-friendly schedule wins
+        earlier; absent/empty/stale ⇒ the static choice."""
         assert self.method in ("auto", "fused", "ll", "xla"), self.method
         if self.method != "auto":
             return self.method
@@ -106,7 +110,9 @@ class AllGatherGEMMContext:
         from triton_distributed_tpu.kernels.comm_perf_model import (
             choose_ll_or_fused)
         return choose_ll_or_fused(mp * k * jnp.dtype(dtype).itemsize,
-                                  mp, n, k, world, dtype)
+                                  mp, n, k, world, dtype,
+                                  axis=self.axis, bus=bus,
+                                  op="ag_gemm")
 
 
 def create_ag_gemm_context(axis: str, world_size: int, **kw) -> AllGatherGEMMContext:

@@ -14,6 +14,7 @@ probes (SURVEY.md §2.3).
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import jax
 
@@ -179,9 +180,56 @@ def estimate_torus_ag_time_us(nbytes_per_shard: int, sizes,
             + hops * spec.latency_us)
 
 
+def _consult_bus(bus):
+    """Resolve the feedback bus a chooser should act on.
+
+    Returns ``(signals, fallback, record)``: ``signals`` is a fresh
+    snapshot carrying link heat (None otherwise — the STATIC path),
+    ``fallback`` the truthful reason signals were unusable, ``record``
+    whether a DecisionEvent should be emitted.  An explicitly-passed
+    bus always records (even its fallbacks — that IS the
+    explainability contract); the ambient bus records only when live
+    signals actually influenced the choice, so bus-less programs keep
+    today's exact event streams."""
+    explicit = bus is not None
+    if bus is None:
+        from triton_distributed_tpu.observability import feedback
+        bus = feedback.ambient_bus()
+        if bus is None:
+            return None, None, False
+    sig = bus.read()
+    if not (sig.link_utilization or sig.contended_links):
+        return None, "signals_absent", explicit
+    if not sig.fresh(bus.clock(), bus.staleness_s):
+        return None, "signals_stale", explicit
+    return sig, None, True
+
+
+def _record_method_decision(op, choice, candidates, sig, fallback,
+                            axes=None):
+    from triton_distributed_tpu.observability import feedback
+    inputs = sig.to_inputs(axes=axes) if sig is not None else {}
+    feedback.record_decision(feedback.DecisionEvent(
+        consumer="comm.method_select", op=op, choice=choice,
+        candidates=[{"name": name, "score_us": round(t, 3)}
+                    for name, t in candidates],
+        inputs=inputs, fallback=fallback))
+
+
+def _derated(spec: IciSpec, busy: float):
+    """Residual-bandwidth spec under background load ``busy`` — the
+    identical object when there is nothing to derate, so the
+    empty-bus path cannot perturb a single bit."""
+    from triton_distributed_tpu.observability.feedback import (
+        effective_spec)
+    return effective_spec(spec, busy)
+
+
 def torus_beats_single_axis(nbytes_per_shard: int, sizes,
                             spec: IciSpec = None,
-                            margin: float = 0.7) -> bool:
+                            margin: float = 0.7, *,
+                            axes=None, bus=None,
+                            op: str = "all_gather_torus") -> bool:
     """Crossover for the multi-axis torus schedule vs the best
     single-axis method over the flattened world: the torus wins on
     bandwidth (~nd× a bidir ring) once payloads amortize its extra
@@ -190,16 +238,42 @@ def torus_beats_single_axis(nbytes_per_shard: int, sizes,
     `choose_ll_or_fused`: the torus kernel's un-modeled fixed costs
     (per-axis entry barrier, 2·nd× strided-DMA issue) mean a marginal
     modeled win is not a real one, so the simple path is kept unless
-    the win is decisive."""
+    the win is decisive.
+
+    Closed loop (``bus``/ambient — see `observability.feedback`): a
+    single-axis schedule serializes all traffic through the busiest
+    lane, so it sees the WORST background utilization over ``axes``;
+    the 2·nd-lane torus spreads over every axis and sees the MEAN —
+    live contention on one axis (a concurrent decode allreduce)
+    therefore shifts the crossover toward the schedule that avoids
+    the hot links.  Empty/stale signals keep the static choice
+    bit-identically."""
     sizes = tuple(int(s) for s in sizes)
     world = 1
     for s in sizes:
         world *= s
-    t_torus = estimate_torus_ag_time_us(nbytes_per_shard, sizes, spec)
+    sig, fallback, record = _consult_bus(bus)
+    spec_t = spec_1 = spec
+    if sig is not None:
+        names = list(axes) if axes else [None]
+        spec0 = spec or get_ici_spec()
+        u_single = max(sig.busy_fraction(a) for a in names)
+        u_torus = (sig.mean_busy_fraction(names) if axes
+                   else u_single)
+        spec_t = _derated(spec0, u_torus)
+        spec_1 = _derated(spec0, u_single)
+    t_torus = estimate_torus_ag_time_us(nbytes_per_shard, sizes,
+                                        spec_t)
     t_1axis = min(
-        estimate_all_gather_time_us(nbytes_per_shard, world, spec),
-        estimate_one_shot_time_us(nbytes_per_shard, world, spec))
-    return t_torus < margin * t_1axis
+        estimate_all_gather_time_us(nbytes_per_shard, world, spec_1),
+        estimate_one_shot_time_us(nbytes_per_shard, world, spec_1))
+    wins = t_torus < margin * t_1axis
+    if record:
+        _record_method_decision(
+            op, "torus" if wins else "single_axis",
+            [("torus", t_torus), ("single_axis", t_1axis)],
+            sig, fallback, axes=axes)
+    return wins
 
 
 def estimate_two_shot_time_us(nbytes: int, world: int,
@@ -212,16 +286,39 @@ def estimate_two_shot_time_us(nbytes: int, world: int,
 
 
 def one_shot_beats_ring(nbytes: int, world: int,
-                        spec: IciSpec = None) -> bool:
+                        spec: IciSpec = None, *,
+                        axis: Optional[str] = None, bus=None,
+                        op: str = "collective") -> bool:
     """Shared crossover decision for AG/RS method auto-selection, so
-    all collectives agree on the same perf-model comparison."""
-    return (estimate_one_shot_time_us(nbytes, world, spec)
-            <= estimate_all_gather_time_us(nbytes, world, spec))
+    all collectives agree on the same perf-model comparison.
+
+    Closed loop: background utilization on the axis' links derates
+    the residual bandwidth both methods see — one-shot's busiest link
+    carries ~world²/8 payload transits vs the ring's exactly one, so
+    under live contention its bandwidth term inflates ~world²/8×
+    faster and the crossover shifts toward the ring earlier.
+    Empty/stale signals keep the static choice bit-identically."""
+    sig, fallback, record = _consult_bus(bus)
+    spec_eff = spec
+    if sig is not None:
+        spec_eff = _derated(spec or get_ici_spec(),
+                            sig.busy_fraction(axis))
+    t_one = estimate_one_shot_time_us(nbytes, world, spec_eff)
+    t_ring = estimate_all_gather_time_us(nbytes, world, spec_eff)
+    wins = t_one <= t_ring
+    if record:
+        _record_method_decision(
+            op, "one_shot" if wins else "ring",
+            [("one_shot", t_one), ("ring", t_ring)], sig, fallback,
+            axes=[axis] if axis else None)
+    return wins
 
 
 def choose_ll_or_fused(chunk_bytes: int, m_rows: int, n: int, k: int,
                        world: int, dtype,
-                       margin: float = 0.7) -> str:
+                       margin: float = 0.7, *,
+                       axis: Optional[str] = None, bus=None,
+                       op: str = "ag_gemm") -> str:
     """Shared fused-ring vs one-shot-ll chooser for the overlap GEMMs
     (ag_gemm / gemm_rs): the ring wins when each chunk's matmul hides
     its DMA; ll wins when the GEMM is B-streaming-bound (a per-chunk
@@ -232,14 +329,33 @@ def choose_ll_or_fused(chunk_bytes: int, m_rows: int, n: int, k: int,
     abandoned when the analytic model predicts a DECISIVE ll win
     (t_ll < margin * t_fused) — published-peak tables with a fixed
     efficiency derate cannot be trusted to call a 1% margin.
+
+    Closed loop: background utilization on the axis derates the comm
+    terms only (the MXU is not the contended resource).  The fused
+    ring hides its per-step DMA under the chunk matmul until the
+    derated comm outgrows it, while ll's one-shot comm is serial and
+    ~world²/8 link-transits heavy — so live contention (e.g. a decode
+    allreduce sharing the axis) pushes the choice toward the fused
+    schedule that keeps overlapping.  Empty/stale signals keep the
+    static choice bit-identically.
     """
     from triton_distributed_tpu.kernels.gemm_perf_model import (
         estimate_gemm_time_us)
 
-    step_comm = (estimate_all_gather_time_us(chunk_bytes, world)
+    sig, fallback, record = _consult_bus(bus)
+    spec_eff = None
+    if sig is not None:
+        spec_eff = _derated(get_ici_spec(), sig.busy_fraction(axis))
+    step_comm = (estimate_all_gather_time_us(chunk_bytes, world,
+                                             spec_eff)
                  / max(world - 1, 1))
     t_fused = world * max(
         estimate_gemm_time_us(m_rows, n, k, dtype), step_comm)
-    t_ll = (estimate_one_shot_time_us(chunk_bytes, world)
+    t_ll = (estimate_one_shot_time_us(chunk_bytes, world, spec_eff)
             + estimate_gemm_time_us(world * m_rows, n, k, dtype))
-    return "ll" if t_ll < margin * t_fused else "fused"
+    choice = "ll" if t_ll < margin * t_fused else "fused"
+    if record:
+        _record_method_decision(
+            op, choice, [("ll", t_ll), ("fused", t_fused)], sig,
+            fallback, axes=[axis] if axis else None)
+    return choice

@@ -69,10 +69,12 @@ class GEMMReduceScatterContext:
     LL_MAX_ROWS = 256
 
     def resolve_method(self, mc: int, dtype, k: Optional[int] = None,
-                       n: Optional[int] = None) -> str:
+                       n: Optional[int] = None, bus=None) -> str:
         """Model-driven fused/ll choice when K/N are known (shared
         `choose_ll_or_fused` with hysteresis); shape-only decode
-        threshold otherwise."""
+        threshold otherwise.  ``bus``: optional feedback bus whose
+        live link heat shifts the crossover; absent/empty/stale ⇒
+        the static choice."""
         assert self.method in ("auto", "fused", "ll", "xla"), self.method
         if self.method != "auto":
             return self.method
@@ -85,7 +87,9 @@ class GEMMReduceScatterContext:
         from triton_distributed_tpu.kernels.comm_perf_model import (
             choose_ll_or_fused)
         return choose_ll_or_fused(mcp * n * jnp.dtype(dtype).itemsize,
-                                  mcp, n, k, world, dtype)
+                                  mcp, n, k, world, dtype,
+                                  axis=self.axis, bus=bus,
+                                  op="gemm_rs")
 
 
 def create_gemm_rs_context(axis: str, world_size: int, **kw):

@@ -65,15 +65,20 @@ class ReduceScatterContext:
     straggler: Optional[tuple] = None
     for_correctness: bool = False
 
-    def resolve_method(self, nbytes_per_chunk: int) -> ReduceScatterMethod:
+    def resolve_method(self, nbytes_per_chunk: int,
+                       bus=None) -> ReduceScatterMethod:
         if self.method != ReduceScatterMethod.AUTO:
             return self.method
         # Perf-model-driven: one-shot wins until chunks are large
         # enough that world-1 parallel long-haul puts congest the
-        # torus links (see estimate_one_shot_time_us).
+        # torus links (see estimate_one_shot_time_us).  ``bus``:
+        # optional feedback bus whose live link heat shifts the
+        # crossover; absent/empty/stale ⇒ the static choice.
         from triton_distributed_tpu.kernels.comm_perf_model import (
             one_shot_beats_ring)
-        if one_shot_beats_ring(nbytes_per_chunk, self.world_size):
+        if one_shot_beats_ring(nbytes_per_chunk, self.world_size,
+                               axis=self.axis, bus=bus,
+                               op="reduce_scatter"):
             return ReduceScatterMethod.SCATTER_REDUCE
         return ReduceScatterMethod.RING
 

@@ -120,20 +120,23 @@ class TorusContext:
         pairs = [(a, s) for a, s in zip(self.axes, self.sizes) if s > 1]
         return (tuple(a for a, _ in pairs), tuple(s for _, s in pairs))
 
-    def resolve_method(self, nbytes_per_shard: int) -> str:
+    def resolve_method(self, nbytes_per_shard: int, bus=None) -> str:
         """Perf-model crossover: the multi-lane torus schedule wins on
         bandwidth (~nd× a bidir single-axis ring) but pays nd
         serialized ring phases of latency; below the crossover fall
-        back to the XLA collective over all axes."""
+        back to the XLA collective over all axes.  ``bus``: optional
+        feedback bus — live contention on one axis favors the lane
+        schedule that spreads over the others; absent/empty/stale ⇒
+        the static choice."""
         if self.method != "auto":
             return self.method
-        _, sizes = self.active()
+        axes, sizes = self.active()
         if len(sizes) <= 1:
             return "torus"   # degenerates to the single-axis auto path
         from triton_distributed_tpu.kernels.comm_perf_model import (
             torus_beats_single_axis)
         return ("torus" if torus_beats_single_axis(
-            nbytes_per_shard, sizes) else "xla")
+            nbytes_per_shard, sizes, axes=axes, bus=bus) else "xla")
 
 
 def create_torus_context(axes, sizes, **kw) -> TorusContext:

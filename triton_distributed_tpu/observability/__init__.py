@@ -26,6 +26,21 @@ from triton_distributed_tpu.observability.links import (  # noqa: F401
     links_for_event,
     links_global,
 )
+from triton_distributed_tpu.observability.feedback import (  # noqa: F401
+    DecisionEvent,
+    SignalBus,
+    Signals,
+    ambient_bus,
+    closed_loop_enabled,
+    get_signal_bus,
+    load_decisions,
+    recent_decision_summaries,
+    recent_decisions,
+    record_decision,
+    set_decision_log,
+    synthetic_bus,
+    validate_decision,
+)
 from triton_distributed_tpu.observability.audit import (  # noqa: F401
     AuditRow,
     audit_events,

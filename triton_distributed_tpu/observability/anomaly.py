@@ -32,6 +32,7 @@ nothing here is constructed (callers bail out before reaching us).
 from __future__ import annotations
 
 import atexit
+import collections
 import json
 import math
 import os
@@ -51,6 +52,13 @@ Z_THRESHOLD = 3.0
 MIN_SAMPLES = 5
 #: Welford → EWMA switchover.
 WINDOW = 64
+#: Consecutive observations at/above threshold that count as a
+#: SUSTAINED anomaly (one slow occurrence is jitter; N in a row is a
+#: drifted winner the closed loop may act on — see
+#: :meth:`BaselineStore.sustained_z`).
+SUSTAINED_N = 3
+#: Per-key recent-z history depth.
+RECENT_Z_KEEP = 8
 
 BASELINE_SCHEMA = 1
 
@@ -161,18 +169,46 @@ class BaselineStore:
         self.path = path
         self._lock = threading.RLock()
         self._baselines: Dict[str, Baseline] = {}
+        self._recent_z: Dict[str, "collections.deque"] = {}
         self._loaded = False
+        self._warned_corrupt = False
 
     # -- persistence ----------------------------------------------------
 
     def _read_file(self) -> Dict[str, Baseline]:
+        """Best-effort parse of the on-disk store.  A concurrently
+        truncated / torn file (a multi-rank save race, a rank killed
+        mid-write before `os.replace` landed) warns ONCE and starts
+        fresh — it must never crash a rank, least of all at the
+        atexit flush.  Individually-malformed rows are dropped, the
+        rest kept."""
         try:
             with open(self.path) as f:
-                raw = json.load(f)
-            return {k: Baseline.from_list(v)
-                    for k, v in raw.get("baselines", {}).items()}
-        except Exception:
+                text = f.read()
+        except OSError:
+            return {}          # absent / unreadable: fresh store
+        if not text.strip():
+            return {}          # truncated-to-empty: fresh store
+        try:
+            raw = json.loads(text)
+            rows = raw.get("baselines", {})
+            if not isinstance(rows, dict):
+                raise ValueError("baselines not a dict")
+        except Exception as e:
+            if not self._warned_corrupt:
+                self._warned_corrupt = True
+                from triton_distributed_tpu.utils.debug import logger
+                logger.warning(
+                    "anomaly baselines %s unreadable (%s: %s) — "
+                    "starting fresh", self.path, type(e).__name__, e)
             return {}
+        out: Dict[str, Baseline] = {}
+        for k, v in rows.items():
+            try:
+                out[k] = Baseline.from_list(v)
+            except (TypeError, ValueError):
+                continue       # one bad row must not drop the rest
+        return out
 
     def load(self) -> "BaselineStore":
         with self._lock:
@@ -203,7 +239,10 @@ class BaselineStore:
                     json.dump(payload, f, indent=1)
                 os.replace(tmp, self.path)
             return self.path
-        except OSError:
+        except Exception:
+            # Disk trouble — or a hostilely-corrupted store the merge
+            # tripped over — must never break a bench or a rank's
+            # atexit flush.
             return None
 
     # -- observation ----------------------------------------------------
@@ -227,7 +266,33 @@ class BaselineStore:
                 b = self._baselines[key] = Baseline()
             z = b.zscore(float(us))
             b.update(float(us))
+            if z is not None:
+                hist = self._recent_z.get(key)
+                if hist is None:
+                    hist = self._recent_z[key] = collections.deque(
+                        maxlen=RECENT_Z_KEEP)
+                hist.append(float(z))
             return z
+
+    def recent_z(self, key: str) -> List[float]:
+        """The last few z-scores observed for ``key`` (empty while
+        the baseline warms — pre-MIN_SAMPLES observations have no z)."""
+        with self._lock:
+            return list(self._recent_z.get(key, ()))
+
+    def sustained_z(self, key: str, n: Optional[int] = None
+                    ) -> Optional[float]:
+        """The SMALLEST of the last ``n`` z-scores when at least ``n``
+        exist — so ``sustained_z(k) >= Z_THRESHOLD`` means the last
+        ``n`` consecutive observations were ALL at least that
+        anomalous (the closed loop's invalidation signal), while one
+        slow outlier among normal readings stays None-or-low."""
+        n = SUSTAINED_N if n is None else int(n)
+        with self._lock:
+            hist = self._recent_z.get(key)
+            if hist is None or len(hist) < n:
+                return None
+            return min(list(hist)[-n:])
 
     def keys(self) -> List[str]:
         with self._lock:

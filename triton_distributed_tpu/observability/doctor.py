@@ -124,6 +124,7 @@ class Artifacts:
         self.metrics: Dict[int, dict] = {}
         self.static_findings: Optional[dict] = None
         self.resource_findings: Optional[dict] = None
+        self.decisions: List[dict] = []
         self._discover()
 
     def _glob(self, pattern: str) -> List[str]:
@@ -166,6 +167,11 @@ class Artifacts:
             if d is not None:
                 self.resource_findings = d
                 break
+        decision_files = self._glob("decisions*.jsonl")
+        if decision_files:
+            from triton_distributed_tpu.observability.feedback import (
+                load_decisions)
+            self.decisions = load_decisions(decision_files)
 
     def empty(self) -> bool:
         return not (self.traces or self.flights or self.heartbeats
@@ -455,6 +461,72 @@ def run_resource_analysis(art: Artifacts, stall: dict,
     return out
 
 
+def analyze_decisions(art: Artifacts, now: float) -> Optional[dict]:
+    """Replay the closed loop's control decisions into the report
+    (`observability.feedback`): the ``decisions-rank-*.jsonl``
+    artifact when present, else the last-N summaries the heartbeats
+    carried (a hung rank's beats are often the only surviving control
+    state).  None — and thus NO report key, keeping pre-feedback
+    golden reports byte-identical — when neither exists."""
+    rows = list(art.decisions)
+    source = "artifact"
+    if not rows:
+        for rank, hb in sorted(art.heartbeats.items()):
+            for s in hb.get("decisions") or []:
+                d = dict(s)
+                d.setdefault("rank", rank)
+                rows.append(d)
+        rows.sort(key=lambda d: (float(d.get("ts", 0.0)),
+                                 int(d.get("rank", 0))))
+        source = "heartbeats"
+    if not rows:
+        return None
+    by_consumer: Dict[str, int] = {}
+    fallbacks = 0
+    for d in rows:
+        c = str(d.get("consumer", "?"))
+        by_consumer[c] = by_consumer.get(c, 0) + 1
+        if d.get("fallback"):
+            fallbacks += 1
+    recent = [{
+        "age_s": round(now - float(d.get("ts", 0.0)), 3),
+        "rank": int(d.get("rank", 0)),
+        "consumer": d.get("consumer"),
+        "op": d.get("op"),
+        "choice": d.get("choice"),
+        "why": (d.get("fallback")
+                or _decision_why(d.get("inputs") or {})),
+    } for d in rows[-10:]]
+    return {"source": source, "count": len(rows),
+            "fallbacks": fallbacks,
+            "by_consumer": dict(sorted(by_consumer.items())),
+            "recent": recent}
+
+
+def _decision_why(inputs: dict) -> Optional[str]:
+    """One compact clause from a decision's inputs snapshot."""
+    parts = []
+    if inputs.get("predicted_step_ms") is not None:
+        s = f"predicted step {inputs['predicted_step_ms']}ms"
+        if inputs.get("slo_tbt_ms") is not None:
+            s += f" vs SLO {inputs['slo_tbt_ms']}ms"
+        parts.append(s)
+    if inputs.get("cleared_by"):
+        parts.append(f"cleared by {inputs['cleared_by']}")
+    stale = inputs.get("stale")
+    if isinstance(stale, dict) and stale.get("z") is not None:
+        parts.append(f"winner z={stale['z']}")
+    if inputs.get("contended_links"):
+        parts.append("contended "
+                     + ",".join(inputs["contended_links"][:3]))
+    elif inputs.get("axis_busy"):
+        busy = {a: u for a, u in inputs["axis_busy"].items() if u}
+        if busy:
+            parts.append("busy " + ",".join(
+                f"{a}={u}" for a, u in sorted(busy.items())))
+    return "; ".join(parts) or None
+
+
 def analyze_links(art: Artifacts) -> dict:
     from triton_distributed_tpu.observability import links as _links
     from triton_distributed_tpu.observability.events import KernelEvent
@@ -597,6 +669,11 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
     # file) — golden incident reports stay byte-identical.
     if resource_out is not None:
         report["resources"] = resource_out
+    # Control decisions: key absent when no decisions artifact (and
+    # no heartbeat-carried summaries) exist — same golden discipline.
+    decision_out = analyze_decisions(art, now)
+    if decision_out is not None:
+        report["decisions"] = decision_out
     report["verdict"] = _verdict(report, in_flight)
     return report
 
@@ -755,6 +832,22 @@ def render_markdown(report: dict) -> str:
                          f"{f.get('message')}")
         if resource_out.get("verdict"):
             lines.append(f"- **{resource_out['verdict']}**")
+        lines.append("")
+
+    dec = report.get("decisions")
+    if dec:
+        lines += ["## Control decisions", "",
+                  f"{dec['count']} decision(s) "
+                  f"({dec['source']}; {dec['fallbacks']} static "
+                  "fallback(s)): "
+                  + ", ".join(f"{c}×{n}" for c, n in
+                              dec["by_consumer"].items()) + ".", "",
+                  "| age (s) | rank | consumer | op | choice | why |",
+                  "|---|---|---|---|---|---|"]
+        for d in dec["recent"]:
+            lines.append(
+                f"| {d['age_s']} | {d['rank']} | {d['consumer']} "
+                f"| {d['op']} | {d['choice']} | {d['why'] or '-'} |")
         lines.append("")
 
     hot = report["links"].get("hot") or []

@@ -144,6 +144,9 @@ class MetricsServer:
                 elif self.path.startswith("/links"):
                     body = json.dumps(link_table(reg)).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/decisions"):
+                    body = json.dumps(decision_table()).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
@@ -229,6 +232,16 @@ def link_table(registry: Optional[MetricsRegistry] = None) -> dict:
             "links": dict(sorted(links.items()))}
 
 
+def decision_table(n: int = 50) -> dict:
+    """JSON view of the most recent control decisions (the closed
+    loop's DecisionEvents, `observability.feedback`) — the
+    ``/decisions`` endpoint next to ``/links``."""
+    from triton_distributed_tpu.observability.feedback import (
+        recent_decisions)
+    return {"schema": 1, "rank": _process_index(),
+            "decisions": [e.to_dict() for e in recent_decisions(n)]}
+
+
 # ---------------------------------------------------------------------------
 # Heartbeat files
 # ---------------------------------------------------------------------------
@@ -238,6 +251,9 @@ def link_table(registry: Optional[MetricsRegistry] = None) -> dict:
 #: stopped (doctor folds these into its rank table).  The paged-KV
 #: gauges ride along so doctor can call out page pressure (a rank
 #: thrashing on preemption/eviction) in incident reports.
+#: How many recent decision summaries a heartbeat carries.
+_HEARTBEAT_DECISIONS = 5
+
 _HEARTBEAT_GAUGES = ("serving_queue_depth", "serving_active_slots",
                      "serving_slot_occupancy",
                      "serving_kv_bytes_in_use",
@@ -267,6 +283,15 @@ def heartbeat_payload() -> dict:
                if (v := reg.peek(name)) is not None}
     if serving:
         payload["serving"] = serving
+    # Last few control decisions ride along (key absent when the
+    # closed loop never fired — pre-feedback heartbeat bodies are
+    # byte-identical): a hung rank's final beat then says what the
+    # loop last decided, not just what was running.
+    from triton_distributed_tpu.observability.feedback import (
+        recent_decision_summaries)
+    decisions = recent_decision_summaries(_HEARTBEAT_DECISIONS)
+    if decisions:
+        payload["decisions"] = decisions
     return payload
 
 

@@ -1,0 +1,526 @@
+"""Closed-loop feedback: the decision bus that turns the passive
+observatory (PRs 1/2/5) into control signals, and the DecisionEvent
+record that makes every resulting control decision explainable.
+
+Two halves:
+
+- :class:`SignalBus` snapshots the live control inputs behind one
+  cheap ``read()``: per-ICI-link utilization and recent contention
+  (:mod:`.links`), rolling anomaly baselines — z-scores, sustained-z,
+  predicted latencies — (:mod:`.anomaly`), and the serving gauges
+  (queue depth, page occupancy) from the metrics registry.  Every
+  snapshot carries its build timestamp and a **staleness bound**:
+  consumers treat a snapshot older than :data:`STALENESS_S` (or one
+  with no signals at all) exactly like no bus — the degradation
+  contract is *bit-identical static behavior*.
+- :class:`DecisionEvent` (schema v1) records what a consumer decided
+  and why: the inputs snapshot it acted on, every candidate it scored,
+  the choice, and — when it fell back to static behavior — the
+  truthful reason.  :func:`record_decision` lands each event in the
+  metrics registry (``decisions_total``), the flight-recorder ring
+  (as a ``kind="decision"`` KernelEvent, so dumps and the doctor see
+  control state), a bounded in-memory ring (the exporter's
+  ``/decisions`` endpoint and the heartbeat body read it), and — when
+  a log is armed — a ``decisions-rank-<N>.jsonl`` artifact the doctor
+  replays into its "Control decisions" section.
+
+Consumers (each degrades to today's exact static behavior when the
+bus is absent, empty, or stale):
+
+- ``kernels/comm_perf_model.py`` method selection penalizes estimates
+  on links the bus reports busy/contended;
+- ``autotuner.py`` invalidates a cached winner whose anomaly z-score
+  is sustained past threshold, falls back to the second-best config
+  and schedules a background re-tune;
+- ``serving/scheduler.py`` defers admits whose predicted step time
+  would blow the TBT SLO.
+
+Arming: the **ambient** bus (what consumers consult when no bus is
+passed explicitly) is opt-in via ``TDT_CLOSED_LOOP=1`` — a bench or
+test that never asks for the closed loop runs byte-identical to the
+pre-feedback tree.  An explicitly-passed bus is always honored.
+``TDT_OBSERVABILITY=0`` disables everything here unconditionally.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from triton_distributed_tpu.observability.metrics import (
+    observability_enabled,
+)
+
+DECISION_SCHEMA = 1
+
+#: Ambient-bus opt-in (explicitly-passed buses ignore this).
+ENV_CLOSED_LOOP = "TDT_CLOSED_LOOP"
+#: Directory for the per-rank ``decisions-rank-<N>.jsonl`` artifact
+#: (``scripts/launch.py --trace-dir`` could export it like the
+#: heartbeat dir; tests/smokes set it directly).
+ENV_DECISIONS_DIR = "TDT_DECISIONS_DIR"
+
+#: A bus snapshot older than this is STALE: consumers must behave as
+#: if no bus existed (and say so in the DecisionEvent fallback).
+STALENESS_S = 10.0
+#: Snapshot rebuild throttle: ``read()`` within this window returns
+#: the cached snapshot (the choosers run at trace time — they must
+#: not pay a registry walk per call).
+REFRESH_S = 0.25
+#: Utilization is capped here before bandwidth derating: a saturated
+#: link slows a method, it does not make it infinitely slow.
+UTILIZATION_CAP = 0.9
+#: A link with a contention record but no measured utilization is
+#: treated as at least this busy.
+CONTENDED_FLOOR = 0.5
+
+#: Fields every DecisionEvent JSON line must carry (doctor/CI schema
+#: validation).
+DECISION_FIELDS = ("schema", "ts", "rank", "consumer", "op", "choice",
+                   "candidates", "inputs")
+
+#: Recent-decision ring size (exporter /decisions + heartbeats).
+RECENT_DECISIONS = 256
+
+
+def closed_loop_enabled() -> bool:
+    """Is the ambient bus armed?  Opt-in (default OFF) so every
+    existing static path stays byte-identical unless a deployment —
+    or a test — asks for the loop."""
+    if not observability_enabled():
+        return False
+    return os.environ.get(ENV_CLOSED_LOOP, "0").lower() in (
+        "1", "on", "true", "yes")
+
+
+# ---------------------------------------------------------------------------
+# Signals snapshot
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Signals:
+    """One immutable-ish snapshot of the control inputs.
+
+    ``link_utilization``: {link label ("tp:0>1") → fraction of one
+    direction's bandwidth the last window's bytes would fill}.
+    ``contended_links``: labels with a recent cross-op contention
+    record.  ``gauges``: serving gauges present in the registry.
+    Baseline lookups delegate to the (thread-safe) store so the
+    snapshot stays cheap — the store's contents are themselves rolling.
+    """
+
+    ts: float
+    link_utilization: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    contended_links: Tuple[str, ...] = ()
+    gauges: Dict[str, float] = dataclasses.field(default_factory=dict)
+    store: Optional[object] = None        # anomaly.BaselineStore
+
+    def fresh(self, now: Optional[float] = None,
+              staleness_s: float = STALENESS_S) -> bool:
+        now = time.time() if now is None else now
+        return (now - self.ts) <= staleness_s
+
+    # -- link view -------------------------------------------------------
+
+    def busy_fraction(self, axis: Optional[str] = None) -> float:
+        """Worst background utilization over the axis' links (all
+        links when ``axis`` is None), folding the contended floor in.
+        0.0 when nothing is hot — the derate is then exactly 1."""
+        worst = 0.0
+        prefix = f"{axis}:" if axis else None
+        for label, u in self.link_utilization.items():
+            if prefix is None or label.startswith(prefix):
+                worst = max(worst, float(u))
+        for label in self.contended_links:
+            if prefix is None or label.startswith(prefix):
+                worst = max(worst, CONTENDED_FLOOR)
+        return min(worst, UTILIZATION_CAP)
+
+    def mean_busy_fraction(self, axes) -> float:
+        """Mean per-axis worst utilization — the load a schedule that
+        SPREADS over ``axes`` sees, vs :meth:`busy_fraction`'s worst
+        case for one that concentrates."""
+        axes = list(axes)
+        if not axes:
+            return 0.0
+        return sum(self.busy_fraction(a) for a in axes) / len(axes)
+
+    def hot_links(self, axis: Optional[str] = None) -> Dict[str, float]:
+        prefix = f"{axis}:" if axis else None
+        return {label: u for label, u in
+                sorted(self.link_utilization.items())
+                if prefix is None or label.startswith(prefix)}
+
+    # -- baseline view ---------------------------------------------------
+
+    def zscore(self, key: str, us: float) -> Optional[float]:
+        return (self.store.zscore(key, us)
+                if self.store is not None else None)
+
+    def predicted_us(self, key: str) -> Optional[float]:
+        """Baseline mean for ``key`` once it has a usable sample count
+        (what "this machine usually does" predicts the next occurrence
+        costs)."""
+        if self.store is None:
+            return None
+        from triton_distributed_tpu.observability.anomaly import (
+            MIN_SAMPLES)
+        b = self.store.get(key)
+        if b is None or b.n < MIN_SAMPLES:
+            return None
+        return float(b.mean)
+
+    def sustained_z(self, key: str, n: Optional[int] = None
+                    ) -> Optional[float]:
+        return (self.store.sustained_z(key, n)
+                if self.store is not None else None)
+
+    def to_inputs(self, axes=None) -> dict:
+        """The compact inputs snapshot a DecisionEvent embeds."""
+        out: dict = {"signal_ts": round(self.ts, 3)}
+        if axes:
+            out["axis_busy"] = {a: round(self.busy_fraction(a), 4)
+                                for a in axes}
+        if self.link_utilization:
+            hot = sorted(self.link_utilization.items(),
+                         key=lambda kv: (-kv[1], kv[0]))[:4]
+            out["hot_links"] = {k: round(v, 4) for k, v in hot}
+        if self.contended_links:
+            out["contended_links"] = list(self.contended_links)[:8]
+        if self.gauges:
+            out["gauges"] = dict(self.gauges)
+        return out
+
+
+class SignalBus:
+    """Process-local snapshot source for the closed-loop consumers.
+
+    The default construction reads the live singletons — link tracker,
+    baseline store, metrics registry — lazily and cheaply (a process
+    that never attributed a link pays a None-check).  Tests build
+    private buses around private trackers/stores/registries, or use
+    :func:`synthetic_bus` for fully-scripted signals.
+    """
+
+    #: Serving gauges mirrored into snapshots (the admission consumer
+    #: and DecisionEvent inputs read these).
+    GAUGE_NAMES = ("serving_queue_depth", "serving_kv_page_occupancy",
+                   "serving_slot_occupancy")
+
+    def __init__(self, registry=None, tracker=None, store=None,
+                 clock=None, staleness_s: float = STALENESS_S):
+        self._registry = registry
+        self._tracker = tracker
+        self._store = store
+        self.clock = clock or time.time
+        self.staleness_s = float(staleness_s)
+        self._lock = threading.Lock()
+        self._snapshot: Optional[Signals] = None
+
+    # -- sources ---------------------------------------------------------
+
+    def _live_tracker(self):
+        if self._tracker is not None:
+            return self._tracker
+        from triton_distributed_tpu.observability import links
+        return links.peek_link_tracker()   # None until first event
+
+    def _live_store(self):
+        if self._store is not None:
+            return self._store
+        from triton_distributed_tpu.observability.anomaly import (
+            get_baseline_store)
+        return get_baseline_store()
+
+    def _live_registry(self):
+        if self._registry is not None:
+            return self._registry
+        from triton_distributed_tpu.observability.metrics import (
+            get_registry)
+        return get_registry()
+
+    def _build(self, now: float) -> Signals:
+        util: Dict[str, float] = {}
+        contended: List[str] = []
+        tracker = self._live_tracker()
+        if tracker is not None:
+            for label, row in tracker.link_signals(now).items():
+                if now - row["last_ts"] <= self.staleness_s:
+                    util[label] = row["utilization"]
+                if row.get("contended"):
+                    contended.append(label)
+        gauges: Dict[str, float] = {}
+        reg = self._live_registry()
+        for name in self.GAUGE_NAMES:
+            v = reg.peek(name)
+            if v is not None:
+                gauges[name] = float(v)
+        return Signals(ts=now, link_utilization=util,
+                       contended_links=tuple(sorted(set(contended))),
+                       gauges=gauges, store=self._live_store())
+
+    def read(self, now: Optional[float] = None) -> Signals:
+        """The one consumer entry point: a throttled snapshot."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            snap = self._snapshot
+            if snap is None or (now - snap.ts) > REFRESH_S:
+                snap = self._snapshot = self._build(now)
+            return snap
+
+
+class _FixedBus(SignalBus):
+    """A bus whose read() always returns one scripted snapshot —
+    seeded-contention tests and the verify-tier1 smoke fixture."""
+
+    def __init__(self, signals: Signals, clock=None):
+        super().__init__(clock=clock)
+        self._fixed = signals
+
+    def read(self, now: Optional[float] = None) -> Signals:
+        return self._fixed
+
+
+def synthetic_bus(link_utilization: Optional[Dict[str, float]] = None,
+                  contended: Tuple[str, ...] = (),
+                  gauges: Optional[Dict[str, float]] = None,
+                  store=None, ts: Optional[float] = None,
+                  clock=None) -> SignalBus:
+    """A deterministic bus for tests and fixtures: scripted signals,
+    no live singletons.  ``ts`` defaults to now (fresh); pass an old
+    one to script staleness."""
+    clock = clock or time.time
+    return _FixedBus(Signals(
+        ts=clock() if ts is None else float(ts),
+        link_utilization=dict(link_utilization or {}),
+        contended_links=tuple(contended),
+        gauges=dict(gauges or {}),
+        store=store), clock=clock)
+
+
+_BUS: Optional[SignalBus] = None
+_BUS_LOCK = threading.Lock()
+
+
+def get_signal_bus() -> SignalBus:
+    """The process-global bus (constructed lazily)."""
+    global _BUS
+    with _BUS_LOCK:
+        if _BUS is None:
+            _BUS = SignalBus()
+        return _BUS
+
+
+def ambient_bus() -> Optional[SignalBus]:
+    """What a consumer consults when no bus was passed explicitly:
+    the global bus iff the closed loop is armed, else None (static
+    behavior, no decision recorded)."""
+    return get_signal_bus() if closed_loop_enabled() else None
+
+
+def effective_spec(spec, busy: float):
+    """Derate an :class:`~..kernels.comm_perf_model.IciSpec`'s
+    per-link bandwidth by the background ``busy`` fraction: the
+    foreground collective only gets the residual share of each
+    contended link.  ``busy`` ≤ 0 returns ``spec`` unchanged — the
+    empty-bus path is the IDENTICAL object, not a rebuilt equal one."""
+    if busy <= 0.0:
+        return spec
+    busy = min(float(busy), UTILIZATION_CAP)
+    return dataclasses.replace(
+        spec, link_gbps=spec.link_gbps * (1.0 - busy))
+
+
+# ---------------------------------------------------------------------------
+# DecisionEvent
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class DecisionEvent:
+    """One recorded control decision (schema v1).
+
+    consumer: "comm.method_select" | "autotune.invalidate" |
+              "autotune.retune" | "serving.admission".
+    op:       what was being decided about (collective entry point,
+              tuned-function id, request id).
+    candidates: every option considered, each a dict with at least
+              ``name`` and (when scored) ``score_us``.
+    choice:   the candidate name chosen.
+    inputs:   the signals snapshot the decision acted on
+              (:meth:`Signals.to_inputs`, plus consumer extras).
+    fallback: why static behavior was kept, when it was
+              ("signals_absent" | "signals_stale" | "no_second_best"
+              | "multiprocess" | consumer-specific) — None for a
+              live closed-loop decision.
+    """
+
+    consumer: str
+    op: str
+    choice: str
+    candidates: List[dict] = dataclasses.field(default_factory=list)
+    inputs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    fallback: Optional[str] = None
+    ts: float = 0.0
+    rank: int = 0
+    schema: int = DECISION_SCHEMA
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DecisionEvent":
+        kw = {f.name: d[f.name] for f in dataclasses.fields(cls)
+              if f.name in d}
+        return cls(**kw)
+
+    def summary(self) -> dict:
+        """The compact form heartbeats and /decisions carry."""
+        return {"ts": round(self.ts, 3), "consumer": self.consumer,
+                "op": self.op, "choice": self.choice,
+                "fallback": self.fallback}
+
+
+_RECENT: collections.deque = collections.deque(maxlen=RECENT_DECISIONS)
+_RECENT_LOCK = threading.Lock()
+
+_LOG_PATH: Optional[str] = None
+_LOG_EXPLICIT = False
+_LOG_LOCK = threading.Lock()
+
+
+def set_decision_log(path: Optional[str]) -> None:
+    """Point the decisions.jsonl writer at ``path`` (None disarms and
+    re-enables the env-derived default)."""
+    global _LOG_PATH, _LOG_EXPLICIT
+    with _LOG_LOCK:
+        _LOG_PATH = path
+        _LOG_EXPLICIT = path is not None
+
+
+def decision_log_path() -> Optional[str]:
+    """Where decision lines go: an explicit :func:`set_decision_log`
+    path, else ``$TDT_DECISIONS_DIR/decisions-rank-<N>.jsonl``."""
+    with _LOG_LOCK:
+        if _LOG_EXPLICIT:
+            return _LOG_PATH
+    directory = os.environ.get(ENV_DECISIONS_DIR)
+    if not directory:
+        return None
+    from triton_distributed_tpu.observability.metrics import (
+        _process_index)
+    return os.path.join(directory,
+                        f"decisions-rank-{_process_index()}.jsonl")
+
+
+def _append_log(event: DecisionEvent) -> None:
+    path = decision_log_path()
+    if not path:
+        return
+    try:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with _LOG_LOCK:
+            with open(path, "a") as f:
+                f.write(json.dumps(event.to_dict(), default=str)
+                        + "\n")
+    except OSError:
+        pass   # the artifact is forensics; it must never break the op
+
+
+def record_decision(event: DecisionEvent) -> Optional[DecisionEvent]:
+    """Land one decision in the registry, the flight ring, the recent
+    ring and the jsonl artifact.  No-op when observability is off."""
+    if not observability_enabled():
+        return None
+    from triton_distributed_tpu.observability.metrics import (
+        _process_index, get_registry)
+    if not event.ts:
+        event.ts = time.time()
+    event.rank = _process_index()
+    reg = get_registry()
+    reg.counter("decisions_total", consumer=event.consumer,
+                choice=str(event.choice)).inc()
+    if event.fallback:
+        reg.counter("decisions_fallback_total",
+                    consumer=event.consumer,
+                    reason=str(event.fallback)).inc()
+    # The flight ring: a dump from a hung rank then carries its last
+    # control decisions next to its last kernel events.
+    from triton_distributed_tpu.observability.events import (
+        emit_kernel_event)
+    emit_kernel_event(f"decision.{event.consumer}", kind="decision",
+                      method=str(event.choice),
+                      decision=event.to_dict())
+    with _RECENT_LOCK:
+        _RECENT.append(event)
+    _append_log(event)
+    return event
+
+
+def recent_decisions(n: Optional[int] = None) -> List[DecisionEvent]:
+    with _RECENT_LOCK:
+        out = list(_RECENT)
+    return out if n is None else out[-n:]
+
+
+def recent_decision_summaries(n: int = 50) -> List[dict]:
+    return [e.summary() for e in recent_decisions(n)]
+
+
+def clear_recent_decisions() -> None:
+    """Test hook: empty the in-memory ring."""
+    with _RECENT_LOCK:
+        _RECENT.clear()
+
+
+def validate_decision(d: dict) -> List[str]:
+    """Schema-v1 check for one decisions.jsonl line; empty = valid.
+    CI's closed-loop smoke and the tests run every recorded line
+    through this."""
+    problems = []
+    for f in DECISION_FIELDS:
+        if f not in d:
+            problems.append(f"missing field {f!r}")
+    if d.get("schema") != DECISION_SCHEMA:
+        problems.append(f"schema {d.get('schema')!r} != "
+                        f"{DECISION_SCHEMA}")
+    if not isinstance(d.get("candidates"), list):
+        problems.append("candidates not a list")
+    elif any(not isinstance(c, dict) or "name" not in c
+             for c in d["candidates"]):
+        problems.append("candidate without a name")
+    if not isinstance(d.get("inputs"), dict):
+        problems.append("inputs not a dict")
+    return problems
+
+
+def load_decisions(paths) -> List[dict]:
+    """Parse decision lines from jsonl file(s), skipping torn lines
+    (a rank killed mid-write must not break the doctor)."""
+    out: List[dict] = []
+    if isinstance(paths, str):
+        paths = [paths]
+    for path in paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        d = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(d, dict) and "consumer" in d:
+                        out.append(d)
+        except OSError:
+            continue
+    out.sort(key=lambda d: (float(d.get("ts", 0.0)),
+                            int(d.get("rank", 0))))
+    return out

@@ -401,6 +401,14 @@ class LinkTracker:
             if self._recent and self._recent[0][0] < cutoff:
                 self._recent = [r for r in self._recent
                                 if r[0] >= cutoff]
+            # Contention records roll off at the same window: the
+            # totals live in ici_link_contention_total, and the live
+            # consumers (link_signals, the feedback bus) only ever
+            # look inside the window — an append-only list would grow
+            # without bound in a long-running serving process.
+            if self.contentions and self.contentions[0]["ts"] < cutoff:
+                self.contentions = [c for c in self.contentions
+                                    if c["ts"] >= cutoff]
         return lks
 
     def window_bytes(self, now: Optional[float] = None
@@ -413,6 +421,37 @@ class LinkTracker:
                 if ts >= cutoff:
                     out[link] = out.get(link, 0) + b
         return out
+
+    def link_signals(self, now: Optional[float] = None
+                     ) -> Dict[str, dict]:
+        """Per-link control-signal snapshot for the feedback bus:
+        ``{label: {bytes, utilization, last_ts, contended}}`` over the
+        rolling window.  ``contended`` marks links with a cross-op
+        contention record inside the window (the live analogue of
+        :func:`detect_contention`)."""
+        now = time.time() if now is None else now
+        cutoff = now - self.WINDOW_S
+        bw = _link_bytes_per_s()
+        denom = bw * self.WINDOW_S
+        per: Dict[Link, list] = {}
+        with self._lock:
+            for ts, link, b in self._recent:
+                if ts >= cutoff:
+                    e = per.setdefault(link, [0, 0.0])
+                    e[0] += b
+                    e[1] = max(e[1], ts)
+            recent_contended = {c["link"] for c in self.contentions
+                                if c["ts"] >= cutoff}
+        return {
+            link_label(link): {
+                "bytes": b,
+                "utilization": (round(b / denom, 12) if denom
+                                else 0.0),
+                "last_ts": ts,
+                "contended": link_label(link) in recent_contended,
+            }
+            for link, (b, ts) in sorted(per.items())
+        }
 
     def update_gauges(self, now: Optional[float] = None) -> None:
         """Refresh ``ici_link_utilization`` gauges: fraction of one
@@ -439,6 +478,14 @@ def _link_bytes_per_s() -> float:
 
 _TRACKER: Optional[LinkTracker] = None
 _TRACKER_LOCK = threading.Lock()
+
+
+def peek_link_tracker() -> Optional[LinkTracker]:
+    """The global tracker if one was ever constructed, else None —
+    the feedback bus' cheap does-anything-exist probe (it must not
+    construct a tracker in processes that never attribute links)."""
+    with _TRACKER_LOCK:
+        return _TRACKER
 
 
 def get_link_tracker() -> LinkTracker:

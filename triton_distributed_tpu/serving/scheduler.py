@@ -106,6 +106,18 @@ class SchedulerConfig:
     #: relative to it (small models, CPU).  Pre-EOS tokens are
     #: identical either way.
     steps_per_sync: int = 1
+    #: SLO-aware admission (closed loop, `observability.feedback`):
+    #: a time-between-tokens target in milliseconds.  When set, the
+    #: scheduler consults the rolling decode-step baseline before
+    #: admitting: a queue head whose admission cannot meet the target
+    #: (predicted step time already past it) is DEFERRED — left
+    #: queued with a truthful, recorded reason (DecisionEvent +
+    #: ``serving_slo_deferrals_total``) — until the predicted step
+    #: time clears or the engine drains.  An EMPTY engine always
+    #: admits (deferral must never starve the only request), and with
+    #: the target unset (default) or no usable baseline the admission
+    #: order is bit-identical to the static scheduler.
+    slo_tbt_ms: Optional[float] = None
 
 
 class ContinuousBatchingScheduler:
@@ -116,11 +128,19 @@ class ContinuousBatchingScheduler:
     def __init__(self, model, params,
                  config: Optional[SchedulerConfig] = None,
                  clock: Optional[Callable[[], float]] = None,
-                 clock_advance: Optional[Callable[[float], None]] = None):
+                 clock_advance: Optional[Callable[[float], None]] = None,
+                 bus=None):
         self.model = model
         self.params = params
         self.config = cfg = config or SchedulerConfig()
         self.clock = clock or time.monotonic
+        #: Feedback bus for SLO-aware admission (only consulted when
+        #: ``cfg.slo_tbt_ms`` is set — which IS the opt-in; None then
+        #: means the process-global bus).
+        self._bus = bus
+        #: Current deferral episode: {"request_id", "since",
+        #: "predicted_ms"} while the queue head is SLO-deferred.
+        self._slo_episode: Optional[dict] = None
         #: With a virtual clock, how the idle loop moves time forward
         #: to the next arrival; with the default wall clock we sleep.
         self._clock_advance = clock_advance
@@ -168,6 +188,13 @@ class ContinuousBatchingScheduler:
             decode_fn, cfg.temperature, cfg.top_k, cfg.top_p,
             cfg.pad_id, block=cfg.steps_per_sync)
             if cfg.steps_per_sync > 1 else None)
+        from triton_distributed_tpu.observability.anomaly import (
+            event_key)
+        #: Baseline key every measured decode step rolls into — and
+        #: the SLO admission check reads back as the predicted step
+        #: time (the empirical "what does a step cost HERE, NOW").
+        self._step_key = event_key("serving.decode_step", None,
+                                   (cfg.num_slots,), 1)
         self._tokens = np.full(cfg.num_slots, cfg.pad_id, np.int32)
         #: Per-bucket reusable prefill input caches (see _admit).
         self._row_caches: Dict[int, object] = {}
@@ -318,12 +345,93 @@ class ContinuousBatchingScheduler:
             self._row_caches[bucket] = row_in
         return row_in
 
+    def _slo_gate(self, now: float) -> bool:
+        """SLO-aware admission (closed loop): True = the queue head
+        may be admitted now.  With no ``slo_tbt_ms`` target this is
+        unconditionally True — the static scheduler, bit-identically.
+        Runs only AFTER capacity said yes (``_can_admit_head``): a
+        recorded choice="admit" must mean the head is actually
+        admitted this call, and a capacity wait must not close an
+        open SLO-deferral episode (which would double-count
+        ``serving_slo_deferrals_total`` for one continuous wait).
+
+        The predicted step time is the rolling decode-step baseline
+        (every measured step feeds it); if it already exceeds the TBT
+        target, admitting more work cannot meet the SLO, so the head
+        is deferred — truthfully recorded ONCE per episode as a
+        DecisionEvent — until the prediction clears or the engine
+        drains.  An empty engine always admits: deferral must never
+        starve the only runnable request (and an idle engine is how
+        the baseline re-learns that steps got cheap again)."""
+        slo = self.config.slo_tbt_ms
+        if slo is None:
+            return True
+        head = self._queue[0]
+        if not self._by_slot:
+            return self._slo_admit(head, now, reason="engine_empty")
+        from triton_distributed_tpu.observability import feedback
+        bus = self._bus if self._bus is not None else (
+            feedback.get_signal_bus())
+        sig = bus.read()
+        if not sig.fresh(bus.clock(), bus.staleness_s):
+            return self._slo_admit(head, now, reason="signals_stale")
+        pred_us = sig.predicted_us(self._step_key)
+        if pred_us is None:
+            return self._slo_admit(head, now, reason="no_baseline")
+        pred_ms = pred_us / 1e3
+        if pred_ms <= slo:
+            return self._slo_admit(head, now, predicted_ms=pred_ms)
+        if (self._slo_episode is None
+                or self._slo_episode["request_id"] != head.request_id):
+            # Episode start: record the deferral, its inputs, and the
+            # truthful reason — this is the "why wasn't I admitted"
+            # answer the doctor replays.
+            self._slo_episode = {"request_id": head.request_id,
+                                 "since": now,
+                                 "predicted_ms": pred_ms}
+            reg = self._registry()
+            if reg:
+                reg.counter("serving_slo_deferrals_total").inc()
+            feedback.record_decision(feedback.DecisionEvent(
+                consumer="serving.admission",
+                op=f"request:{head.request_id}", choice="defer",
+                candidates=[{"name": "admit",
+                             "score_us": round(pred_us, 1)},
+                            {"name": "defer"}],
+                inputs=dict(sig.to_inputs(),
+                            predicted_step_ms=round(pred_ms, 3),
+                            slo_tbt_ms=float(slo),
+                            active=len(self._by_slot),
+                            queued=len(self._queue))))
+        return False
+
+    def _slo_admit(self, head, now: float, predicted_ms=None,
+                   reason=None) -> bool:
+        """Close a deferral episode (if one was open for this head)
+        with a recorded admit decision; always returns True."""
+        ep = self._slo_episode
+        if ep is not None and ep["request_id"] == head.request_id:
+            self._slo_episode = None
+            from triton_distributed_tpu.observability import feedback
+            inputs = {"deferred_s": round(now - ep["since"], 6),
+                      "slo_tbt_ms": float(self.config.slo_tbt_ms)}
+            if predicted_ms is not None:
+                inputs["predicted_step_ms"] = round(predicted_ms, 3)
+            if reason is not None:
+                inputs["cleared_by"] = reason
+            feedback.record_decision(feedback.DecisionEvent(
+                consumer="serving.admission",
+                op=f"request:{head.request_id}", choice="admit",
+                inputs=inputs))
+        return True
+
     def _admit(self, now: float) -> int:
         from triton_distributed_tpu.observability import get_tracer
         n = 0
         while (self._queue and not self._stopped
                and self._queue[0].t_arrival <= now
-               and self._can_admit_head()):
+               and self._can_admit_head()
+               and self._slo_gate(now)):
             req = self._queue.popleft()
             reg = self._registry()
             if self.paged:
@@ -524,10 +632,9 @@ class ContinuousBatchingScheduler:
             # slow step up against what else was on the links.  The
             # store is memory-only here (no disk I/O per step).
             from triton_distributed_tpu.observability.anomaly import (
-                Z_THRESHOLD, event_key, get_baseline_store)
-            z = get_baseline_store().observe(
-                event_key("serving.decode_step", None,
-                          (self.config.num_slots,), 1), step_ms * 1e3)
+                Z_THRESHOLD, get_baseline_store)
+            z = get_baseline_store().observe(self._step_key,
+                                             step_ms * 1e3)
             if z is not None and z > Z_THRESHOLD:
                 reg.counter("serving_decode_anomalies_total").inc()
                 from triton_distributed_tpu.observability.events \
