@@ -1,0 +1,227 @@
+"""Router bench: cluster scale-out vs the single engine, and
+signal-aware vs round-robin placement under seeded replica imbalance
+— the ISSUE-9 acceptance benchmark.
+
+A *virtual-time* benchmark, deliberately: the scenario the router
+pays off in — one replica of a data-parallel pod running slow (hot
+ICI links, thermal throttle, a noisy neighbor) while the others are
+fine — cannot be produced on a CPU CI host reproducibly.  So the
+imbalance is SEEDED: every replica/worker runs on the shared virtual
+clock with a modeled per-step cost (`ClusterConfig.step_time_s`; a
+straggling replica's steps cost ``factor``×, a link-contended one
+``1/(1-busy)``× — the same residual-bandwidth ground truth the
+closed-loop bench uses), the REAL schedulers decode the REAL toy
+model underneath, and makespan/TTFT are read off the virtual clock —
+deterministic given the trace, machine-independent.
+
+Emitted rows (one JSON line each, ``bench: "router"``):
+
+- ``workload: "scale"`` — router + N replicas vs N=1 on the same
+  trace: virtual makespan (``ms``), mean/p99 TTFT, useful-token
+  throughput, ``speedup_vs_single``;
+- ``workload: "disagg"`` — 2 replicas + 1 prefill worker: the same
+  metrics plus shipped-KV accounting;
+- ``workload: "imbalance_*"`` — per (mode ∈ round_robin /
+  signal_aware) rows and one ``mode: "paired"`` summary with
+  ``signal_aware_beats_rr`` (the gate: placement signals must WIN
+  under seeded imbalance);
+- ``workload: "balanced"`` — the paired summary carries
+  ``matches_round_robin`` (identical assignments — balanced signals
+  reproduce the rotation exactly) and ``signal_aware_never_worse``.
+
+Gate semantics (`scripts/check_bench_regression.py`
+``router_checks``): every fresh imbalance pair must report
+``signal_aware_beats_rr`` and every balanced pair
+``matches_round_robin`` + ``signal_aware_never_worse``.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+import argparse
+import json
+
+import jax
+import numpy as np
+
+from triton_distributed_tpu.serving import (
+    ClusterConfig,
+    SchedulerConfig,
+    ServingCluster,
+    ToyConfig,
+    ToyModel,
+)
+from triton_distributed_tpu.serving.cluster import RouterConfig
+
+#: Modeled virtual costs (fixed so committed numbers are
+#: machine-independent; the v5e-ish 1 ms decode step of the serving
+#: bench's 24-slot toy configuration).
+STEP_S = 1e-3
+PREFILL_S = 2e-3
+
+N_REQUESTS = 24
+SLOTS = 4
+BUCKETS = (8, 16, 32)
+
+
+def build_trace(homogeneous: bool = False):
+    """Seeded arrival trace.  The heterogeneous trace (varied prompt
+    lengths / budgets, exponential interarrivals) drives the scale
+    and imbalance sweeps; the homogeneous one (identical requests,
+    uniform spacing) is the balanced-parity fixture — symmetric load
+    is what makes 'signal-aware == round-robin' exact."""
+    rng = np.random.default_rng(1234)
+    trace = []
+    t = 0.0
+    for i in range(N_REQUESTS):
+        if homogeneous:
+            t += 0.0015
+            prompt = [1 + (i % 7), 2, 3, 4, 5, 6]
+            gen = 8
+        else:
+            t += float(rng.exponential(0.0005))
+            plen = int(rng.integers(4, 14))
+            prompt = [int(x) for x in rng.integers(1, 61, plen)]
+            gen = int(rng.integers(5, 13))
+        trace.append(dict(prompt=prompt, max_new_tokens=gen,
+                          seed=1000 + i, arrival_time=round(t, 6)))
+    return trace
+
+
+def run_cluster(model, params, trace, n_replicas, mode,
+                workers=0, straggle=None, link_busy=None):
+    cfg = ClusterConfig(
+        n_replicas=n_replicas, n_prefill_workers=workers,
+        scheduler=SchedulerConfig(num_slots=SLOTS,
+                                  prefill_buckets=BUCKETS),
+        router=RouterConfig(mode=mode),
+        step_time_s=STEP_S, prefill_time_s=PREFILL_S)
+    cluster = ServingCluster(model, params, cfg)
+    if straggle:
+        idx, factor = straggle
+        cluster.straggle_replica(idx, factor)
+        # Ground truth AND signal agree from t=0: the replica already
+        # knows its step cost (a deployment's rolling step baseline).
+        cluster.replicas[idx].last_step_s = STEP_S * factor
+    if link_busy:
+        idx, busy = link_busy
+        cluster.replicas[idx].link_busy = busy
+        # Ground truth: a contended link slows every decode step to
+        # the residual-bandwidth share (the feedback.effective_spec
+        # model applied to the step time).
+        cluster.straggle_replica(idx, 1.0 / (1.0 - busy))
+    recs = [cluster.submit(**t) for t in trace]
+    done = cluster.drain()
+    assert len(done) == len(trace), [r.state for r in recs]
+    tokens = sum(len(r.tokens) for r in done)
+    makespan = (max(r.t_finish for r in done)
+                - min(r.arrival_time for r in done))
+    ttfts = sorted(r.ttft for r in done)
+    return {
+        "ms": round(makespan * 1e3, 6),
+        "mean_ttft_ms": round(1e3 * sum(ttfts) / len(ttfts), 6),
+        "p99_ttft_ms": round(1e3 * ttfts[
+            min(len(ttfts) - 1, int(0.99 * len(ttfts)))], 6),
+        "useful_tokens": tokens,
+        "tokens_per_virtual_s": round(tokens / makespan, 3),
+        "assignments": [tuple(r.replica_history) for r in recs],
+        "streams": [r.tokens for r in
+                    sorted(done, key=lambda r: r.record_id)],
+        "kv_shipped_bytes": cluster.transport.shipped_bytes,
+        "shipments": cluster.transport.shipments,
+        "failovers": len(cluster.router.failovers),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON lines here (committed "
+                         "copy: benchmark/results/router.json)")
+    args = ap.parse_args()
+    out = open(args.out, "w") if args.out else None
+    rows = []
+
+    def emit(rec):
+        rows.append(rec)
+        line = json.dumps(rec)
+        print(line)
+        if out is not None:
+            out.write(line + "\n")
+
+    model = ToyModel(ToyConfig(vocab_size=61, hidden=16,
+                               max_seq_len=64))
+    params = model.init_params(jax.random.key(0))
+    trace = build_trace()
+
+    def strip(r):
+        return {k: v for k, v in r.items()
+                if k not in ("assignments", "streams")}
+
+    # -- scale: N replicas vs the single engine -------------------------
+    single = run_cluster(model, params, trace, 1, "signal_aware")
+    for n in (1, 2, 4):
+        r = (single if n == 1
+             else run_cluster(model, params, trace, n,
+                              "signal_aware"))
+        assert r["streams"] == single["streams"], (
+            "replica count changed a token stream")
+        emit(dict(bench="router", workload="scale", n_replicas=n,
+                  mode="signal_aware", **strip(r),
+                  speedup_vs_single=round(single["ms"] / r["ms"], 4)))
+
+    # -- disaggregated: dedicated prefill + KV shipping -----------------
+    r = run_cluster(model, params, trace, 2, "signal_aware",
+                    workers=1)
+    assert r["streams"] == single["streams"], (
+        "prefill shipping changed a token stream")
+    assert r["shipments"] == N_REQUESTS
+    emit(dict(bench="router", workload="disagg", n_replicas=2,
+              n_prefill=1, mode="signal_aware", **strip(r)))
+
+    # -- imbalance: signal-aware must beat round-robin ------------------
+    for name, kw in (
+        ("imbalance_straggler", dict(straggle=(0, 3.0))),
+        ("imbalance_hot_link", dict(link_busy=(0, 0.65))),
+    ):
+        rr = run_cluster(model, params, trace, 3, "round_robin", **kw)
+        sa = run_cluster(model, params, trace, 3, "signal_aware",
+                         **kw)
+        assert sa["streams"] == rr["streams"] == single["streams"], (
+            "placement changed a token stream")
+        for mode, r in (("round_robin", rr), ("signal_aware", sa)):
+            emit(dict(bench="router", workload=name, n_replicas=3,
+                      mode=mode, **strip(r)))
+        emit(dict(bench="router", workload=name, n_replicas=3,
+                  mode="paired",
+                  speedup_makespan=round(rr["ms"] / sa["ms"], 4),
+                  speedup_ttft=round(rr["mean_ttft_ms"]
+                                     / sa["mean_ttft_ms"], 4),
+                  signal_aware_beats_rr=sa["ms"] < rr["ms"]))
+
+    # -- balanced: signal-aware must match round-robin exactly ----------
+    htrace = build_trace(homogeneous=True)
+    rr = run_cluster(model, params, htrace, 3, "round_robin")
+    sa = run_cluster(model, params, htrace, 3, "signal_aware")
+    emit(dict(bench="router", workload="balanced", n_replicas=3,
+              mode="paired",
+              speedup_makespan=round(rr["ms"] / sa["ms"], 4),
+              matches_round_robin=(sa["assignments"]
+                                   == rr["assignments"]
+                                   and sa["streams"] == rr["streams"]),
+              signal_aware_never_worse=sa["ms"] <= rr["ms"] + 1e-9))
+
+    if out is not None:
+        out.close()
+    paired = [r for r in rows if r.get("mode") == "paired"]
+    assert all(r.get("signal_aware_beats_rr", True) for r in paired)
+    assert all(r.get("matches_round_robin", True) for r in paired), (
+        "balanced signal-aware placement diverged from round-robin")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

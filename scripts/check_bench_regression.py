@@ -66,6 +66,14 @@ MEASUREMENT_FIELDS = {
     # the paired-summary statistics.
     "chosen", "modeled_us", "flips", "mean_speedup", "min_speedup",
     "max_speedup", "closed_loop_never_worse",
+    # Router bench (bench_router.py): virtual-clock cluster metrics
+    # and the paired signal-aware-vs-round-robin summaries (gated by
+    # router_checks).
+    "mean_ttft_ms", "p99_ttft_ms", "tokens_per_virtual_s",
+    "speedup_vs_single", "kv_shipped_bytes", "shipments",
+    "failovers", "speedup_makespan", "speedup_ttft",
+    "signal_aware_beats_rr", "matches_round_robin",
+    "signal_aware_never_worse",
 }
 #: Fields that may hold the latency to compare, in preference order.
 LATENCY_FIELDS = ("us", "ms", "ms_per_step")
@@ -178,6 +186,54 @@ def closed_loop_checks(fresh, base) -> tuple:
     return checked, fails
 
 
+def router_checks(fresh) -> tuple:
+    """Gates specific to the router bench (`benchmark/bench_router.py`
+    paired summaries — these hold by construction of the scoring rule,
+    so a failure is a behavior change in the router, not noise):
+
+    - every ``imbalance_*`` pair must report
+      ``signal_aware_beats_rr`` — placement signals must WIN under
+      seeded replica imbalance;
+    - the ``balanced`` pair must report ``matches_round_robin`` AND
+      ``signal_aware_never_worse`` — balanced signals must reproduce
+      the round-robin rotation exactly (the PR-8 degradation
+      contract, extended to placement).
+
+    Returns ``(n_checked, failures)``."""
+    fails = []
+    checked = 0
+    for rec in fresh:
+        if (rec.get("bench") != "router"
+                or rec.get("mode") != "paired"):
+            continue
+        wl = rec.get("workload")
+        # `checked` counts only rows a gated branch actually
+        # asserted on — a paired row with an unrecognized workload
+        # must not inflate the coverage count (or suppress the
+        # nothing-comparable exit) while nothing was verified.
+        if str(wl).startswith("imbalance"):
+            checked += 1
+            if not rec.get("signal_aware_beats_rr"):
+                fails.append(
+                    f"router regression: {wl} pair reports "
+                    f"signal-aware LOSING to round-robin "
+                    f"(speedup_makespan="
+                    f"{rec.get('speedup_makespan')})")
+        elif wl == "balanced":
+            checked += 1
+            if not rec.get("matches_round_robin"):
+                fails.append(
+                    "router regression: balanced signal-aware "
+                    "placement diverged from round-robin")
+            if not rec.get("signal_aware_never_worse"):
+                fails.append(
+                    "router regression: balanced signal-aware "
+                    "placement is WORSE than round-robin "
+                    f"(speedup_makespan="
+                    f"{rec.get('speedup_makespan')})")
+    return checked, fails
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--fresh", required=True,
@@ -268,11 +324,12 @@ def main() -> int:
             regressions += 1
 
     cl_checked, cl_fails = closed_loop_checks(fresh, base)
+    rt_checked, rt_fails = router_checks(fresh)
 
     # Markdown summary: CI logs and PR comments read the same thing.
     print("## Bench regression check")
     print()
-    verdict = ("FAIL" if regressions or cl_fails else
+    verdict = ("FAIL" if regressions or cl_fails or rt_fails else
                "OK (with anomalies)" if anomalies else "OK")
     print(f"**{verdict}** — {compared} row(s) compared, "
           f"{regressions} regression(s) beyond "
@@ -297,9 +354,16 @@ def main() -> int:
               f"{len(cl_fails)} failure(s).")
         for f in cl_fails:
             print(f"- {f}")
-    if compared == 0 and cl_checked == 0:
+    if rt_checked:
+        print()
+        print(f"Router gate: {rt_checked} paired row(s) checked "
+              f"(beats round-robin under imbalance + balanced "
+              f"parity), {len(rt_fails)} failure(s).")
+        for f in rt_fails:
+            print(f"- {f}")
+    if compared == 0 and cl_checked == 0 and rt_checked == 0:
         return 2
-    return 1 if regressions or cl_fails else 0
+    return 1 if regressions or cl_fails or rt_fails else 0
 
 
 if __name__ == "__main__":

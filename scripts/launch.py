@@ -176,6 +176,18 @@ def main() -> int:
     ap.add_argument("--nnodes", type=int, default=1)
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (test harness)")
+    ap.add_argument("--roles", default=None, metavar="SPEC",
+                    help="cluster role assignment, e.g. "
+                         "'router:1,prefill:1,replica:2' — ranks get "
+                         "roles by contiguous ranges in the order "
+                         "given (rank 0 = first role) and each worker "
+                         "sees TDT_ROLE / TDT_ROLE_INDEX / "
+                         "TDT_CLUSTER_SPEC, so one launch line brings "
+                         "up a whole serving topology "
+                         "(serving/cluster.role_from_env reads them). "
+                         "The counts must sum to the world size; with "
+                         "--nproc left at its default on one node, "
+                         "nproc grows to the spec total")
     ap.add_argument("--flight-dir", default=None,
                     help="arm the per-rank flight recorder: workers "
                          "dump their recent kernel events to this "
@@ -199,6 +211,47 @@ def main() -> int:
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args()
+
+    # --roles: parse 'router:1,prefill:1,replica:2' into a rank ->
+    # (role, index-within-role) map.  Stdlib-only, like the rest of
+    # the launcher.
+    role_of = None
+    roles_spec = None
+    if args.roles:
+        known = ("router", "replica", "prefill")
+        pairs = []
+        for part in args.roles.split(","):
+            name, _, count = part.partition(":")
+            name = name.strip()
+            if name not in known or not count.strip().isdigit():
+                print(f"launch: bad --roles entry {part!r} (want "
+                      f"role:count with role in {known})",
+                      file=sys.stderr)
+                return 2
+            if any(n == name for n, _ in pairs):
+                # A repeated role would restart TDT_ROLE_INDEX at 0
+                # mid-range (two workers believing they are the same
+                # replica) and collapse in role_from_env()'s
+                # {role: count} spec — reject it.
+                print(f"launch: duplicate --roles entry {name!r} "
+                      f"(give each role once, with its total count)",
+                      file=sys.stderr)
+                return 2
+            pairs.append((name, int(count)))
+        total = sum(c for _, c in pairs)
+        if args.nproc == 1 and args.nnodes == 1 and total > 1:
+            args.nproc = total     # one launch line, whole topology
+        if total != args.nproc * args.nnodes:
+            print(f"launch: --roles totals {total} but world size is "
+                  f"{args.nproc * args.nnodes}", file=sys.stderr)
+            return 2
+        roles_spec = ",".join(f"{n}:{c}" for n, c in pairs)
+        role_of = {}
+        rank = 0
+        for name, count in pairs:
+            for idx in range(count):
+                role_of[rank] = (name, idx)
+                rank += 1
 
     world = args.nproc * args.nnodes
     procs = []
@@ -264,6 +317,11 @@ def main() -> int:
             env["TDT_HEARTBEAT_DIR"] = hb_dir
         if args.cpu:
             env["JAX_PLATFORMS"] = "cpu"
+        if role_of is not None:
+            role, idx = role_of[rank]
+            env["TDT_ROLE"] = role
+            env["TDT_ROLE_INDEX"] = str(idx)
+            env["TDT_CLUSTER_SPEC"] = roles_spec
         procs.append(subprocess.Popen(
             [sys.executable, args.script, *args.script_args], env=env))
 

@@ -23,7 +23,11 @@
 #  - a closed-loop smoke (synthetic contended bus -> method flip,
 #    SLO deferral, schema-valid decisions.jsonl, doctor
 #    Control-decisions section) plus the paired closed-loop bench
-#    gate (bus-disabled rows exactly match the committed results).
+#    gate (bus-disabled rows exactly match the committed results);
+#  - a router smoke (2-replica + 1-prefill virtual-clock cluster:
+#    prefix-affinity routing, kill-a-replica failover, /routing
+#    endpoint render) plus the router bench gate (signal-aware beats
+#    round-robin under seeded imbalance, matches it balanced).
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -356,6 +360,93 @@ closed_rc=$?
 echo "$closed_log" | tail -3
 if [ "$closed_rc" -ne 0 ]; then
     echo "CLOSED_LOOP_SMOKE=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
+# Router smoke: the disaggregated cluster end-to-end on CPU — 2
+# decode replicas + 1 prefill worker on the virtual clock; asserts
+# prefix-affinity routing, kill-one-replica failover with every
+# request finishing, and the /routing endpoint rendering the replica
+# table (ISSUE-9 ROUTER_SMOKE gate).
+router_log=$(JAX_PLATFORMS=cpu python - <<'EOF' 2>&1
+import json, urllib.request
+import numpy as np
+import jax
+from triton_distributed_tpu.observability.exporter import (
+    start_metrics_server)
+from triton_distributed_tpu.serving import (
+    ClusterConfig, SchedulerConfig, ServingCluster, ToyConfig,
+    ToyModel)
+from triton_distributed_tpu.serving.cluster import RouterConfig
+
+model = ToyModel(ToyConfig(vocab_size=61, hidden=16, max_seq_len=64))
+params = model.init_params(jax.random.key(0))
+sc = SchedulerConfig(num_slots=3, prefill_buckets=(8, 16, 32),
+                     kv_layout="paged", page_size=16)
+cluster = ServingCluster(model, params, ClusterConfig(
+    n_replicas=2, n_prefill_workers=1, scheduler=sc,
+    router=RouterConfig(dead_after_s=0.01)))
+
+# Prefix affinity: spaced same-prefix requests must all land on one
+# replica (whose radix cache then serves the shared page).
+sysp = list(np.random.default_rng(7).integers(1, 61, 16))
+aff = [cluster.submit(sysp + [1 + i], 2, seed=i,
+                      arrival_time=0.05 * i) for i in range(3)]
+# Distinct-prefix background traffic spreads round-robin-ish.
+bg = [cluster.submit([40 + i, 2, 3, 4], 3, seed=10 + i,
+                     arrival_time=0.05 * i + 0.01) for i in range(3)]
+done = cluster.drain()
+assert len(done) == 6, [r.state for r in done]
+homes = {r.replica_history[0] for r in aff}
+assert len(homes) == 1, f"prefix affinity spread: {homes}"
+assert cluster.transport.shipments == 6
+
+# Failover: kill the affinity home mid-flight; everything finishes
+# on the survivor, token streams intact.
+more = [cluster.submit(sysp + [30 + i], 4, seed=20 + i)
+        for i in range(3)]
+cluster.step()
+cluster.kill_replica(homes.pop())
+done2 = cluster.drain()
+assert all(r.state == "finished" for r in more), (
+    [r.state for r in more])
+assert cluster.router.failovers, "no failover recorded"
+
+# /routing endpoint renders the table with the dead replica named.
+srv = start_metrics_server(port=0)
+try:
+    body = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}/routing", timeout=10).read())
+finally:
+    srv.stop()
+router = body["router"]
+assert router["kind"] == "router"
+states = {r["name"]: r["alive"] for r in router["replicas"]}
+assert sorted(states) == ["replica-0", "replica-1"]
+assert list(states.values()).count(False) == 1, states
+assert router["failovers"][0]["reason"] == "heartbeat_loss"
+print("ROUTER_SMOKE=ok")
+EOF
+)
+router_rc=$?
+echo "$router_log" | tail -3
+if [ "$router_rc" -ne 0 ]; then
+    echo "ROUTER_SMOKE=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
+# Router bench gate: the virtual-clock router bench is deterministic
+# — re-run it and require every paired summary to hold (signal-aware
+# beats round-robin under seeded imbalance, matches it balanced).
+if JAX_PLATFORMS=cpu python benchmark/bench_router.py \
+        --out /tmp/_t1_router.json > /dev/null \
+   && python scripts/check_bench_regression.py \
+        --fresh /tmp/_t1_router.json \
+        --baselines /tmp/_t1_nonexistent_baselines.json > /dev/null
+then
+    echo "ROUTER_BENCH=ok"
+else
+    echo "ROUTER_BENCH=FAILED"
     [ "$rc" -eq 0 ] && rc=1
 fi
 

@@ -418,14 +418,21 @@ class ContextualAutotuner:
 
     # -- closed-loop staleness (observability.feedback) ------------------
 
-    def winner_baseline_key(self, config) -> str:
+    def winner_baseline_key(self, config, scope: str = "") -> str:
         """The anomaly-baseline key runtime measurements of ``config``
         roll into (see :meth:`observe_runtime`) and the staleness
-        check reads."""
+        check reads.  ``scope`` namespaces feeds that measure
+        DIFFERENT quantities — the serving loop observes whole-step
+        host latency while bench drivers observe the tuned op alone;
+        mixing them in one rolling baseline would make its z-scores
+        meaningless (a store warmed with ~50 µs kernel samples would
+        flag every ~1 ms serving step as sustained-slow)."""
         from triton_distributed_tpu.observability.anomaly import (
             event_key)
-        return event_key(f"autotune:{self._fn_id()}",
-                         method=repr(config),
+        op = f"autotune:{self._fn_id()}"
+        if scope:
+            op = f"{op}#{scope}"
+        return event_key(op, method=repr(config),
                          world=jax.device_count())
 
     def _observe_store(self):
@@ -445,17 +452,26 @@ class ContextualAutotuner:
             get_baseline_store)
         return get_baseline_store()
 
-    def observe_runtime(self, key, us: float):
+    def observe_runtime(self, key, us: float, scope: str = ""):
         """Roll one measured runtime of the cached winner for ``key``
         into its rolling baseline — the feed the staleness check
         consumes.  Callers with a host-side latency for the tuned op
-        (serving loops, bench drivers) call this; returns the z-score
-        (None while warming) like ``BaselineStore.observe``."""
+        (bench drivers) call this bare; feeds measuring a different
+        quantity (the serving loop's whole-step latency) pass a
+        ``scope`` so each baseline stays self-consistent.  Returns
+        the z-score (None while warming) like
+        ``BaselineStore.observe``."""
         entry = self.cache.get(key)
         if entry is None:
             return None
         return self._observe_store().observe(
-            self.winner_baseline_key(entry.config), float(us))
+            self.winner_baseline_key(entry.config, scope), float(us))
+
+    def arm_serving(self, *args, **kwargs) -> None:
+        """Arm this tuner's entry for the given call signature to be
+        fed by the serving decode loop (:func:`observe_serving_step`)
+        — call it where the tuned serving op is built, after tuning."""
+        arm_serving_observation(self, self.key_fn(*args, **kwargs))
 
     def _check_winner_health(self, key, args, kwargs) -> None:
         """On a cache hit: demote a winner whose live latency is
@@ -478,8 +494,16 @@ class ContextualAutotuner:
             SUSTAINED_N, Z_THRESHOLD)
         stale = entry.stale          # persisted marker from disk
         if stale is None:
-            z = bus.read().sustained_z(
-                self.winner_baseline_key(entry.config))
+            # Sustained drift in EITHER feed acts: the bench-fed
+            # kernel baseline and the serving-fed whole-step baseline
+            # are separate (scoped) keys, each compared only against
+            # itself.
+            sig = bus.read()
+            zs = [sig.sustained_z(
+                      self.winner_baseline_key(entry.config, scope))
+                  for scope in ("", SERVING_SCOPE)]
+            zs = [z for z in zs if z is not None]
+            z = max(zs) if zs else None
             if z is None or z < Z_THRESHOLD:
                 return
             stale = {"z": round(float(z), 2), "ts": round(time.time(), 3),
@@ -572,6 +596,68 @@ class ContextualAutotuner:
                 fallback=type(e).__name__))
         finally:
             self._retunes_inflight.discard(key)
+
+
+# ---------------------------------------------------------------------------
+# Serving-loop runtime observation (ROADMAP item 4 follow-up)
+# ---------------------------------------------------------------------------
+
+#: Tuners armed to receive the serving decode loop's per-step host
+#: latency: ``(weakref(tuner), cache key)`` pairs.  The scheduler
+#: (`serving.scheduler._decode_step`) calls :func:`observe_serving_step`
+#: once per measured step, so tuned-kernel anomaly baselines warm from
+#: production traffic — previously only the bench drivers fed
+#: `observe_runtime`, and a winner could go stale in a server that
+#: never runs benches.
+_SERVING_OBSERVERS: list = []
+
+#: Baseline-key scope for the serving feed: whole-step host latency
+#: is a different quantity than the bench drivers' tuned-op-only
+#: latency and must never share a rolling baseline with it.
+SERVING_SCOPE = "serving"
+
+
+def arm_serving_observation(tuner: "ContextualAutotuner",
+                            key) -> None:
+    """Register ``tuner``'s cached entry for ``key`` (its call key —
+    ``tuner.key_fn(*serving_args)``) to be fed by every serving decode
+    step.  Weakly referenced: a dropped tuner silently unarms.
+    Idempotent per (tuner, key): an op rebuilt after a re-tune heal or
+    engine restart re-arms without double-feeding every step."""
+    import weakref
+    for ref, k in _SERVING_OBSERVERS:
+        if ref() is tuner and k == key:
+            return
+    _SERVING_OBSERVERS.append((weakref.ref(tuner), key))
+
+
+def clear_serving_observers() -> None:
+    """Test hook: drop every armed (tuner, key) pair."""
+    _SERVING_OBSERVERS.clear()
+
+
+def observe_serving_step(us: float) -> None:
+    """Feed one serving decode step's host latency (µs) to every
+    armed tuner's winner baseline (`observe_runtime`).  The step time
+    CONTAINS the tuned op — as a rolling baseline compared against
+    itself that is exactly the sustained-drift signal the closed
+    loop's invalidation consumes.  No-op (one empty-list check) when
+    nothing is armed."""
+    if not _SERVING_OBSERVERS:
+        return
+    dead = []
+    for pair in list(_SERVING_OBSERVERS):
+        ref, key = pair
+        tuner = ref()
+        if tuner is None:
+            dead.append(pair)
+            continue
+        tuner.observe_runtime(key, float(us), scope=SERVING_SCOPE)
+    for pair in dead:
+        try:
+            _SERVING_OBSERVERS.remove(pair)
+        except ValueError:
+            pass
 
 
 DEFAULT_CACHE = ".autotune_cache.json"

@@ -125,6 +125,7 @@ class Artifacts:
         self.static_findings: Optional[dict] = None
         self.resource_findings: Optional[dict] = None
         self.decisions: List[dict] = []
+        self.router: Optional[dict] = None
         self._discover()
 
     def _glob(self, pattern: str) -> List[str]:
@@ -167,6 +168,11 @@ class Artifacts:
             if d is not None:
                 self.resource_findings = d
                 break
+        for p in self._glob("router-state*.json"):
+            d = _load_json(p)
+            if d is not None and d.get("kind") == "router":
+                self.router = d
+                break
         decision_files = self._glob("decisions*.jsonl")
         if decision_files:
             from triton_distributed_tpu.observability.feedback import (
@@ -174,8 +180,12 @@ class Artifacts:
             self.decisions = load_decisions(decision_files)
 
     def empty(self) -> bool:
+        # A router artifact alone is an incident report's worth of
+        # state: a virtual-clock cluster run writes router-state.json
+        # without any heartbeat/trace files, and the doctor must
+        # still name the failed replica from it.
         return not (self.traces or self.flights or self.heartbeats
-                    or self.metrics)
+                    or self.metrics or self.router)
 
     def ranks(self) -> List[int]:
         from triton_distributed_tpu.observability.timeline import (
@@ -527,6 +537,37 @@ def _decision_why(inputs: dict) -> Optional[str]:
     return "; ".join(parts) or None
 
 
+def analyze_cluster(art: Artifacts) -> Optional[dict]:
+    """Replay the serving cluster's router artifact
+    (``router-state.json``, `serving.cluster`) into the report: the
+    replica health table and every executed failover, so "which
+    replica died / straggled, and what happened to its requests" is
+    answered by name.  None — and thus NO report key, keeping
+    pre-cluster golden reports byte-identical — without the artifact.
+    """
+    if art.router is None:
+        return None
+    replicas = [{
+        "id": r.get("id"), "name": r.get("name"),
+        "alive": r.get("alive"), "quarantined": r.get("quarantined"),
+        "fail_reason": r.get("fail_reason"),
+        "hb_age_s": r.get("hb_age_s"),
+        "routed": r.get("routed"),
+        "queue_depth": r.get("queue_depth"),
+    } for r in art.router.get("replicas", [])]
+    failovers = list(art.router.get("failovers", []))
+    failed = [r for r in replicas
+              if not r.get("alive") or r.get("quarantined")]
+    return {
+        "mode": art.router.get("mode"),
+        "replicas": replicas,
+        "failovers": failovers,
+        "failed_replicas": [r["name"] for r in failed],
+        "kv_shipped_bytes": art.router.get("kv_shipped_bytes"),
+        "shipments": art.router.get("shipments"),
+    }
+
+
 def analyze_links(art: Artifacts) -> dict:
     from triton_distributed_tpu.observability import links as _links
     from triton_distributed_tpu.observability.events import KernelEvent
@@ -674,6 +715,11 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
     decision_out = analyze_decisions(art, now)
     if decision_out is not None:
         report["decisions"] = decision_out
+    # Cluster/router state: key absent without a router-state.json
+    # artifact, so non-cluster incidents stay byte-identical.
+    cluster_out = analyze_cluster(art)
+    if cluster_out is not None:
+        report["cluster"] = cluster_out
     report["verdict"] = _verdict(report, in_flight)
     return report
 
@@ -696,6 +742,14 @@ def _verdict(report: dict, in_flight: Optional[dict]) -> str:
         hot_s += (f"; KV page pressure on rank {worst['rank']} "
                   f"({worst['page_occupancy']:.0%} of pages in use, "
                   f"{worst['pages_free']} free)")
+    # Cluster failovers: name the failed replica(s) in the verdict
+    # (clause only exists when a router artifact was ingested).
+    failover_s = ""
+    for f in (report.get("cluster") or {}).get("failovers", []):
+        failover_s += (f"; cluster: {f.get('replica')} failed over "
+                       f"({f.get('reason')}), {f.get('requeued')} "
+                       f"request(s) re-queued")
+    hot_s += failover_s
     if stall["first_stalled_rank"] is not None:
         r = stall["first_stalled_rank"]
         what = (f" inside {stall['open_span']!r}"
@@ -738,6 +792,10 @@ def _verdict(report: dict, in_flight: Optional[dict]) -> str:
                 f"contention between {' and '.join(c['ops'])} on "
                 f"link(s) {', '.join(c['links'])}")
         return "; ".join(parts) + hot_s + "."
+    if failover_s:
+        # A failover IS the incident — it must never read as "no
+        # incident detected" with the dead replica in a subclause.
+        return "cluster incident" + hot_s + "."
     return ("no incident detected: heartbeats fresh, no anomalies, "
             "no link contention" + hot_s + ".")
 
@@ -849,6 +907,34 @@ def render_markdown(report: dict) -> str:
                 f"| {d['age_s']} | {d['rank']} | {d['consumer']} "
                 f"| {d['op']} | {d['choice']} | {d['why'] or '-'} |")
         lines.append("")
+
+    cluster = report.get("cluster")
+    if cluster:
+        lines += ["## Cluster", "",
+                  f"Router mode `{cluster.get('mode')}`; "
+                  f"{len(cluster.get('replicas', []))} replica(s), "
+                  f"{len(cluster.get('failovers', []))} failover(s)"
+                  + (f", {cluster['kv_shipped_bytes']} KV bytes "
+                     f"shipped over {cluster['shipments']} "
+                     "shipment(s)"
+                     if cluster.get("shipments") else "") + ".", "",
+                  "| replica | state | reason | beat age (s) "
+                  "| routed | queued |", "|---|---|---|---|---|---|"]
+        for r in cluster.get("replicas", []):
+            state = ("QUARANTINED" if r.get("quarantined")
+                     else ("DEAD" if not r.get("alive") else "ok"))
+            lines.append(
+                f"| {r.get('name')} | {state} "
+                f"| {r.get('fail_reason') or '-'} "
+                f"| {r.get('hb_age_s') if r.get('hb_age_s') is not None else '-'} "
+                f"| {r.get('routed')} | {r.get('queue_depth')} |")
+        lines.append("")
+        for f in cluster.get("failovers", []):
+            lines.append(f"- {f.get('replica')}: {f.get('reason')} "
+                         f"at t={f.get('ts')} — {f.get('requeued')} "
+                         "in-flight request(s) drained and re-queued")
+        if cluster.get("failovers"):
+            lines.append("")
 
     hot = report["links"].get("hot") or []
     if hot:

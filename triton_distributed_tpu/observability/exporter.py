@@ -147,6 +147,9 @@ class MetricsServer:
                 elif self.path.startswith("/decisions"):
                     body = json.dumps(decision_table()).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/routing"):
+                    body = json.dumps(routing_table()).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
@@ -240,6 +243,17 @@ def decision_table(n: int = 50) -> dict:
         recent_decisions)
     return {"schema": 1, "rank": _process_index(),
             "decisions": [e.to_dict() for e in recent_decisions(n)]}
+
+
+def routing_table() -> dict:
+    """JSON view of the live serving cluster's router state (replica
+    health, routed counts, failovers — `serving.cluster`) — the
+    ``/routing`` endpoint.  ``router`` is null in a process that runs
+    no cluster."""
+    from triton_distributed_tpu.serving.cluster import (
+        current_routing_table)
+    return {"schema": 1, "rank": _process_index(),
+            "router": current_routing_table()}
 
 
 # ---------------------------------------------------------------------------

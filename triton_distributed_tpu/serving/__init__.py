@@ -41,3 +41,12 @@ from triton_distributed_tpu.serving.toy import (  # noqa: F401
     ToyConfig,
     ToyModel,
 )
+# The disaggregated cluster rides on top of the scheduler (imported
+# last to keep the dependency direction one-way).
+from triton_distributed_tpu.serving.cluster import (  # noqa: F401,E402
+    ClusterConfig,
+    ClusterRequest,
+    KVShipment,
+    RouterConfig,
+    ServingCluster,
+)

@@ -1,0 +1,33 @@
+"""Disaggregated serving cluster: router + data-parallel engine
+replicas + optional dedicated prefill workers with KV shipping.
+
+See docs/serving.md "Disaggregated cluster" for the topology, the
+routing-signal table and the drain/failover semantics.
+"""
+
+from triton_distributed_tpu.serving.cluster.cluster import (  # noqa: F401
+    ENV_CLUSTER_SPEC,
+    ENV_ROLE,
+    ENV_ROLE_INDEX,
+    ROLES,
+    ClusterConfig,
+    ClusterRequest,
+    ServingCluster,
+    current_routing_table,
+    role_from_env,
+)
+from triton_distributed_tpu.serving.cluster.prefill import (  # noqa: F401
+    PrefillWorker,
+)
+from triton_distributed_tpu.serving.cluster.replica import (  # noqa: F401
+    Replica,
+    advance_request_key,
+)
+from triton_distributed_tpu.serving.cluster.router import (  # noqa: F401
+    ClusterRouter,
+    RouterConfig,
+)
+from triton_distributed_tpu.serving.cluster.transport import (  # noqa: F401
+    KVShipment,
+    VirtualTransport,
+)

@@ -1,0 +1,624 @@
+"""`ServingCluster`: router + N engine replicas (+ prefill workers)
+on one deterministic event loop.
+
+This is the scale-out of the single-engine scheduler (PR 3/6) over
+the disaggregated-serving shape: a front-door router places requests
+on data-parallel replicas (each a full `ContinuousBatchingScheduler`
+with its own KV pool), optional dedicated prefill workers compute
+prompt KV and ship it to the chosen decode replica over the
+`VirtualTransport` wire, and failures — heartbeat loss, a straggling
+replica — drain + re-queue in-flight requests with **exact resume**
+(the stream continues token-for-token as if nothing happened, see
+`replica.advance_request_key`).
+
+Execution is an event-driven virtual-time simulation by default
+(every replica/worker has its own ``busy_until`` timeline over a
+shared clock; the loop advances to the next event), which is what
+makes the chaos test and the router bench deterministic and
+machine-independent — the same code runs on the wall clock by
+passing ``clock=time.monotonic``.  Token streams never depend on the
+time model at all: a request's tokens are a function of (prompt,
+seed) only (the masked-step guarantee), so cluster output is
+token-for-token identical to the single-engine scheduler's whatever
+the routing, shipping or failure schedule did.
+
+Client API: :meth:`ServingCluster.submit` returns a
+:class:`ClusterRequest` — the router-side record that survives
+failover (the per-replica `serving.Request` objects are disposable
+attempts; the record accumulates the mirrored token stream across
+them).
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import dataclasses
+import itertools
+import json
+import os
+import time
+import weakref
+from typing import Callable, Deque, Dict, List, Optional, Sequence
+
+from triton_distributed_tpu.serving.cluster.prefill import (
+    PrefillWorker,
+)
+from triton_distributed_tpu.serving.cluster.replica import (
+    Replica,
+    advance_request_key,
+)
+from triton_distributed_tpu.serving.cluster.router import (
+    ClusterRouter,
+    RouterConfig,
+)
+from triton_distributed_tpu.serving.cluster.transport import (
+    VirtualTransport,
+)
+from triton_distributed_tpu.serving.request import (
+    FinishReason,
+    RejectReason,
+    Request,
+)
+from triton_distributed_tpu.serving.scheduler import SchedulerConfig
+
+_next_record_id = itertools.count()
+
+#: Refusals that clear on their own (the queue drains, another replica
+#: takes it) — the record stays queued and re-routes, never truncated.
+#: Everything else is structural: replicas are homogeneous, so a
+#: bucket/KV infeasibility here is infeasible everywhere.
+_TRANSIENT_REJECTS = (RejectReason.QUEUE_FULL, RejectReason.STOPPED)
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    n_replicas: int = 2
+    #: 0 = replicas prefill locally at admission (the PR-3 path);
+    #: >0 = disaggregated: prompts prefill on dedicated workers and
+    #: the KV ships to the chosen decode replica.
+    n_prefill_workers: int = 0
+    scheduler: SchedulerConfig = dataclasses.field(
+        default_factory=SchedulerConfig)
+    router: RouterConfig = dataclasses.field(
+        default_factory=RouterConfig)
+    #: Modeled virtual cost of one decode step / one bucketed prefill
+    #: (the real compute still runs; these price the event timeline).
+    step_time_s: float = 1e-3
+    prefill_time_s: float = 2e-3
+    #: Modeled DCN bandwidth for KV shipments (None = instant wire).
+    wire_gbps: Optional[float] = 25.0
+    #: When set, ``router-state.json`` is (re)written here on every
+    #: failover — the artifact the doctor's Cluster section ingests.
+    artifact_dir: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ClusterRequest:
+    """The client's handle: survives failover, accumulates the
+    mirrored token stream across replica attempts."""
+
+    prompt: List[int]
+    max_new_tokens: int
+    eos_token_ids: tuple = ()
+    seed: int = 0
+    arrival_time: float = 0.0
+    on_token: Optional[Callable] = None
+    record_id: int = dataclasses.field(
+        default_factory=lambda: next(_next_record_id))
+
+    # -- cluster-owned lifecycle --
+    state: str = "queued"          # queued | running | finished | rejected
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    replica: Optional[int] = None
+    replica_history: List[int] = dataclasses.field(default_factory=list)
+    failovers: int = 0
+    finish_reason: Optional[str] = None
+    reject_reason: Optional[str] = None
+    t_first_token: Optional[float] = None
+    t_finish: Optional[float] = None
+    #: A claimed-but-undelivered `KVShipment` (decode-side
+    #: backpressure refused the row after it crossed the wire).  The
+    #: artifact is replica-agnostic, so the re-route attaches it
+    #: directly — no second prefill, nothing new on the wire.
+    ship_cache: Optional[object] = None
+
+    @property
+    def done(self) -> bool:
+        return self.state in ("finished", "rejected")
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.arrival_time
+
+    @property
+    def latency(self) -> Optional[float]:
+        if self.t_finish is None:
+            return None
+        return self.t_finish - self.arrival_time
+
+
+class _VClock:
+    def __init__(self):
+        self.t = 0.0
+
+
+class ServingCluster:
+    def __init__(self, model, params,
+                 config: Optional[ClusterConfig] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 clock_advance: Optional[Callable[[float], None]] = None):
+        self.config = cfg = config or ClusterConfig()
+        if clock is None:
+            v = _VClock()
+            clock = lambda: v.t                          # noqa: E731
+            clock_advance = lambda dt: setattr(           # noqa: E731
+                v, "t", v.t + dt)
+        self._clock = clock
+        self._clock_advance = clock_advance
+        self.replicas = [
+            Replica(i, model, params, cfg.scheduler, clock,
+                    step_time_s=cfg.step_time_s)
+            for i in range(cfg.n_replicas)]
+        self.workers = [
+            PrefillWorker(i, model, params,
+                          self.replicas[0].scheduler.buckets,
+                          pad_id=cfg.scheduler.pad_id,
+                          prefill_time_s=cfg.prefill_time_s)
+            for i in range(cfg.n_prefill_workers)]
+        self.transport = VirtualTransport(wire_gbps=cfg.wire_gbps)
+        self.router = ClusterRouter(cfg.router, self.replicas)
+        self._pending: List[ClusterRequest] = []
+        self._pending_i = 0
+        self._requeue: Deque[ClusterRequest] = collections.deque()
+        #: True while the requeue head is backpressure-blocked (every
+        #: routable replica refused it) — `_advance` must move time to
+        #: the next replica step instead of spinning at `now`.
+        self._blocked = False
+        self._ships: List[dict] = []
+        self._by_req: Dict[int, ClusterRequest] = {}
+        #: request_id -> the router stage a worker dispatch detached
+        #: (`ClusterRouter.take_staged`); committed only when the
+        #: shipment's delivery is ACCEPTED by the decode replica, so
+        #: the worker path keeps the commit-on-accept invariant.
+        self._staged_routes: Dict[int, tuple] = {}
+        self._wrr = 0
+        self._open = 0
+        self.finished: List[ClusterRequest] = []
+        _register(self)
+        self._update_gauges()
+
+    # -- client API ------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               eos_token_ids: Sequence[int] = (), seed: int = 0,
+               arrival_time: Optional[float] = None,
+               on_token: Optional[Callable] = None) -> ClusterRequest:
+        arrival = (self._clock() if arrival_time is None
+                   else float(arrival_time))
+        record = ClusterRequest(
+            prompt=[int(t) for t in prompt],
+            max_new_tokens=int(max_new_tokens),
+            eos_token_ids=tuple(int(t) for t in eos_token_ids),
+            seed=int(seed), arrival_time=arrival, on_token=on_token)
+        # Kept sorted by arrival (stable for ties: submission order)
+        # within the not-yet-routed tail, so the router always sees
+        # the next arrival at the head whatever order clients submit.
+        idx = bisect.bisect_right(self._pending, arrival,
+                                  lo=self._pending_i,
+                                  key=lambda r: r.arrival_time)
+        self._pending.insert(idx, record)
+        self._open += 1
+        return record
+
+    def has_work(self) -> bool:
+        return self._open > 0
+
+    def drain(self) -> List[ClusterRequest]:
+        """Run until every submitted request reached a terminal state;
+        returns finished records in completion order."""
+        while self.has_work():
+            self.step()
+        return self.finished
+
+    def take_finished(self) -> List[ClusterRequest]:
+        """Hand over (and forget) the finished records.  A
+        long-running server driving `step` directly must consume
+        completions through this — `finished` otherwise accumulates
+        every record (prompt + full stream) for the process lifetime;
+        `drain()`'s return-everything contract is for bounded runs."""
+        out = self.finished
+        self.finished = []
+        return out
+
+    # -- chaos hooks -----------------------------------------------------
+
+    def kill_replica(self, idx: int) -> None:
+        self.replicas[idx].kill()
+
+    def straggle_replica(self, idx: int, factor: float) -> None:
+        self.replicas[idx].inject_straggle(factor)
+
+    # -- the event loop --------------------------------------------------
+
+    def step(self) -> dict:
+        now = self._clock()
+        for rep in self.replicas:
+            rep.beat(now)
+        progressed = self._pump_ships(now)
+        progressed |= self._pump_queue(now)
+        for w in self.workers:
+            out = w.step(now, self.transport)
+            if out is not None:
+                req, dst, token, ready_at = out
+                self._ships.append({
+                    "req": req, "dst": dst, "token": token,
+                    "ready_at": ready_at,
+                    "record": self._by_req.get(req.request_id)})
+                progressed = True
+        stepped = 0
+        for rep in self.replicas:
+            if rep.ready(now):
+                rep.step(now)
+                self._collect_finished(rep, now)
+                stepped += 1
+        progressed |= stepped > 0
+        self._health(now)
+        if not progressed:
+            self._advance(now)
+        return {"now": now, "stepped": stepped,
+                "open": self._open}
+
+    # -- routing / dispatch ----------------------------------------------
+
+    def _pump_queue(self, now: float) -> bool:
+        progressed = False
+        self._blocked = False
+        if (self._pending_i > 256
+                and self._pending_i * 2 >= len(self._pending)):
+            # Drop the already-routed prefix: a long-running server
+            # must not retain every record (prompt + full stream)
+            # forever just to keep the queue cursor meaningful.
+            del self._pending[:self._pending_i]
+            self._pending_i = 0
+        while self._requeue:
+            if not self._dispatch(self._requeue[0], now):
+                self._blocked = True
+                return progressed
+            self._requeue.popleft()
+            progressed = True
+        while self._pending_i < len(self._pending):
+            record = self._pending[self._pending_i]
+            if record.arrival_time > now:
+                break
+            if not self._dispatch(record, now):
+                break
+            self._pending_i += 1
+            progressed = True
+        return progressed
+
+    def _dispatch(self, record: ClusterRequest, now: float) -> bool:
+        """True = the record left the queue (placed or terminally
+        resolved); False = keep it queued and retry later."""
+        rep = self.router.route(record.prompt,
+                                f"request:{record.record_id}", now)
+        if rep is None:
+            return False
+        req = self._make_request(record, now)
+        if (self.workers and req.resume_key is None
+                and record.ship_cache is None):
+            # Disaggregated path: prompt KV is computed on a prefill
+            # worker and shipped to the chosen decode replica.
+            # Resumed (failover) requests skip it: their "prompt"
+            # embeds already-streamed tokens and latency matters more
+            # than offloading one re-prefill.
+            reason = rep.scheduler.structural_reject(req)
+            if reason is not None:
+                # submit() would reject this on every (homogeneous)
+                # replica — resolve it here rather than crash the
+                # prefill worker on an unbucketable prompt.
+                self.router.take_staged()    # never landed
+                req.reject_reason = reason
+                self._resolve_structural(record, req)
+                return True
+            record.replica = rep.id
+            record.replica_history.append(rep.id)
+            record.state = "running"
+            w = self.workers[self._wrr % len(self.workers)]
+            self._wrr += 1
+            w.submit(req, rep.id)
+            self._by_req[req.request_id] = record
+            # Commit-on-accept holds here too: the route is recorded
+            # when the decode replica accepts the delivered shipment
+            # (`_pump_ships`), not at worker hand-off — detach the
+            # stage, since other routes will stage in between.
+            self._staged_routes[req.request_id] = (
+                self.router.take_staged())
+            return True
+        if record.ship_cache is not None:
+            # A prior delivery was refused on backpressure after the
+            # row crossed the wire: reuse the claimed artifact (it is
+            # replica-agnostic) instead of prefilling again.
+            req.shipped_kv = record.ship_cache
+        accepted = self._submit_to(rep, req, record)
+        if accepted:
+            record.ship_cache = None
+            self.router.commit_route()
+        return accepted or record.done
+
+    def _make_request(self, record: ClusterRequest,
+                      now: float) -> Request:
+        done = len(record.tokens)
+        req = Request(
+            prompt=list(record.prompt) + list(record.tokens),
+            max_new_tokens=record.max_new_tokens - done,
+            eos_token_ids=record.eos_token_ids, seed=record.seed,
+            arrival_time=(record.arrival_time if done == 0 else now),
+            on_token=self._mirror(record))
+        if done:
+            # Exact resume from router-side state alone: re-prefill
+            # recomputes the KV of prompt+streamed bit-identically,
+            # and the PRNG key is a pure function of (seed, streamed).
+            req.resume_key = advance_request_key(record.seed, done)
+        return req
+
+    def _mirror(self, record: ClusterRequest):
+        def cb(req, tok):
+            if record.t_first_token is None:
+                record.t_first_token = self._clock()
+            record.tokens.append(int(tok))
+            if record.on_token is not None:
+                record.on_token(record, tok)
+        return cb
+
+    def _submit_to(self, rep: Replica, req: Request,
+                   record: ClusterRequest) -> bool:
+        """Deliver ``req`` to ``rep``'s scheduler.  True = accepted
+        (record now running there).  False = refused: a transient
+        refusal leaves the record "queued" for a later re-route
+        (nothing is ever truncated by backpressure); a structural one
+        resolves it terminally (``record.done``)."""
+        if rep.scheduler.submit(req):
+            self._by_req[req.request_id] = record
+            if record.replica != rep.id:
+                record.replica_history.append(rep.id)
+            record.replica = rep.id
+            record.state = "running"
+            return True
+        self._by_req.pop(req.request_id, None)
+        record.replica = None
+        if req.reject_reason in _TRANSIENT_REJECTS:
+            record.state = "queued"
+            return False
+        self._resolve_structural(record, req)
+        return False
+
+    def _resolve_structural(self, record: ClusterRequest,
+                            req: Request) -> None:
+        """Terminal resolution of a structurally infeasible request
+        (replicas are homogeneous: a bucket/KV infeasibility here is
+        infeasible everywhere).  A resumed stream that outgrew the
+        buckets still delivered what it had; a fresh request is a
+        true reject."""
+        if record.tokens:
+            record.state = "finished"
+            record.finish_reason = FinishReason.KV_CAPACITY.value
+            record.t_finish = self._clock()
+            self.finished.append(record)
+        else:
+            record.state = "rejected"
+            record.reject_reason = (
+                req.reject_reason.value if req.reject_reason else None)
+        self._open -= 1
+
+    def _pump_ships(self, now: float) -> bool:
+        progressed = False
+        for ship in [s for s in self._ships
+                     if s["ready_at"] <= now]:
+            self._ships.remove(ship)
+            record = ship["record"]
+            rep = self.replicas[ship["dst"]]
+            if (record is None or record.state != "running"
+                    or record.replica != ship["dst"]
+                    or not rep.routable):
+                # The destination failed (or the record was re-queued)
+                # while the shipment was on the wire: drop the wire
+                # copy — the record already took the failover path.
+                self.transport.drop(ship["token"])
+                self._by_req.pop(ship["req"].request_id, None)
+                self._staged_routes.pop(ship["req"].request_id, None)
+                continue
+            req = ship["req"]
+            req.shipped_kv = self.transport.claim(ship["token"])
+            staged = self._staged_routes.pop(req.request_id, None)
+            if self._submit_to(rep, req, record):
+                self.router.commit_staged(staged)
+            elif not record.done:
+                # Transient backpressure at the decode side: nothing
+                # has streamed and the route never landed (its stage
+                # dies uncommitted) — keep the claimed row on the
+                # record and re-route when capacity frees; the next
+                # dispatch delivers it directly, no second prefill.
+                record.ship_cache = req.shipped_kv
+                req.shipped_kv = None
+                self._requeue.append(record)
+            progressed = True
+        return progressed
+
+    # -- completion ------------------------------------------------------
+
+    def _collect_finished(self, rep: Replica, now: float) -> None:
+        fin = rep.scheduler.finished
+        while rep.fin_i < len(fin):
+            req = fin[rep.fin_i]
+            rep.fin_i += 1
+            record = self._by_req.pop(req.request_id, None)
+            if record is None:
+                continue           # drained before stop(); re-queued
+            record.state = "finished"
+            record.finish_reason = (req.finish_reason.value
+                                    if req.finish_reason else None)
+            record.replica = None
+            record.t_finish = now
+            self.finished.append(record)
+            self._open -= 1
+
+    # -- health / failover -----------------------------------------------
+
+    def _health(self, now: float) -> None:
+        for rep, reason in self.router.health_verdicts(now):
+            self._failover(rep, reason, now)
+
+    def _failover(self, rep: Replica, reason: str,
+                  now: float) -> None:
+        """Drain a failed replica: every non-terminal request assigned
+        to it is re-queued (front of the router queue) with exact
+        resume state; the replica is marked dead/quarantined."""
+        victims: List[ClusterRequest] = []
+        for req_id, record in list(self._by_req.items()):
+            if record.replica == rep.id and not record.done:
+                victims.append(record)
+                del self._by_req[req_id]
+        if rep.alive:
+            # A straggler is still a live process: stop its scheduler
+            # so its slots free deterministically.  (Its requests are
+            # already unmapped — the STOPPED retirements there do not
+            # touch the records.)  A dead process gets no calls.
+            rep.scheduler.stop()
+        for record in sorted(victims, key=lambda r: r.record_id,
+                             reverse=True):
+            record.replica = None
+            record.state = "queued"
+            record.failovers += 1
+            self._requeue.appendleft(record)
+        self.router.note_failover(rep, reason, len(victims), now)
+        # The re-queued victims are new same-tick work: let `_advance`
+        # hold time so they route at the failure's virtual timestamp.
+        self._blocked = False
+        self._update_gauges()
+        if self.config.artifact_dir:
+            self.write_artifact(self.config.artifact_dir)
+
+    # -- time ------------------------------------------------------------
+
+    def _advance(self, now: float) -> None:
+        if (self._requeue and not self._blocked
+                and any(r.routable for r in self.replicas)):
+            # A failover this step re-queued dispatchable work: it
+            # routes at the SAME virtual time on the next tick.  (A
+            # backpressure-blocked head instead waits for the next
+            # replica step below — the queues must drain first.)
+            return
+        cands: List[float] = []
+        if self._pending_i < len(self._pending):
+            # Only a FUTURE arrival is an event; a past-due head is
+            # merely backpressure-blocked and waits on a replica step.
+            arrival = self._pending[self._pending_i].arrival_time
+            if arrival > now:
+                cands.append(arrival)
+        cands.extend(s["ready_at"] for s in self._ships)
+        for w in self.workers:
+            if w.queue:
+                cands.append(w.busy_until)
+        for rep in self.replicas:
+            if (rep.alive and rep.routable
+                    and rep.scheduler.has_work()):
+                cands.append(rep.busy_until)
+            if not rep.alive and rep.routable:
+                # Dead process awaiting detection: the next event is
+                # the router's heartbeat-loss deadline.
+                cands.append(rep.hb_ts
+                             + self.router.config.dead_after_s + 1e-6)
+        if not cands:
+            if self.has_work():
+                raise RuntimeError(
+                    "cluster stalled: open requests but no future "
+                    "event (all replicas failed?)")
+            return
+        dt = max(min(cands) - now, 1e-9)
+        if self._clock_advance is not None:
+            self._clock_advance(dt)
+        else:
+            time.sleep(min(dt, 0.001))
+
+    # -- introspection / artifacts ---------------------------------------
+
+    def routing_table(self) -> dict:
+        t = self.router.table(self._clock())
+        t["prefill_workers"] = [
+            {"name": w.name, "queued": len(w.queue),
+             "jobs_done": w.jobs_done} for w in self.workers]
+        t["kv_shipped_bytes"] = self.transport.shipped_bytes
+        t["shipments"] = self.transport.shipments
+        t["open_requests"] = self._open
+        return t
+
+    def write_artifact(self, directory: str) -> str:
+        """Write ``router-state.json`` — the doctor ingests it into
+        its Cluster section and names failed replicas."""
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, "router-state.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.routing_table(), f, indent=1)
+        os.replace(tmp, path)
+        return path
+
+    def _update_gauges(self) -> None:
+        from triton_distributed_tpu.observability.metrics import (
+            get_registry, observability_enabled)
+        if not observability_enabled():
+            return
+        reg = get_registry()
+        reg.gauge("cluster_replicas_total").set(len(self.replicas))
+        reg.gauge("cluster_replicas_alive").set(
+            sum(1 for r in self.replicas if r.routable))
+
+
+# ---------------------------------------------------------------------------
+# Process-global registration (the exporter's /routing endpoint)
+# ---------------------------------------------------------------------------
+
+_CURRENT: Optional[weakref.ref] = None
+
+
+def _register(cluster: ServingCluster) -> None:
+    global _CURRENT
+    _CURRENT = weakref.ref(cluster)
+
+
+def current_routing_table() -> Optional[dict]:
+    """The live cluster's routing table (None when no cluster exists
+    in this process) — what ``GET /routing`` serves."""
+    cluster = _CURRENT() if _CURRENT is not None else None
+    return cluster.routing_table() if cluster is not None else None
+
+
+# ---------------------------------------------------------------------------
+# Role plumbing (scripts/launch.py --roles)
+# ---------------------------------------------------------------------------
+
+ENV_ROLE = "TDT_ROLE"
+ENV_ROLE_INDEX = "TDT_ROLE_INDEX"
+ENV_CLUSTER_SPEC = "TDT_CLUSTER_SPEC"
+
+ROLES = ("router", "replica", "prefill")
+
+
+def role_from_env() -> Optional[dict]:
+    """The cluster role `scripts/launch.py --roles` assigned this
+    process: ``{"role", "index", "spec"}`` (spec = {role: count}),
+    or None outside a role-plumbed launch."""
+    role = os.environ.get(ENV_ROLE)
+    if not role:
+        return None
+    spec: Dict[str, int] = {}
+    for part in os.environ.get(ENV_CLUSTER_SPEC, "").split(","):
+        name, _, count = part.partition(":")
+        if name and count.isdigit():
+            spec[name] = int(count)
+    return {"role": role,
+            "index": int(os.environ.get(ENV_ROLE_INDEX, "0")),
+            "spec": spec}

@@ -1,0 +1,92 @@
+"""Dedicated prefill workers: the compute-bound half of the split.
+
+A `PrefillWorker` runs the SAME jitted bucketed prefill the scheduler
+runs at admission (`model.make_prefill_fn`, right-padded to the same
+length buckets, the same reusable per-bucket input row cache) and
+flattens the result into a `KVShipment` for the transport.  Because
+the artifact is identical to a local prefill's, the decode replica's
+insert is bit-exact — disaggregation changes WHERE prefill runs and
+WHEN decode steps stall (never, that's the point), not a single
+token.
+
+Virtual-clock accounting: the worker is busy for ``prefill_time_s``
+per job (the modeled prompt-FLOPs cost); the shipment then rides the
+wire for ``transport.ship_time_s(nbytes)``.  The `ServingCluster`
+owns delivery — the worker just turns (request, destination) pairs
+into (token, nbytes, done_at) tuples.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Deque, Dict, Optional, Tuple
+
+import jax
+
+from triton_distributed_tpu.serving.cluster.transport import (
+    KVShipment,
+    VirtualTransport,
+)
+from triton_distributed_tpu.serving.engine_batched import (
+    pad_prompt,
+    pick_bucket,
+)
+
+
+class PrefillWorker:
+    def __init__(self, wid: int, model, params, buckets,
+                 pad_id: int = 0, prefill_time_s: float = 2e-3):
+        self.id = int(wid)
+        self.name = f"prefill-{wid}"
+        self.model = model
+        self.params = params
+        self.buckets = tuple(sorted(buckets))
+        self.pad_id = pad_id
+        self.prefill_time_s = float(prefill_time_s)
+        self._prefill = jax.jit(model.make_prefill_fn())
+        self._row_caches: Dict[int, object] = {}
+        #: (request, destination replica id) jobs, FIFO.
+        self.queue: Deque[tuple] = collections.deque()
+        self.busy_until = 0.0
+        self.jobs_done = 0
+
+    def submit(self, req, dst: int) -> None:
+        self.queue.append((req, int(dst)))
+
+    def ready(self, now: float) -> bool:
+        return bool(self.queue) and now >= self.busy_until
+
+    def _row_cache(self, bucket: int):
+        row = self._row_caches.get(bucket)
+        if row is None:
+            row = self.model.create_cache(1, max_seq=bucket)
+            self._row_caches[bucket] = row
+        return row
+
+    def step(self, now: float, transport: VirtualTransport
+             ) -> Optional[Tuple]:
+        """Run ONE queued prefill and put its shipment on the wire.
+        Returns ``(req, dst, token, ready_at)`` — the cluster delivers
+        the claim to ``dst`` at virtual time ``ready_at`` — or None
+        when idle."""
+        if not self.ready(now):
+            return None
+        req, dst = self.queue.popleft()
+        bucket = pick_bucket(len(req.prompt), self.buckets)
+        assert bucket is not None, (len(req.prompt), self.buckets)
+        ids, s = pad_prompt(req.prompt, bucket, self.pad_id)
+        _, row = self._prefill(self.params, ids,
+                               self._row_cache(bucket))
+        shipment = KVShipment.from_row_cache(row, s)
+        token, nbytes = transport.ship(shipment)
+        self.busy_until = now + self.prefill_time_s
+        self.jobs_done += 1
+        from triton_distributed_tpu.observability.metrics import (
+            get_registry, observability_enabled)
+        if observability_enabled():
+            reg = get_registry()
+            reg.counter("cluster_prefill_shipments_total",
+                        worker=self.name).inc()
+            reg.counter("cluster_kv_shipped_bytes_total").inc(nbytes)
+        return req, dst, token, (self.busy_until
+                                 + transport.ship_time_s(nbytes))

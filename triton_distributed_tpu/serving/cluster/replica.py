@@ -1,0 +1,162 @@
+"""One data-parallel engine replica, as the router sees it.
+
+A `Replica` wraps a full `ContinuousBatchingScheduler` (its own KV
+pool, its own masked-step program) plus the cluster-facing state the
+router reads: a heartbeat timestamp, the modeled per-step cost on the
+shared virtual clock, and the `ReplicaSignals` snapshot routing scores
+are computed from.  In the in-process virtual cluster the snapshot is
+read straight off the scheduler; a multi-process deployment exports
+the identical fields through the heartbeat files the PR-2 exporter
+already writes (queue depth / slot occupancy / page gauges ride
+`heartbeat_payload`'s serving section).
+
+Fault injection mirrors the kernel-level knobs:
+
+- :meth:`Replica.kill` is process death — the heartbeat freezes, and
+  the router's liveness check (not this object's ``alive`` flag, which
+  models the OS's view) detects the loss after ``dead_after_s``;
+- :meth:`Replica.inject_straggle` is the serving-cluster analogue of
+  ``dl.maybe_straggle`` (`language/core.py` — delay one rank before it
+  communicates): the replica stays alive and beating but every decode
+  step costs ``factor``× on the virtual clock, which is exactly the
+  signature a contended-ICI or thermally-throttled replica shows.
+
+Exact resume is host-side arithmetic, not device state: a slot's PRNG
+key after ``g`` generated tokens is ``split^g(PRNGKey(seed))[0]``
+(`engine_batched._split_rows` advances active rows once per executed
+step, and an in-flight request's executed steps == its streamed
+tokens), so :func:`advance_request_key` recomputes the resume key from
+the router's mirrored token count alone — a DEAD replica's requests
+resume bit-exactly with nothing salvaged from the corpse.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from triton_distributed_tpu.serving.engine_batched import request_key
+from triton_distributed_tpu.serving.scheduler import (
+    ContinuousBatchingScheduler,
+)
+
+
+@jax.jit
+def _advance_key(key, generated):
+    return jax.lax.fori_loop(
+        0, generated, lambda _, k: jax.random.split(k)[0], key)
+
+
+def advance_request_key(seed: int, generated: int) -> np.ndarray:
+    """The slot PRNG key of a request that has streamed ``generated``
+    tokens: pure function of (seed, count) — the failover path's
+    resume key (see module docstring for why the counts line up).
+    One fused dispatch however long the stream: failover cost must
+    not scale with how much the victims had already generated."""
+    key = _advance_key(request_key(seed), int(generated))
+    return np.asarray(key)
+
+
+class Replica:
+    def __init__(self, rid: int, model, params, sched_config,
+                 clock, step_time_s: float = 1e-3):
+        self.id = int(rid)
+        self.name = f"replica-{rid}"
+        self._clock = clock
+        self.scheduler = ContinuousBatchingScheduler(
+            model, params, sched_config, clock=clock)
+        #: Process liveness (the OS's view): `kill` clears it.  The
+        #: ROUTER never reads this — it learns of death the only way
+        #: a real router can, from the heartbeat going stale.
+        self.alive = True
+        #: Router verdicts (set by the cluster's health check).
+        self.dead = False
+        self.quarantined = False
+        self.fail_reason: Optional[str] = None
+        self.straggle_factor = 1.0
+        #: Worst background utilization over this replica's ICI/DCN
+        #: links, [0, 1).  A deployment feeds it from the replica's
+        #: own `SignalBus` link signals; the virtual cluster's tests
+        #: and benches script it.  The router derates the replica's
+        #: step time to its residual-bandwidth share.
+        self.link_busy = 0.0
+        self.base_step_s = float(step_time_s)
+        self.last_step_s = float(step_time_s)
+        self.busy_until = 0.0
+        self.hb_ts = float(clock())
+        self.routed_total = 0
+        #: Cluster-side cursor into ``scheduler.finished`` (which
+        #: retirements the cluster has already finalized).
+        self.fin_i = 0
+
+    # -- fault injection -------------------------------------------------
+
+    def kill(self) -> None:
+        """Process death: no more steps, no more heartbeats."""
+        self.alive = False
+
+    def inject_straggle(self, factor: float) -> None:
+        """Slow every decode step by ``factor``× on the virtual clock
+        — the cluster-level ``dl.maybe_straggle``.  The replica keeps
+        beating; the router must catch it from its step-time signal,
+        not from liveness."""
+        self.straggle_factor = float(factor)
+
+    # -- cluster loop ----------------------------------------------------
+
+    @property
+    def routable(self) -> bool:
+        """May the router place NEW work here?  Based purely on the
+        router's own verdicts (a killed-but-undetected replica is
+        still routable — that window is what failover re-queues)."""
+        return not self.dead and not self.quarantined
+
+    def beat(self, now: float) -> None:
+        if self.alive:
+            self.hb_ts = now
+
+    def ready(self, now: float) -> bool:
+        return (self.alive and not self.dead and not self.quarantined
+                and now >= self.busy_until
+                and self.scheduler.has_work())
+
+    def step(self, now: float) -> dict:
+        """One scheduler iteration; charges the modeled step cost
+        (× the injected straggle) to this replica's own timeline."""
+        out = self.scheduler.step()
+        cost = self.base_step_s * self.straggle_factor
+        self.last_step_s = cost
+        self.busy_until = now + cost
+        return out
+
+    # -- signals ---------------------------------------------------------
+
+    def signals(self, now: float) -> dict:
+        """The routing-score snapshot the router scores from (see
+        `router.ClusterRouter._score` for the formula; the same
+        fields ride heartbeat files in a multi-process deployment)."""
+        s = self.scheduler
+        return {
+            "ts": self.hb_ts,
+            "queue_depth": len(s._queue),
+            "active_slots": s.slots.active_slots,
+            "kv_occupancy": (s.slots.page_occupancy if s.paged
+                             else s.slots.occupancy),
+            "step_us": self.last_step_s * 1e6,
+            "link_busy": float(self.link_busy),
+        }
+
+    def table_row(self, now: float) -> dict:
+        """One `/routing` / router-artifact row."""
+        return {
+            "id": self.id, "name": self.name,
+            "alive": not self.dead, "quarantined": self.quarantined,
+            "fail_reason": self.fail_reason,
+            "hb_age_s": round(now - self.hb_ts, 6),
+            "routed": self.routed_total,
+            "queue_depth": len(self.scheduler._queue),
+            "active_slots": self.scheduler.slots.active_slots,
+            "last_step_s": self.last_step_s,
+        }

@@ -1,0 +1,301 @@
+"""The front-door router: placement, health verdicts, explainability.
+
+Placement is **load- and link-aware** when it can be and round-robin
+when it can't, with the PR-8 degradation contract: the signal-aware
+chooser with an absent or stale signal snapshot makes BIT-IDENTICAL
+choices to the round-robin router (both walk the same rotation
+counter), so arming signals can never change behavior until signals
+actually exist.
+
+Scoring (deterministic, documented so a DecisionEvent's numbers can
+be re-derived by hand):
+
+    eff_step_us = step_us / max(1 - min(link_busy, LINK_CAP), 0.1)
+    score_us    = (1 + queue_depth + active_slots) * eff_step_us
+
+i.e. "how many step-times of work is already in line here, each
+step derated by the background load on this replica's ICI/DCN links"
+— the same residual-bandwidth idea `feedback.effective_spec` applies
+to method selection, folded into placement (the PR-8 follow-up).
+Replicas at ``kv_occupancy >= KV_FULL`` are skipped outright unless
+every candidate is (admitting into a thrashing pool only buys a
+preemption).  Ties break along the rotation, so perfectly balanced
+signals reproduce round-robin exactly.
+
+**Prefix affinity**: the first ``affinity_tokens`` prompt tokens key a
+home-replica map — a same-prefix request follows its home (the radix
+cache there already holds the prefix pages) unless the home's score
+has fallen more than ``affinity_slack``× behind the best candidate
+(affinity must yield to load, or one hot system prompt melts one
+replica).  Affinity only acts in the signal-aware regime: the
+round-robin fallback stays bit-identical.
+
+Every routing choice and every health verdict is recorded as a
+schema-v1 `DecisionEvent` (`observability.feedback`) — consumers
+``cluster.router`` and ``cluster.failover`` — so ``decisions.jsonl``,
+the ``/decisions`` endpoint and the doctor's "Control decisions"
+table explain cluster behavior with the same machinery as the other
+closed-loop consumers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: Utilization cap for the link derate (mirrors feedback.UTILIZATION_CAP:
+#: a saturated link slows a replica, it does not make it infinite).
+LINK_CAP = 0.9
+#: Page/slot occupancy at which a replica stops taking new work.
+KV_FULL = 0.98
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    #: "signal_aware" scores replicas on their signal snapshots;
+    #: "round_robin" is the static baseline (also the degradation
+    #: target when snapshots are absent/stale).
+    mode: str = "signal_aware"
+    #: A replica signal snapshot older than this is stale; ANY stale
+    #: or missing snapshot degrades the whole decision to round-robin
+    #: (partial information would silently bias against the quiet
+    #: replica — the one most likely to be idle).
+    staleness_s: float = 10.0
+    #: Heartbeat age past which a replica is declared dead and its
+    #: requests re-queued.
+    dead_after_s: float = 3.0
+    #: A replica whose step time exceeds this multiple of the median
+    #: routable peer's is quarantined (drain + re-queue) — the
+    #: ``dl.maybe_straggle`` detector.
+    straggle_ratio: float = 4.0
+    #: Prompt tokens keying the prefix-affinity map (one KV page by
+    #: default).  0 disables affinity.
+    affinity_tokens: int = 16
+    #: Follow the affinity home while its score is within this factor
+    #: of the best candidate's.
+    affinity_slack: float = 2.5
+    #: Distinct prefixes the affinity map holds; least-recently-routed
+    #: prefixes are evicted past it (a long-running router serving
+    #: diverse prompts must not grow without bound).
+    affinity_max: int = 4096
+
+
+class ClusterRouter:
+    """Pure decision logic over a list of `Replica`-shaped objects;
+    the `ServingCluster` owns execution (stepping, draining,
+    re-queueing).  ``signals_fn(replica, now)`` supplies snapshots —
+    injectable so tests script absent/stale signals without touching
+    replica state."""
+
+    def __init__(self, config: Optional[RouterConfig], replicas,
+                 signals_fn=None):
+        self.config = config or RouterConfig()
+        self.replicas = list(replicas)
+        self._signals_fn = signals_fn or (
+            lambda rep, now: rep.signals(now))
+        #: Rotation counter — shared by the round-robin choice, the
+        #: degraded signal-aware choice and the tie-break, which is
+        #: what makes the degradation bit-identical.
+        self._rr = 0
+        self._affinity: Dict[Tuple[int, ...], int] = {}
+        self.failovers: List[dict] = []
+        #: The last route()'s decision payload, held until the cluster
+        #: confirms the dispatch landed (`commit_route`).
+        self._staged: Optional[tuple] = None
+
+    # -- placement -------------------------------------------------------
+
+    def _routable(self) -> List:
+        return [r for r in self.replicas if r.routable]
+
+    def route(self, tokens: Sequence[int], op: str, now: float):
+        """Pick a replica for one request (``tokens`` = its prompt,
+        ``op`` labels the DecisionEvent).  Returns None when no
+        replica is routable (caller keeps the request queued).  The
+        choice is STAGED, not yet recorded — the cluster calls
+        `commit_route` once the replica actually accepted, so a
+        backpressure-refused dispatch retried every event-loop tick
+        does not inflate routed counters or flood decisions.jsonl
+        with phantom placements."""
+        self._staged = None
+        alive = self._routable()
+        if not alive:
+            return None
+        k = self._rr % len(alive)
+        self._rr += 1
+        fallback = None
+        key = None
+        if self.config.mode != "signal_aware":
+            choice, candidates, inputs = alive[k], [], {}
+            fallback = "round_robin"
+        else:
+            sigs = {r.id: self._signals_fn(r, now) for r in alive}
+            if any(s is None or (now - s["ts"]) > self.config.staleness_s
+                   for s in sigs.values()):
+                choice, candidates, inputs = alive[k], [], {}
+                fallback = ("signals_absent"
+                            if any(s is None for s in sigs.values())
+                            else "signals_stale")
+            else:
+                choice, candidates, inputs, key = self._score(
+                    alive, k, sigs, tokens)
+        self._staged = (op, choice, candidates, inputs, fallback,
+                        len(alive), key)
+        return choice
+
+    def take_staged(self) -> Optional[tuple]:
+        """Detach the last `route()`'s staged decision: the caller
+        owns committing it (`commit_staged`) once the dispatch it
+        covers really lands.  The prefill-worker path needs this —
+        its acceptance (shipment delivery) happens whole virtual
+        milliseconds after route(), with other routes staging in
+        between."""
+        staged, self._staged = self._staged, None
+        return staged
+
+    def commit_route(self) -> None:
+        """Count + record the last `route()` once its dispatch landed
+        (no-op when nothing is staged or the choice was refused and
+        re-staged by a newer route).  The prefix-affinity map is also
+        written HERE — a refused placement must not re-home a prefix
+        to a replica that never accepted it, nor churn the LRU ahead
+        of prefixes whose requests actually landed."""
+        self.commit_staged(self.take_staged())
+
+    def commit_staged(self, staged: Optional[tuple]) -> None:
+        if staged is None:
+            return
+        (op, choice, candidates, inputs, fallback, n_alive,
+         key) = staged
+        if key is not None:
+            # Re-insert so dict order is recency-of-route: eviction
+            # past affinity_max drops the coldest prefix first.
+            self._affinity.pop(key, None)
+            self._affinity[key] = choice.id
+            while len(self._affinity) > self.config.affinity_max:
+                del self._affinity[next(iter(self._affinity))]
+        choice.routed_total += 1
+        self._record_route(op, choice, candidates, inputs, fallback,
+                           n_alive)
+
+    def _score(self, alive: List, k: int, sigs: Dict[int, dict],
+               tokens: Sequence[int]):
+        def score(sig: dict) -> float:
+            derate = max(1.0 - min(sig["link_busy"], LINK_CAP), 0.1)
+            eff = sig["step_us"] / derate
+            return (1.0 + sig["queue_depth"]
+                    + sig["active_slots"]) * eff
+
+        scores = {r.id: score(sigs[r.id]) for r in alive}
+        open_ = [r for r in alive
+                 if sigs[r.id]["kv_occupancy"] < KV_FULL] or alive
+        # Ties follow the rotation: candidate order starts at the
+        # round-robin choice, so equal scores reproduce it exactly.
+        order = sorted(
+            open_, key=lambda r: (scores[r.id],
+                                  (alive.index(r) - k) % len(alive)))
+        best = order[0]
+        affinity = False
+        key = self._affinity_key(tokens)
+        if key is not None:
+            home_id = self._affinity.get(key)
+            home = next((r for r in open_ if r.id == home_id), None)
+            if (home is not None and scores[home.id]
+                    <= self.config.affinity_slack * scores[best.id]):
+                best = home
+                affinity = True
+        inputs = {"affinity": affinity,
+                  "queue_depths": {r.name: sigs[r.id]["queue_depth"]
+                                   for r in alive}}
+        candidates = [{"name": r.name,
+                       "score_us": round(scores[r.id], 3)}
+                      for r in alive]
+        return best, candidates, inputs, key
+
+    def _affinity_key(self, tokens: Sequence[int]):
+        n = self.config.affinity_tokens
+        if n <= 0 or len(tokens) < n:
+            return None
+        return tuple(int(t) for t in tokens[:n])
+
+    def _record_route(self, op: str, choice, candidates, inputs,
+                      fallback, n_alive: int) -> None:
+        from triton_distributed_tpu.observability import feedback
+        from triton_distributed_tpu.observability.metrics import (
+            get_registry, observability_enabled)
+        if not observability_enabled():
+            return
+        get_registry().counter("cluster_requests_routed_total",
+                               replica=choice.name).inc()
+        feedback.record_decision(feedback.DecisionEvent(
+            consumer="cluster.router", op=op, choice=choice.name,
+            candidates=candidates,
+            inputs=dict(inputs, alive=n_alive), fallback=fallback))
+
+    # -- health ----------------------------------------------------------
+
+    def health_verdicts(self, now: float) -> List[tuple]:
+        """Replicas that must be failed over NOW:
+        ``[(replica, reason), ...]`` with reason ``"heartbeat_loss"``
+        (beat older than ``dead_after_s``) or ``"straggler"`` (step
+        time past ``straggle_ratio``× the median routable peer's,
+        with at least one healthy peer to drain onto)."""
+        out = []
+        routable = self._routable()
+        for rep in routable:
+            if now - rep.hb_ts > self.config.dead_after_s:
+                out.append((rep, "heartbeat_loss"))
+        verdicted = {r.id for r, _ in out}
+        peers = [r for r in routable if r.id not in verdicted]
+        if len(peers) > 1:
+            steps = sorted(r.last_step_s for r in peers)
+            # Lower median: with 2 peers the comparison point is the
+            # FASTER one (the upper median would be the straggler
+            # itself and nothing would ever trip).
+            median = steps[(len(steps) - 1) // 2]
+            for rep in peers:
+                if (median > 0 and rep.last_step_s
+                        > self.config.straggle_ratio * median):
+                    out.append((rep, "straggler"))
+        return out
+
+    def note_failover(self, rep, reason: str, requeued: int,
+                      now: float) -> None:
+        """Record one executed failover (the cluster calls this after
+        draining): verdict flags on the replica, a DecisionEvent, the
+        artifact row and the counters."""
+        if reason == "heartbeat_loss":
+            rep.dead = True
+        else:
+            rep.quarantined = True
+        rep.fail_reason = reason
+        self.failovers.append({
+            "ts": round(now, 6), "replica": rep.name,
+            "reason": reason, "requeued": requeued,
+            "hb_age_s": round(now - rep.hb_ts, 6)})
+        from triton_distributed_tpu.observability import feedback
+        from triton_distributed_tpu.observability.metrics import (
+            get_registry, observability_enabled)
+        if not observability_enabled():
+            return
+        reg = get_registry()
+        reg.counter("cluster_failovers_total", reason=reason).inc()
+        reg.counter("cluster_requeued_total").inc(requeued)
+        feedback.record_decision(feedback.DecisionEvent(
+            consumer="cluster.failover", op=rep.name, choice="drain",
+            candidates=[{"name": "drain"}, {"name": "keep"}],
+            inputs={"reason": reason, "requeued": requeued,
+                    "hb_age_s": round(now - rep.hb_ts, 6),
+                    "last_step_s": rep.last_step_s}))
+
+    # -- introspection ---------------------------------------------------
+
+    def table(self, now: float) -> dict:
+        """The `/routing` endpoint / `router-state.json` body."""
+        return {
+            "schema": 1, "kind": "router",
+            "ts": round(now, 6), "mode": self.config.mode,
+            "replicas": [r.table_row(now) for r in self.replicas],
+            "failovers": list(self.failovers),
+            "affinity_prefixes": len(self._affinity),
+        }

@@ -76,6 +76,14 @@ class Request:
     resume_tokens: Optional[List[int]] = None
     resume_key: Optional[object] = None
     preemptions: int = 0
+    #: Disaggregated-prefill hook (`serving.cluster`): a prefilled-KV
+    #: shipment (`cluster.transport.KVShipment`-shaped: ``prompt_len``,
+    #: ``bucket``, ``to_row_cache()``) a dedicated prefill worker
+    #: produced for this prompt.  When set, admission inserts the
+    #: shipped row cache instead of running prefill locally — the
+    #: artifact is identical to a local prefill's, so tokens are
+    #: unchanged.  Cleared at admission.
+    shipped_kv: Optional[object] = None
 
     # -- SLO timestamps (scheduler clock, seconds) ---------------------
     t_arrival: Optional[float] = None

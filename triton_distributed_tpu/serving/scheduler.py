@@ -207,6 +207,33 @@ class ContinuousBatchingScheduler:
 
     # -- submission / backpressure --------------------------------------
 
+    def structural_reject(self, req: Request) -> Optional[RejectReason]:
+        """The admission checks that depend only on request geometry
+        vs this engine's static configuration — never on queue state.
+        A hit is final: the request can never run here (and, replicas
+        being homogeneous, nowhere else in a cluster — which is why
+        the cluster's prefill-worker dispatch pre-validates with this
+        instead of finding out via an assert inside the worker)."""
+        if pick_bucket(req.prompt_len, self.buckets) is None:
+            return RejectReason.PROMPT_TOO_LONG
+        if req.prompt_len + req.max_new_tokens > self.max_seq + 1:
+            # offset after the last generated token may reach max_seq:
+            # position max_seq-1 is the last writable KV row, and the
+            # final token needs no KV write of its own.
+            return RejectReason.EXCEEDS_KV_CAPACITY
+        if self.paged and not self.slots.feasible(
+                req.prompt_len, req.max_new_tokens):
+            # page arithmetic: the request's horizon
+            # (prompt + max_new - 1 positions) costs more pages than
+            # the pool holds — it can never run, even alone.
+            return RejectReason.EXCEEDS_KV_CAPACITY
+        if (not self.paged
+                and self.slots.kv_budget_bytes < self.slots.bytes_per_slot):
+            # a budget below one slot can never admit anything —
+            # queueing it would make drain() spin forever.
+            return RejectReason.EXCEEDS_KV_CAPACITY
+        return None
+
     def submit(self, req: Request) -> bool:
         """Enqueue; False = rejected with ``req.reject_reason`` set."""
         now = self.clock()
@@ -217,24 +244,8 @@ class ContinuousBatchingScheduler:
             reason = RejectReason.STOPPED
         elif len(self._queue) >= self.config.max_queue:
             reason = RejectReason.QUEUE_FULL
-        elif pick_bucket(req.prompt_len, self.buckets) is None:
-            reason = RejectReason.PROMPT_TOO_LONG
-        elif req.prompt_len + req.max_new_tokens > self.max_seq + 1:
-            # offset after the last generated token may reach max_seq:
-            # position max_seq-1 is the last writable KV row, and the
-            # final token needs no KV write of its own.
-            reason = RejectReason.EXCEEDS_KV_CAPACITY
-        elif self.paged and not self.slots.feasible(
-                req.prompt_len, req.max_new_tokens):
-            # page arithmetic: the request's horizon
-            # (prompt + max_new - 1 positions) costs more pages than
-            # the pool holds — it can never run, even alone.
-            reason = RejectReason.EXCEEDS_KV_CAPACITY
-        elif (not self.paged
-              and self.slots.kv_budget_bytes < self.slots.bytes_per_slot):
-            # a budget below one slot can never admit anything —
-            # queueing it would make drain() spin forever.
-            reason = RejectReason.EXCEEDS_KV_CAPACITY
+        else:
+            reason = self.structural_reject(req)
         reg = self._registry()
         if reason is not None:
             req.state = RequestState.REJECTED
@@ -333,6 +344,29 @@ class ContinuousBatchingScheduler:
             return self.slots.can_admit()
         head = self._queue[0]
         return self.slots.can_admit(head.resume_tokens or head.prompt)
+
+    def _request_key(self, req: Request):
+        """The slot PRNG key a request starts (or RESUMES) from: its
+        snapshot/recomputed resume key when one is carried (preempt
+        re-admission, cluster failover — the stream continues the
+        exact sample chain), else the pure function of its seed."""
+        if req.resume_key is not None:
+            return jnp.asarray(req.resume_key, jnp.uint32)
+        return request_key(req.seed)
+
+    def _shipped_row(self, req: Request, reg):
+        """Admission of a prefill-worker shipment
+        (`serving.cluster.transport.KVShipment`): the shipped
+        single-row cache replaces the local prefill — the identical
+        artifact, inserted by the identical program, with zero prompt
+        FLOPs spent on this replica."""
+        ship = req.shipped_kv
+        req.shipped_kv = None
+        assert ship.prompt_len == req.prompt_len, (
+            ship.prompt_len, req.prompt_len)
+        if reg:
+            reg.counter("serving_shipped_inserts_total").inc()
+        return ship.to_row_cache(), ship.prompt_len, ship.bucket
 
     def _row_cache(self, bucket: int):
         # One reusable input row cache per bucket: prefill is
@@ -434,29 +468,34 @@ class ContinuousBatchingScheduler:
                and self._slo_gate(now)):
             req = self._queue.popleft()
             reg = self._registry()
+            had_ship = req.shipped_kv is not None
             if self.paged:
                 admitted = self._admit_paged(req, now, reg)
                 if admitted is None:
                     continue              # retired at admission
                 slot, bucket, tokens = admitted
             else:
-                bucket = pick_bucket(req.prompt_len, self.buckets)
-                assert bucket is not None  # submit() validated
                 tokens = req.prompt
-                ids, s = pad_prompt(req.prompt, bucket,
-                                    self.config.pad_id)
-                row_in = self._row_cache(bucket)
-                t0 = time.perf_counter()
-                _, row_cache = self._prefill(self.params, ids, row_in)
-                if reg:
-                    # dispatch is async: block so the histogram
-                    # records prefill compute, not dispatch (as
-                    # Engine.serve does)
-                    jax.block_until_ready(row_cache.ks[0])
-                    reg.histogram("serving_prefill_ms").observe(
-                        (time.perf_counter() - t0) * 1e3)
-                slot = self.slots.insert_prefill(row_cache, s,
-                                                 request_key(req.seed))
+                if req.shipped_kv is not None:
+                    row_cache, s, bucket = self._shipped_row(req, reg)
+                else:
+                    bucket = pick_bucket(req.prompt_len, self.buckets)
+                    assert bucket is not None  # submit() validated
+                    ids, s = pad_prompt(req.prompt, bucket,
+                                        self.config.pad_id)
+                    row_in = self._row_cache(bucket)
+                    t0 = time.perf_counter()
+                    _, row_cache = self._prefill(self.params, ids,
+                                                 row_in)
+                    if reg:
+                        # dispatch is async: block so the histogram
+                        # records prefill compute, not dispatch (as
+                        # Engine.serve does)
+                        jax.block_until_ready(row_cache.ks[0])
+                        reg.histogram("serving_prefill_ms").observe(
+                            (time.perf_counter() - t0) * 1e3)
+                slot = self.slots.insert_prefill(
+                    row_cache, s, self._request_key(req))
             self._tokens[slot] = tokens[-1]
             req.state = RequestState.RUNNING
             req.slot = slot
@@ -469,8 +508,14 @@ class ContinuousBatchingScheduler:
             sp.__enter__()
             self._spans[slot] = sp
             if reg:
-                reg.counter("serving_prefills_total",
-                            bucket=str(bucket)).inc()
+                # A consumed shipment (`_shipped_row` clears the
+                # hook) ran NO local prefill — it has its own
+                # serving_shipped_inserts_total, and counting it here
+                # would desync this counter from the
+                # serving_prefill_ms histogram it pairs with.
+                if not (had_ship and req.shipped_kv is None):
+                    reg.counter("serving_prefills_total",
+                                bucket=str(bucket)).inc()
                 reg.histogram("serving_queue_wait_ms").observe(
                     max(now - req.t_arrival, 0.0) * 1e3)
             n += 1
@@ -486,10 +531,18 @@ class ContinuousBatchingScheduler:
         s = len(tokens)
         shared = self.slots.match_prefix(tokens)
         c = len(shared) * self.config.page_size
-        key = (jnp.asarray(req.resume_key, jnp.uint32)
-               if req.resume_key is not None else request_key(req.seed))
+        key = self._request_key(req)
         bucket = row = row_start = None
-        if c > 0 and self._prefill_suffix is not None:
+        t0 = None
+        if req.shipped_kv is not None and req.resume_tokens is None:
+            # Prefill-worker shipment: the full-prompt row arrives
+            # precomputed; shared prefix pages (if any matched) are
+            # still mapped and the insert discards their writes, so
+            # storage sharing composes with shipping unchanged.
+            row, s2, bucket = self._shipped_row(req, reg)
+            assert s2 == s, (s2, s)
+            row_start = 0
+        elif c > 0 and self._prefill_suffix is not None:
             # Prefix hit with a prefix-aware model: prefill ONLY the
             # private suffix — the shared pages are already in the
             # pool.  This is the compute half of prefix sharing (the
@@ -526,8 +579,9 @@ class ContinuousBatchingScheduler:
             row_start = 0
         if reg:
             jax.block_until_ready(row.ks[0])
-            reg.histogram("serving_prefill_ms").observe(
-                (time.perf_counter() - t0) * 1e3)
+            if t0 is not None:
+                reg.histogram("serving_prefill_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
             reg.counter("serving_prefix_cache_hit_tokens_total").inc(c)
             reg.counter("serving_prefix_cache_miss_tokens_total").inc(
                 s - c)
@@ -633,6 +687,14 @@ class ContinuousBatchingScheduler:
             # store is memory-only here (no disk I/O per step).
             from triton_distributed_tpu.observability.anomaly import (
                 Z_THRESHOLD, get_baseline_store)
+            # Warm tuned-kernel baselines in production: tuners armed
+            # with `autotuner.arm_serving_observation` receive every
+            # step's host latency — the same feed the bench drivers
+            # give `observe_runtime`, so the closed loop's sustained-z
+            # invalidation works from serving traffic, not just
+            # benches (ROADMAP item 4 follow-up).
+            from triton_distributed_tpu import autotuner as _autotuner
+            _autotuner.observe_serving_step(step_ms * 1e3)
             z = get_baseline_store().observe(self._step_key,
                                              step_ms * 1e3)
             if z is not None and z > Z_THRESHOLD:
