@@ -1,0 +1,182 @@
+"""Chaos bench: what absorbing each fault class COSTS — the
+ISSUE-10 acceptance benchmark.
+
+A *virtual-time* benchmark like `bench_router.py`: the faults a real
+DCN throws (loss, duplication, corruption, reordering, link flaps,
+heartbeat stalls) cannot be produced reproducibly on a CI host, so
+they are SEEDED through `serving.cluster.chaos.FaultSchedule` and
+replayed bit-exactly on the shared virtual clock.  The REAL
+schedulers decode the REAL toy model underneath; the delivery
+protocol (checksum -> NACK -> exponential backoff -> deadline ->
+re-route) and the health hysteresis (K stale checks -> drain ->
+probation re-admission) really execute, and their cost is read off
+the virtual clock.
+
+Emitted rows (one JSON line each, ``bench: "chaos"``):
+
+- ``workload: "clean"`` — the fault-free baseline (also asserted
+  bit-identical to running with NO injector wired at all);
+- ``workload: "fault_<class>"`` — one fault class armed at a fixed
+  rate: virtual makespan, ``overhead_vs_clean`` (makespan ratio),
+  the absorption counters (retries / duplicates / corrupt NACKs /
+  failovers / re-admissions), and ``exact`` — token streams equal to
+  the single-engine reference (the invariant; the bench FAILS on a
+  mismatch rather than reporting it);
+- ``workload: "seed_sweep"`` — aggregate over a seed range with
+  schedule-derived class mixes: every seed exact, total faults
+  absorbed, worst-case overhead.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+import argparse
+import json
+
+import jax
+import numpy as np
+
+from triton_distributed_tpu.serving import (
+    ClusterConfig,
+    FaultInjector,
+    FaultSchedule,
+    SchedulerConfig,
+    ServingCluster,
+    ToyConfig,
+    ToyModel,
+)
+from triton_distributed_tpu.serving.cluster import RouterConfig
+
+STEP_S = 1e-3
+PREFILL_S = 2e-3
+N_REQUESTS = 16
+SLOTS = 4
+BUCKETS = (8, 16, 32)
+FAULT_RATE = 0.5
+SWEEP_SEEDS = range(32)
+
+
+def build_trace():
+    rng = np.random.default_rng(4321)
+    trace = []
+    t = 0.0
+    for i in range(N_REQUESTS):
+        t += float(rng.exponential(0.0008))
+        plen = int(rng.integers(4, 12))
+        prompt = [int(x) for x in rng.integers(1, 61, plen)]
+        gen = int(rng.integers(5, 12))
+        trace.append(dict(prompt=prompt, max_new_tokens=gen,
+                          seed=1000 + i, arrival_time=round(t, 6)))
+    return trace
+
+
+def run_cluster(model, params, trace, injector=None):
+    from triton_distributed_tpu.observability import get_registry
+    get_registry().clear()
+    cfg = ClusterConfig(
+        n_replicas=2, n_prefill_workers=1,
+        scheduler=SchedulerConfig(num_slots=SLOTS,
+                                  prefill_buckets=BUCKETS),
+        router=RouterConfig(dead_after_s=0.005, dead_checks=2,
+                            probation_checks=2),
+        step_time_s=STEP_S, prefill_time_s=PREFILL_S,
+        ship_retry_base_s=0.002, ship_deadline_s=0.1)
+    cluster = ServingCluster(model, params, cfg,
+                             fault_injector=injector)
+    recs = [cluster.submit(**t) for t in trace]
+    done = cluster.drain()
+    assert len(done) == len(trace), [r.state for r in recs]
+    makespan = (max(r.t_finish for r in done)
+                - min(r.arrival_time for r in done))
+    counters = get_registry().snapshot()["counters"]
+
+    def total(name):
+        return int(sum(v for k, v in counters.items()
+                       if k == name or k.startswith(name + "{")))
+
+    return {
+        "ms": round(makespan * 1e3, 6),
+        "streams": [r.tokens for r in
+                    sorted(done, key=lambda r: r.record_id)],
+        "retries": total("cluster_ship_retries_total"),
+        "reroutes": total("cluster_ship_reroutes_total"),
+        "duplicates": total("cluster_shipments_duplicate_total"),
+        "corrupt_nacks": total("cluster_shipments_corrupt_total"),
+        "failovers": total("cluster_failovers_total"),
+        "readmits": total("cluster_replicas_readmitted_total"),
+        "faults_injected": total("cluster_faults_injected_total"),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON lines here (committed "
+                         "copy: benchmark/results/chaos.json)")
+    args = ap.parse_args()
+    out = open(args.out, "w") if args.out else None
+
+    def emit(rec):
+        line = json.dumps(rec)
+        print(line)
+        if out is not None:
+            out.write(line + "\n")
+
+    model = ToyModel(ToyConfig(vocab_size=61, hidden=16,
+                               max_seq_len=64))
+    params = model.init_params(jax.random.key(0))
+    trace = build_trace()
+
+    def strip(r):
+        return {k: v for k, v in r.items() if k != "streams"}
+
+    clean = run_cluster(model, params, trace)
+    no_injector = run_cluster(model, params, trace, injector=None)
+    off = run_cluster(model, params, trace,
+                      injector=FaultInjector(FaultSchedule.none()))
+    assert off["streams"] == no_injector["streams"] == clean["streams"]
+    assert off == no_injector, "empty schedule is not a passthru"
+    assert clean["retries"] == clean["failovers"] == 0
+    emit(dict(bench="chaos", workload="clean", **strip(clean)))
+
+    # -- one class at a time: the absorption cost per fault class -------
+    for cls in ("drop", "dup", "reorder", "corrupt", "flap",
+                "stale_hb", "skew"):
+        inj = FaultInjector(FaultSchedule(
+            17, classes=(cls,), ship_fault_rate=FAULT_RATE,
+            window_s=0.02))
+        r = run_cluster(model, params, trace, injector=inj)
+        assert r["streams"] == clean["streams"], (
+            f"fault class {cls} changed a token stream")
+        emit(dict(bench="chaos", workload=f"fault_{cls}",
+                  fault_rate=FAULT_RATE, **strip(r),
+                  overhead_vs_clean=round(r["ms"] / clean["ms"], 4),
+                  exact=True))
+
+    # -- seed sweep: schedule-derived class mixes -----------------------
+    total_faults = 0
+    worst = 1.0
+    for seed in SWEEP_SEEDS:
+        inj = FaultInjector(FaultSchedule(
+            seed, ship_fault_rate=FAULT_RATE, window_s=0.02))
+        r = run_cluster(model, params, trace, injector=inj)
+        assert r["streams"] == clean["streams"], (
+            f"seed {seed} ({inj.schedule.classes}) changed a stream")
+        total_faults += r["faults_injected"]
+        worst = max(worst, r["ms"] / clean["ms"])
+    emit(dict(bench="chaos", workload="seed_sweep",
+              seeds=len(SWEEP_SEEDS), fault_rate=FAULT_RATE,
+              faults_absorbed=total_faults,
+              worst_overhead_vs_clean=round(worst, 4),
+              all_exact=True))
+
+    if out is not None:
+        out.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

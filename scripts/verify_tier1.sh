@@ -27,7 +27,10 @@
 #  - a router smoke (2-replica + 1-prefill virtual-clock cluster:
 #    prefix-affinity routing, kill-a-replica failover, /routing
 #    endpoint render) plus the router bench gate (signal-aware beats
-#    round-robin under seeded imbalance, matches it balanced).
+#    round-robin under seeded imbalance, matches it balanced);
+#  - a chaos smoke (seeded lossy-wire fault schedule on the virtual
+#    clock -> token-for-token exact survivors -> schema-valid
+#    faults.jsonl -> doctor "Chaos" section names the fault classes).
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -145,7 +148,8 @@ fi
 # deterministic by construction ("now" = newest artifact timestamp),
 # so any diff is a real behavior change in links/anomaly/doctor.
 doctor_rc=0
-for scenario in stalled_rank sem_leak slow_link clean; do
+for scenario in stalled_rank sem_leak slow_link clean \
+        lossy_transport; do
     if ! JAX_PLATFORMS=cpu python -m \
             triton_distributed_tpu.observability.doctor \
             "tests/data/incidents/$scenario" -q \
@@ -432,6 +436,80 @@ router_rc=$?
 echo "$router_log" | tail -3
 if [ "$router_rc" -ne 0 ]; then
     echo "ROUTER_SMOKE=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
+# Chaos smoke: a seeded fault schedule (drop/dup/corrupt/reorder on
+# the wire, a suppressed heartbeat) against the 2-replica + 1-worker
+# virtual cluster — every request must finish token-for-token exact
+# vs the single-engine scheduler, the retries/failover must be
+# RECORDED (faults.jsonl schema-valid), and the doctor must render a
+# "Chaos" section naming the fault classes from the artifact.
+chaos_log=$(JAX_PLATFORMS=cpu python - <<'EOF' 2>&1
+import tempfile
+import jax
+from triton_distributed_tpu.serving import (
+    ClusterConfig, ContinuousBatchingScheduler, FaultInjector,
+    FaultSchedule, Request, SchedulerConfig, ServingCluster,
+    ToyConfig, ToyModel)
+from triton_distributed_tpu.serving.cluster import (
+    RouterConfig, load_faults, validate_fault)
+from triton_distributed_tpu.observability.doctor import (
+    diagnose, render_markdown)
+
+model = ToyModel(ToyConfig(vocab_size=61, hidden=16, max_seq_len=64))
+params = model.init_params(jax.random.key(0))
+sc = SchedulerConfig(num_slots=2, prefill_buckets=(8, 16),
+                     temperature=0.8, top_k=8)
+trace = [dict(prompt=[1 + i, 2, 3], max_new_tokens=4 + (i % 3),
+              seed=i, arrival_time=0.002 * i) for i in range(6)]
+
+class Clock:
+    t = 0.0
+c = Clock()
+sched = ContinuousBatchingScheduler(
+    model, params, sc, clock=lambda: c.t,
+    clock_advance=lambda dt: setattr(c, "t", c.t + dt))
+ref = [r.generated for r in
+       sorted(sched.run([Request(**t) for t in trace]),
+              key=lambda r: r.request_id)]
+
+d = tempfile.mkdtemp(prefix="tdt-chaos-")
+inj = FaultInjector(FaultSchedule(
+    7, classes=("drop", "dup", "corrupt", "reorder", "stale_hb"),
+    ship_fault_rate=0.5, window_s=0.03))
+cluster = ServingCluster(
+    model, params,
+    ClusterConfig(n_replicas=2, n_prefill_workers=1, scheduler=sc,
+                  ship_retry_base_s=0.002, ship_deadline_s=0.1,
+                  router=RouterConfig(dead_after_s=0.005,
+                                      dead_checks=2,
+                                      probation_checks=2),
+                  artifact_dir=d),
+    fault_injector=inj)
+recs = [cluster.submit(**t) for t in trace]
+done = cluster.drain()
+assert len(done) == len(trace), [r.state for r in recs]
+toks = [r.tokens for r in sorted(done, key=lambda r: r.record_id)]
+assert toks == ref, "seeded faults changed a token stream"
+assert inj.events, "schedule injected nothing"
+cluster.write_artifact(d)
+rows = load_faults(f"{d}/faults.jsonl")
+assert rows, "faults.jsonl empty"
+for row in rows:
+    problems = validate_fault(row)
+    assert not problems, (problems, row)
+report = diagnose([d])
+classes = set(report["chaos"]["by_class"])
+assert classes == {e.fault for e in inj.events}, classes
+assert "## Chaos" in render_markdown(report)
+print("CHAOS_SMOKE=ok")
+EOF
+)
+chaos_rc=$?
+echo "$chaos_log" | tail -3
+if [ "$chaos_rc" -ne 0 ]; then
+    echo "CHAOS_SMOKE=FAILED"
     [ "$rc" -eq 0 ] && rc=1
 fi
 

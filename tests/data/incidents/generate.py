@@ -16,6 +16,12 @@ pre-computed static-analysis findings file):
   straggler, one occurrence is a 4.5x latency anomaly, and an
   ``ag_gemm`` / ``all_reduce`` pair contend on link ``tp:2>3``.
 - ``clean``: a healthy run — the doctor must say so.
+- ``lossy_transport``: a seeded chaos schedule (serving.cluster.chaos)
+  dropped/corrupted/duplicated KV shipments and suppressed one
+  replica's heartbeats; the cluster absorbed it (retries, one
+  drain + probation re-admission).  The doctor's Chaos section must
+  name the injected fault classes from ``faults.jsonl``, and the
+  Cluster section the drained-then-re-admitted replica.
 
 Everything is deterministic (fixed base timestamp, no randomness), so
 ``report.golden.json`` files can gate drift in CI.  Run from anywhere:
@@ -41,7 +47,8 @@ T0 = 1_700_000_000.0
 WORLD = 4
 AXIS = "tp"
 
-SCENARIOS = ("stalled_rank", "sem_leak", "slow_link", "clean")
+SCENARIOS = ("stalled_rank", "sem_leak", "slow_link", "clean",
+             "lossy_transport")
 
 
 def _write(scenario: str, name: str, payload, truncate_at=None):
@@ -269,6 +276,73 @@ def gen_clean():
                flight(rank, T0 + 3.1, evs, heartbeat_body=hb))
 
 
+def gen_lossy_transport():
+    """A virtual-clock cluster run under a seeded fault schedule:
+    the artifacts such a run writes are router-state.json plus
+    faults.jsonl (no heartbeat/trace files — virtual time).  The
+    wire ate one shipment (two retransmits), corrupted another
+    (checksum NACK), duplicated a third; replica-1's heartbeat was
+    suppressed long enough to drain it, then it recovered and passed
+    probation.  Timestamps are VIRTUAL seconds (small floats) — the
+    doctor's "now" is the newest artifact timestamp, so the report
+    is deterministic either way."""
+    s = "lossy_transport"
+    faults = [
+        {"schema": 1, "kind": "fault", "ts": 0.004, "fault": "drop",
+         "target": "shipment:2", "inputs": {"nbytes": 9472},
+         "seed": 42},
+        {"schema": 1, "kind": "fault", "ts": 0.0062, "fault": "drop",
+         "target": "shipment:3", "inputs": {"nbytes": 9472},
+         "seed": 42},
+        {"schema": 1, "kind": "fault", "ts": 0.009,
+         "fault": "corrupt", "target": "shipment:5",
+         "inputs": {"nbytes": 9472}, "seed": 42},
+        {"schema": 1, "kind": "fault", "ts": 0.011, "fault": "dup",
+         "target": "shipment:7", "inputs": {"nbytes": 9472},
+         "seed": 42},
+        {"schema": 1, "kind": "fault", "ts": 0.012,
+         "fault": "stale_hb", "target": "replica-1",
+         "inputs": {"window": [0.012, 0.062]}, "seed": 42},
+        {"schema": 1, "kind": "fault", "ts": 0.02, "fault": "flap",
+         "target": "wire",
+         "inputs": {"factor": 50.0, "window": [0.012, 0.062]},
+         "seed": 42},
+    ]
+    d = os.path.join(HERE, s)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "faults.jsonl"), "w") as f:
+        for row in faults:
+            f.write(json.dumps(row) + "\n")
+    _write(s, "router-state.json", {
+        "schema": 1, "kind": "router", "ts": 0.085,
+        "mode": "signal_aware",
+        "replicas": [
+            {"id": 0, "name": "replica-0", "alive": True,
+             "quarantined": False, "fail_reason": None,
+             "hb_age_s": 0.0, "routed": 7, "queue_depth": 0,
+             "active_slots": 0, "last_step_s": 0.001},
+            {"id": 1, "name": "replica-1", "alive": True,
+             "quarantined": False, "fail_reason": None,
+             "hb_age_s": 0.0, "routed": 3, "queue_depth": 0,
+             "active_slots": 0, "last_step_s": 0.001},
+        ],
+        "failovers": [
+            {"ts": 0.0355, "replica": "replica-1",
+             "reason": "heartbeat_loss", "requeued": 2,
+             "hb_age_s": 0.0235},
+        ],
+        "readmits": [
+            {"ts": 0.0795, "replica": "replica-1",
+             "was": "heartbeat_loss", "probation_checks": 3},
+        ],
+        "affinity_prefixes": 1,
+        "kv_shipped_bytes": 104192, "shipments": 11,
+        "open_requests": 0,
+        "prefill_workers": [
+            {"name": "prefill-0", "queued": 0, "jobs_done": 8}],
+    })
+
+
 def generate(clean_first: bool = True):
     for scenario in SCENARIOS:
         d = os.path.join(HERE, scenario)
@@ -280,6 +354,7 @@ def generate(clean_first: bool = True):
     gen_sem_leak()
     gen_slow_link()
     gen_clean()
+    gen_lossy_transport()
     return [os.path.join(HERE, sc) for sc in SCENARIOS]
 
 

@@ -490,6 +490,50 @@ class TestChaos:
         assert len(drains) == 1 and drains[0].choice == "drain"
         assert drains[0].inputs["reason"] == "heartbeat_loss"
 
+    @pytest.mark.parametrize("temperature", [0.0, 0.8])
+    def test_double_failover_exact_resume(self, toy, temperature):
+        """Kill the victim's replica, let it resume on a second
+        replica, kill that one too: `advance_request_key` compounds
+        across two re-queues (split^n from the total mirrored count,
+        not from the last resume point), so the sampled stream must
+        STILL match the single engine token-for-token."""
+        model, params = toy
+        sc = SchedulerConfig(num_slots=2, prefill_buckets=(8, 16, 32),
+                             temperature=temperature, top_k=8)
+        trace = [dict(prompt=[1 + i, 2, 3, 4], max_new_tokens=12,
+                      seed=200 + i, arrival_time=0.001 * i)
+                 for i in range(5)]
+        ref = _reference(toy, sc, trace)
+        cluster = ServingCluster(
+            model, params,
+            ClusterConfig(n_replicas=3, scheduler=sc,
+                          router=RouterConfig(dead_after_s=0.01,
+                                              dead_checks=2,
+                                              readmit=False)))
+        recs = [cluster.submit(**t) for t in trace]
+        # Let streams start, then kill the replica serving record 0.
+        while not recs[0].tokens:
+            cluster.step()
+        first = recs[0].replica
+        cluster.kill_replica(first)
+        # Wait for the drain + re-placement to produce MORE tokens on
+        # a second replica, then kill that one too.
+        n0 = len(recs[0].tokens)
+        while not (recs[0].state == "running"
+                   and recs[0].replica not in (None, first)
+                   and len(recs[0].tokens) > n0):
+            assert not recs[0].done, "victim finished too early"
+            cluster.step()
+        second = recs[0].replica
+        assert second != first
+        cluster.kill_replica(second)
+        done = cluster.drain()
+        assert len(done) == len(trace), [r.state for r in recs]
+        assert recs[0].failovers == 2
+        assert len(recs[0].replica_history) >= 3
+        assert [r.tokens for r in
+                sorted(done, key=lambda r: r.record_id)] == ref
+
     def test_shipment_to_failed_replica_is_rerouted(self, toy):
         """A KV shipment on the wire to a replica that dies before
         delivery must not strand its request."""

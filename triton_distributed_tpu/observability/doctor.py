@@ -104,6 +104,15 @@ def _rank_of(path: str) -> Optional[int]:
     return int(m.group(1)) if m else None
 
 
+def _num(value, default: float = 0.0) -> float:
+    """Tolerant numeric coercion for artifact fields: a hand-edited
+    or version-drifted line must degrade, never crash the report."""
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return default
+
+
 def _load_json(path: str) -> Optional[dict]:
     try:
         with open(path) as f:
@@ -126,6 +135,7 @@ class Artifacts:
         self.resource_findings: Optional[dict] = None
         self.decisions: List[dict] = []
         self.router: Optional[dict] = None
+        self.faults: List[dict] = []
         self._discover()
 
     def _glob(self, pattern: str) -> List[str]:
@@ -178,14 +188,21 @@ class Artifacts:
             from triton_distributed_tpu.observability.feedback import (
                 load_decisions)
             self.decisions = load_decisions(decision_files)
+        fault_files = self._glob("faults*.jsonl")
+        if fault_files:
+            from triton_distributed_tpu.serving.cluster.chaos import (
+                load_faults)
+            self.faults = load_faults(fault_files)
 
     def empty(self) -> bool:
         # A router artifact alone is an incident report's worth of
         # state: a virtual-clock cluster run writes router-state.json
         # without any heartbeat/trace files, and the doctor must
-        # still name the failed replica from it.
+        # still name the failed replica from it.  Likewise a
+        # faults.jsonl alone: the Chaos section must name the
+        # injected fault classes from that artifact by itself.
         return not (self.traces or self.flights or self.heartbeats
-                    or self.metrics or self.router)
+                    or self.metrics or self.router or self.faults)
 
     def ranks(self) -> List[int]:
         from triton_distributed_tpu.observability.timeline import (
@@ -202,6 +219,8 @@ class Artifacts:
         ts = [0.0]
         for hb in self.heartbeats.values():
             ts.append(float(hb.get("unix_time", 0.0)))
+        for fv in self.faults:
+            ts.append(_num(fv.get("ts")))
         for fl in self.flights.values():
             ts.append(float(fl.get("unix_time", 0.0)))
             for ev in fl.get("events", []):
@@ -558,7 +577,7 @@ def analyze_cluster(art: Artifacts) -> Optional[dict]:
     failovers = list(art.router.get("failovers", []))
     failed = [r for r in replicas
               if not r.get("alive") or r.get("quarantined")]
-    return {
+    out = {
         "mode": art.router.get("mode"),
         "replicas": replicas,
         "failovers": failovers,
@@ -566,6 +585,44 @@ def analyze_cluster(art: Artifacts) -> Optional[dict]:
         "kv_shipped_bytes": art.router.get("kv_shipped_bytes"),
         "shipments": art.router.get("shipments"),
     }
+    if art.router.get("readmits"):
+        # Key absent unless a probation re-admission happened, so
+        # pre-hysteresis reports stay byte-identical.
+        out["readmits"] = list(art.router["readmits"])
+    return out
+
+
+def analyze_chaos(art: Artifacts, now: float) -> Optional[dict]:
+    """Replay the chaos harness's fault artifact (``faults.jsonl``,
+    `serving.cluster.chaos`) into the report: which fault classes a
+    seeded schedule injected, into what, when — so "was this
+    incident injected, and what was injected" is answered from the
+    artifact alone.  None — and thus NO report key, keeping
+    pre-chaos golden reports byte-identical — without the artifact.
+    """
+    if not art.faults:
+        return None
+    by_class: Dict[str, int] = {}
+    seeds = set()
+    for d in art.faults:
+        c = str(d.get("fault", "?"))
+        by_class[c] = by_class.get(c, 0) + 1
+        try:
+            if d.get("seed") is not None:
+                seeds.add(int(d["seed"]))
+        except (TypeError, ValueError):
+            pass    # malformed line: report without it, never crash
+    recent = [{
+        "age_s": round(now - _num(d.get("ts")), 3),
+        "fault": d.get("fault"),
+        "target": d.get("target"),
+        "inputs": (d.get("inputs") if isinstance(d.get("inputs"),
+                                                 dict) else {}),
+    } for d in art.faults[-10:]]
+    return {"count": len(art.faults),
+            "by_class": dict(sorted(by_class.items())),
+            "seeds": sorted(seeds),
+            "recent": recent}
 
 
 def analyze_links(art: Artifacts) -> dict:
@@ -720,6 +777,11 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
     cluster_out = analyze_cluster(art)
     if cluster_out is not None:
         report["cluster"] = cluster_out
+    # Chaos harness faults: key absent without a faults.jsonl
+    # artifact — same golden discipline.
+    chaos_out = analyze_chaos(art, now)
+    if chaos_out is not None:
+        report["chaos"] = chaos_out
     report["verdict"] = _verdict(report, in_flight)
     return report
 
@@ -750,6 +812,15 @@ def _verdict(report: dict, in_flight: Optional[dict]) -> str:
                        f"({f.get('reason')}), {f.get('requeued')} "
                        f"request(s) re-queued")
     hot_s += failover_s
+    # Injected faults: name the fault classes (clause only exists
+    # when a faults.jsonl artifact was ingested) — an incident with a
+    # chaos schedule behind it must say so, by class.
+    chaos = report.get("chaos")
+    chaos_s = ""
+    if chaos:
+        chaos_s = (f"; chaos: {chaos['count']} injected fault(s) — "
+                   f"classes {', '.join(sorted(chaos['by_class']))}")
+    hot_s += chaos_s
     if stall["first_stalled_rank"] is not None:
         r = stall["first_stalled_rank"]
         what = (f" inside {stall['open_span']!r}"
@@ -796,6 +867,11 @@ def _verdict(report: dict, in_flight: Optional[dict]) -> str:
         # A failover IS the incident — it must never read as "no
         # incident detected" with the dead replica in a subclause.
         return "cluster incident" + hot_s + "."
+    if chaos_s:
+        # Faults were injected and everything absorbed them: that is
+        # the headline (the run was a chaos schedule, not an
+        # organic incident).
+        return "chaos schedule absorbed" + hot_s + "."
     return ("no incident detected: heartbeats fresh, no anomalies, "
             "no link contention" + hot_s + ".")
 
@@ -933,8 +1009,31 @@ def render_markdown(report: dict) -> str:
             lines.append(f"- {f.get('replica')}: {f.get('reason')} "
                          f"at t={f.get('ts')} — {f.get('requeued')} "
                          "in-flight request(s) drained and re-queued")
-        if cluster.get("failovers"):
+        for r in cluster.get("readmits", []):
+            lines.append(f"- {r.get('replica')}: re-admitted at "
+                         f"t={r.get('ts')} after recovery probation "
+                         f"(was {r.get('was')})")
+        if cluster.get("failovers") or cluster.get("readmits"):
             lines.append("")
+
+    chaos = report.get("chaos")
+    if chaos:
+        lines += ["## Chaos", "",
+                  f"{chaos['count']} fault(s) injected by seeded "
+                  "schedule"
+                  + (f" (seed(s) {', '.join(str(s) for s in chaos['seeds'])})"
+                     if chaos.get("seeds") else "")
+                  + ": "
+                  + ", ".join(f"{c}×{n}" for c, n in
+                              chaos["by_class"].items()) + ".", "",
+                  "| age (s) | fault | target | inputs |",
+                  "|---|---|---|---|"]
+        for d in chaos["recent"]:
+            inp = ", ".join(f"{k}={v}" for k, v in
+                            sorted(d["inputs"].items())) or "-"
+            lines.append(f"| {d['age_s']} | {d['fault']} "
+                         f"| {d['target']} | {inp} |")
+        lines.append("")
 
     hot = report["links"].get("hot") or []
     if hot:

@@ -273,7 +273,12 @@ _HEARTBEAT_GAUGES = ("serving_queue_depth", "serving_active_slots",
                      "serving_kv_bytes_in_use",
                      "serving_kv_pages_free", "serving_kv_pages_used",
                      "serving_kv_page_occupancy",
-                     "serving_prefix_cache_pages")
+                     "serving_prefix_cache_pages",
+                     # Peer placement signals: a router rank scores
+                     # replicas from these heartbeat fields when it
+                     # has no in-process snapshot
+                     # (serving.cluster.router.heartbeat_signals).
+                     "serving_decode_step_us")
 
 
 def heartbeat_payload() -> dict:

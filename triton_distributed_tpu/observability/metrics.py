@@ -252,6 +252,14 @@ def get_registry() -> MetricsRegistry:
         return _GLOBAL
 
 
+def count_metric(name: str, n: float = 1, **labels) -> None:
+    """Increment a global counter iff observability is enabled — the
+    one-liner every hot-path call site otherwise re-implements as an
+    enabled-guard + registry lookup."""
+    if observability_enabled():
+        get_registry().counter(name, **labels).inc(n)
+
+
 # ---------------------------------------------------------------------------
 # Cross-rank aggregation
 # ---------------------------------------------------------------------------

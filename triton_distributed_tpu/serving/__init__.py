@@ -25,6 +25,7 @@ from triton_distributed_tpu.serving.pages import (  # noqa: F401
     PagedKV,
     PagePool,
     RadixCache,
+    SpillPool,
 )
 from triton_distributed_tpu.serving.request import (  # noqa: F401
     FinishReason,
@@ -46,6 +47,8 @@ from triton_distributed_tpu.serving.toy import (  # noqa: F401
 from triton_distributed_tpu.serving.cluster import (  # noqa: F401,E402
     ClusterConfig,
     ClusterRequest,
+    FaultInjector,
+    FaultSchedule,
     KVShipment,
     RouterConfig,
     ServingCluster,

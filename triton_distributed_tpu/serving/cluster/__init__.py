@@ -5,6 +5,14 @@ See docs/serving.md "Disaggregated cluster" for the topology, the
 routing-signal table and the drain/failover semantics.
 """
 
+from triton_distributed_tpu.serving.cluster.chaos import (  # noqa: F401
+    FAULT_CLASSES,
+    FaultEvent,
+    FaultInjector,
+    FaultSchedule,
+    load_faults,
+    validate_fault,
+)
 from triton_distributed_tpu.serving.cluster.cluster import (  # noqa: F401
     ENV_CLUSTER_SPEC,
     ENV_ROLE,
@@ -26,8 +34,10 @@ from triton_distributed_tpu.serving.cluster.replica import (  # noqa: F401
 from triton_distributed_tpu.serving.cluster.router import (  # noqa: F401
     ClusterRouter,
     RouterConfig,
+    heartbeat_signals,
 )
 from triton_distributed_tpu.serving.cluster.transport import (  # noqa: F401
     KVShipment,
+    ShipmentCorrupt,
     VirtualTransport,
 )

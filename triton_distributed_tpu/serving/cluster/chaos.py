@@ -1,0 +1,364 @@
+"""Deterministic chaos harness: seeded fault schedules the virtual
+cluster replays bit-exactly.
+
+PR 9's chaos test injected exactly two faults (a killed replica, a
+straggler) over a transport that never misbehaved.  Real multi-host
+DCN drops, duplicates, reorders and corrupts; heartbeat writers
+stall without dying; clocks skew; links flap.  This module turns
+that failure space into a *seeded, enumerable* schedule:
+
+- :class:`FaultSchedule` — a pure function of ``seed``: which fault
+  classes are armed, which shipment ids they hit, which time windows
+  suppress a replica's heartbeat or collapse the wire.  Same seed,
+  same faults, bit-exactly — so a grid of hundreds of seeds is a
+  *proof sweep* (every schedule must be token-for-token exact), not
+  a flaky soak test.
+- :class:`FaultInjector` — the runtime half the `ServingCluster`
+  consults at its seams (shipment send, heartbeat write, wire
+  timing).  Every injected fault is recorded as a schema-v1
+  :class:`FaultEvent` (the DecisionEvent discipline applied to
+  faults: ts / fault class / target / inputs snapshot) and lands in
+  a ``faults.jsonl`` artifact the incident doctor replays into its
+  "Chaos" section — an incident report can name the injected fault
+  class from the artifact alone.
+
+Fault classes (:data:`FAULT_CLASSES`):
+
+========== ============================================================
+class      injection point
+========== ============================================================
+drop       shipment vanishes from the wire (sender retransmit timer
+           + exponential backoff absorb it)
+dup        a second delivery of the same shipment id (idempotent
+           claim absorbs it)
+reorder    a shipment's delivery is delayed past later sends
+corrupt    one payload byte flipped in flight (checksum → NACK →
+           bounded retry)
+flap       transient bandwidth collapse: wire time × ``flap_factor``
+           inside a window
+stale_hb   heartbeat writes suppressed for a window — the file (and
+           ts) is PRESENT but stale; router hysteresis must ride it
+           out or drain + later re-admit, never thrash
+skew       a replica's heartbeat timestamps lag its true clock by a
+           constant offset for a window
+========== ============================================================
+
+The invariant under ALL of it is PR 9's: tokens are a function of
+(prompt, seed) only.  Faults may move work, cost retries, or shed
+load truthfully — they may never change a delivered token.
+
+Termination: a schedule stops injecting after ``max_faults`` events
+(``drop``/``corrupt`` on every retransmission of an unlucky shipment
+would otherwise be able to starve it past its deadline forever on an
+adversarial seed).  The budget is part of the schedule, so replays
+stay bit-exact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+FAULTS_SCHEMA = 1
+FAULTS_FILE = "faults.jsonl"
+
+#: Every injectable fault class, in schedule-derivation order.
+FAULT_CLASSES = ("drop", "dup", "reorder", "corrupt", "flap",
+                 "stale_hb", "skew")
+
+
+@dataclasses.dataclass
+class FaultEvent:
+    """One injected fault (schema v1, the DecisionEvent discipline):
+    ``fault`` is the class, ``target`` what it hit (``shipment:<id>``
+    / ``replica-<i>`` / ``wire``), ``inputs`` the knobs it applied."""
+
+    fault: str
+    target: str
+    ts: float = 0.0
+    inputs: Dict[str, object] = dataclasses.field(default_factory=dict)
+    seed: Optional[int] = None
+    schema: int = FAULTS_SCHEMA
+    kind: str = "fault"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+#: Fields every faults.jsonl line must carry (doctor/CI validation).
+FAULT_FIELDS = ("schema", "kind", "ts", "fault", "target", "inputs")
+
+
+def validate_fault(d: dict) -> List[str]:
+    """Schema-v1 check for one faults.jsonl line; empty = valid."""
+    problems = []
+    for f in FAULT_FIELDS:
+        if f not in d:
+            problems.append(f"missing field {f!r}")
+    if d.get("schema") != FAULTS_SCHEMA:
+        problems.append(f"schema {d.get('schema')!r} != "
+                        f"{FAULTS_SCHEMA}")
+    if d.get("kind") != "fault":
+        problems.append(f"kind {d.get('kind')!r} != 'fault'")
+    if d.get("fault") not in FAULT_CLASSES:
+        problems.append(f"unknown fault class {d.get('fault')!r}")
+    if not isinstance(d.get("inputs"), dict):
+        problems.append("inputs not a dict")
+    return problems
+
+
+def load_faults(paths) -> List[dict]:
+    """Parse fault lines from jsonl file(s), skipping torn lines."""
+    out: List[dict] = []
+    if isinstance(paths, str):
+        paths = [paths]
+    for path in paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        d = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(d, dict) and d.get("kind") == "fault":
+                        out.append(d)
+        except OSError:
+            continue
+
+    def ts(d):
+        try:
+            return float(d.get("ts", 0.0))
+        except (TypeError, ValueError):
+            return 0.0
+    out.sort(key=ts)
+    return out
+
+
+class FaultSchedule:
+    """A seeded, immutable description of which faults fire.
+
+    Everything derives from ``seed`` through one `random.Random`
+    stream consumed at CONSTRUCTION time (per-query decisions hash
+    the seed with the query, never draw from shared mutable state),
+    so two injectors built from the same seed agree forever.
+
+    ``classes=()`` (or ``seed=None`` via :meth:`none`) is the
+    all-faults-off schedule: the injector becomes a pure recorder
+    that records nothing — cluster behavior is bit-identical to
+    running without an injector at all.
+    """
+
+    def __init__(self, seed: Optional[int] = None,
+                 classes: Optional[Sequence[str]] = None,
+                 ship_fault_rate: float = 0.3,
+                 flap_factor: float = 50.0,
+                 window_s: float = 0.05,
+                 skew_s: float = 0.05,
+                 reorder_delay_s: float = 0.02,
+                 max_faults: int = 32):
+        self.seed = seed
+        rng = random.Random(0 if seed is None else seed)
+        if classes is None:
+            if seed is None:
+                classes = ()
+            else:
+                # Each seed arms 1..3 classes — across a seed sweep
+                # every class appears alone and in combination.
+                k = 1 + rng.randrange(3)
+                classes = tuple(rng.sample(FAULT_CLASSES, k))
+        self.classes: Tuple[str, ...] = tuple(classes)
+        for c in self.classes:
+            assert c in FAULT_CLASSES, c
+        self.ship_fault_rate = float(ship_fault_rate)
+        self.flap_factor = float(flap_factor)
+        self.skew_s = float(skew_s)
+        self.reorder_delay_s = float(reorder_delay_s)
+        self.max_faults = int(max_faults)
+        #: Fault windows start after a seeded delay so some traffic
+        #: flows cleanly first, and close again so recovery paths
+        #: (probation re-admission, flap clearing) are exercised.
+        t0 = 0.002 + rng.random() * 0.02
+        self.window: Tuple[float, float] = (t0, t0 + window_s)
+        #: Which replica the replica-targeted classes hit.
+        self.victim = rng.randrange(1 << 16)
+        self._salt = rng.getrandbits(32)
+
+    @classmethod
+    def none(cls) -> "FaultSchedule":
+        """The all-faults-off schedule (bit-identical cluster)."""
+        return cls(seed=None)
+
+    # -- derivation helpers ----------------------------------------------
+
+    def _hash(self, *parts) -> float:
+        """Uniform [0, 1) hash of (salt, parts) — stateless, so query
+        order never changes an answer, and stable across processes
+        (CRC-based: never Python's randomized str hashing)."""
+        acc = self._salt
+        for p in parts:
+            acc = zlib.crc32(repr(p).encode(), acc)
+        return random.Random(acc).random()
+
+    def in_window(self, now: float) -> bool:
+        lo, hi = self.window
+        return lo <= now < hi
+
+    # -- per-seam queries --------------------------------------------------
+
+    def ship_fault(self, ship_id: int) -> Optional[str]:
+        """Which wire fault (if any) hits shipment ``ship_id``.
+        Deterministic per id: a retransmission (new id) re-rolls."""
+        armed = [c for c in ("drop", "dup", "reorder", "corrupt")
+                 if c in self.classes]
+        if not armed:
+            return None
+        r = self._hash("ship", ship_id)
+        if r >= self.ship_fault_rate:
+            return None
+        return armed[int(self._hash("ship.class", ship_id)
+                         * len(armed))]
+
+    def reorder_delay(self, ship_id: int) -> float:
+        return (0.5 + self._hash("reorder", ship_id)) \
+            * self.reorder_delay_s
+
+    def flap(self, now: float) -> float:
+        """Wire-time multiplier at ``now`` (1.0 = healthy link)."""
+        if "flap" in self.classes and self.in_window(now):
+            return self.flap_factor
+        return 1.0
+
+    def victim_id(self, n_replicas: int) -> int:
+        """The replica the replica-targeted classes (stale_hb, skew)
+        hit, for a cluster of ``n_replicas``."""
+        return self.victim % max(int(n_replicas), 1)
+
+
+class FaultInjector:
+    """Runtime fault state: consults a :class:`FaultSchedule`,
+    enforces the fault budget, and records every injection as a
+    :class:`FaultEvent`.
+
+    The `ServingCluster` calls :meth:`on_ship` when a shipment goes
+    on the wire (and acts on the returned action), :meth:`wire_factor`
+    when pricing a delivery, and :meth:`beat_ts` before every
+    heartbeat write.  All three are no-ops on an empty schedule.
+    """
+
+    def __init__(self, schedule: Optional[FaultSchedule] = None,
+                 n_replicas: int = 0):
+        self.schedule = schedule or FaultSchedule.none()
+        self.n_replicas = int(n_replicas)
+        self.events: List[FaultEvent] = []
+        self.by_class: Dict[str, int] = {}
+
+    @property
+    def active(self) -> bool:
+        return bool(self.schedule.classes)
+
+    def _budget_left(self) -> bool:
+        return len(self.events) < self.schedule.max_faults
+
+    def _record(self, fault: str, target: str, now: float,
+                **inputs) -> None:
+        self.events.append(FaultEvent(
+            fault=fault, target=target, ts=round(float(now), 9),
+            inputs=inputs, seed=self.schedule.seed))
+        self.by_class[fault] = self.by_class.get(fault, 0) + 1
+        from triton_distributed_tpu.observability.metrics import (
+            count_metric)
+        count_metric("cluster_faults_injected_total", fault=fault)
+
+    # -- seams -------------------------------------------------------------
+
+    def on_ship(self, ship_id: int, nbytes: int,
+                now: float) -> Optional[dict]:
+        """Wire fault for a freshly shipped payload, or None.  The
+        caller applies the action: ``{"fault": "drop"}``,
+        ``{"fault": "dup"}``, ``{"fault": "corrupt"}`` or
+        ``{"fault": "reorder", "delay_s": ...}``."""
+        if not self.active or not self._budget_left():
+            return None
+        fault = self.schedule.ship_fault(ship_id)
+        if fault is None:
+            return None
+        action = {"fault": fault}
+        inputs = {"nbytes": int(nbytes)}
+        if fault == "reorder":
+            action["delay_s"] = self.schedule.reorder_delay(ship_id)
+            inputs["delay_s"] = round(action["delay_s"], 9)
+        self._record(fault, f"shipment:{ship_id}", now, **inputs)
+        return action
+
+    def wire_factor(self, now: float) -> float:
+        """Bandwidth-collapse multiplier for a delivery priced at
+        ``now`` (checked against the budget; the flap is recorded
+        once per window entry)."""
+        if not self.active:
+            return 1.0
+        f = self.schedule.flap(now)
+        if f == 1.0:
+            return 1.0
+        if not any(e.fault == "flap" for e in self.events):
+            if not self._budget_left():
+                # Unrecordable -> not applied: faults.jsonl must
+                # account for every injection.
+                return 1.0
+            self._record("flap", "wire", now, factor=f,
+                         window=list(self.schedule.window))
+        return f
+
+    def beat_ts(self, replica_id: int, now: float) -> Optional[float]:
+        """The timestamp ``replica_id``'s heartbeat should carry at
+        ``now``: ``None`` = suppressed (stale_hb), ``now - skew``
+        under clock skew, else ``now``.  Recorded once per window per
+        replica."""
+        if not self.active:
+            return now
+        sched = self.schedule
+        victim = sched.victim_id(self.n_replicas)
+
+        def recorded(fault: str) -> bool:
+            """One record per window per replica — and a fault that
+            cannot be recorded (budget spent before the window's
+            first beat) is NOT applied: faults.jsonl must account
+            for every injection."""
+            target = f"replica-{replica_id}"
+            if any(e.fault == fault and e.target == target
+                   for e in self.events):
+                return True
+            if not self._budget_left():
+                return False
+            kw = ({"skew_s": sched.skew_s} if fault == "skew" else {})
+            self._record(fault, target, now,
+                         window=list(sched.window), **kw)
+            return True
+
+        if ("stale_hb" in sched.classes and sched.in_window(now)
+                and replica_id == victim and recorded("stale_hb")):
+            return None
+        if ("skew" in sched.classes and sched.in_window(now)
+                and replica_id == victim and recorded("skew")):
+            return now - sched.skew_s
+        return now
+
+    # -- artifact ----------------------------------------------------------
+
+    def write_artifact(self, directory: str) -> str:
+        """Write ``faults.jsonl`` — one schema-v1 line per injected
+        fault, the artifact the doctor's "Chaos" section replays."""
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, FAULTS_FILE)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            for e in self.events:
+                f.write(json.dumps(e.to_dict()) + "\n")
+        os.replace(tmp, path)
+        return path

@@ -41,6 +41,9 @@ import time
 import weakref
 from typing import Callable, Deque, Dict, List, Optional, Sequence
 
+from triton_distributed_tpu.serving.cluster.chaos import (
+    FaultInjector,
+)
 from triton_distributed_tpu.serving.cluster.prefill import (
     PrefillWorker,
 )
@@ -53,12 +56,17 @@ from triton_distributed_tpu.serving.cluster.router import (
     RouterConfig,
 )
 from triton_distributed_tpu.serving.cluster.transport import (
+    ShipmentCorrupt,
     VirtualTransport,
+)
+from triton_distributed_tpu.serving.engine_batched import (
+    pick_bucket,
 )
 from triton_distributed_tpu.serving.request import (
     FinishReason,
     RejectReason,
     Request,
+    RequestState,
 )
 from triton_distributed_tpu.serving.scheduler import SchedulerConfig
 
@@ -88,8 +96,18 @@ class ClusterConfig:
     prefill_time_s: float = 2e-3
     #: Modeled DCN bandwidth for KV shipments (None = instant wire).
     wire_gbps: Optional[float] = 25.0
-    #: When set, ``router-state.json`` is (re)written here on every
-    #: failover — the artifact the doctor's Cluster section ingests.
+    #: Lossy-wire delivery protocol (docs/serving.md "Failure
+    #: model"): a shipment that is not delivered intact retransmits
+    #: with exponential backoff (``base * 2^(attempt-1)``), at most
+    #: ``ship_max_retries`` times and never past ``ship_deadline_s``
+    #: after the first send — beyond either bound the request
+    #: re-routes through the normal commit-on-accept dispatch path.
+    ship_retry_base_s: float = 0.004
+    ship_max_retries: int = 4
+    ship_deadline_s: float = 0.5
+    #: When set, ``router-state.json`` (and ``faults.jsonl`` when a
+    #: fault injector fired) is (re)written here on every failover —
+    #: the artifacts the doctor's Cluster/Chaos sections ingest.
     artifact_dir: Optional[str] = None
 
 
@@ -149,8 +167,15 @@ class ServingCluster:
     def __init__(self, model, params,
                  config: Optional[ClusterConfig] = None,
                  clock: Optional[Callable[[], float]] = None,
-                 clock_advance: Optional[Callable[[float], None]] = None):
+                 clock_advance: Optional[Callable[[float], None]] = None,
+                 fault_injector: Optional[FaultInjector] = None):
         self.config = cfg = config or ClusterConfig()
+        #: Chaos seam (`serving.cluster.chaos`): consulted at every
+        #: heartbeat write and wire send.  The default injector has
+        #: an empty schedule — every hook is a no-op and the cluster
+        #: behaves bit-identically to one with no injector wired.
+        self.injector = fault_injector or FaultInjector()
+        self.injector.n_replicas = cfg.n_replicas
         if clock is None:
             v = _VClock()
             clock = lambda: v.t                          # noqa: E731
@@ -246,17 +271,27 @@ class ServingCluster:
     def step(self) -> dict:
         now = self._clock()
         for rep in self.replicas:
-            rep.beat(now)
+            # The chaos seam: a suppressed write leaves the previous
+            # heartbeat in place (present but stale); clock skew
+            # backdates the timestamp.  No injector = beat(now).
+            ts = self.injector.beat_ts(rep.id, now)
+            if ts is not None:
+                rep.beat(ts)
         progressed = self._pump_ships(now)
         progressed |= self._pump_queue(now)
         for w in self.workers:
-            out = w.step(now, self.transport)
+            out = w.step(now)
             if out is not None:
-                req, dst, token, ready_at = out
-                self._ships.append({
-                    "req": req, "dst": dst, "token": token,
-                    "ready_at": ready_at,
-                    "record": self._by_req.get(req.request_id)})
+                req, dst, shipment, done_at = out
+                ship = {
+                    "req": req, "dst": dst, "shipment": shipment,
+                    "record": self._by_req.get(req.request_id),
+                    "attempt": 0,
+                    "deadline_at": done_at
+                    + self.config.ship_deadline_s,
+                }
+                self._send(ship, done_at)
+                self._ships.append(ship)
                 progressed = True
         stepped = 0
         for rep in self.replicas:
@@ -302,19 +337,41 @@ class ServingCluster:
     def _dispatch(self, record: ClusterRequest, now: float) -> bool:
         """True = the record left the queue (placed or terminally
         resolved); False = keep it queued and retry later."""
+        req = self._make_request(record, now)
+        resumed = bool(record.tokens)
+        eligible = None
+        if (not self.workers or resumed
+                or record.ship_cache is not None):
+            # Local-prefill path: a prompt longer than every prefill
+            # bucket is servable ONLY via a cached prefix — a
+            # CACHE-dependent capability, not a homogeneous one, so
+            # placement must steer to a replica that can serve it
+            # (prefix-dependent admission, `structural_reject`).
+            ref = self.replicas[0].scheduler
+            if pick_bucket(len(req.prompt), ref.buckets) is None:
+                eligible = (lambda r:
+                            r.scheduler.structural_reject(req) is None)
         rep = self.router.route(record.prompt,
-                                f"request:{record.record_id}", now)
+                                f"request:{record.record_id}", now,
+                                eligible=eligible)
         if rep is None:
             return False
-        req = self._make_request(record, now)
-        if (self.workers and req.resume_key is None
+        if resumed:
+            # Exact resume from router-side state alone: the PRNG
+            # key is a pure function of (seed, streamed count) —
+            # computed only AFTER a route landed, since it costs a
+            # JAX dispatch and a blocked queue retries every tick.
+            req.resume_key = advance_request_key(record.seed,
+                                                len(record.tokens))
+        if (self.workers and not resumed
                 and record.ship_cache is None):
             # Disaggregated path: prompt KV is computed on a prefill
             # worker and shipped to the chosen decode replica.
             # Resumed (failover) requests skip it: their "prompt"
             # embeds already-streamed tokens and latency matters more
             # than offloading one re-prefill.
-            reason = rep.scheduler.structural_reject(req)
+            reason = rep.scheduler.structural_reject(
+                req, full_prefill=True)
             if reason is not None:
                 # submit() would reject this on every (homogeneous)
                 # replica — resolve it here rather than crash the
@@ -350,19 +407,17 @@ class ServingCluster:
 
     def _make_request(self, record: ClusterRequest,
                       now: float) -> Request:
+        """The per-attempt `serving.Request`.  For a resumed record
+        the prompt embeds the streamed tokens (re-prefill recomputes
+        their KV bit-identically); the resume PRNG key is set by
+        `_dispatch` once a route lands."""
         done = len(record.tokens)
-        req = Request(
+        return Request(
             prompt=list(record.prompt) + list(record.tokens),
             max_new_tokens=record.max_new_tokens - done,
             eos_token_ids=record.eos_token_ids, seed=record.seed,
             arrival_time=(record.arrival_time if done == 0 else now),
             on_token=self._mirror(record))
-        if done:
-            # Exact resume from router-side state alone: re-prefill
-            # recomputes the KV of prompt+streamed bit-identically,
-            # and the PRNG key is a pure function of (seed, streamed).
-            req.resume_key = advance_request_key(record.seed, done)
-        return req
 
     def _mirror(self, record: ClusterRequest):
         def cb(req, tok):
@@ -413,13 +468,122 @@ class ServingCluster:
                 req.reject_reason.value if req.reject_reason else None)
         self._open -= 1
 
+    def _count(self, name: str, n: int = 1, **labels) -> None:
+        from triton_distributed_tpu.observability.metrics import (
+            count_metric)
+        count_metric(name, n, **labels)
+
+    def _send(self, ship: dict, now: float) -> None:
+        """Put (or re-put) one shipment on the wire at ``now``: a
+        fresh monotonic id + checksum from the transport, modeled
+        wire time (derated through a flapping link), exponential
+        backoff on retransmissions — and any wire fault the chaos
+        schedule holds for the new id."""
+        token, nbytes = self.transport.ship(ship["shipment"])
+        ship["token"] = token
+        ship["nbytes"] = nbytes
+        ship["lost"] = False
+        ship.pop("dup", None)
+        attempt = ship["attempt"]
+        backoff = (self.config.ship_retry_base_s
+                   * (2 ** (attempt - 1)) if attempt else 0.0)
+        wire_s = (self.transport.ship_time_s(nbytes)
+                  * self.injector.wire_factor(now))
+        ship["ready_at"] = now + backoff + wire_s
+        # Retransmit timer: when the wire ate the packet nothing
+        # ever arrives — the sender notices one backoff step after
+        # the expected delivery and re-sends.
+        ship["timeout_at"] = (ship["ready_at"]
+                              + self.config.ship_retry_base_s
+                              * (2 ** attempt))
+        self._count("cluster_kv_shipped_bytes_total", nbytes)
+        action = self.injector.on_ship(token, nbytes, now)
+        if action is None:
+            return
+        fault = action["fault"]
+        if fault == "drop":
+            self.transport.drop(token)
+            ship["lost"] = True
+        elif fault == "corrupt":
+            self.transport.corrupt(token, byte_index=token * 131)
+        elif fault == "dup":
+            ship["dup"] = True
+        elif fault == "reorder":
+            ship["ready_at"] += action["delay_s"]
+            ship["timeout_at"] += action["delay_s"]
+
+    def _retry_or_reroute(self, ship: dict, now: float,
+                          trigger: str) -> None:
+        """A shipment failed to deliver intact (``timeout`` = the
+        wire ate it, ``corrupt`` = checksum NACK).  Retransmit with
+        exponential backoff while the attempt bound and the
+        per-shipment deadline allow; past either, hand the request
+        back to the router — the normal commit-on-accept dispatch
+        path re-places it (at worst one more prefill, never a stuck
+        request, never a truncated stream)."""
+        self.transport.drop(ship.get("token"))
+        record = ship["record"]
+        req = ship["req"]
+        if (record is None or record.done
+                or record.state != "running"
+                or record.replica != ship["dst"]):
+            # The record moved on (a failover drained the
+            # destination while the wire flailed): nothing to do.
+            self._by_req.pop(req.request_id, None)
+            self._staged_routes.pop(req.request_id, None)
+            return
+        if (ship["attempt"] < self.config.ship_max_retries
+                and now < ship["deadline_at"]):
+            ship["attempt"] += 1
+            self._count("cluster_ship_retries_total",
+                        trigger=trigger)
+            self._send(ship, now)
+            self._ships.append(ship)
+            return
+        # Bounded retry exhausted: the route never landed, so its
+        # stage dies uncommitted and the record re-queues at the
+        # failure's virtual timestamp.
+        self._count("cluster_ship_reroutes_total", trigger=trigger)
+        self._by_req.pop(req.request_id, None)
+        self._staged_routes.pop(req.request_id, None)
+        record.replica = None
+        record.state = "queued"
+        self._requeue.append(record)
+
     def _pump_ships(self, now: float) -> bool:
         progressed = False
-        for ship in [s for s in self._ships
-                     if s["ready_at"] <= now]:
+        for ship in list(self._ships):
+            if ship.get("lost"):
+                if now >= ship["timeout_at"]:
+                    self._ships.remove(ship)
+                    self._retry_or_reroute(ship, now, "timeout")
+                    progressed = True
+                continue
+            if ship["ready_at"] > now:
+                continue
             self._ships.remove(ship)
             record = ship["record"]
+            req = ship["req"]
             rep = self.replicas[ship["dst"]]
+            if ship.get("dup_copy"):
+                # Idempotent delivery: the shipment id was already
+                # consumed, so the duplicate claims None — and even
+                # a copy that somehow still held bytes is discarded,
+                # never admitted twice.
+                try:
+                    self.transport.claim(ship["token"])
+                except ShipmentCorrupt:
+                    pass
+                self._count("cluster_shipments_duplicate_total")
+                progressed = True
+                continue
+            if ship.pop("dup", False):
+                # The wire duplicated this shipment: a second copy
+                # lands shortly after the first and must be absorbed.
+                self._ships.append({
+                    "dup_copy": True, "token": ship["token"],
+                    "dst": ship["dst"], "req": req, "record": record,
+                    "ready_at": now + self.config.ship_retry_base_s})
             if (record is None or record.state != "running"
                     or record.replica != ship["dst"]
                     or not rep.routable):
@@ -427,11 +591,25 @@ class ServingCluster:
                 # while the shipment was on the wire: drop the wire
                 # copy — the record already took the failover path.
                 self.transport.drop(ship["token"])
-                self._by_req.pop(ship["req"].request_id, None)
-                self._staged_routes.pop(ship["req"].request_id, None)
+                self._by_req.pop(req.request_id, None)
+                self._staged_routes.pop(req.request_id, None)
+                progressed = True
                 continue
-            req = ship["req"]
-            req.shipped_kv = self.transport.claim(ship["token"])
+            try:
+                shipment = self.transport.claim(ship["token"])
+            except ShipmentCorrupt:
+                # NACK: the payload failed its checksum — a corrupted
+                # row must never reach the insert program.
+                self._count("cluster_shipments_corrupt_total")
+                self._retry_or_reroute(ship, now, "corrupt")
+                progressed = True
+                continue
+            if shipment is None:
+                # Already claimed under another delivery of this id.
+                self._count("cluster_shipments_duplicate_total")
+                progressed = True
+                continue
+            req.shipped_kv = shipment
             staged = self._staged_routes.pop(req.request_id, None)
             if self._submit_to(rep, req, record):
                 self.router.commit_staged(staged)
@@ -457,12 +635,21 @@ class ServingCluster:
             record = self._by_req.pop(req.request_id, None)
             if record is None:
                 continue           # drained before stop(); re-queued
-            record.state = "finished"
-            record.finish_reason = (req.finish_reason.value
-                                    if req.finish_reason else None)
             record.replica = None
             record.t_finish = now
-            self.finished.append(record)
+            if req.state == RequestState.REJECTED:
+                # Shed at admission (KV pressure: the cached prefix
+                # this request depended on was evicted) — terminal
+                # with the scheduler's truthful reason, mirroring
+                # `_resolve_structural`.
+                record.state = "rejected"
+                record.reject_reason = (req.reject_reason.value
+                                        if req.reject_reason else None)
+            else:
+                record.state = "finished"
+                record.finish_reason = (req.finish_reason.value
+                                        if req.finish_reason else None)
+                self.finished.append(record)
             self._open -= 1
 
     # -- health / failover -----------------------------------------------
@@ -470,6 +657,29 @@ class ServingCluster:
     def _health(self, now: float) -> None:
         for rep, reason in self.router.health_verdicts(now):
             self._failover(rep, reason, now)
+        for rep in self.router.readmit_verdicts(now):
+            self._readmit(rep, now)
+
+    def _readmit(self, rep: Replica, now: float) -> None:
+        """Return a drained-but-recovered replica to the rotation
+        (the drain was a false positive: its heartbeat flapped but
+        the process never died).  Its scheduler is reset first —
+        anything it still held was re-queued at the drain and
+        finished elsewhere; those stale retirements touch no records
+        (they were unmapped from ``_by_req`` when drained)."""
+        rep.scheduler.stop()
+        rep.scheduler.restart()
+        rep.fin_i = len(rep.scheduler.finished)
+        rep.busy_until = now
+        if hasattr(rep, "probe_step_s"):
+            # The last EXECUTED step is from before the drain; left
+            # stale-straggled it would re-trip the straggler check on
+            # the very next health pass and thrash the probation.
+            rep.last_step_s = rep.probe_step_s()
+        self.router.note_readmit(rep, now)
+        self._update_gauges()
+        if self.config.artifact_dir:
+            self.write_artifact(self.config.artifact_dir)
 
     def _failover(self, rep: Replica, reason: str,
                   now: float) -> None:
@@ -518,19 +728,32 @@ class ServingCluster:
             arrival = self._pending[self._pending_i].arrival_time
             if arrival > now:
                 cands.append(arrival)
-        cands.extend(s["ready_at"] for s in self._ships)
+        cands.extend(s["timeout_at"] if s.get("lost")
+                     else s["ready_at"] for s in self._ships)
         for w in self.workers:
             if w.queue:
                 cands.append(w.busy_until)
+        rcfg = self.router.config
+        # Health checks count one observation per DISTINCT virtual
+        # time, so hysteresis (K stale checks to drain, K fresh to
+        # re-admit) needs the clock to keep moving through detection
+        # and probation windows even when nothing else is scheduled.
+        recheck = rcfg.dead_after_s / max(rcfg.dead_checks, 1)
         for rep in self.replicas:
             if (rep.alive and rep.routable
                     and rep.scheduler.has_work()):
                 cands.append(rep.busy_until)
-            if not rep.alive and rep.routable:
-                # Dead process awaiting detection: the next event is
-                # the router's heartbeat-loss deadline.
-                cands.append(rep.hb_ts
-                             + self.router.config.dead_after_s + 1e-6)
+            if rep.routable and (now - rep.hb_ts) > rcfg.dead_after_s:
+                # Stale-but-undrained (dead process, suppressed or
+                # skewed beats): the next stale observation.
+                cands.append(now + recheck)
+            elif not rep.alive and rep.routable:
+                # Dead process not yet stale: the first observation
+                # lands at the heartbeat-loss deadline.
+                cands.append(rep.hb_ts + rcfg.dead_after_s + 1e-6)
+            if self.router.readmit_pending(rep, now):
+                # Probation: the next fresh observation.
+                cands.append(now + recheck)
         if not cands:
             if self.has_work():
                 raise RuntimeError(
@@ -557,13 +780,17 @@ class ServingCluster:
 
     def write_artifact(self, directory: str) -> str:
         """Write ``router-state.json`` — the doctor ingests it into
-        its Cluster section and names failed replicas."""
+        its Cluster section and names failed replicas — plus
+        ``faults.jsonl`` when a chaos schedule injected anything
+        (the doctor's "Chaos" section names the fault classes)."""
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(directory, "router-state.json")
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(self.routing_table(), f, indent=1)
         os.replace(tmp, path)
+        if self.injector.events:
+            self.injector.write_artifact(directory)
         return path
 
     def _update_gauges(self) -> None:

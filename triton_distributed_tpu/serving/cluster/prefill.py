@@ -10,10 +10,12 @@ WHEN decode steps stall (never, that's the point), not a single
 token.
 
 Virtual-clock accounting: the worker is busy for ``prefill_time_s``
-per job (the modeled prompt-FLOPs cost); the shipment then rides the
-wire for ``transport.ship_time_s(nbytes)``.  The `ServingCluster`
-owns delivery — the worker just turns (request, destination) pairs
-into (token, nbytes, done_at) tuples.
+per job (the modeled prompt-FLOPs cost).  The `ServingCluster` owns
+the WIRE — sending, retransmission after loss/corruption, delivery —
+so the worker just turns (request, destination) pairs into
+(request, destination, shipment, done_at) tuples; keeping the
+`KVShipment` artifact on the cluster side is what makes bounded
+retransmit possible without a second prefill.
 """
 
 from __future__ import annotations
@@ -25,7 +27,6 @@ import jax
 
 from triton_distributed_tpu.serving.cluster.transport import (
     KVShipment,
-    VirtualTransport,
 )
 from triton_distributed_tpu.serving.engine_batched import (
     pad_prompt,
@@ -63,12 +64,12 @@ class PrefillWorker:
             self._row_caches[bucket] = row
         return row
 
-    def step(self, now: float, transport: VirtualTransport
-             ) -> Optional[Tuple]:
-        """Run ONE queued prefill and put its shipment on the wire.
-        Returns ``(req, dst, token, ready_at)`` — the cluster delivers
-        the claim to ``dst`` at virtual time ``ready_at`` — or None
-        when idle."""
+    def step(self, now: float) -> Optional[Tuple]:
+        """Run ONE queued prefill.  Returns ``(req, dst, shipment,
+        done_at)`` — the prompt's KV flattened for the wire, compute
+        finished at virtual time ``done_at``; the cluster puts it on
+        the wire (and re-sends it on loss/corruption, reusing this
+        same artifact) — or None when idle."""
         if not self.ready(now):
             return None
         req, dst = self.queue.popleft()
@@ -78,15 +79,10 @@ class PrefillWorker:
         _, row = self._prefill(self.params, ids,
                                self._row_cache(bucket))
         shipment = KVShipment.from_row_cache(row, s)
-        token, nbytes = transport.ship(shipment)
         self.busy_until = now + self.prefill_time_s
         self.jobs_done += 1
         from triton_distributed_tpu.observability.metrics import (
-            get_registry, observability_enabled)
-        if observability_enabled():
-            reg = get_registry()
-            reg.counter("cluster_prefill_shipments_total",
-                        worker=self.name).inc()
-            reg.counter("cluster_kv_shipped_bytes_total").inc(nbytes)
-        return req, dst, token, (self.busy_until
-                                 + transport.ship_time_s(nbytes))
+            count_metric)
+        count_metric("cluster_prefill_shipments_total",
+                     worker=self.name)
+        return req, dst, shipment, self.busy_until

@@ -64,6 +64,10 @@ class Replica:
                  clock, step_time_s: float = 1e-3):
         self.id = int(rid)
         self.name = f"replica-{rid}"
+        #: Global launch rank (for peer heartbeat files,
+        #: `router.heartbeat_signals`); the in-process cluster has no
+        #: rank plumbing, so it defaults to the replica id.
+        self.rank = int(rid)
         self._clock = clock
         self.scheduler = ContinuousBatchingScheduler(
             model, params, sched_config, clock=clock)
@@ -132,6 +136,15 @@ class Replica:
         return out
 
     # -- signals ---------------------------------------------------------
+
+    def probe_step_s(self) -> float:
+        """The step cost this replica would pay NOW — the recovery
+        probe the router consults during probation.  A drained
+        replica never executes scheduler steps, so ``last_step_s``
+        freezes at the straggled value and could never show healing;
+        this reads the live cost model instead (a multi-process
+        deployment wires a canary decode here)."""
+        return self.base_step_s * self.straggle_factor
 
     def signals(self, now: float) -> dict:
         """The routing-score snapshot the router scores from (see

@@ -30,6 +30,23 @@ has fallen more than ``affinity_slack``× behind the best candidate
 replica).  Affinity only acts in the signal-aware regime: the
 round-robin fallback stays bit-identical.
 
+**Flap-resistant health**: a replica is declared DEAD only after
+``dead_checks`` CONSECUTIVE stale heartbeat observations at distinct
+times (one slow heartbeat write is jitter; K in a row is a verdict —
+the same sustained-signal rule `BaselineStore.sustained_z` applies to
+autotune invalidation), and a drained replica whose heartbeat comes
+BACK is re-admitted only after ``probation_checks`` consecutive fresh
+observations — so a flapping replica settles into drained instead of
+thrashing drain→re-admit→drain.
+
+**Peer signals without shared memory**: when a replica handle has no
+in-process snapshot (multi-process deployments — the router is its
+own rank), ``RouterConfig.heartbeat_dir`` points at the PR-2
+heartbeat directory and :func:`heartbeat_signals` maps each peer's
+``heartbeat-rank-<N>.json`` serving gauges onto the same snapshot
+schema.  Missing or stale files degrade the whole decision to
+round-robin, bit-identically — the PR-8 contract unchanged.
+
 Every routing choice and every health verdict is recorded as a
 schema-v1 `DecisionEvent` (`observability.feedback`) — consumers
 ``cluster.router`` and ``cluster.failover`` — so ``decisions.jsonl``,
@@ -41,6 +58,8 @@ closed-loop consumers.
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 #: Utilization cap for the link derate (mirrors feedback.UTILIZATION_CAP:
@@ -61,9 +80,27 @@ class RouterConfig:
     #: (partial information would silently bias against the quiet
     #: replica — the one most likely to be idle).
     staleness_s: float = 10.0
-    #: Heartbeat age past which a replica is declared dead and its
-    #: requests re-queued.
+    #: Heartbeat age past which one health check counts a STALE
+    #: observation against a replica.
     dead_after_s: float = 3.0
+    #: Consecutive stale observations (at distinct check times)
+    #: before the replica is declared dead and drained.  1 restores
+    #: the flap-prone pre-hysteresis behavior (a single slow
+    #: heartbeat write triggered a full drain).
+    dead_checks: int = 3
+    #: Consecutive FRESH observations (at distinct check times) a
+    #: drained replica must show before it is re-admitted — recovery
+    #: probation, so a flapping heartbeat cannot thrash
+    #: drain→re-admit→drain.  A quarantined straggler must also show
+    #: a healed step time.
+    probation_checks: int = 3
+    #: Allow re-admission at all (a drained replica whose heartbeat
+    #: returns is a false positive — the process never died).
+    readmit: bool = True
+    #: Directory of PR-2 heartbeat files (``heartbeat-rank-<N>.json``)
+    #: to read peer placement signals from when a replica handle has
+    #: no in-process snapshot.  None = in-process snapshots only.
+    heartbeat_dir: Optional[str] = None
     #: A replica whose step time exceeds this multiple of the median
     #: routable peer's is quarantined (drain + re-queue) — the
     #: ``dl.maybe_straggle`` detector.
@@ -80,35 +117,118 @@ class RouterConfig:
     affinity_max: int = 4096
 
 
+#: Serving gauges a heartbeat file must carry to yield a usable
+#: placement snapshot (any missing -> snapshot absent -> round-robin).
+_HB_REQUIRED = ("serving_queue_depth", "serving_active_slots",
+                "serving_decode_step_us")
+
+
+#: Parsed-heartbeat memo keyed by path: (mtime_ns, size, snapshot).
+#: Heartbeat files change once per interval (seconds) while route()
+#: runs per request — re-parsing JSON per placement would put
+#: O(replicas) disk reads on the hot path for nothing.  Staleness
+#: semantics are untouched: the snapshot's ``ts`` is the file's own
+#: and still gates freshness.
+_HB_CACHE: Dict[str, Tuple[int, int, Optional[dict]]] = {}
+
+
+def heartbeat_signals(directory: str, rank: int) -> Optional[dict]:
+    """Placement-signal snapshot for a peer replica, read from its
+    PR-2 heartbeat file (``heartbeat-rank-<rank>.json``) — the
+    multi-process stand-in for `Replica.signals`.
+
+    The heartbeat's ``serving`` section (written by the exporter from
+    the live scheduler gauges) maps onto the exact snapshot schema
+    the scorer consumes; ``ts`` is the file's own ``unix_time``, so
+    the router's staleness gate applies unchanged.  Returns None —
+    degrading the WHOLE decision to round-robin, bit-identically —
+    when the file is missing, unparseable, or lacks any required
+    gauge (partial information would silently bias placement)."""
+    path = os.path.join(directory, f"heartbeat-rank-{rank}.json")
+    try:
+        st = os.stat(path)
+    except OSError:
+        _HB_CACHE.pop(path, None)
+        return None
+    cached = _HB_CACHE.get(path)
+    if cached is not None and cached[:2] == (st.st_mtime_ns,
+                                             st.st_size):
+        return dict(cached[2]) if cached[2] is not None else None
+    sig = _parse_heartbeat(path)
+    _HB_CACHE[path] = (st.st_mtime_ns, st.st_size, sig)
+    return dict(sig) if sig is not None else None
+
+
+def _parse_heartbeat(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            hb = json.load(f)
+    except (OSError, ValueError):
+        return None
+    serving = hb.get("serving") or {}
+    if any(k not in serving for k in _HB_REQUIRED):
+        return None
+    occ = serving.get("serving_kv_page_occupancy",
+                      serving.get("serving_slot_occupancy"))
+    if occ is None or hb.get("unix_time") is None:
+        return None
+    return {
+        "ts": float(hb["unix_time"]),
+        "queue_depth": float(serving["serving_queue_depth"]),
+        "active_slots": float(serving["serving_active_slots"]),
+        "kv_occupancy": float(occ),
+        "step_us": float(serving["serving_decode_step_us"]),
+        "link_busy": float(serving.get("serving_link_busy", 0.0)),
+    }
+
+
 class ClusterRouter:
     """Pure decision logic over a list of `Replica`-shaped objects;
     the `ServingCluster` owns execution (stepping, draining,
     re-queueing).  ``signals_fn(replica, now)`` supplies snapshots —
     injectable so tests script absent/stale signals without touching
-    replica state."""
+    replica state.  The default chain: the replica's in-process
+    snapshot when the handle has one, else its peer heartbeat file
+    under ``config.heartbeat_dir``, else None (round-robin)."""
 
     def __init__(self, config: Optional[RouterConfig], replicas,
                  signals_fn=None):
         self.config = config or RouterConfig()
         self.replicas = list(replicas)
-        self._signals_fn = signals_fn or (
-            lambda rep, now: rep.signals(now))
+        self._signals_fn = signals_fn or self._default_signals
         #: Rotation counter — shared by the round-robin choice, the
         #: degraded signal-aware choice and the tie-break, which is
         #: what makes the degradation bit-identical.
         self._rr = 0
         self._affinity: Dict[Tuple[int, ...], int] = {}
         self.failovers: List[dict] = []
+        self.readmits: List[dict] = []
+        #: Health hysteresis: per-replica consecutive stale / fresh
+        #: observation counts, plus the check time each was last
+        #: updated at (an event loop spinning at one virtual instant
+        #: counts ONE observation, however many times it checks).
+        self._stale_obs: Dict[int, int] = {}
+        self._fresh_obs: Dict[int, int] = {}
+        self._obs_ts: Dict[int, float] = {}
         #: The last route()'s decision payload, held until the cluster
         #: confirms the dispatch landed (`commit_route`).
         self._staged: Optional[tuple] = None
+
+    def _default_signals(self, rep, now: float) -> Optional[dict]:
+        fn = getattr(rep, "signals", None)
+        sig = fn(now) if fn is not None else None
+        if sig is None and self.config.heartbeat_dir:
+            sig = heartbeat_signals(self.config.heartbeat_dir,
+                                    getattr(rep, "rank", rep.id))
+        return sig
 
     # -- placement -------------------------------------------------------
 
     def _routable(self) -> List:
         return [r for r in self.replicas if r.routable]
 
-    def route(self, tokens: Sequence[int], op: str, now: float):
+    def route(self, tokens: Sequence[int], op: str, now: float,
+              eligible=None):
         """Pick a replica for one request (``tokens`` = its prompt,
         ``op`` labels the DecisionEvent).  Returns None when no
         replica is routable (caller keeps the request queued).  The
@@ -116,9 +236,20 @@ class ClusterRouter:
         `commit_route` once the replica actually accepted, so a
         backpressure-refused dispatch retried every event-loop tick
         does not inflate routed counters or flood decisions.jsonl
-        with phantom placements."""
+        with phantom placements.
+
+        ``eligible(replica) -> bool``, when given, restricts the
+        candidate set — the cluster passes it for CACHE-dependent
+        admission (a prompt longer than every prefill bucket is
+        servable only on a replica whose radix cache holds its
+        prefix, so "replicas are homogeneous" does not apply and the
+        placement must steer, not shed).  If NO routable replica is
+        eligible the full set is used: the chosen replica's submit
+        then rejects with the truthful structural reason."""
         self._staged = None
         alive = self._routable()
+        if eligible is not None:
+            alive = [r for r in alive if eligible(r)] or alive
         if not alive:
             return None
         k = self._rr % len(alive)
@@ -237,14 +368,26 @@ class ClusterRouter:
     def health_verdicts(self, now: float) -> List[tuple]:
         """Replicas that must be failed over NOW:
         ``[(replica, reason), ...]`` with reason ``"heartbeat_loss"``
-        (beat older than ``dead_after_s``) or ``"straggler"`` (step
-        time past ``straggle_ratio``× the median routable peer's,
-        with at least one healthy peer to drain onto)."""
+        (``dead_checks`` CONSECUTIVE stale observations — beat older
+        than ``dead_after_s`` at distinct check times; one slow
+        heartbeat write is jitter, K in a row is a verdict) or
+        ``"straggler"`` (step time past ``straggle_ratio``× the
+        median routable peer's, with at least one healthy peer to
+        drain onto)."""
         out = []
         routable = self._routable()
         for rep in routable:
             if now - rep.hb_ts > self.config.dead_after_s:
-                out.append((rep, "heartbeat_loss"))
+                if self._obs_ts.get(rep.id) != now:
+                    self._obs_ts[rep.id] = now
+                    self._stale_obs[rep.id] = (
+                        self._stale_obs.get(rep.id, 0) + 1)
+                if self._stale_obs.get(rep.id, 0) \
+                        >= self.config.dead_checks:
+                    out.append((rep, "heartbeat_loss"))
+                    self._stale_obs[rep.id] = 0
+            else:
+                self._stale_obs[rep.id] = 0
         verdicted = {r.id for r, _ in out}
         peers = [r for r in routable if r.id not in verdicted]
         if len(peers) > 1:
@@ -258,6 +401,97 @@ class ClusterRouter:
                         > self.config.straggle_ratio * median):
                     out.append((rep, "straggler"))
         return out
+
+    # -- recovery probation / re-admission -------------------------------
+
+    def _recovered(self, rep, now: float) -> bool:
+        """Does this drained replica LOOK healthy right now?  Fresh
+        heartbeat, and — for a quarantined straggler — a healed step
+        time relative to the current routable peers.  The step time
+        is the replica's recovery PROBE (`Replica.probe_step_s`):
+        a drained replica executes no scheduler steps, so its last
+        EXECUTED step stays straggled forever and could never pass
+        probation.  With zero routable peers the step check is
+        deliberately skipped — a slow replica beats a dead cluster.
+        """
+        if now - rep.hb_ts > self.config.dead_after_s:
+            return False
+        if rep.quarantined:
+            probe = getattr(rep, "probe_step_s",
+                            lambda: rep.last_step_s)()
+            peers = self._routable()
+            if peers:
+                steps = sorted(r.last_step_s for r in peers)
+                median = steps[(len(steps) - 1) // 2]
+                if (median > 0
+                        and probe
+                        > self.config.straggle_ratio * median):
+                    return False
+        return True
+
+    def readmit_pending(self, rep, now: float) -> bool:
+        """True when ``rep`` is drained but currently recovered — a
+        probation observation at a new check time would count (the
+        cluster's event loop uses this to keep virtual time moving
+        through a probation window).  Liveness is judged from the
+        heartbeat alone (`_recovered`) — the router never reads the
+        process's own alive flag, same as detection."""
+        return (self.config.readmit and not rep.routable
+                and self._recovered(rep, now))
+
+    def readmit_verdicts(self, now: float) -> List:
+        """Drained replicas that completed recovery probation:
+        ``probation_checks`` consecutive recovered observations at
+        distinct check times.  Any relapse resets the count — a
+        flapping replica keeps failing probation instead of
+        re-entering the rotation."""
+        if not self.config.readmit:
+            return []
+        out = []
+        for rep in self.replicas:
+            if rep.routable:
+                continue
+            if self._recovered(rep, now):
+                if self._obs_ts.get(rep.id) != now:
+                    self._obs_ts[rep.id] = now
+                    self._fresh_obs[rep.id] = (
+                        self._fresh_obs.get(rep.id, 0) + 1)
+                if self._fresh_obs.get(rep.id, 0) \
+                        >= self.config.probation_checks:
+                    out.append(rep)
+                    self._fresh_obs[rep.id] = 0
+            else:
+                self._fresh_obs[rep.id] = 0
+        return out
+
+    def note_readmit(self, rep, now: float) -> None:
+        """Record one executed re-admission (the cluster calls this
+        after resetting the replica's scheduler): verdict flags
+        cleared, artifact row, DecisionEvent, counter."""
+        was = rep.fail_reason
+        rep.dead = False
+        rep.quarantined = False
+        rep.fail_reason = None
+        self._stale_obs[rep.id] = 0
+        self.readmits.append({
+            "ts": round(now, 6), "replica": rep.name,
+            "was": was,
+            "probation_checks": self.config.probation_checks})
+        from triton_distributed_tpu.observability import feedback
+        from triton_distributed_tpu.observability.metrics import (
+            get_registry, observability_enabled)
+        if not observability_enabled():
+            return
+        get_registry().counter("cluster_replicas_readmitted_total",
+                               reason=str(was)).inc()
+        feedback.record_decision(feedback.DecisionEvent(
+            consumer="cluster.failover", op=rep.name,
+            choice="readmit",
+            candidates=[{"name": "readmit"}, {"name": "keep_drained"}],
+            inputs={"was": was,
+                    "hb_age_s": round(now - rep.hb_ts, 6),
+                    "probation_checks":
+                        self.config.probation_checks}))
 
     def note_failover(self, rep, reason: str, requeued: int,
                       now: float) -> None:
@@ -292,10 +526,16 @@ class ClusterRouter:
 
     def table(self, now: float) -> dict:
         """The `/routing` endpoint / `router-state.json` body."""
-        return {
+        out = {
             "schema": 1, "kind": "router",
             "ts": round(now, 6), "mode": self.config.mode,
             "replicas": [r.table_row(now) for r in self.replicas],
             "failovers": list(self.failovers),
             "affinity_prefixes": len(self._affinity),
         }
+        if self.readmits:
+            # Key absent when nothing was ever re-admitted, so
+            # pre-hysteresis artifacts (and the doctor goldens built
+            # on them) are byte-identical.
+            out["readmits"] = list(self.readmits)
+        return out

@@ -26,18 +26,39 @@ hierarchical collectives (`kernels/hierarchical.py` — the
 shards between sequence-parallel ranks); the virtual backend models
 that wire with a configurable bandwidth so virtual-clock benches
 charge shipping time proportional to real page bytes.
+
+The wire is LOSSY by assumption (the chaos harness
+`serving.cluster.chaos` makes it so deterministically), and the
+transport carries the per-shipment integrity state the cluster's
+delivery protocol is built on:
+
+- every ``ship`` assigns a **monotonic shipment id** (the claim
+  token) and records a CRC32 **checksum** of the wire bytes;
+- ``claim`` verifies the checksum and raises
+  :class:`ShipmentCorrupt` on mismatch (the receiver NACKs; the
+  sender retries with backoff — `ServingCluster._pump_ships`);
+- ``claim`` of an id that was already claimed (or dropped) returns
+  ``None`` — the **idempotent-delivery** primitive: a duplicated
+  wire copy deserializes nothing and admits nothing twice.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import io
+import zlib
 from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from triton_distributed_tpu.models.kv_cache import KVCache
+
+
+class ShipmentCorrupt(Exception):
+    """A claimed shipment failed its checksum: the payload was
+    corrupted on the wire.  The receiver treats this as a NACK — the
+    wire copy is discarded and the sender must retransmit."""
 
 
 @dataclasses.dataclass
@@ -131,16 +152,25 @@ class VirtualTransport:
         self.wire_gbps = wire_gbps
         self._next_token = 0
         self._in_flight: Dict[int, bytes] = {}
+        #: Claim-time integrity: shipment id -> CRC32 of the bytes as
+        #: they were SENT (a fault injector mutates ``_in_flight``
+        #: only, so a mismatch at claim means wire corruption).
+        self._crc: Dict[int, int] = {}
         self.shipped_bytes = 0
         self.shipments = 0
+        self.corrupt_claims = 0
+        self.duplicate_claims = 0
 
     def ship(self, shipment: KVShipment) -> tuple:
         """Serialize one shipment onto the wire.  Returns
-        ``(token, nbytes)``."""
+        ``(token, nbytes)`` — the token is a monotonic shipment id
+        (each retransmission of the same logical shipment gets a NEW
+        id; dedup happens at claim: a one-shot pop per id)."""
         data = shipment.to_bytes()
         token = self._next_token
         self._next_token += 1
         self._in_flight[token] = data
+        self._crc[token] = zlib.crc32(data)
         self.shipped_bytes += len(data)
         self.shipments += 1
         return token, len(data)
@@ -150,15 +180,43 @@ class VirtualTransport:
             return 0.0
         return nbytes / (self.wire_gbps * 1e9)
 
-    def claim(self, token: int) -> KVShipment:
+    def claim(self, token: int) -> Optional[KVShipment]:
         """Deserialize a delivered shipment (one-shot: the wire copy
-        is dropped)."""
-        return KVShipment.from_bytes(self._in_flight.pop(token))
+        is dropped).  Returns ``None`` when ``token`` was already
+        claimed or dropped — a DUPLICATE delivery, absorbed
+        idempotently.  Raises :class:`ShipmentCorrupt` when the bytes
+        fail their sent-time checksum (the caller NACKs)."""
+        data = self._in_flight.pop(token, None)
+        if data is None:
+            self.duplicate_claims += 1
+            return None
+        crc = self._crc.pop(token)
+        if zlib.crc32(data) != crc:
+            self.corrupt_claims += 1
+            raise ShipmentCorrupt(
+                f"shipment {token}: checksum mismatch "
+                f"({zlib.crc32(data):#010x} != {crc:#010x})")
+        return KVShipment.from_bytes(data)
 
     def drop(self, token: int) -> None:
         """Discard an in-flight shipment without deserializing it
-        (the destination died while it rode the wire)."""
+        (the destination died while it rode the wire, or a fault
+        schedule dropped the packet)."""
         self._in_flight.pop(token, None)
+        self._crc.pop(token, None)
+
+    def corrupt(self, token: int, byte_index: int = 0) -> bool:
+        """Flip one payload byte of an in-flight shipment (the fault
+        injector's corruption primitive — the sent-time CRC is kept,
+        so the claim detects it).  False = nothing in flight."""
+        data = self._in_flight.get(token)
+        if data is None:
+            return False
+        i = byte_index % len(data)
+        self._in_flight[token] = (data[:i]
+                                  + bytes([data[i] ^ 0xFF])
+                                  + data[i + 1:])
+        return True
 
     @property
     def pending(self) -> List[int]:

@@ -29,6 +29,22 @@ host-side structures cooperate over one donated `PagedKVCache`:
   needs it (`can_admit` / `insert_prefill` / `release` /
   `active_mask` / occupancy properties).
 
+- `SpillPool` — graceful degradation under KV pressure: when the
+  radix cache must evict a refcount-0 prefix page, its CONTENT is
+  first parked in host memory (device HBM is the scarce resource;
+  host DRAM is not).  The node stays in the tree marked spilled, so
+  a later prefix hit restores it — a fresh physical page is
+  allocated and the parked bytes written back, bit-exactly (numpy
+  round-trip of the stored dtypes) — instead of silently losing the
+  prefix.  This is what keeps *prefix-dependent admission* alive
+  under pressure: a prompt longer than every prefill bucket is only
+  servable through a cached prefix + suffix-only prefill, and
+  without spill one eviction turns it from servable into a load
+  shed.  Spill is opt-in (``spill_pages``/`SchedulerConfig.
+  spill_pages` > 0); with it off, eviction behaves exactly as
+  before.  Counters: ``serving_kv_spill_out_pages_total`` /
+  ``serving_kv_spill_in_pages_total``.
+
 Invariant that makes mid-stream allocation safe: a request was only
 admitted if its WORST-CASE total pages fit the usable pool, and
 everything not referenced by a live request is evictable — so after
@@ -40,7 +56,9 @@ Scheduler._preempt`).
 
 from __future__ import annotations
 
+import dataclasses
 import heapq
+import itertools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -101,9 +119,67 @@ class PagePool:
                 self._free.append(i)
 
 
+def _count_metric(name: str, n: int = 1) -> None:
+    from triton_distributed_tpu.observability.metrics import (
+        count_metric)
+    count_metric(name, n)
+
+
+_next_spill_key = itertools.count(1)
+
+
+class SpillPool:
+    """Host-memory parking lot for spilled KV pages.
+
+    ``put`` parks one page's content (a dict of numpy arrays, one
+    k/v [+scale] entry per layer) under a unique key; ``take``
+    retrieves-and-forgets it on restore.  Bounded in PAGES
+    (``max_pages``): a full pool refuses the spill and the caller
+    degrades to plain eviction — best-effort preservation, never
+    unbounded host growth.
+    """
+
+    def __init__(self, max_pages: int):
+        assert max_pages >= 1, max_pages
+        self.max_pages = int(max_pages)
+        self._store: Dict[int, dict] = {}
+        self.spilled_out = 0
+        self.spilled_in = 0
+        self.rejected = 0
+
+    @property
+    def pages(self) -> int:
+        return len(self._store)
+
+    @property
+    def bytes(self) -> int:
+        return sum(a.nbytes for p in self._store.values()
+                   for a in p.values())
+
+    def put(self, key: int, payload: dict) -> bool:
+        """Park one page; False = pool full (caller evicts plainly)."""
+        if len(self._store) >= self.max_pages:
+            self.rejected += 1
+            return False
+        self._store[key] = payload
+        self.spilled_out += 1
+        _count_metric("serving_kv_spill_out_pages_total")
+        return True
+
+    def take(self, key: int) -> Optional[dict]:
+        payload = self._store.pop(key, None)
+        if payload is not None:
+            self.spilled_in += 1
+            _count_metric("serving_kv_spill_in_pages_total")
+        return payload
+
+    def drop(self, key: int) -> None:
+        self._store.pop(key, None)
+
+
 class _RadixNode:
     __slots__ = ("children", "parent", "chunk", "page", "refs",
-                 "last_use")
+                 "last_use", "spill_key")
 
     def __init__(self, parent, chunk: Tuple[int, ...], page: int):
         self.children: Dict[Tuple[int, ...], "_RadixNode"] = {}
@@ -114,6 +190,13 @@ class _RadixNode:
         #: retention is NOT counted here — refs 0 means evictable).
         self.refs = 0
         self.last_use = 0
+        #: SpillPool key when this node's page content is parked in
+        #: host memory (``page`` is then NULL_PAGE); None = physical.
+        self.spill_key: Optional[int] = None
+
+    @property
+    def spilled(self) -> bool:
+        return self.spill_key is not None
 
 
 class RadixCache:
@@ -122,18 +205,26 @@ class RadixCache:
     tree holds one pool reference per cached page; live requests add
     theirs via `acquire`.  `evict` frees LRU refcount-0 leaves."""
 
-    def __init__(self, pool: PagePool, page_size: int):
+    def __init__(self, pool: PagePool, page_size: int,
+                 spill: Optional[SpillPool] = None,
+                 read_page=None):
         self.pool = pool
         self.page_size = page_size
         self._root = _RadixNode(None, (), NULL_PAGE)
         self._clock = 0
-        self.cached_pages = 0          # total pages the tree retains
+        self.cached_pages = 0   # PHYSICAL pages the tree retains
         #: Pages at refcount 0 (evictable) — maintained incrementally
         #: so the admission path never walks the tree.
         self._idle_pages = 0
         self.hit_tokens = 0
         self.miss_tokens = 0
         self.evicted_pages = 0
+        #: Spill-before-evict (optional): the host pool and the
+        #: ``read_page(page) -> payload`` content reader (the owning
+        #: `PagedKV` wires both when spill is enabled).
+        self.spill = spill
+        self.read_page = read_page
+        self.spilled_nodes = 0
 
     def _tick(self) -> int:
         self._clock += 1
@@ -157,23 +248,41 @@ class RadixCache:
         return path
 
     def acquire(self, path: Sequence[_RadixNode]) -> None:
+        """Pin ``path`` for one request.  Spilled nodes are pinned
+        too (their refs keep them from being pruned) but hold no
+        pool reference until the caller restores them
+        (`PagedKV.insert_prefill`'s restore pass adds both the
+        tree's and the request's pool refs)."""
         t = self._tick()
         for n in path:
-            if n.refs == 0:
+            if n.refs == 0 and not n.spilled:
                 self._idle_pages -= 1
             n.refs += 1
             n.last_use = t
-            self.pool.incref([n.page])
+            if not n.spilled:
+                self.pool.incref([n.page])
 
     def release(self, path: Sequence[_RadixNode]) -> None:
         t = self._tick()
         for n in path:
+            assert not n.spilled, "released node was never restored"
             n.refs -= 1
             assert n.refs >= 0
             if n.refs == 0:
                 self._idle_pages += 1
             n.last_use = t
             self.pool.decref([n.page])
+
+    def restore(self, node: _RadixNode, page: int) -> None:
+        """Re-materialize a spilled node onto freshly allocated
+        physical ``page`` (the caller has already written the parked
+        content back and holds the allocation's refcount-1, which
+        becomes the TREE's retention ref)."""
+        assert node.spilled and node.page == NULL_PAGE
+        node.spill_key = None
+        node.page = int(page)
+        self.cached_pages += 1
+        self.spilled_nodes -= 1
 
     def extend(self, parent_path: Sequence[_RadixNode],
                tokens: Sequence[int], first_page: int,
@@ -211,16 +320,46 @@ class RadixCache:
         admission check off the tree."""
         return self._idle_pages
 
+    def _frontier_leaf(self, node: _RadixNode) -> bool:
+        """May ``node``'s physical page be freed right now?  Unheld,
+        physical, and every child already spilled (spill keeps the
+        node in the tree, so "leaf" means no *physical* subtree; with
+        spill disabled no node is ever spilled and this is exactly
+        the old childless test)."""
+        return (node.refs == 0 and not node.spilled
+                and all(c.spilled for c in node.children.values()))
+
+    def _prune(self, node: _RadixNode) -> None:
+        """Remove a spilled-or-evicted node AND its (necessarily
+        spilled) subtree from the tree, dropping parked content."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            n.children.clear()
+            if n.spilled:
+                if self.spill is not None:
+                    self.spill.drop(n.spill_key)
+                n.spill_key = None
+                self.spilled_nodes -= 1
+        del node.parent.children[node.chunk]
+
     def evict(self, need: int) -> int:
         """Free up to ``need`` pages, LRU leaves first.  Returns how
         many were freed.  One tree walk collects the evictable-leaf
-        frontier; freeing a leaf promotes its parent into the frontier
-        when it becomes an evictable leaf itself."""
+        frontier; freeing a leaf promotes its parent into the
+        frontier when it becomes an evictable leaf itself.
+
+        With a `SpillPool` wired, each victim's content is parked in
+        host memory first and the node stays in the tree (spilled, a
+        later prefix hit restores it); a full spill pool degrades to
+        plain eviction — the page is freed either way, which is what
+        the caller needs."""
         frontier = []                      # (last_use, id, node)
         stack = list(self._root.children.values())
         while stack:
             node = stack.pop()
-            if node.refs == 0 and not node.children:
+            if self._frontier_leaf(node):
                 heapq.heappush(frontier,
                                (node.last_use, id(node), node))
             stack.extend(node.children.values())
@@ -228,14 +367,31 @@ class RadixCache:
         while freed < need and frontier:
             _, _, victim = heapq.heappop(frontier)
             parent = victim.parent
-            del parent.children[victim.chunk]
+            spilled = False
+            if self.spill is not None and self.read_page is not None:
+                # Capacity check BEFORE the device->host page copy:
+                # a full pool (its steady state under sustained
+                # pressure) must not pay a discarded read per victim.
+                if self.spill.pages < self.spill.max_pages:
+                    key = next(_next_spill_key)
+                    spilled = self.spill.put(
+                        key, self.read_page(victim.page))
+                    if spilled:
+                        victim.spill_key = key
+                        self.spilled_nodes += 1
+                else:
+                    self.spill.rejected += 1
             self.pool.decref([victim.page])
+            if spilled:
+                victim.page = NULL_PAGE
+            else:
+                self._prune(victim)
+                self.evicted_pages += 1
             self.cached_pages -= 1
             self._idle_pages -= 1
-            self.evicted_pages += 1
             freed += 1
-            if (parent is not self._root and parent.refs == 0
-                    and not parent.children):
+            if (parent is not self._root
+                    and self._frontier_leaf(parent)):
                 heapq.heappush(frontier,
                                (parent.last_use, id(parent), parent))
         return freed
@@ -257,6 +413,7 @@ class PagedKV:
                  num_pages: Optional[int] = None,
                  kv_budget_bytes: Optional[int] = None,
                  prefix_cache: bool = True,
+                 spill_pages: int = 0,
                  insert_fn=None):
         self.page_size = ps = int(page_size)
         self.max_seq = int(max_seq)
@@ -284,6 +441,14 @@ class PagedKV:
         self.pool = PagePool(1 + self.usable_pages)
         self.radix = (RadixCache(self.pool, ps) if prefix_cache
                       else None)
+        #: Host-memory spill (opt-in, ``spill_pages`` > 0): evicted
+        #: refcount-0 prefix pages park their content here and
+        #: restore bit-exactly on the next prefix hit.
+        self.spill: Optional[SpillPool] = None
+        if spill_pages and self.radix is not None:
+            self.spill = SpillPool(spill_pages)
+            self.radix.spill = self.spill
+            self.radix.read_page = self._read_page
         self._free: List[int] = list(range(self.num_slots))
         self._active = np.zeros(self.num_slots, bool)
         #: Host mirror of the device page table — single source of
@@ -362,16 +527,21 @@ class PagedKV:
         evictable: `insert_prefill` acquires the chain before
         allocating, which pins exactly those pages — counting them
         both as "shared, not needed" and "evictable headroom" would
-        admit a request the allocator then cannot serve."""
+        admit a request the allocator then cannot serve.  Spilled
+        chain nodes count as DEMAND, not supply: their restore
+        allocates a fresh physical page each."""
         if not self._free:
             return False
         if tokens is None:
             return self._reclaimable() >= 1
         path = self.match_prefix(tokens)
-        need = pages_for(len(tokens), self.page_size) - len(path)
+        spilled = sum(1 for n in path if n.spilled)
+        need = (pages_for(len(tokens), self.page_size) - len(path)
+                + spilled)
         reclaim = self.pool.free_pages
         if self.radix is not None:
-            on_path_idle = sum(1 for n in path if n.refs == 0)
+            on_path_idle = sum(1 for n in path
+                               if n.refs == 0 and not n.spilled)
             reclaim += self.radix.evictable_pages() - on_path_idle
         return reclaim >= need
 
@@ -452,6 +622,22 @@ class PagedKV:
         # among them.
         if shared_path and self.radix is not None:
             self.radix.acquire(shared_path)
+            # Restore any spilled chain node: a fresh physical page
+            # (the allocation ref becomes the tree's retention ref),
+            # the parked content written back bit-exactly, plus this
+            # request's own pool ref (acquire skipped it while the
+            # node was spilled).  can_admit budgeted these pages.
+            for node in shared_path:
+                if not node.spilled:
+                    continue
+                ids = self._alloc(1)
+                assert ids is not None, \
+                    "insert_prefill without can_admit()"
+                payload = self.spill.take(node.spill_key)
+                assert payload is not None, node.spill_key
+                self._write_page(ids[0], payload)
+                self.radix.restore(node, ids[0])
+                self.pool.incref([ids[0]])
         priv = self._alloc(total_pages - c_pages)
         assert priv is not None, "insert_prefill without can_admit()"
         slot = self._free.pop(0)
@@ -514,6 +700,41 @@ class PagedKV:
         self.cache = self.cache.reset_slot(slot)
         self._active[slot] = False
         self._free.append(slot)
+
+    # -- spill content I/O (admission path, not the decode hot path) ----
+
+    def _read_page(self, page: int) -> dict:
+        """One physical page's content across all layers, as host
+        numpy (the SpillPool payload).  Numpy round-trip of the
+        stored dtypes (float32 / int8 + float32 scales) is exact, so
+        restore-on-hit is bit-exact."""
+        c = self.cache
+        out: Dict[str, np.ndarray] = {}
+        for layer in range(len(c.ks)):
+            out[f"k{layer}"] = np.asarray(c.ks[layer][page])
+            out[f"v{layer}"] = np.asarray(c.vs[layer][page])
+            if c.quantized:
+                out[f"ks{layer}"] = np.asarray(c.kss[layer][page])
+                out[f"vs{layer}"] = np.asarray(c.vss[layer][page])
+        return out
+
+    def _write_page(self, page: int, payload: dict) -> None:
+        """Write parked content back into physical ``page`` (restore;
+        functional `.at[].set` updates, rebound like the insert)."""
+        c = self.cache
+        ks = [k.at[page].set(jnp.asarray(payload[f"k{i}"]))
+              for i, k in enumerate(c.ks)]
+        vs = [v.at[page].set(jnp.asarray(payload[f"v{i}"]))
+              for i, v in enumerate(c.vs)]
+        rep = dict(ks=ks, vs=vs)
+        if c.quantized:
+            rep["kss"] = [x.at[page].set(
+                jnp.asarray(payload[f"ks{i}"]))
+                for i, x in enumerate(c.kss)]
+            rep["vss"] = [x.at[page].set(
+                jnp.asarray(payload[f"vs{i}"]))
+                for i, x in enumerate(c.vss)]
+        self.cache = dataclasses.replace(c, **rep)
 
     def active_mask(self) -> jnp.ndarray:
         return jnp.asarray(self._active)

@@ -39,6 +39,12 @@ class RejectReason(enum.Enum):
     PROMPT_TOO_LONG = "prompt_too_long"      # exceeds largest bucket
     EXCEEDS_KV_CAPACITY = "exceeds_kv_capacity"  # prompt+gen > max_seq
     STOPPED = "stopped"          # submitted after scheduler.stop()
+    #: Load shed under KV pressure: the request was only admittable
+    #: through a cached prompt prefix (suffix-only prefill), and that
+    #: prefix was evicted — not just spilled — before admission.  The
+    #: truthful degradation reason: with a `SpillPool` the prefix
+    #: would have been restored and the request served.
+    KV_PRESSURE = "kv_pressure_shed"
 
 
 @dataclasses.dataclass

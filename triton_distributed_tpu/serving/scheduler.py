@@ -94,6 +94,14 @@ class SchedulerConfig:
     #: refcounted pages; full prompt pages are cached after use and
     #: evicted LRU under pressure (paged mode).
     prefix_cache: bool = True
+    #: Host-memory spill capacity in pages (paged mode; 0 disables).
+    #: Under KV pressure, refcount-0 prefix pages park their content
+    #: in a `serving.pages.SpillPool` instead of being destroyed, and
+    #: restore bit-exactly on the next prefix hit — which keeps
+    #: prefix-dependent admission (prompts longer than every prefill
+    #: bucket, servable only via suffix prefill) alive through
+    #: pressure instead of shedding it.
+    spill_pages: int = 0
     pad_id: int = 0
     temperature: float = 0.0
     top_k: int = 0
@@ -164,7 +172,8 @@ class ContinuousBatchingScheduler:
                 model, cfg.num_slots, max_seq=self.max_seq,
                 page_size=cfg.page_size, num_pages=cfg.num_pages,
                 kv_budget_bytes=cfg.kv_budget_bytes,
-                prefix_cache=cfg.prefix_cache)
+                prefix_cache=cfg.prefix_cache,
+                spill_pages=cfg.spill_pages)
             decode_fn = model.make_paged_decode_fn(
                 page_size=cfg.page_size)
             sfn = getattr(model, "make_prefill_suffix_fn", None)
@@ -207,15 +216,36 @@ class ContinuousBatchingScheduler:
 
     # -- submission / backpressure --------------------------------------
 
-    def structural_reject(self, req: Request) -> Optional[RejectReason]:
+    def structural_reject(self, req: Request,
+                          full_prefill: bool = False
+                          ) -> Optional[RejectReason]:
         """The admission checks that depend only on request geometry
         vs this engine's static configuration — never on queue state.
         A hit is final: the request can never run here (and, replicas
         being homogeneous, nowhere else in a cluster — which is why
         the cluster's prefill-worker dispatch pre-validates with this
-        instead of finding out via an assert inside the worker)."""
+        instead of finding out via an assert inside the worker).
+
+        One check is geometry-vs-CACHE, not geometry-vs-config: a
+        prompt longer than every prefill bucket is still servable
+        when a cached radix prefix leaves a bucketable suffix
+        (prefix-dependent admission — the storage AND compute halves
+        of prefix sharing).  ``full_prefill=True`` disables that
+        allowance (the cluster's prefill-worker path computes the
+        whole prompt on a worker, which needs a full-prompt bucket).
+        If the prefix is evicted between this check and admission,
+        the admission path sheds the request with the truthful
+        ``KV_PRESSURE`` reason (`SchedulerConfig.spill_pages` keeps
+        the prefix restorable instead)."""
         if pick_bucket(req.prompt_len, self.buckets) is None:
-            return RejectReason.PROMPT_TOO_LONG
+            if (full_prefill or not self.paged
+                    or self._prefill_suffix is None):
+                return RejectReason.PROMPT_TOO_LONG
+            shared = self.slots.match_prefix(req.prompt)
+            c = len(shared) * self.config.page_size
+            if (c == 0 or pick_bucket(req.prompt_len - c,
+                                      self.buckets) is None):
+                return RejectReason.PROMPT_TOO_LONG
         if req.prompt_len + req.max_new_tokens > self.max_seq + 1:
             # offset after the last generated token may reach max_seq:
             # position max_seq-1 is the last writable KV row, and the
@@ -331,6 +361,16 @@ class ContinuousBatchingScheduler:
                 reg.counter("serving_requests_rejected_total",
                             reason=RejectReason.STOPPED.value).inc()
         self._update_gauges()
+
+    def restart(self) -> None:
+        """Re-open a stopped scheduler.  The cluster uses this on
+        re-admission after a false-positive drain (the replica never
+        died — its heartbeat flapped): `stop()` already cleared the
+        queue and slots deterministically; restarting just accepts
+        new submissions again."""
+        assert not self._by_slot and not self._queue, (
+            "restart() before stop() drained the engine")
+        self._stopped = False
 
     # -- internals ------------------------------------------------------
 
@@ -559,10 +599,30 @@ class ContinuousBatchingScheduler:
         if row is None:
             bucket = pick_bucket(s, self.buckets)
             if bucket is None:
-                # Only reachable on resume (submit() checked the
-                # original prompt): prompt + generated outgrew every
-                # bucket — deliver what it has.  (The matched chain
-                # was never acquired — nothing to undo.)
+                # No full-prompt bucket.  (The matched chain was
+                # never acquired — nothing to undo.)  Two ways here:
+                if (req.resume_tokens is None
+                        and req.resume_key is None
+                        and not req.generated):
+                    # A fresh request admitted on the strength of a
+                    # cached prefix (prefix-dependent admission,
+                    # `structural_reject`) whose prefix was EVICTED
+                    # under pressure before it reached a slot: shed
+                    # it with the truthful reason.  With spill
+                    # enabled the prefix would have been restored —
+                    # this branch is the no-spill degradation.
+                    req.state = RequestState.REJECTED
+                    req.reject_reason = RejectReason.KV_PRESSURE
+                    req.t_finish = now
+                    if reg:
+                        reg.counter(
+                            "serving_requests_rejected_total",
+                            reason=RejectReason.KV_PRESSURE.value
+                        ).inc()
+                    self.finished.append(req)
+                    return None
+                # Resume: prompt + generated outgrew every bucket —
+                # deliver what it has.
                 req.state = RequestState.FINISHED
                 req.finish_reason = FinishReason.KV_CAPACITY
                 req.t_finish = now
@@ -679,6 +739,10 @@ class ContinuousBatchingScheduler:
         if reg:
             step_ms = (time.perf_counter() - t0) * 1e3 / k
             reg.histogram("serving_decode_step_ms").observe(step_ms)
+            # Last measured step as a gauge: rides the heartbeat
+            # files, where it is the `step_us` a PEER router scores
+            # placement from (`cluster.router.heartbeat_signals`).
+            reg.gauge("serving_decode_step_us").set(step_ms * 1e3)
             # Rolling-baseline anomaly check on the serving hot path:
             # a decode step that goes multi-sigma slow (a contended
             # ICI link, a straggling rank) is counted AND dropped into
