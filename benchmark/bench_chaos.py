@@ -75,7 +75,10 @@ def build_trace():
 
 def run_cluster(model, params, trace, injector=None):
     from triton_distributed_tpu.observability import get_registry
+    from triton_distributed_tpu.observability.lineage import (
+        get_lineage_recorder)
     get_registry().clear()
+    get_lineage_recorder().clear()
     cfg = ClusterConfig(
         n_replicas=2, n_prefill_workers=1,
         scheduler=SchedulerConfig(num_slots=SLOTS,
@@ -97,10 +100,15 @@ def run_cluster(model, params, trace, injector=None):
         return int(sum(v for k, v in counters.items()
                        if k == name or k.startswith(name + "{")))
 
+    from benchmark.bench_router import hop_breakdowns
+    hops = hop_breakdowns(done)
+    assert hops["hop_sum_exact"], (
+        "TTFT hop decomposition drifted from the measured TTFT")
     return {
         "ms": round(makespan * 1e3, 6),
         "streams": [r.tokens for r in
                     sorted(done, key=lambda r: r.record_id)],
+        **hops,
         "retries": total("cluster_ship_retries_total"),
         "reroutes": total("cluster_ship_reroutes_total"),
         "duplicates": total("cluster_shipments_duplicate_total"),

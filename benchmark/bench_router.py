@@ -91,8 +91,38 @@ def build_trace(homogeneous: bool = False):
     return trace
 
 
+def hop_breakdowns(done):
+    """Per-hop p50/p99 TTFT decomposition over the finished records
+    (`observability.lineage.ttft_breakdown`), plus the hop-sum ≡ TTFT
+    exactness flag the regression gate enforces on every row."""
+    from triton_distributed_tpu.observability.audit import percentile
+    from triton_distributed_tpu.observability.lineage import (
+        get_lineage_recorder, ttft_breakdown)
+    rec = get_lineage_recorder()
+    per_hop = {}
+    exact = True
+    for r in done:
+        bd = ttft_breakdown(rec.events_for(r.record_id),
+                            arrival=r.arrival_time,
+                            measured_ttft=r.ttft)
+        exact = exact and bd is not None and bd["exact"]
+        if bd is not None:
+            for hop, ms in bd["by_hop_ms"].items():
+                per_hop.setdefault(hop, []).append(ms)
+    return {
+        "hop_p50_ms": {h: round(percentile(v, 50), 6)
+                       for h, v in sorted(per_hop.items())},
+        "hop_p99_ms": {h: round(percentile(v, 99), 6)
+                       for h, v in sorted(per_hop.items())},
+        "hop_sum_exact": exact,
+    }
+
+
 def run_cluster(model, params, trace, n_replicas, mode,
                 workers=0, straggle=None, link_busy=None):
+    from triton_distributed_tpu.observability.lineage import (
+        get_lineage_recorder)
+    get_lineage_recorder().clear()
     cfg = ClusterConfig(
         n_replicas=n_replicas, n_prefill_workers=workers,
         scheduler=SchedulerConfig(num_slots=SLOTS,
@@ -120,6 +150,9 @@ def run_cluster(model, params, trace, n_replicas, mode,
     makespan = (max(r.t_finish for r in done)
                 - min(r.arrival_time for r in done))
     ttfts = sorted(r.ttft for r in done)
+    hops = hop_breakdowns(done)
+    assert hops["hop_sum_exact"], (
+        "TTFT hop decomposition drifted from the measured TTFT")
     return {
         "ms": round(makespan * 1e3, 6),
         "mean_ttft_ms": round(1e3 * sum(ttfts) / len(ttfts), 6),
@@ -133,6 +166,7 @@ def run_cluster(model, params, trace, n_replicas, mode,
         "kv_shipped_bytes": cluster.transport.shipped_bytes,
         "shipments": cluster.transport.shipments,
         "failovers": len(cluster.router.failovers),
+        **hops,
     }
 
 

@@ -74,6 +74,14 @@ MEASUREMENT_FIELDS = {
     "failovers", "speedup_makespan", "speedup_ttft",
     "signal_aware_beats_rr", "matches_round_robin",
     "signal_aware_never_worse",
+    # Request-lineage TTFT decomposition (bench_router / bench_chaos
+    # rows; gated for hop-sum ≡ TTFT consistency by lineage_checks).
+    "hop_p50_ms", "hop_p99_ms", "hop_sum_exact",
+    # Chaos bench rows (bench_chaos.py): absorption counters + the
+    # overhead summary are run outputs.
+    "retries", "reroutes", "duplicates", "corrupt_nacks",
+    "readmits", "faults_injected", "overhead_vs_clean", "exact",
+    "faults_absorbed", "worst_overhead_vs_clean", "all_exact",
 }
 #: Fields that may hold the latency to compare, in preference order.
 LATENCY_FIELDS = ("us", "ms", "ms_per_step")
@@ -234,6 +242,32 @@ def router_checks(fresh) -> tuple:
     return checked, fails
 
 
+def lineage_checks(fresh) -> tuple:
+    """Gate specific to the request-lineage instrumentation
+    (`observability.lineage`): every fresh row that carries a TTFT
+    hop decomposition must report ``hop_sum_exact`` — the per-hop
+    intervals sum EXACTLY to the measured TTFT on the virtual clock.
+    This holds by construction (exact rational arithmetic over the
+    recorded hop timestamps), so a failure means a lineage seam was
+    skipped or double-recorded, not noise.
+
+    Returns ``(n_checked, failures)``."""
+    fails = []
+    checked = 0
+    for rec in fresh:
+        if "hop_sum_exact" not in rec:
+            continue
+        checked += 1
+        if rec.get("hop_sum_exact") is not True:
+            fails.append(
+                f"lineage regression: {rec.get('bench')} "
+                f"workload={rec.get('workload')} "
+                f"mode={rec.get('mode')} reports a TTFT hop "
+                f"decomposition that does NOT sum to the measured "
+                f"TTFT")
+    return checked, fails
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--fresh", required=True,
@@ -325,11 +359,13 @@ def main() -> int:
 
     cl_checked, cl_fails = closed_loop_checks(fresh, base)
     rt_checked, rt_fails = router_checks(fresh)
+    ln_checked, ln_fails = lineage_checks(fresh)
 
     # Markdown summary: CI logs and PR comments read the same thing.
     print("## Bench regression check")
     print()
-    verdict = ("FAIL" if regressions or cl_fails or rt_fails else
+    verdict = ("FAIL" if regressions or cl_fails or rt_fails
+               or ln_fails else
                "OK (with anomalies)" if anomalies else "OK")
     print(f"**{verdict}** — {compared} row(s) compared, "
           f"{regressions} regression(s) beyond "
@@ -361,9 +397,17 @@ def main() -> int:
               f"parity), {len(rt_fails)} failure(s).")
         for f in rt_fails:
             print(f"- {f}")
-    if compared == 0 and cl_checked == 0 and rt_checked == 0:
+    if ln_checked:
+        print()
+        print(f"Lineage gate: {ln_checked} row(s) checked (per-hop "
+              f"TTFT decomposition sums exactly to measured TTFT), "
+              f"{len(ln_fails)} failure(s).")
+        for f in ln_fails:
+            print(f"- {f}")
+    if (compared == 0 and cl_checked == 0 and rt_checked == 0
+            and ln_checked == 0):
         return 2
-    return 1 if regressions or cl_fails or rt_fails else 0
+    return 1 if regressions or cl_fails or rt_fails or ln_fails else 0
 
 
 if __name__ == "__main__":

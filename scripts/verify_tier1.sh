@@ -30,7 +30,11 @@
 #    round-robin under seeded imbalance, matches it balanced);
 #  - a chaos smoke (seeded lossy-wire fault schedule on the virtual
 #    clock -> token-for-token exact survivors -> schema-valid
-#    faults.jsonl -> doctor "Chaos" section names the fault classes).
+#    faults.jsonl -> doctor "Chaos" section names the fault classes);
+#  - a lineage smoke (2-replica virtual cluster -> schema-valid
+#    lineage.jsonl -> TTFT hop decomposition sums EXACTLY to the
+#    measured TTFT for every request -> doctor "Request lineage"
+#    section names the dominant hop).
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -149,7 +153,7 @@ fi
 # so any diff is a real behavior change in links/anomaly/doctor.
 doctor_rc=0
 for scenario in stalled_rank sem_leak slow_link clean \
-        lossy_transport; do
+        lossy_transport slow_request; do
     if ! JAX_PLATFORMS=cpu python -m \
             triton_distributed_tpu.observability.doctor \
             "tests/data/incidents/$scenario" -q \
@@ -510,6 +514,70 @@ chaos_rc=$?
 echo "$chaos_log" | tail -3
 if [ "$chaos_rc" -ne 0 ]; then
     echo "CHAOS_SMOKE=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
+# Lineage smoke: request lineage end-to-end on the virtual clock — a
+# 2-replica + 1-prefill cluster run must write a schema-valid
+# lineage.jsonl, every request's TTFT hop decomposition must sum
+# EXACTLY to its measured TTFT (the asserted invariant), and the
+# doctor must render a "Request lineage" section naming a dominant
+# hop from the artifact alone.
+lineage_log=$(JAX_PLATFORMS=cpu python - <<'EOF' 2>&1
+import tempfile
+import jax
+from triton_distributed_tpu.observability.doctor import (
+    diagnose, render_markdown)
+from triton_distributed_tpu.observability.lineage import (
+    get_lineage_recorder, load_lineage, ttft_breakdown,
+    validate_lineage)
+from triton_distributed_tpu.serving import (
+    ClusterConfig, SchedulerConfig, ServingCluster, ToyConfig,
+    ToyModel)
+
+model = ToyModel(ToyConfig(vocab_size=61, hidden=16, max_seq_len=64))
+params = model.init_params(jax.random.key(0))
+get_lineage_recorder().clear()
+cluster = ServingCluster(model, params, ClusterConfig(
+    n_replicas=2, n_prefill_workers=1,
+    scheduler=SchedulerConfig(num_slots=3,
+                              prefill_buckets=(8, 16, 32))))
+recs = [cluster.submit([1 + i, 2, 3, 4], 3 + (i % 3), seed=i,
+                       arrival_time=0.001 * i) for i in range(8)]
+done = cluster.drain()
+assert len(done) == 8, [r.state for r in recs]
+
+# Exact hop-sum on every request, against the cluster's own TTFT.
+rec = get_lineage_recorder()
+for r in done:
+    bd = ttft_breakdown(rec.events_for(r.record_id),
+                        arrival=r.arrival_time, measured_ttft=r.ttft)
+    assert bd is not None and bd["exact"], (r.record_id, bd)
+
+# Schema-valid artifact...
+d = tempfile.mkdtemp(prefix="tdt-lineage-")
+cluster.write_artifact(d)
+rows = load_lineage(f"{d}/lineage.jsonl")
+assert rows, "lineage.jsonl empty"
+for row in rows:
+    problems = validate_lineage(row)
+    assert not problems, (problems, row)
+
+# ...the doctor replays into a Request-lineage section + verdict.
+report = diagnose([d])
+lineage = report.get("lineage")
+assert lineage and lineage["exact"], lineage
+assert lineage["completed"] == 8, lineage
+assert lineage["slowest"][0]["dominant_hop"], lineage
+assert "## Request lineage" in render_markdown(report)
+assert "hop '" in report["verdict"], report["verdict"]
+print("LINEAGE_SMOKE=ok")
+EOF
+)
+lineage_rc=$?
+echo "$lineage_log" | tail -3
+if [ "$lineage_rc" -ne 0 ]; then
+    echo "LINEAGE_SMOKE=FAILED"
     [ "$rc" -eq 0 ] && rc=1
 fi
 

@@ -48,7 +48,7 @@ WORLD = 4
 AXIS = "tp"
 
 SCENARIOS = ("stalled_rank", "sem_leak", "slow_link", "clean",
-             "lossy_transport")
+             "lossy_transport", "slow_request")
 
 
 def _write(scenario: str, name: str, payload, truncate_at=None):
@@ -343,6 +343,99 @@ def gen_lossy_transport():
     })
 
 
+def gen_slow_request():
+    """One request's TTFT blown by the wire: the chaos schedule
+    dropped its KV shipment twice, so its lineage shows two
+    retransmissions with exponential backoff before delivery — the
+    doctor's "Request lineage" section must decompose the 20 ms TTFT
+    into hop intervals that sum EXACTLY, name ``ship_retry`` as the
+    dominant hop, and cross-reference the retries to the injected
+    ``drop`` faults by shipment id.  Two fast same-shape requests
+    ride along so slow reads as slow, not as baseline.  Timestamps
+    are VIRTUAL seconds (a virtual-clock cluster run's artifacts:
+    lineage.jsonl + faults.jsonl, no heartbeats/traces)."""
+    s = "slow_request"
+
+    def hop(rid, name, ts, actor, **detail):
+        return {"request_id": rid, "hop": name, "ts": ts,
+                "actor": actor, "detail": detail, "rank": 0,
+                "schema": 1, "kind": "lineage"}
+
+    rows = []
+    # Two healthy requests: worker prefill + one clean wire crossing.
+    for rid, t in ((3, 0.001), (4, 0.0015)):
+        tok = rid - 3
+        rows += [
+            hop(rid, "submit", t, "cluster", prompt_len=6, max_new=8),
+            hop(rid, "route_stage", t, "router", replica="replica-0",
+                path="worker", worker="prefill-0"),
+            hop(rid, "prefill_start", t + 0.0002, "prefill-0",
+                bucket=8, prompt_len=6),
+            hop(rid, "prefill_end", t + 0.0022, "prefill-0",
+                bucket=8, nbytes=9472),
+            hop(rid, "ship", t + 0.0022, "transport", token=tok,
+                nbytes=9472, wire_ms=0.003),
+            hop(rid, "ship_deliver", t + 0.0025, "transport",
+                token=tok, replica="replica-0"),
+            hop(rid, "enqueue", t + 0.0025, "replica-0",
+                prompt_len=6, queued=1),
+            hop(rid, "route_commit", t + 0.0025, "router",
+                replica="replica-0", fallback=None),
+            hop(rid, "admit", t + 0.0025, "replica-0", slot=0,
+                bucket=8, mode="shipped"),
+            hop(rid, "first_token", t + 0.003, "replica-0", slot=0),
+            hop(rid, "retire", t + 0.011, "replica-0", reason="eos",
+                generated=8),
+        ]
+    # The victim: shipment 2 dropped, its retransmission (token 5)
+    # dropped again, the second retransmission (token 6) delivered —
+    # 11.2 of its 20 ms TTFT sit in ship_retry backoff + re-crossing.
+    rows += [
+        hop(7, "submit", 0.0, "cluster", prompt_len=6, max_new=8),
+        hop(7, "route_stage", 0.0004, "router", replica="replica-1",
+            path="worker", worker="prefill-0"),
+        hop(7, "prefill_start", 0.0008, "prefill-0", bucket=8,
+            prompt_len=6),
+        hop(7, "prefill_end", 0.0028, "prefill-0", bucket=8,
+            nbytes=9472),
+        hop(7, "ship", 0.0028, "transport", token=2, nbytes=9472,
+            wire_ms=0.003),
+        hop(7, "ship_retry", 0.0078, "transport", token=5,
+            nbytes=9472, attempt=1, trigger="timeout",
+            backoff_ms=2.0, wire_ms=0.003),
+        hop(7, "ship_retry", 0.0148, "transport", token=6,
+            nbytes=9472, attempt=2, trigger="timeout",
+            backoff_ms=4.0, wire_ms=0.003),
+        hop(7, "ship_deliver", 0.019, "transport", token=6,
+            replica="replica-1"),
+        hop(7, "enqueue", 0.019, "replica-1", prompt_len=6,
+            queued=1),
+        hop(7, "route_commit", 0.019, "router", replica="replica-1",
+            fallback=None),
+        hop(7, "admit", 0.019, "replica-1", slot=0, bucket=8,
+            mode="shipped"),
+        hop(7, "first_token", 0.02, "replica-1", slot=0),
+        hop(7, "retire", 0.024, "replica-1", reason="eos",
+            generated=8),
+    ]
+    faults = [
+        {"schema": 1, "kind": "fault", "ts": 0.0058, "fault": "drop",
+         "target": "shipment:2", "inputs": {"nbytes": 9472},
+         "seed": 42},
+        {"schema": 1, "kind": "fault", "ts": 0.0108, "fault": "drop",
+         "target": "shipment:5", "inputs": {"nbytes": 9472},
+         "seed": 42},
+    ]
+    d = os.path.join(HERE, s)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "lineage.jsonl"), "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    with open(os.path.join(d, "faults.jsonl"), "w") as f:
+        for row in faults:
+            f.write(json.dumps(row) + "\n")
+
+
 def generate(clean_first: bool = True):
     for scenario in SCENARIOS:
         d = os.path.join(HERE, scenario)
@@ -355,6 +448,7 @@ def generate(clean_first: bool = True):
     gen_slow_link()
     gen_clean()
     gen_lossy_transport()
+    gen_slow_request()
     return [os.path.join(HERE, sc) for sc in SCENARIOS]
 
 

@@ -60,12 +60,15 @@ def _fresh_decision_state():
     """Same hygiene as test_cluster: routing/fault DecisionEvents
     must not leak into later test modules' ring-length asserts."""
     from triton_distributed_tpu.observability import feedback
+    from triton_distributed_tpu.observability.lineage import (
+        get_lineage_recorder)
     from triton_distributed_tpu.observability.recorder import (
         get_flight_recorder)
     feedback.clear_recent_decisions()
     yield
     feedback.clear_recent_decisions()
     get_flight_recorder().clear()
+    get_lineage_recorder().clear()
 
 
 @pytest.fixture(scope="module")

@@ -54,12 +54,15 @@ def _fresh_decision_state():
     fills the flight ring to capacity and breaks later test files
     that assert on its length (test_observability's emit test)."""
     from triton_distributed_tpu.observability import feedback
+    from triton_distributed_tpu.observability.lineage import (
+        get_lineage_recorder)
     from triton_distributed_tpu.observability.recorder import (
         get_flight_recorder)
     feedback.clear_recent_decisions()
     yield
     feedback.clear_recent_decisions()
     get_flight_recorder().clear()
+    get_lineage_recorder().clear()
 
 
 @pytest.fixture(scope="module")

@@ -60,6 +60,17 @@ def _fresh_decisions():
     yield
     feedback.clear_recent_decisions()
     set_decision_log(None)
+    # Same ring hygiene as test_cluster: the SLO-admission tests run
+    # real schedulers, whose decision AND lineage events land in the
+    # process-global flight ring and lineage recorder — left behind
+    # they break later modules' ring-length asserts and leak
+    # in-flight "lineage" keys into heartbeat-payload tests.
+    from triton_distributed_tpu.observability.lineage import (
+        get_lineage_recorder)
+    from triton_distributed_tpu.observability.recorder import (
+        get_flight_recorder)
+    get_lineage_recorder().clear()
+    get_flight_recorder().clear()
 
 
 # ---------------------------------------------------------------------------

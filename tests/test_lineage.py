@@ -1,0 +1,759 @@
+"""Request lineage tests — CPU-only, deterministic (virtual clock).
+
+The tentpole invariants under test:
+
+- every seam a request crosses emits a schema-v1 LineageEvent, and
+  the TTFT hop decomposition sums EXACTLY (rational arithmetic, not
+  approximately) to the measured TTFT on the same clock — standalone
+  scheduler, local-prefill cluster, disaggregated worker path,
+  preemption, failover, and the seeded chaos grid alike;
+- every injected shipment fault appears in the victim request's
+  lineage (joined by shipment id) with the retry/backoff interval it
+  cost;
+- the all-faults-off schedule produces lineage identical to running
+  with no injector at all, and ``TDT_OBSERVABILITY=0`` records
+  nothing and allocates nothing;
+- heartbeats, flight dumps, the ``/requests`` endpoint and the doctor
+  all surface the same lineage.
+
+All tier-1 (`not slow`).
+"""
+
+import json
+import os
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from triton_distributed_tpu.observability.lineage import (
+    HOPS,
+    LineageEvent,
+    LineageRecorder,
+    attribute_tbt,
+    get_lineage_recorder,
+    load_lineage,
+    record_hop,
+    set_lineage_log,
+    ttft_breakdown,
+    validate_lineage,
+    write_lineage_artifact,
+)
+from triton_distributed_tpu.serving import (
+    ClusterConfig,
+    ContinuousBatchingScheduler,
+    FaultInjector,
+    FaultSchedule,
+    Request,
+    SchedulerConfig,
+    ServingCluster,
+    ToyConfig,
+    ToyModel,
+)
+from triton_distributed_tpu.serving.cluster import (
+    RouterConfig,
+    faults_by_shipment,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_lineage_state():
+    """Same hygiene as test_cluster: lineage events land in the
+    process-global recorder AND the flight ring — left behind they
+    leak into later modules' heartbeat payloads and ring-length
+    asserts."""
+    from triton_distributed_tpu.observability import feedback
+    from triton_distributed_tpu.observability.recorder import (
+        get_flight_recorder)
+    get_lineage_recorder().clear()
+    feedback.clear_recent_decisions()
+    yield
+    get_lineage_recorder().clear()
+    feedback.clear_recent_decisions()
+    get_flight_recorder().clear()
+
+
+@pytest.fixture(scope="module")
+def toy():
+    model = ToyModel(ToyConfig(vocab_size=61, hidden=16,
+                               max_seq_len=64))
+    params = model.init_params(jax.random.key(0))
+    return model, params
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_sched(model, params, clock=None, **cfg_kw):
+    cfg_kw.setdefault("num_slots", 3)
+    cfg_kw.setdefault("prefill_buckets", (8, 16, 32))
+    ck = clock or Clock()
+    return ContinuousBatchingScheduler(
+        model, params, SchedulerConfig(**cfg_kw),
+        clock=ck.now, clock_advance=ck.advance), ck
+
+
+def make_cluster(model, params, workers=0, injector=None, **ck):
+    cfg = ClusterConfig(
+        n_replicas=2, n_prefill_workers=workers,
+        scheduler=SchedulerConfig(num_slots=3,
+                                  prefill_buckets=(8, 16, 32)),
+        ship_retry_base_s=0.002, ship_deadline_s=0.1,
+        router=RouterConfig(dead_after_s=0.005, dead_checks=2,
+                            probation_checks=2, **ck))
+    return ServingCluster(model, params, cfg,
+                          fault_injector=injector)
+
+
+def hops_of(rid):
+    return [e.hop for e in get_lineage_recorder().events_for(rid)]
+
+
+def assert_exact(record):
+    evs = get_lineage_recorder().events_for(record.record_id)
+    bd = ttft_breakdown(evs, arrival=record.arrival_time,
+                        measured_ttft=record.ttft)
+    assert bd is not None, [(e.hop, e.ts) for e in evs]
+    assert bd["exact"], (record.record_id, bd, record.ttft)
+    return bd
+
+
+# ---------------------------------------------------------------------------
+# Schema / recorder units
+# ---------------------------------------------------------------------------
+
+def test_event_schema_roundtrip_and_validation():
+    ev = record_hop(7, "submit", 1.25, "cluster", prompt_len=4)
+    assert isinstance(ev, LineageEvent)
+    d = ev.to_dict()
+    assert not validate_lineage(d), validate_lineage(d)
+    assert LineageEvent.from_dict(d) == ev
+    json.dumps(d)                       # one JSON line
+
+    assert validate_lineage({}), "empty dict must not validate"
+    bad = dict(d, hop="teleport")
+    assert any("unknown hop" in p for p in validate_lineage(bad))
+    bad = dict(d, kind="fault")
+    assert any("kind" in p for p in validate_lineage(bad))
+    bad = dict(d)
+    del bad["actor"]
+    assert any("actor" in p for p in validate_lineage(bad))
+    with pytest.raises(AssertionError):
+        record_hop(8, "not_a_hop", 0.0)
+
+
+def test_recorder_bounds_and_eviction():
+    from triton_distributed_tpu.observability import get_registry
+    rec = LineageRecorder(max_requests=2, max_events=3)
+    for i in range(4):
+        rec.record(LineageEvent(request_id=i, hop="submit", ts=0.0))
+    assert rec.evicted_requests == 2
+    assert sorted(rec.request_ids()) == [2, 3]
+    h = get_registry().histogram("cluster_hop_ms", hop="admit")
+    before = h.snapshot()["count"]
+    for k in range(5):
+        rec.record(LineageEvent(request_id=3, hop="admit",
+                                ts=0.001 * k))
+    assert rec.dropped_events == 3           # cap of 3 per request
+    assert len(rec.events_for(3)) == 3
+    # Dropped events must not keep charging overlapping intervals
+    # from the retained tail: only RETAINED appends observe.
+    assert h.snapshot()["count"] == before + 1   # admit#0 -> admit#1
+
+
+def test_hop_interval_histogram():
+    from triton_distributed_tpu.observability import get_registry
+    h = get_registry().histogram("cluster_hop_ms", hop="ship")
+    before = h.snapshot()["count"]
+    record_hop("h1", "ship", 1.0, "transport")
+    record_hop("h1", "ship_deliver", 1.005, "transport")
+    snap = h.snapshot()
+    assert snap["count"] == before + 1
+    assert snap["max"] >= 4.99               # the ~5 ms ship interval
+
+
+def test_disabled_records_nothing_and_allocates_nothing(monkeypatch):
+    import tracemalloc
+
+    import triton_distributed_tpu.observability.lineage as lineage
+
+    monkeypatch.setenv("TDT_OBSERVABILITY", "0")
+    assert record_hop(1, "submit", 0.0) is None
+    assert lineage.lineage_summaries() == []
+    assert len(get_lineage_recorder()) == 0
+
+    def hot_path():
+        for _ in range(50):
+            record_hop(1, "submit", 0.0, "cluster")
+
+    hot_path()   # warm lazy imports outside the measurement
+    tracemalloc.start()
+    try:
+        snap0 = tracemalloc.take_snapshot()
+        hot_path()
+        snap1 = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    filt = tracemalloc.Filter(True, lineage.__file__)
+    blocks = sum(s.size for s in
+                 snap1.filter_traces([filt]).statistics("filename"))
+    blocks0 = sum(s.size for s in
+                  snap0.filter_traces([filt]).statistics("filename"))
+    assert blocks - blocks0 <= 0, (
+        "lineage allocated on the disabled hot path")
+    assert len(get_lineage_recorder()) == 0
+
+
+def test_disabled_scheduler_emits_no_lineage(toy, monkeypatch):
+    monkeypatch.setenv("TDT_OBSERVABILITY", "0")
+    model, params = toy
+    sched, _ = make_sched(model, params)
+    done = sched.run([Request(prompt=[1 + i, 2, 3], max_new_tokens=2)
+                      for i in range(3)])
+    assert len(done) == 3
+    assert len(get_lineage_recorder()) == 0
+
+
+# ---------------------------------------------------------------------------
+# Standalone scheduler
+# ---------------------------------------------------------------------------
+
+def test_standalone_scheduler_exact_breakdown(toy):
+    model, params = toy
+    sched, _ = make_sched(model, params)
+    gens = [2, 5, 3, 6, 2, 4]
+    reqs = [Request(prompt=[1 + i, 2, 3, 4], max_new_tokens=g,
+                    arrival_time=(i % 2) * 0.01)
+            for i, g in enumerate(gens)]
+    done = sched.run(reqs)
+    assert len(done) == 6
+    rec = get_lineage_recorder()
+    for r in done:
+        key = f"eng-{r.request_id}"
+        evs = rec.events_for(key)
+        hops = [e.hop for e in evs]
+        assert hops[0] == "enqueue" and hops[-1] == "retire"
+        assert "admit" in hops and "first_token" in hops
+        # t0 == t_arrival even for pre-submitted future arrivals: the
+        # enqueue hop clamps forward to the arrival time.
+        bd = ttft_breakdown(evs, arrival=r.t_arrival,
+                            measured_ttft=r.ttft)
+        assert bd is not None and bd["exact"], (r.request_id, bd)
+        admit = next(e for e in evs if e.hop == "admit")
+        assert admit.detail["mode"] == "local"
+        assert admit.actor == "engine"
+        retire = next(e for e in evs if e.hop == "retire")
+        assert retire.detail == {
+            "reason": r.finish_reason.value,
+            "generated": len(r.generated)}
+
+
+def test_structural_reject_hop(toy):
+    model, params = toy
+    sched, _ = make_sched(model, params)
+    req = Request(prompt=[1] * 60, max_new_tokens=2)  # > every bucket
+    assert not sched.submit(req)
+    evs = get_lineage_recorder().events_for(f"eng-{req.request_id}")
+    assert [e.hop for e in evs] == ["reject"]
+    assert evs[0].detail["reason"] == "prompt_too_long"
+
+
+def test_suffix_admission_mode(toy):
+    model, params = toy
+    sysp = list(np.random.default_rng(7).integers(1, 61, 16))
+    sched, _ = make_sched(model, params, kv_layout="paged",
+                          page_size=16)
+    done = sched.run([
+        Request(prompt=sysp + [1 + i, 2], max_new_tokens=2,
+                arrival_time=0.01 * i) for i in range(3)])
+    assert len(done) == 3
+    rec = get_lineage_recorder()
+    modes = {}
+    for r in done:
+        evs = rec.events_for(f"eng-{r.request_id}")
+        admit = next(e for e in evs if e.hop == "admit")
+        modes[r.request_id] = admit.detail["mode"]
+        bd = ttft_breakdown(evs, arrival=r.t_arrival,
+                            measured_ttft=r.ttft)
+        assert bd is not None and bd["exact"]
+    vals = [modes[r.request_id]
+            for r in sorted(done, key=lambda r: r.request_id)]
+    assert vals[0] == "local"            # first fills the cache
+    assert set(vals[1:]) == {"suffix"}   # later ones hit the prefix
+
+
+def test_preempt_and_resume_hops(toy):
+    model, params = toy
+    sched, _ = make_sched(model, params, kv_layout="paged",
+                          page_size=16, num_pages=6,
+                          prefill_buckets=(8, 16, 32, 64),
+                          temperature=1.0)
+    done = sched.run([Request(prompt=[1 + i] * 10, max_new_tokens=30,
+                              seed=i, eos_token_ids=())
+                      for i in range(3)])
+    assert len(done) == 3
+    preempted = [r for r in done if r.preemptions]
+    assert preempted, "pool pressure should have preempted someone"
+    rec = get_lineage_recorder()
+    for r in preempted:
+        evs = rec.events_for(f"eng-{r.request_id}")
+        hops = [e.hop for e in evs]
+        assert "preempt" in hops
+        admits = [e for e in evs if e.hop == "admit"]
+        assert len(admits) >= 2
+        assert admits[-1].detail.get("resumed") is True
+        bd = ttft_breakdown(evs, arrival=r.t_arrival,
+                            measured_ttft=r.ttft)
+        assert bd is not None and bd["exact"]
+
+
+# ---------------------------------------------------------------------------
+# Cluster: local path, worker path, failover
+# ---------------------------------------------------------------------------
+
+def test_cluster_local_path_hops_exact(toy):
+    model, params = toy
+    cluster = make_cluster(model, params)
+    for i in range(6):
+        cluster.submit([1 + i, 2, 3, 4], 3, seed=i,
+                       arrival_time=0.001 * i)
+    done = cluster.drain()
+    assert len(done) == 6
+    for r in done:
+        hops = hops_of(r.record_id)
+        assert hops[0] == "submit" and hops[-1] == "retire"
+        for h in ("enqueue", "route_stage", "route_commit", "admit",
+                  "first_token"):
+            assert h in hops, (h, hops)
+        bd = assert_exact(r)
+        assert bd["ttft_ms"] == round(r.ttft * 1e3, 6)
+    evs = get_lineage_recorder().events_for(done[0].record_id)
+    stage = next(e for e in evs if e.hop == "route_stage")
+    assert stage.detail["path"] == "local"
+    admit = next(e for e in evs if e.hop == "admit")
+    assert admit.actor.startswith("replica-")
+
+
+def test_cluster_worker_path_ship_hops_exact(toy):
+    model, params = toy
+    cluster = make_cluster(model, params, workers=1)
+    for i in range(5):
+        cluster.submit([1 + i, 2, 3, 4], 3, seed=i,
+                       arrival_time=0.001 * i)
+    done = cluster.drain()
+    assert len(done) == 5
+    for r in done:
+        hops = hops_of(r.record_id)
+        for h in ("prefill_start", "prefill_end", "ship",
+                  "ship_deliver", "route_commit", "admit"):
+            assert h in hops, (h, hops)
+        evs = get_lineage_recorder().events_for(r.record_id)
+        admit = next(e for e in evs if e.hop == "admit")
+        assert admit.detail["mode"] == "shipped"
+        ship = next(e for e in evs if e.hop == "ship")
+        deliver = next(e for e in evs if e.hop == "ship_deliver")
+        assert deliver.detail["token"] == ship.detail["token"]
+        # commit lands at delivery acceptance, not at worker hand-off
+        stage = next(e for e in evs if e.hop == "route_stage")
+        commit = next(e for e in evs if e.hop == "route_commit")
+        assert commit.ts >= deliver.ts >= stage.ts
+        assert_exact(r)
+
+
+def test_worker_path_structural_reject_is_terminal(toy):
+    """The disaggregated dispatch path rejects unbucketable prompts
+    via structural_reject() directly (scheduler.submit never runs):
+    the record must still get a terminal lineage hop, or it reads as
+    stuck-in-'submit' forever in heartbeats/dumps/doctor."""
+    from triton_distributed_tpu.observability.lineage import (
+        lineage_summaries)
+    model, params = toy
+    cluster = make_cluster(model, params, workers=1)
+    rec = cluster.submit([1] * 60, 2, seed=0)   # > every bucket
+    cluster.drain()
+    assert rec.state == "rejected"
+    assert rec.reject_reason == "prompt_too_long"
+    hops = hops_of(rec.record_id)
+    assert hops[-1] == "reject", hops
+    assert lineage_summaries() == []            # nothing in flight
+
+
+def test_local_path_structural_reject_single_terminal_hop(toy):
+    """On the local path scheduler.submit records the reject hop;
+    the cluster's terminal resolution must not add a duplicate."""
+    model, params = toy
+    cluster = make_cluster(model, params)      # no workers
+    rec = cluster.submit([1] * 30, 60, seed=0)  # > KV capacity
+    cluster.drain()
+    assert rec.state == "rejected"
+    assert rec.reject_reason == "exceeds_kv_capacity"
+    hops = hops_of(rec.record_id)
+    assert hops.count("reject") == 1, hops
+    assert hops[-1] == "reject"
+
+
+def test_failover_lineage_and_tbt_attribution(toy):
+    model, params = toy
+    clock = Clock()
+    cfg = ClusterConfig(
+        n_replicas=2,
+        scheduler=SchedulerConfig(num_slots=3,
+                                  prefill_buckets=(8, 16, 32)),
+        router=RouterConfig(dead_after_s=0.005, dead_checks=2,
+                            probation_checks=2, readmit=False))
+    cluster = ServingCluster(model, params, cfg, clock=clock.now,
+                             clock_advance=clock.advance)
+    times = {}
+
+    def on_token(record, tok):
+        times.setdefault(record.record_id, []).append(clock.t)
+
+    recs = [cluster.submit([1 + i, 2, 3], 12, seed=i,
+                           on_token=on_token) for i in range(4)]
+    for _ in range(3):
+        cluster.step()
+    victim_rep = recs[0].replica
+    assert victim_rep is not None
+    cluster.kill_replica(victim_rep)
+    done = cluster.drain()
+    assert len(done) == 4
+    rec = get_lineage_recorder()
+    victims = [r for r in done if r.failovers]
+    assert victims, "kill before completion should fail someone over"
+    for r in victims:
+        evs = rec.events_for(r.record_id)
+        hops = [e.hop for e in evs]
+        assert "failover" in hops
+        fo = next(e for e in evs if e.hop == "failover")
+        assert fo.detail["reason"] == "heartbeat_loss"
+        assert fo.detail["replica"] == f"replica-{victim_rep}"
+        # the resumed re-dispatch is recorded as a resumed admit
+        admits = [e for e in evs if e.hop == "admit"]
+        if fo.detail["streamed"]:
+            assert admits[-1].detail.get("resumed") is True
+        assert_exact(r)
+        # TBT attribution: the failover gap is named as such
+        tt = times[r.record_id]
+        if fo.detail["streamed"] and len(tt) > 2:
+            att = attribute_tbt(evs, tt)
+            assert att["spikes"], (att, tt)
+            assert any(s["cause"] == "failover"
+                       for s in att["spikes"]), att
+
+
+def test_attribute_tbt_step_time_default():
+    evs = [LineageEvent(request_id=1, hop="admit", ts=0.0)]
+    att = attribute_tbt(evs, [0.0, 0.001, 0.002, 0.003, 0.030])
+    assert att["gaps"] == 4
+    assert att["spikes"] == [{"token": 4, "gap_ms": 27.0,
+                              "cause": "step_time"}]
+
+
+# ---------------------------------------------------------------------------
+# Chaos grid: faults join lineage; all-off is bit-identical
+# ---------------------------------------------------------------------------
+
+def run_chaos(model, params, injector):
+    get_lineage_recorder().clear()
+    cluster = make_cluster(model, params, workers=1,
+                           injector=injector)
+    trace = [dict(prompt=[1 + i, 2, 3], max_new_tokens=4 + (i % 3),
+                  seed=i, arrival_time=0.002 * i) for i in range(6)]
+    recs = [cluster.submit(**t) for t in trace]
+    done = cluster.drain()
+    assert len(done) == len(trace), [r.state for r in recs]
+    return cluster, done
+
+
+def lineage_shapes(done):
+    """Normalised per-request lineage (record ids come from a global
+    counter, so runs are compared by submission order)."""
+    rec = get_lineage_recorder()
+    out = []
+    for r in sorted(done, key=lambda r: r.record_id):
+        out.append([(e.hop, e.ts, e.actor, e.detail)
+                    for e in rec.events_for(r.record_id)])
+    return out
+
+
+def test_chaos_grid_every_shipment_fault_in_victim_lineage(toy):
+    model, params = toy
+    rec = get_lineage_recorder()
+    saw_retry = saw_fault = False
+    for seed in range(10):
+        inj = FaultInjector(FaultSchedule(
+            seed, classes=("drop", "corrupt", "dup", "reorder"),
+            ship_fault_rate=0.5, window_s=0.03))
+        cluster, done = run_chaos(model, params, inj)
+        fault_ships = faults_by_shipment(inj.events)
+        ship_tokens = {}
+        for r in done:
+            evs = rec.events_for(r.record_id)
+            for e in evs:
+                if e.hop in ("ship", "ship_retry"):
+                    ship_tokens[e.detail["token"]] = r.record_id
+            bd = assert_exact(r)
+            assert bd["exact"]
+        # every injected shipment fault names a shipment some victim's
+        # lineage carries — the join the doctor renders
+        for ship_id, cls in fault_ships.items():
+            assert ship_id in ship_tokens, (seed, ship_id, cls)
+            saw_fault = True
+            if cls in ("drop", "corrupt"):
+                # the fault COST something: the victim's lineage shows
+                # the retransmission with its backoff, or the
+                # exhausted-retry reroute
+                victim = ship_tokens[ship_id]
+                hops = [e.hop for e in rec.events_for(victim)]
+                assert ("ship_retry" in hops or "reroute" in hops), (
+                    seed, cls, hops)
+        for r in done:
+            for e in rec.events_for(r.record_id):
+                if e.hop == "ship_retry":
+                    saw_retry = True
+                    assert e.detail["backoff_ms"] > 0
+                    assert e.detail["trigger"] in ("timeout",
+                                                   "corrupt")
+    assert saw_fault, "grid injected nothing into the wire"
+    assert saw_retry, "grid provoked no retransmission"
+
+
+def test_all_faults_off_lineage_bit_identical(toy):
+    model, params = toy
+    _, done_none = run_chaos(model, params, None)
+    shapes_none = lineage_shapes(done_none)
+    _, done_off = run_chaos(model, params,
+                            FaultInjector(FaultSchedule.none()))
+    shapes_off = lineage_shapes(done_off)
+    assert shapes_none == shapes_off
+
+
+# ---------------------------------------------------------------------------
+# Surfaces: heartbeat, flight dump, /requests, artifact, doctor
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_and_flight_dump_carry_in_flight_lineage(tmp_path):
+    from triton_distributed_tpu.observability.exporter import (
+        heartbeat_payload)
+    from triton_distributed_tpu.observability.recorder import (
+        FlightRecorder)
+
+    assert "lineage" not in heartbeat_payload()
+    record_hop(41, "submit", 0.0, "cluster")
+    record_hop(41, "admit", 0.001, "replica-0", slot=0, bucket=8,
+               mode="local")
+    record_hop(42, "submit", 0.0, "cluster")
+    record_hop(42, "retire", 0.002, "replica-0", reason="eos")
+    hb = heartbeat_payload()
+    assert [s["request_id"] for s in hb["lineage"]] == [41]
+    assert hb["lineage"][0]["hop"] == "admit"
+
+    fr = FlightRecorder(capacity=8)
+    path = fr.dump(str(tmp_path / "f.json"), reason="test")
+    payload = json.load(open(path))
+    assert payload["lineage"][0]["request_id"] == 41
+    assert payload["lineage"][0]["hop"] == "admit"
+
+
+def test_requests_endpoint(toy):
+    from triton_distributed_tpu.observability.exporter import (
+        start_metrics_server)
+    model, params = toy
+    # Worker path: the prefill+wire pipeline gives every request a
+    # nonzero TTFT, so the table rows carry a dominant hop.
+    cluster = make_cluster(model, params, workers=1)
+    recs = [cluster.submit([1 + i, 2, 3], 2, seed=i)
+            for i in range(3)]
+    cluster.drain()
+    srv = start_metrics_server(port=0)
+    try:
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/requests",
+            timeout=10).read())
+    finally:
+        srv.stop()
+    rows = {r["request_id"]: r for r in body["requests"]}
+    for r in recs:
+        row = rows[r.record_id]
+        assert row["state"] == "done"
+        assert row["last_hop"] == "retire"
+        assert row["ttft_ms"] == round(r.ttft * 1e3, 6)
+        assert row["dominant_hop"] in HOPS
+
+
+def test_artifact_write_filtered_and_streamed(tmp_path, monkeypatch):
+    # streamed jsonl via TDT_LINEAGE_DIR
+    monkeypatch.setenv("TDT_LINEAGE_DIR", str(tmp_path / "stream"))
+    record_hop(51, "submit", 0.0, "cluster")
+    record_hop("eng-51", "enqueue", 0.0, "engine")
+    record_hop(51, "retire", 0.01, "cluster", reason="eos")
+    monkeypatch.delenv("TDT_LINEAGE_DIR")
+    rows = load_lineage(str(tmp_path / "stream"
+                            / "lineage-rank-0.jsonl"))
+    assert len(rows) == 3
+    for row in rows:
+        assert not validate_lineage(row), row
+
+    # artifact write filters to the cluster's own ids (an unrelated
+    # engine's lineage in the same process stays out)
+    path = write_lineage_artifact(str(tmp_path / "art"),
+                                  request_ids=[51])
+    rows = load_lineage(path)
+    assert {r["request_id"] for r in rows} == {51}
+    assert len(rows) == 2
+
+    # explicit log path
+    set_lineage_log(str(tmp_path / "explicit.jsonl"))
+    try:
+        record_hop(52, "submit", 0.0, "cluster")
+    finally:
+        set_lineage_log(None)
+    assert load_lineage(str(tmp_path / "explicit.jsonl"))
+
+
+def test_doctor_lineage_only_dir_yields_report(tmp_path):
+    from triton_distributed_tpu.observability.doctor import (
+        diagnose, render_markdown)
+    record_hop(61, "submit", 0.0, "cluster")
+    record_hop(61, "admit", 0.004, "replica-0", slot=0, bucket=8,
+               mode="local")
+    record_hop(61, "first_token", 0.005, "replica-0")
+    record_hop(61, "retire", 0.006, "replica-0", reason="eos")
+    record_hop(62, "submit", 0.001, "cluster")   # still in flight
+    write_lineage_artifact(str(tmp_path))
+    report = diagnose([str(tmp_path)])
+    assert report is not None, "lineage.jsonl alone must report"
+    lineage = report["lineage"]
+    assert lineage["requests"] == 2
+    assert lineage["completed"] == 1
+    assert lineage["exact"] is True
+    # intervals are charged to the hop they FOLLOW: submit→admit is
+    # the 4 ms the request waited after submit, the dominant share
+    assert lineage["slowest"][0]["dominant_hop"] == "submit"
+    assert lineage["slowest"][0]["by_hop_ms"] == {
+        "submit": 4.0, "admit": 1.0}
+    assert lineage["in_flight"][0] == {
+        "request_id": 62, "stuck_in": "submit",
+        "age_s": round(0.006 - 0.001, 6)}
+    md = render_markdown(report)
+    assert "## Request lineage" in md
+    assert "hop 'submit'" in report["verdict"]
+    assert "still stuck in hop 'submit'" in report["verdict"]
+
+
+def test_doctor_tolerates_malformed_and_truncated_lineage(tmp_path):
+    """A torn artifact (non-numeric ts, a lost head line) must
+    degrade the report — flagged inexact / sorted to 0 — never crash
+    the doctor, and never silently claim an under-reported TTFT is
+    exact."""
+    from triton_distributed_tpu.observability.doctor import (
+        diagnose, render_markdown)
+    rows = [
+        # request 1: head torn off (no submit/enqueue line survived)
+        {"schema": 1, "kind": "lineage", "ts": 0.004, "rank": 0,
+         "request_id": 1, "hop": "admit", "actor": "replica-0",
+         "detail": {}},
+        {"schema": 1, "kind": "lineage", "ts": 0.005, "rank": 0,
+         "request_id": 1, "hop": "first_token",
+         "actor": "replica-0", "detail": {}},
+        # request 2: a corrupted timestamp on one line
+        {"schema": 1, "kind": "lineage", "ts": "garbage", "rank": 0,
+         "request_id": 2, "hop": "submit", "actor": "cluster",
+         "detail": {}},
+        {"schema": 1, "kind": "lineage", "ts": 0.002, "rank": 0,
+         "request_id": 2, "hop": "first_token",
+         "actor": "replica-0", "detail": {}},
+    ]
+    with open(tmp_path / "lineage.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    report = diagnose([str(tmp_path)])        # must not raise
+    lineage = report["lineage"]
+    assert lineage["exact"] is False
+    r1 = next(r for r in lineage["slowest"] if r["request_id"] == 1)
+    assert r1["head_truncated"] is True and r1["exact"] is False
+    assert "INCOMPLETE" in render_markdown(report)
+
+
+def test_doctor_without_lineage_has_no_key():
+    from triton_distributed_tpu.observability.doctor import diagnose
+    d = os.path.join(os.path.dirname(__file__), "data", "incidents",
+                     "clean")
+    report = diagnose([d])
+    assert report is not None
+    assert "lineage" not in report
+
+
+def test_slow_request_golden_names_dominant_hop():
+    from triton_distributed_tpu.observability.doctor import diagnose
+    d = os.path.join(os.path.dirname(__file__), "data", "incidents",
+                     "slow_request")
+    report = diagnose([d])
+    lineage = report["lineage"]
+    assert lineage["exact"] is True
+    slowest = lineage["slowest"][0]
+    assert slowest["request_id"] == 7
+    assert slowest["dominant_hop"] == "ship_retry"
+    assert slowest["faults_absorbed"] == ["drop"]
+    assert slowest["ship_retries"] == 2
+    assert "ship_retry" in report["verdict"]
+    assert "drop" in report["verdict"]
+
+
+def test_lineage_trace_perfetto_lane(tmp_path):
+    from triton_distributed_tpu.observability.timeline import (
+        lineage_trace)
+    record_hop(71, "submit", 0.0, "cluster")
+    record_hop(71, "admit", 0.002, "replica-0", mode="local")
+    record_hop(71, "first_token", 0.003, "replica-0")
+    write_lineage_artifact(str(tmp_path))
+    rows = load_lineage(str(tmp_path / "lineage.jsonl"))
+    trace = lineage_trace(rows)
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert [e["name"] for e in xs] == ["submit", "admit"]
+    assert xs[0]["dur"] == 2000.0            # 2 ms in trace µs
+    assert xs[0]["args"]["request_id"] == 71
+    names = [e for e in trace["traceEvents"]
+             if e.get("name") == "thread_name"]
+    assert names[0]["args"]["name"] == "request 71"
+
+
+def test_merge_directory_renders_lineage_without_traces(tmp_path):
+    """A virtual-clock cluster run leaves lineage.jsonl with NO
+    trace-rank files — merge_directory must still write the Perfetto
+    lane file (it returns None only for the span-merge half)."""
+    from triton_distributed_tpu.observability.timeline import (
+        merge_directory)
+    record_hop(81, "submit", 0.0, "cluster")
+    record_hop(81, "first_token", 0.004, "replica-0")
+    write_lineage_artifact(str(tmp_path))
+    assert merge_directory(str(tmp_path)) is None
+    lt = json.load(open(tmp_path / "lineage_trace.json"))
+    xs = [e for e in lt["traceEvents"] if e.get("ph") == "X"]
+    assert [e["name"] for e in xs] == ["submit"]
+    assert xs[0]["dur"] == 4000.0
+
+
+def test_cluster_artifact_includes_lineage(toy, tmp_path):
+    model, params = toy
+    cluster = make_cluster(model, params, workers=1)
+    for i in range(4):
+        cluster.submit([1 + i, 2, 3], 2, seed=i)
+    cluster.drain()
+    cluster.write_artifact(str(tmp_path))
+    rows = load_lineage(str(tmp_path / "lineage.jsonl"))
+    assert rows
+    for row in rows:
+        assert not validate_lineage(row), row
+    # the artifact is filtered to the cluster's records: every id is
+    # a cluster record id (int), never an engine-local "eng-" key
+    assert all(isinstance(r["request_id"], int) for r in rows)

@@ -552,9 +552,12 @@ def test_launcher_trace_dir_merges_timeline(tmp_path):
 WORKER_STALL = textwrap.dedent("""
     import os, time
     from triton_distributed_tpu.observability import (
-        maybe_start_heartbeat, span)
+        maybe_install_flight_recorder, maybe_start_heartbeat, span)
+    from triton_distributed_tpu.observability.lineage import (
+        record_hop)
 
     rank = int(os.environ["TDT_PROCESS_ID"])
+    maybe_install_flight_recorder()
     hb = maybe_start_heartbeat()
     assert hb is not None
     with span("warmup", rank=rank):
@@ -563,6 +566,10 @@ WORKER_STALL = textwrap.dedent("""
         # Simulate a rank wedged inside a compiled collective: a span
         # left open and the heartbeat thread silenced (the real wedge
         # holds the GIL so the beat thread starves the same way).
+        # A request admitted mid-decode rides along — the SIGTERM
+        # flight dump must say which hop it was stuck in.
+        record_hop(9001, "admit", time.time(), "replica-1", slot=0,
+                   bucket=8, mode="local")
         ctx = span("dcn_collective.wait", step=3)
         ctx.__enter__()
         hb.write_now()
@@ -585,10 +592,21 @@ def test_launcher_timeout_names_stalled_rank(tmp_path):
     res = _run_launcher(
         ["--trace-dir", str(trace_dir), "--timeout", "12"],
         WORKER_STALL, tmp_path,
-        env_extra={"TDT_HEARTBEAT_INTERVAL": "0.2"})
+        env_extra={"TDT_HEARTBEAT_INTERVAL": "0.2",
+                   "TDT_FLIGHT_RECORDER": str(tmp_path / "flight")})
     assert res.returncode == 124, (res.returncode, res.stdout,
                                    res.stderr)
     assert "stalled rank 1" in res.stderr, res.stderr
     assert "dcn_collective.wait" in res.stderr, res.stderr
     # Rank 0 kept beating: reported healthy, with its own last span.
     assert "rank 0" in res.stderr and "'warmup'" in res.stderr
+    # The stalled rank's SIGTERM flight dump names the hop each
+    # in-flight request was stuck in (request-lineage satellite).
+    dump = json.load(open(tmp_path / "flight" / "flight-rank-1.json"))
+    stuck = dump["lineage"]
+    assert [s["request_id"] for s in stuck] == [9001], stuck
+    assert stuck[0]["hop"] == "admit"
+    # The wedged rank's last heartbeat carried the same summary.
+    hb = json.load(open(trace_dir / "heartbeats"
+                        / "heartbeat-rank-1.json"))
+    assert hb["lineage"][0]["hop"] == "admit"

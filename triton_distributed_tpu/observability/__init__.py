@@ -41,6 +41,20 @@ from triton_distributed_tpu.observability.feedback import (  # noqa: F401
     synthetic_bus,
     validate_decision,
 )
+from triton_distributed_tpu.observability.lineage import (  # noqa: F401
+    HOPS,
+    LineageEvent,
+    LineageRecorder,
+    attribute_tbt,
+    get_lineage_recorder,
+    lineage_summaries,
+    load_lineage,
+    record_hop,
+    set_lineage_log,
+    ttft_breakdown,
+    validate_lineage,
+    write_lineage_artifact,
+)
 from triton_distributed_tpu.observability.audit import (  # noqa: F401
     AuditRow,
     audit_events,

@@ -136,6 +136,7 @@ class Artifacts:
         self.decisions: List[dict] = []
         self.router: Optional[dict] = None
         self.faults: List[dict] = []
+        self.lineage: List[dict] = []
         self._discover()
 
     def _glob(self, pattern: str) -> List[str]:
@@ -193,16 +194,24 @@ class Artifacts:
             from triton_distributed_tpu.serving.cluster.chaos import (
                 load_faults)
             self.faults = load_faults(fault_files)
+        lineage_files = self._glob("lineage*.jsonl")
+        if lineage_files:
+            from triton_distributed_tpu.observability.lineage import (
+                load_lineage)
+            self.lineage = load_lineage(lineage_files)
 
     def empty(self) -> bool:
         # A router artifact alone is an incident report's worth of
         # state: a virtual-clock cluster run writes router-state.json
         # without any heartbeat/trace files, and the doctor must
         # still name the failed replica from it.  Likewise a
-        # faults.jsonl alone: the Chaos section must name the
-        # injected fault classes from that artifact by itself.
+        # faults.jsonl alone (the Chaos section must name the
+        # injected fault classes from that artifact by itself) and a
+        # lineage.jsonl alone (the Request-lineage section must name
+        # the dominant hop from it).
         return not (self.traces or self.flights or self.heartbeats
-                    or self.metrics or self.router or self.faults)
+                    or self.metrics or self.router or self.faults
+                    or self.lineage)
 
     def ranks(self) -> List[int]:
         from triton_distributed_tpu.observability.timeline import (
@@ -221,6 +230,8 @@ class Artifacts:
             ts.append(float(hb.get("unix_time", 0.0)))
         for fv in self.faults:
             ts.append(_num(fv.get("ts")))
+        for lv in self.lineage:
+            ts.append(_num(lv.get("ts")))
         for fl in self.flights.values():
             ts.append(float(fl.get("unix_time", 0.0)))
             for ev in fl.get("events", []):
@@ -625,6 +636,90 @@ def analyze_chaos(art: Artifacts, now: float) -> Optional[dict]:
             "recent": recent}
 
 
+#: Slowest-request rows the lineage section keeps.
+LINEAGE_SLOWEST_K = 5
+
+
+def analyze_lineage(art: Artifacts, now: float) -> Optional[dict]:
+    """Replay the request-lineage artifact (``lineage*.jsonl``,
+    `observability.lineage`) into the report: per-request TTFT
+    decomposed into hop intervals (exact on the recording clock — the
+    asserted invariant, not an estimate), the slowest-K table with
+    each request's dominant hop, shipment retries cross-referenced to
+    the injected faults (`chaos.faults_by_shipment`), and which hop
+    every still-in-flight request is stuck in.  None — and thus NO
+    report key, keeping pre-lineage golden reports byte-identical —
+    without the artifact."""
+    if not art.lineage:
+        return None
+    from triton_distributed_tpu.observability.lineage import (
+        TERMINAL_HOPS, group_by_request, ttft_breakdown)
+    from triton_distributed_tpu.serving.cluster.chaos import (
+        faults_by_shipment)
+    fault_ships = faults_by_shipment(art.faults)
+    by_req = group_by_request(art.lineage)
+    completed: List[dict] = []
+    in_flight: List[dict] = []
+    hop_totals: Dict[str, float] = {}
+    all_exact = True
+    for rid, evs in by_req.items():
+        retries = sum(1 for e in evs if e.get("hop") == "ship_retry")
+        faults_hit = sorted({
+            fault_ships[t] for e in evs
+            if e.get("hop") in ("ship", "ship_retry")
+            for t in [(e.get("detail") or {}).get("token")]
+            if t in fault_ships})
+        bd = ttft_breakdown(evs)
+        if bd is None:
+            last = evs[-1]
+            if last.get("hop") not in TERMINAL_HOPS:
+                in_flight.append({
+                    "request_id": rid,
+                    "stuck_in": last.get("hop"),
+                    "age_s": round(now - _num(last.get("ts")), 6),
+                })
+            continue
+        # The exactness the analyzer proves is relative to the
+        # recorded events; the part the DOCTOR can falsify is whether
+        # the lineage starts where a request starts.  A torn artifact
+        # that lost its head (submit/enqueue line) would silently
+        # under-report TTFT — flag it instead of calling it exact.
+        head_ok = evs[0].get("hop") in ("submit", "enqueue")
+        all_exact = all_exact and bd["exact"] and head_ok
+        for hop, ms in bd["by_hop_ms"].items():
+            hop_totals[hop] = round(hop_totals.get(hop, 0.0) + ms, 6)
+        row = {
+            "request_id": rid,
+            "ttft_ms": bd["ttft_ms"],
+            "dominant_hop": bd["dominant_hop"],
+            "dominant_ms": bd["dominant_ms"],
+            "by_hop_ms": bd["by_hop_ms"],
+            "exact": bd["exact"] and head_ok,
+        }
+        if not head_ok:
+            row["head_truncated"] = True
+        if retries:
+            row["ship_retries"] = retries
+        if faults_hit:
+            row["faults_absorbed"] = faults_hit
+        completed.append(row)
+    completed.sort(key=lambda r: (-r["ttft_ms"], str(r["request_id"])))
+    slowest = completed[:LINEAGE_SLOWEST_K]
+    out = {
+        "events": len(art.lineage),
+        "requests": len(by_req),
+        "completed": len(completed),
+        "exact": all_exact,
+        "hop_totals_ms": dict(sorted(hop_totals.items())),
+        "slowest": slowest,
+    }
+    if in_flight:
+        in_flight.sort(key=lambda r: (-r["age_s"],
+                                      str(r["request_id"])))
+        out["in_flight"] = in_flight[:LINEAGE_SLOWEST_K]
+    return out
+
+
 def analyze_links(art: Artifacts) -> dict:
     from triton_distributed_tpu.observability import links as _links
     from triton_distributed_tpu.observability.events import KernelEvent
@@ -782,6 +877,11 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
     chaos_out = analyze_chaos(art, now)
     if chaos_out is not None:
         report["chaos"] = chaos_out
+    # Request lineage: key absent without a lineage*.jsonl artifact —
+    # same golden discipline.
+    lineage_out = analyze_lineage(art, now)
+    if lineage_out is not None:
+        report["lineage"] = lineage_out
     report["verdict"] = _verdict(report, in_flight)
     return report
 
@@ -821,6 +921,22 @@ def _verdict(report: dict, in_flight: Optional[dict]) -> str:
         chaos_s = (f"; chaos: {chaos['count']} injected fault(s) — "
                    f"classes {', '.join(sorted(chaos['by_class']))}")
     hot_s += chaos_s
+    # Request lineage: the verdict NAMES the dominant hop of the
+    # slowest request (clause only exists when a lineage artifact was
+    # ingested) — "why was it slow" answered in one clause.
+    lineage = report.get("lineage")
+    if lineage and lineage.get("slowest"):
+        s = lineage["slowest"][0]
+        fault_s = (" absorbing a "
+                   + "/".join(s["faults_absorbed"]) + " fault"
+                   if s.get("faults_absorbed") else "")
+        hot_s += (f"; slowest request {s['request_id']} spent "
+                  f"{s['dominant_ms']}ms of its {s['ttft_ms']}ms "
+                  f"TTFT in hop '{s['dominant_hop']}'{fault_s}")
+    if lineage and lineage.get("in_flight"):
+        f = lineage["in_flight"][0]
+        hot_s += (f"; request {f['request_id']} still stuck in hop "
+                  f"'{f['stuck_in']}' ({f['age_s']}s)")
     if stall["first_stalled_rank"] is not None:
         r = stall["first_stalled_rank"]
         what = (f" inside {stall['open_span']!r}"
@@ -1034,6 +1150,35 @@ def render_markdown(report: dict) -> str:
             lines.append(f"| {d['age_s']} | {d['fault']} "
                          f"| {d['target']} | {inp} |")
         lines.append("")
+
+    lineage = report.get("lineage")
+    if lineage:
+        lines += ["## Request lineage", "",
+                  f"{lineage['requests']} request(s), "
+                  f"{lineage['completed']} with a first token "
+                  f"({lineage['events']} hop event(s)); TTFT hop "
+                  "decomposition "
+                  + ("sums exactly to the measured TTFT on every "
+                     "request." if lineage["exact"] else
+                     "is INCOMPLETE on some request (lineage head "
+                     "truncated — torn artifact?): its TTFT is "
+                     "under-reported."), "",
+                  "| request | TTFT (ms) | dominant hop | (ms) "
+                  "| retries | faults absorbed |",
+                  "|---|---|---|---|---|---|"]
+        for s in lineage["slowest"]:
+            lines.append(
+                f"| {s['request_id']} | {s['ttft_ms']} "
+                f"| {s['dominant_hop']} | {s['dominant_ms']} "
+                f"| {s.get('ship_retries', '-')} "
+                f"| {', '.join(s['faults_absorbed']) if s.get('faults_absorbed') else '-'} |")
+        lines.append("")
+        if lineage.get("in_flight"):
+            lines += ["In flight (stuck-in hop):", ""]
+            lines += [f"- request {f['request_id']}: "
+                      f"'{f['stuck_in']}' for {f['age_s']}s"
+                      for f in lineage["in_flight"]]
+            lines.append("")
 
     hot = report["links"].get("hot") or []
     if hot:

@@ -150,6 +150,10 @@ class MetricsServer:
                 elif self.path.startswith("/routing"):
                     body = json.dumps(routing_table()).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/requests"):
+                    body = json.dumps(request_table(),
+                                      default=str).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
@@ -256,6 +260,16 @@ def routing_table() -> dict:
             "router": current_routing_table()}
 
 
+def request_table(n: int = 50) -> dict:
+    """JSON view of recent request lineage (`observability.lineage`):
+    per-request state, last hop, and — once the first token landed —
+    the TTFT and its dominant hop.  The ``/requests`` endpoint."""
+    from triton_distributed_tpu.observability.lineage import (
+        get_lineage_recorder)
+    return {"schema": 1, "rank": _process_index(),
+            "requests": get_lineage_recorder().request_table(n)}
+
+
 # ---------------------------------------------------------------------------
 # Heartbeat files
 # ---------------------------------------------------------------------------
@@ -311,6 +325,15 @@ def heartbeat_payload() -> dict:
     decisions = recent_decision_summaries(_HEARTBEAT_DECISIONS)
     if decisions:
         payload["decisions"] = decisions
+    # In-flight request lineage rides along the same way (key absent
+    # when nothing is in flight — pre-lineage heartbeat bodies are
+    # byte-identical): a hung rank's last beat then says which hop
+    # each of its requests was stuck in, not just which span.
+    from triton_distributed_tpu.observability.lineage import (
+        lineage_summaries)
+    lineage = lineage_summaries(_HEARTBEAT_DECISIONS)
+    if lineage:
+        payload["lineage"] = lineage
     return payload
 
 

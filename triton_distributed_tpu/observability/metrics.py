@@ -260,6 +260,14 @@ def count_metric(name: str, n: float = 1, **labels) -> None:
         get_registry().counter(name, **labels).inc(n)
 
 
+def observe_metric(name: str, value: float, **labels) -> None:
+    """Histogram analogue of :func:`count_metric`: observe into a
+    global histogram iff observability is enabled (the lineage
+    recorder's per-hop interval histograms ride this)."""
+    if observability_enabled():
+        get_registry().histogram(name, **labels).observe(value)
+
+
 # ---------------------------------------------------------------------------
 # Cross-rank aggregation
 # ---------------------------------------------------------------------------

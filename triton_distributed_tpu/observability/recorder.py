@@ -121,6 +121,14 @@ class FlightRecorder:
             payload["open_spans"] = [s.to_dict() for s in
                                      get_tracer().open_spans()]
             payload["heartbeat"] = heartbeat_payload()
+            # Which hop each in-flight request was stuck in when the
+            # world stopped (key absent when nothing is in flight,
+            # keeping pre-lineage dump bodies identical).
+            from triton_distributed_tpu.observability.lineage import (
+                lineage_summaries)
+            lineage = lineage_summaries(8)
+            if lineage:
+                payload["lineage"] = lineage
         except Exception:
             pass
         os.makedirs(os.path.dirname(os.path.abspath(path)),

@@ -44,6 +44,8 @@ from typing import Dict, List, Optional, Sequence
 TRACE_GLOB = "trace-rank-*.json"
 MERGED_NAME = "merged_trace.json"
 REPORT_NAME = "straggler_report.json"
+LINEAGE_GLOB = "lineage*.jsonl"
+LINEAGE_TRACE_NAME = "lineage_trace.json"
 
 
 def load_trace(path: str, salvage: bool = True) -> dict:
@@ -291,6 +293,53 @@ def format_straggler_report(report: dict) -> str:
     return "\n".join(lines)
 
 
+def lineage_trace(rows: Sequence[dict]) -> Optional[dict]:
+    """Chrome trace of request lineage (`observability.lineage`): one
+    ``tid`` lane per request, one complete event per hop INTERVAL
+    (the time from hop X to the next hop, named X — the same charging
+    rule `ttft_breakdown` uses), so Perfetto renders each request's
+    critical path as a bar chain.  Timestamps are on the lineage's
+    own recording clock (virtual for a virtual-clock cluster),
+    rebased to the earliest hop — deliberately a SEPARATE trace from
+    the span merge, whose events ride the unix clock."""
+    from triton_distributed_tpu.observability.lineage import (
+        group_by_request)
+    by_req = group_by_request(rows)
+    if not by_req:
+        return None
+    t0 = min(float(evs[0].get("ts", 0.0))
+             for evs in by_req.values())
+    events: List[dict] = [
+        {"ph": "M", "name": "process_name", "pid": 0,
+         "args": {"name": "requests"}}]
+    order = sorted(by_req,
+                   key=lambda rid: (float(by_req[rid][0]
+                                          .get("ts", 0.0)),
+                                    str(rid)))
+    for tid, rid in enumerate(order, start=1):
+        events.append({"ph": "M", "name": "thread_name", "pid": 0,
+                       "tid": tid,
+                       "args": {"name": f"request {rid}"}})
+        evs = by_req[rid]
+        for prev, nxt in zip(evs, evs[1:]):
+            start = float(prev.get("ts", 0.0))
+            dur = max(float(nxt.get("ts", 0.0)) - start, 0.0)
+            events.append({
+                "ph": "X", "cat": "lineage", "pid": 0, "tid": tid,
+                "name": str(prev.get("hop")),
+                "ts": round((start - t0) * 1e6, 3),
+                "dur": round(dur * 1e6, 3),
+                "args": {"request_id": rid,
+                         "actor": prev.get("actor"),
+                         **(prev.get("detail") or {})},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"schema": 1, "kind": "lineage",
+                         "t0_s": t0,
+                         "clock": "lineage recording clock, "
+                                  "rebased to t0_s"}}
+
+
 def merge_directory(directory: str, out: Optional[str] = None,
                     report_out: Optional[str] = None) -> Optional[dict]:
     """Merge every per-rank trace in ``directory`` into
@@ -298,6 +347,21 @@ def merge_directory(directory: str, out: Optional[str] = None,
     directory unless overridden).  Returns the report, or None when no
     trace files exist (a killed run may have exported nothing)."""
     paths = find_trace_files(directory)
+    # Request lineage beside (or without) the span traces: render its
+    # own Perfetto lane file (separate clock — see lineage_trace).  A
+    # virtual-clock cluster run writes lineage.jsonl with NO
+    # trace-rank files, and must still get its lane file.
+    lt_out = None
+    lineage_files = sorted(glob.glob(os.path.join(directory,
+                                                  LINEAGE_GLOB)))
+    if lineage_files:
+        from triton_distributed_tpu.observability.lineage import (
+            load_lineage)
+        lt = lineage_trace(load_lineage(lineage_files))
+        if lt is not None:
+            lt_out = os.path.join(directory, LINEAGE_TRACE_NAME)
+            with open(lt_out, "w") as f:
+                json.dump(lt, f)
     if not paths:
         return None
     traces = [load_trace(p) for p in paths]
@@ -307,6 +371,8 @@ def merge_directory(directory: str, out: Optional[str] = None,
         json.dump(merged, f)
     report = straggler_report(traces)
     report["merged_trace"] = out
+    if lt_out is not None:
+        report["lineage_trace"] = lt_out
     report_out = report_out or os.path.join(directory, REPORT_NAME)
     with open(report_out, "w") as f:
         json.dump(report, f, indent=1)

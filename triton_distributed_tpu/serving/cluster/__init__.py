@@ -10,6 +10,7 @@ from triton_distributed_tpu.serving.cluster.chaos import (  # noqa: F401
     FaultEvent,
     FaultInjector,
     FaultSchedule,
+    faults_by_shipment,
     load_faults,
     validate_fault,
 )

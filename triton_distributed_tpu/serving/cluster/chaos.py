@@ -141,6 +141,27 @@ def load_faults(paths) -> List[dict]:
     return out
 
 
+def faults_by_shipment(faults) -> Dict[int, str]:
+    """{shipment id: fault class} for every wire fault in ``faults``
+    (FaultEvents or their loaded dicts with ``target``
+    ``"shipment:<id>"``).  The join key lineage uses: a request's
+    ``ship``/``ship_retry`` hops carry the shipment ``token`` in
+    their detail, so the doctor (and the chaos tests) can name the
+    injected fault a victim request's retries absorbed."""
+    out: Dict[int, str] = {}
+    for f in faults:
+        target = (f.get("target") if isinstance(f, dict)
+                  else getattr(f, "target", ""))
+        fault = (f.get("fault") if isinstance(f, dict)
+                 else getattr(f, "fault", None))
+        if isinstance(target, str) and target.startswith("shipment:"):
+            try:
+                out[int(target.split(":", 1)[1])] = str(fault)
+            except (TypeError, ValueError):
+                continue   # malformed line: skip, never crash
+    return out
+
+
 class FaultSchedule:
     """A seeded, immutable description of which faults fire.
 

@@ -211,6 +211,15 @@ class ServingCluster:
         self._staged_routes: Dict[int, tuple] = {}
         self._wrr = 0
         self._open = 0
+        #: Recent record ids this cluster submitted — the ownership
+        #: filter `write_artifact` hands the lineage-artifact writer
+        #: (the process-global recorder may also hold other engines'
+        #: lineage).  Bounded to the recorder's own retention: ids
+        #: evicted from the recorder are useless in the filter, and a
+        #: long-running server must not retain one entry per request
+        #: forever.
+        self._lineage_ids: "collections.OrderedDict" = (
+            collections.OrderedDict())
         self.finished: List[ClusterRequest] = []
         _register(self)
         self._update_gauges()
@@ -236,6 +245,19 @@ class ServingCluster:
                                   key=lambda r: r.arrival_time)
         self._pending.insert(idx, record)
         self._open += 1
+        from triton_distributed_tpu.observability.lineage import (
+            get_lineage_recorder)
+        self._lineage_ids[record.record_id] = None
+        while (len(self._lineage_ids)
+               > get_lineage_recorder().max_requests):
+            self._lineage_ids.popitem(last=False)
+        # Lineage t0: the submit hop carries the ARRIVAL timestamp
+        # (requests may be pre-submitted with future arrivals), so
+        # the TTFT decomposition starts exactly where `ttft` measures
+        # from.
+        self._hop(record, "submit", arrival, "cluster",
+                  prompt_len=len(record.prompt),
+                  max_new=record.max_new_tokens)
         return record
 
     def has_work(self) -> bool:
@@ -378,13 +400,20 @@ class ServingCluster:
                 # prefill worker on an unbucketable prompt.
                 self.router.take_staged()    # never landed
                 req.reject_reason = reason
-                self._resolve_structural(record, req)
+                self._resolve_structural(record, req,
+                                         reject_hop=True)
                 return True
             record.replica = rep.id
             record.replica_history.append(rep.id)
             record.state = "running"
             w = self.workers[self._wrr % len(self.workers)]
             self._wrr += 1
+            # Worker hand-off is the stage; the commit lands when the
+            # decode replica ACCEPTS the delivered shipment
+            # (`_pump_ships`), so stage→commit spans the whole
+            # disaggregated pipeline on this request's lineage.
+            self._hop(record, "route_stage", now, "router",
+                      replica=rep.name, path="worker", worker=w.name)
             w.submit(req, rep.id)
             self._by_req[req.request_id] = record
             # Commit-on-accept holds here too: the route is recorded
@@ -402,7 +431,14 @@ class ServingCluster:
         accepted = self._submit_to(rep, req, record)
         if accepted:
             record.ship_cache = None
-            self.router.commit_route()
+            # Stage + commit at the same tick for a local dispatch —
+            # recorded only on ACCEPT (a backpressure-refused attempt
+            # retried every event-loop tick is not a hop the request
+            # crossed, the same discipline route decisions keep).
+            self._hop(record, "route_stage", now, "router",
+                      replica=rep.name, path="local",
+                      resumed=resumed)
+            self.router.commit_route(now)
         return accepted or record.done
 
     def _make_request(self, record: ClusterRequest,
@@ -417,7 +453,8 @@ class ServingCluster:
             max_new_tokens=record.max_new_tokens - done,
             eos_token_ids=record.eos_token_ids, seed=record.seed,
             arrival_time=(record.arrival_time if done == 0 else now),
-            on_token=self._mirror(record))
+            on_token=self._mirror(record),
+            lineage_id=record.record_id)
 
     def _mirror(self, record: ClusterRequest):
         def cb(req, tok):
@@ -451,21 +488,38 @@ class ServingCluster:
         return False
 
     def _resolve_structural(self, record: ClusterRequest,
-                            req: Request) -> None:
+                            req: Request,
+                            reject_hop: bool = False) -> None:
         """Terminal resolution of a structurally infeasible request
         (replicas are homogeneous: a bucket/KV infeasibility here is
         infeasible everywhere).  A resumed stream that outgrew the
         buckets still delivered what it had; a fresh request is a
-        true reject."""
+        true reject.
+
+        ``reject_hop``: record the terminal lineage hop here.  The
+        worker-dispatch path passes True (it rejects via
+        structural_reject() directly — submit() never runs, so no
+        scheduler hop exists and the record would otherwise read as
+        in-flight forever); the submit path leaves it False because
+        scheduler.submit already recorded the reject hop."""
         if record.tokens:
             record.state = "finished"
             record.finish_reason = FinishReason.KV_CAPACITY.value
             record.t_finish = self._clock()
+            # Cluster-level terminal hop: the attempt-level reject the
+            # scheduler just recorded is not this record's fate — the
+            # stream it already delivered makes it a truncated FINISH.
+            self._hop(record, "retire", record.t_finish, "cluster",
+                      reason=record.finish_reason,
+                      generated=len(record.tokens))
             self.finished.append(record)
         else:
             record.state = "rejected"
             record.reject_reason = (
                 req.reject_reason.value if req.reject_reason else None)
+            if reject_hop:
+                self._hop(record, "reject", self._clock(), "cluster",
+                          reason=record.reject_reason)
         self._open -= 1
 
     def _count(self, name: str, n: int = 1, **labels) -> None:
@@ -473,13 +527,26 @@ class ServingCluster:
             count_metric)
         count_metric(name, n, **labels)
 
+    def _hop(self, record: Optional[ClusterRequest], hop: str,
+             ts: float, actor: str, **detail) -> None:
+        """Record one lineage hop for ``record`` (no-op for a
+        record-less shipment or when observability is off)."""
+        if record is None:
+            return
+        from triton_distributed_tpu.observability.lineage import (
+            record_hop)
+        record_hop(record.record_id, hop, ts, actor, **detail)
+
     def _send(self, ship: dict, now: float) -> None:
         """Put (or re-put) one shipment on the wire at ``now``: a
         fresh monotonic id + checksum from the transport, modeled
         wire time (derated through a flapping link), exponential
         backoff on retransmissions — and any wire fault the chaos
         schedule holds for the new id."""
-        token, nbytes = self.transport.ship(ship["shipment"])
+        record = ship["record"]
+        token, nbytes = self.transport.ship(
+            ship["shipment"],
+            tag=record.record_id if record is not None else None)
         ship["token"] = token
         ship["nbytes"] = nbytes
         ship["lost"] = False
@@ -489,6 +556,19 @@ class ServingCluster:
                    * (2 ** (attempt - 1)) if attempt else 0.0)
         wire_s = (self.transport.ship_time_s(nbytes)
                   * self.injector.wire_factor(now))
+        if attempt == 0:
+            self._hop(record, "ship", now, "transport", token=token,
+                      nbytes=nbytes,
+                      wire_ms=round(wire_s * 1e3, 6))
+        else:
+            # The retry carries what the fault COST this request: the
+            # exponential backoff plus another wire crossing, all on
+            # the virtual clock.
+            self._hop(record, "ship_retry", now, "transport",
+                      token=token, nbytes=nbytes, attempt=attempt,
+                      trigger=ship.get("trigger"),
+                      backoff_ms=round(backoff * 1e3, 6),
+                      wire_ms=round(wire_s * 1e3, 6))
         ship["ready_at"] = now + backoff + wire_s
         # Retransmit timer: when the wire ate the packet nothing
         # ever arrives — the sender notices one backoff step after
@@ -535,6 +615,7 @@ class ServingCluster:
         if (ship["attempt"] < self.config.ship_max_retries
                 and now < ship["deadline_at"]):
             ship["attempt"] += 1
+            ship["trigger"] = trigger
             self._count("cluster_ship_retries_total",
                         trigger=trigger)
             self._send(ship, now)
@@ -544,6 +625,8 @@ class ServingCluster:
         # stage dies uncommitted and the record re-queues at the
         # failure's virtual timestamp.
         self._count("cluster_ship_reroutes_total", trigger=trigger)
+        self._hop(record, "reroute", now, "transport",
+                  trigger=trigger, attempts=ship["attempt"])
         self._by_req.pop(req.request_id, None)
         self._staged_routes.pop(req.request_id, None)
         record.replica = None
@@ -601,6 +684,8 @@ class ServingCluster:
                 # NACK: the payload failed its checksum — a corrupted
                 # row must never reach the insert program.
                 self._count("cluster_shipments_corrupt_total")
+                self._hop(record, "ship_nack", now, "transport",
+                          token=ship["token"])
                 self._retry_or_reroute(ship, now, "corrupt")
                 progressed = True
                 continue
@@ -609,10 +694,12 @@ class ServingCluster:
                 self._count("cluster_shipments_duplicate_total")
                 progressed = True
                 continue
+            self._hop(record, "ship_deliver", now, "transport",
+                      token=ship["token"], replica=rep.name)
             req.shipped_kv = shipment
             staged = self._staged_routes.pop(req.request_id, None)
             if self._submit_to(rep, req, record):
-                self.router.commit_staged(staged)
+                self.router.commit_staged(staged, now)
             elif not record.done:
                 # Transient backpressure at the decode side: nothing
                 # has streamed and the route never landed (its stage
@@ -702,6 +789,13 @@ class ServingCluster:
             record.replica = None
             record.state = "queued"
             record.failovers += 1
+            # The failover hop: re-dispatch (an exact-resume
+            # re-prefill) follows as route_stage/admit[resumed] — the
+            # interval after THIS hop is what the failure cost the
+            # request's stream.
+            self._hop(record, "failover", now, "router",
+                      replica=rep.name, reason=reason,
+                      streamed=len(record.tokens))
             self._requeue.appendleft(record)
         self.router.note_failover(rep, reason, len(victims), now)
         # The re-queued victims are new same-tick work: let `_advance`
@@ -776,13 +870,20 @@ class ServingCluster:
         t["kv_shipped_bytes"] = self.transport.shipped_bytes
         t["shipments"] = self.transport.shipments
         t["open_requests"] = self._open
+        # Whose KV is on the wire RIGHT NOW (shipment id -> record
+        # id): the hung-cluster question /routing can now answer.
+        t["wire_pending"] = {str(k): v for k, v in
+                             self.transport.pending_tags().items()}
         return t
 
     def write_artifact(self, directory: str) -> str:
         """Write ``router-state.json`` — the doctor ingests it into
         its Cluster section and names failed replicas — plus
         ``faults.jsonl`` when a chaos schedule injected anything
-        (the doctor's "Chaos" section names the fault classes)."""
+        (the doctor's "Chaos" section names the fault classes) and
+        ``lineage.jsonl`` when request lineage was recorded (the
+        doctor's "Request lineage" section decomposes TTFT per hop).
+        """
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(directory, "router-state.json")
         tmp = f"{path}.tmp.{os.getpid()}"
@@ -791,6 +892,13 @@ class ServingCluster:
         os.replace(tmp, path)
         if self.injector.events:
             self.injector.write_artifact(directory)
+        from triton_distributed_tpu.observability.lineage import (
+            write_lineage_artifact)
+        # Filtered to THIS cluster's records: the process-global
+        # recorder may also hold an unrelated engine's lineage (a
+        # reference scheduler run in the same test process).
+        write_lineage_artifact(directory,
+                               request_ids=self._lineage_ids)
         return path
 
     def _update_gauges(self) -> None:

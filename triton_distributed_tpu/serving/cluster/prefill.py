@@ -75,6 +75,12 @@ class PrefillWorker:
         req, dst = self.queue.popleft()
         bucket = pick_bucket(len(req.prompt), self.buckets)
         assert bucket is not None, (len(req.prompt), self.buckets)
+        from triton_distributed_tpu.observability.lineage import (
+            record_hop)
+        if req.lineage_id is not None:
+            record_hop(req.lineage_id, "prefill_start", now,
+                       self.name, bucket=bucket,
+                       prompt_len=len(req.prompt))
         ids, s = pad_prompt(req.prompt, bucket, self.pad_id)
         _, row = self._prefill(self.params, ids,
                                self._row_cache(bucket))
@@ -85,4 +91,11 @@ class PrefillWorker:
             count_metric)
         count_metric("cluster_prefill_shipments_total",
                      worker=self.name)
+        if req.lineage_id is not None:
+            # The compute interval [now, busy_until] on the virtual
+            # clock; the cluster ships at busy_until, so the segment
+            # after prefill_end is pure wire time.
+            record_hop(req.lineage_id, "prefill_end",
+                       self.busy_until, self.name, bucket=bucket,
+                       nbytes=shipment.nbytes)
         return req, dst, shipment, self.busy_until

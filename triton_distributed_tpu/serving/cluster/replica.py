@@ -71,6 +71,11 @@ class Replica:
         self._clock = clock
         self.scheduler = ContinuousBatchingScheduler(
             model, params, sched_config, clock=clock)
+        # Lineage hops emitted from this engine (enqueue/admit/
+        # first_token/retire) name the replica, not a bare "engine" —
+        # the doctor's slowest-request table then says WHERE each hop
+        # ran.
+        self.scheduler.name = self.name
         #: Process liveness (the OS's view): `kill` clears it.  The
         #: ROUTER never reads this — it learns of death the only way
         #: a real router can, from the heartbeat going stale.

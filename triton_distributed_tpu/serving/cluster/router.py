@@ -284,16 +284,17 @@ class ClusterRouter:
         staged, self._staged = self._staged, None
         return staged
 
-    def commit_route(self) -> None:
+    def commit_route(self, now: Optional[float] = None) -> None:
         """Count + record the last `route()` once its dispatch landed
         (no-op when nothing is staged or the choice was refused and
         re-staged by a newer route).  The prefix-affinity map is also
         written HERE — a refused placement must not re-home a prefix
         to a replica that never accepted it, nor churn the LRU ahead
         of prefixes whose requests actually landed."""
-        self.commit_staged(self.take_staged())
+        self.commit_staged(self.take_staged(), now)
 
-    def commit_staged(self, staged: Optional[tuple]) -> None:
+    def commit_staged(self, staged: Optional[tuple],
+                      now: Optional[float] = None) -> None:
         if staged is None:
             return
         (op, choice, candidates, inputs, fallback, n_alive,
@@ -306,6 +307,21 @@ class ClusterRouter:
             while len(self._affinity) > self.config.affinity_max:
                 del self._affinity[next(iter(self._affinity))]
         choice.routed_total += 1
+        if now is not None and op.startswith("request:"):
+            # Lineage: the commit half of the commit-on-accept seam.
+            # For a local dispatch this lands at the stage's own tick;
+            # for the prefill-worker path it lands when the shipped KV
+            # was ACCEPTED — so the stage→commit interval is the
+            # disaggregated pipeline (worker queue + prefill + wire).
+            from triton_distributed_tpu.observability.lineage import (
+                record_hop)
+            try:
+                rid = int(op.split(":", 1)[1])
+            except ValueError:
+                rid = None
+            if rid is not None:
+                record_hop(rid, "route_commit", now, "router",
+                           replica=choice.name, fallback=fallback)
         self._record_route(op, choice, candidates, inputs, fallback,
                            n_alive)
 

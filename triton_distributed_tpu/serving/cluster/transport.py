@@ -156,21 +156,30 @@ class VirtualTransport:
         #: they were SENT (a fault injector mutates ``_in_flight``
         #: only, so a mismatch at claim means wire corruption).
         self._crc: Dict[int, int] = {}
+        #: Caller tag per in-flight shipment id (the cluster passes
+        #: the request's lineage/record id), so introspection — the
+        #: `/routing` table's ``wire_pending`` — can say WHOSE bytes
+        #: are on the wire right now.
+        self._tags: Dict[int, object] = {}
         self.shipped_bytes = 0
         self.shipments = 0
         self.corrupt_claims = 0
         self.duplicate_claims = 0
 
-    def ship(self, shipment: KVShipment) -> tuple:
+    def ship(self, shipment: KVShipment, tag=None) -> tuple:
         """Serialize one shipment onto the wire.  Returns
         ``(token, nbytes)`` — the token is a monotonic shipment id
         (each retransmission of the same logical shipment gets a NEW
-        id; dedup happens at claim: a one-shot pop per id)."""
+        id; dedup happens at claim: a one-shot pop per id).  ``tag``
+        labels the in-flight copy for introspection (the cluster
+        passes the request's record id)."""
         data = shipment.to_bytes()
         token = self._next_token
         self._next_token += 1
         self._in_flight[token] = data
         self._crc[token] = zlib.crc32(data)
+        if tag is not None:
+            self._tags[token] = tag
         self.shipped_bytes += len(data)
         self.shipments += 1
         return token, len(data)
@@ -187,6 +196,7 @@ class VirtualTransport:
         idempotently.  Raises :class:`ShipmentCorrupt` when the bytes
         fail their sent-time checksum (the caller NACKs)."""
         data = self._in_flight.pop(token, None)
+        self._tags.pop(token, None)
         if data is None:
             self.duplicate_claims += 1
             return None
@@ -204,6 +214,7 @@ class VirtualTransport:
         schedule dropped the packet)."""
         self._in_flight.pop(token, None)
         self._crc.pop(token, None)
+        self._tags.pop(token, None)
 
     def corrupt(self, token: int, byte_index: int = 0) -> bool:
         """Flip one payload byte of an in-flight shipment (the fault
@@ -221,3 +232,8 @@ class VirtualTransport:
     @property
     def pending(self) -> List[int]:
         return sorted(self._in_flight)
+
+    def pending_tags(self) -> Dict[int, object]:
+        """{shipment id: caller tag} for everything still on the wire
+        — which requests' KV is in flight right now."""
+        return {t: self._tags.get(t) for t in sorted(self._in_flight)}

@@ -82,6 +82,12 @@ class Request:
     resume_tokens: Optional[List[int]] = None
     resume_key: Optional[object] = None
     preemptions: int = 0
+    #: Request-lineage join key (`observability.lineage`): the id
+    #: every hop this request crosses is recorded under.  The cluster
+    #: sets it to the `ClusterRequest.record_id` so one user request's
+    #: lineage spans every replica attempt (and joins DecisionEvents /
+    #: FaultEvents); a standalone scheduler derives ``eng-<request_id>``.
+    lineage_id: Optional[object] = None
     #: Disaggregated-prefill hook (`serving.cluster`): a prefilled-KV
     #: shipment (`cluster.transport.KVShipment`-shaped: ``prompt_len``,
     #: ``bucket``, ``to_row_cache()``) a dedicated prefill worker

@@ -204,6 +204,9 @@ class ContinuousBatchingScheduler:
         #: time (the empirical "what does a step cost HERE, NOW").
         self._step_key = event_key("serving.decode_step", None,
                                    (cfg.num_slots,), 1)
+        #: Actor label on this engine's lineage hops (the cluster's
+        #: `Replica` renames it to "replica-<i>" so a hop says WHERE).
+        self.name = "engine"
         self._tokens = np.full(cfg.num_slots, cfg.pad_id, np.int32)
         #: Per-bucket reusable prefill input caches (see _admit).
         self._row_caches: Dict[int, object] = {}
@@ -283,11 +286,29 @@ class ContinuousBatchingScheduler:
             if reg:
                 reg.counter("serving_requests_rejected_total",
                             reason=reason.value).inc()
+                if reason not in (RejectReason.QUEUE_FULL,
+                                  RejectReason.STOPPED):
+                    # Structural rejects are terminal lineage hops.
+                    # Transient refusals (backpressure, a draining
+                    # engine) are NOT recorded: the cluster retries
+                    # them every event-loop tick, and lineage keeps
+                    # the commit-on-accept discipline decisions do —
+                    # a refused attempt that never landed is not a
+                    # hop the request crossed.
+                    self._hop(req, "reject", now, reason=reason.value)
             return False
         self._queue.append(req)
         if reg:
             reg.counter("serving_requests_submitted_total").inc()
             reg.gauge("serving_queue_depth").set(len(self._queue))
+            # ts clamps forward to the arrival: a pre-submitted future
+            # arrival "enters the queue" when it becomes eligible, and
+            # a cluster attempt delivered mid-stream (shipped KV, a
+            # failover resume) enqueues at delivery time, keeping each
+            # request's lineage timestamps monotone.
+            self._hop(req, "enqueue", max(req.t_arrival, now),
+                      prompt_len=req.prompt_len,
+                      queued=len(self._queue))
         return True
 
     # -- the iteration loop ---------------------------------------------
@@ -378,6 +399,26 @@ class ContinuousBatchingScheduler:
         from triton_distributed_tpu.observability import (
             get_registry, observability_enabled)
         return get_registry() if observability_enabled() else None
+
+    def _lineage_key(self, req: Request):
+        """The id this request's lineage hops record under: the
+        cluster-assigned record id when one exists (so one user
+        request's lineage spans replica attempts), else a namespaced
+        engine-local key (record ids and request ids come from
+        different counters and would collide as raw ints)."""
+        if req.lineage_id is not None:
+            return req.lineage_id
+        return f"eng-{req.request_id}"
+
+    def _hop(self, req: Request, hop: str, ts: float,
+             **detail) -> None:
+        """Record one lineage hop for ``req``.  Call sites sit behind
+        the existing ``if reg:`` registry guard, so the disabled hot
+        path never reaches here (bit-identical, zero allocations)."""
+        from triton_distributed_tpu.observability.lineage import (
+            record_hop)
+        record_hop(self._lineage_key(req), hop, ts, self.name,
+                   **detail)
 
     def _can_admit_head(self) -> bool:
         if not self.paged:
@@ -513,11 +554,13 @@ class ContinuousBatchingScheduler:
                 admitted = self._admit_paged(req, now, reg)
                 if admitted is None:
                     continue              # retired at admission
-                slot, bucket, tokens = admitted
+                slot, bucket, tokens, mode = admitted
             else:
                 tokens = req.prompt
+                mode = "local"
                 if req.shipped_kv is not None:
                     row_cache, s, bucket = self._shipped_row(req, reg)
+                    mode = "shipped"
                 else:
                     bucket = pick_bucket(req.prompt_len, self.buckets)
                     assert bucket is not None  # submit() validated
@@ -558,15 +601,25 @@ class ContinuousBatchingScheduler:
                                 bucket=str(bucket)).inc()
                 reg.histogram("serving_queue_wait_ms").observe(
                     max(now - req.t_arrival, 0.0) * 1e3)
+                if (req.resume_tokens is not None or req.preemptions
+                        or req.resume_key is not None):
+                    # A preempt-and-requeue (or failover re-prefill)
+                    # resume: the "resume" half of the seam.
+                    self._hop(req, "admit", now, slot=slot,
+                              bucket=bucket, mode=mode, resumed=True)
+                else:
+                    self._hop(req, "admit", now, slot=slot,
+                              bucket=bucket, mode=mode)
             n += 1
         return n
 
     def _admit_paged(self, req: Request, now: float, reg):
         """Paged admission: radix prefix match, suffix-only prefill on
         a hit (near-zero-cost shared system prompts), paged insert.
-        Returns (slot, bucket, tokens) or None when the request had to
-        be retired at admission (a resumed stream that no longer fits
-        any prefill bucket)."""
+        Returns (slot, bucket, tokens, mode) — mode is the lineage
+        admission class (local / shipped / suffix) — or None when the
+        request had to be retired at admission (a resumed stream that
+        no longer fits any prefill bucket)."""
         tokens = req.resume_tokens or req.prompt
         s = len(tokens)
         shared = self.slots.match_prefix(tokens)
@@ -574,6 +627,7 @@ class ContinuousBatchingScheduler:
         key = self._request_key(req)
         bucket = row = row_start = None
         t0 = None
+        mode = "local"
         if req.shipped_kv is not None and req.resume_tokens is None:
             # Prefill-worker shipment: the full-prompt row arrives
             # precomputed; shared prefix pages (if any matched) are
@@ -582,6 +636,7 @@ class ContinuousBatchingScheduler:
             row, s2, bucket = self._shipped_row(req, reg)
             assert s2 == s, (s2, s)
             row_start = 0
+            mode = "shipped"
         elif c > 0 and self._prefill_suffix is not None:
             # Prefix hit with a prefix-aware model: prefill ONLY the
             # private suffix — the shared pages are already in the
@@ -596,7 +651,9 @@ class ContinuousBatchingScheduler:
                                            jnp.int32(c),
                                            self._row_cache(bucket))
                 row_start = c
+                mode = "suffix"
         if row is None:
+            mode = "local"
             bucket = pick_bucket(s, self.buckets)
             if bucket is None:
                 # No full-prompt bucket.  (The matched chain was
@@ -619,6 +676,9 @@ class ContinuousBatchingScheduler:
                             "serving_requests_rejected_total",
                             reason=RejectReason.KV_PRESSURE.value
                         ).inc()
+                        self._hop(req, "reject", now,
+                                  reason=RejectReason.KV_PRESSURE
+                                  .value)
                     self.finished.append(req)
                     return None
                 # Resume: prompt + generated outgrew every bucket —
@@ -630,6 +690,9 @@ class ContinuousBatchingScheduler:
                     reg.counter("serving_requests_completed_total",
                                 reason=FinishReason.KV_CAPACITY.value
                                 ).inc()
+                    self._hop(req, "retire", now,
+                              reason=FinishReason.KV_CAPACITY.value,
+                              generated=len(req.generated))
                 self.finished.append(req)
                 return None
             ids, _ = pad_prompt(tokens, bucket, self.config.pad_id)
@@ -647,7 +710,7 @@ class ContinuousBatchingScheduler:
                 s - c)
         slot = self.slots.insert_prefill(row, tokens, s, key, shared,
                                          row_start=row_start)
-        return slot, bucket, tokens
+        return slot, bucket, tokens, mode
 
     def _block_size(self) -> int:
         """Steps for this dispatch: the configured block, unless some
@@ -716,6 +779,9 @@ class ContinuousBatchingScheduler:
         reg = self._registry()
         if reg:
             reg.counter("serving_preemptions_total").inc()
+            self._hop(req, "preempt", self.clock(),
+                      generated=len(req.generated),
+                      preemptions=req.preemptions)
 
     def _decode_step(self) -> int:
         t0 = time.perf_counter()
@@ -781,6 +847,11 @@ class ContinuousBatchingScheduler:
                     if reg:
                         reg.histogram("serving_ttft_ms").observe(
                             max(req.ttft, 0.0) * 1e3)
+                        # The TTFT endpoint: `now` is the same clock
+                        # value the cluster's token mirror stamps, so
+                        # the lineage sum telescopes to the measured
+                        # TTFT exactly (ttft_breakdown's invariant).
+                        self._hop(req, "first_token", now, slot=slot)
                 elif reg:
                     # With k>1 the whole block lands at one sync: TBT
                     # is reported at sync granularity (the first
@@ -832,6 +903,8 @@ class ContinuousBatchingScheduler:
             if req.latency is not None:
                 reg.histogram("serving_request_latency_ms").observe(
                     req.latency * 1e3)
+            self._hop(req, "retire", now, reason=reason.value,
+                      generated=len(req.generated))
         self.finished.append(req)
 
     def _update_gauges(self) -> None:
