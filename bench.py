@@ -286,6 +286,73 @@ def _regime_flash_decode(mesh, world, s=8192):
             f"S={s} vs min(paged, xla) ({kv_gbps:.0f} GB/s KV)")
 
 
+def _regime_moe(mesh, world):
+    """MoE epilogue: `moe_reduce_rs_fused` (grouped down-GEMM +
+    topk-weighted combine in one kernel) vs the XLA einsum composition
+    a user would otherwise run, at the weight-streaming-bound decode
+    shape `bench_moe` profiles.  VERDICT r5 flagged this path at
+    0.52–0.69× XLA — putting it in the headline min makes the gate SEE
+    the weakest regime instead of averaging it away: the headline can
+    no longer improve while MoE stays below 1.0."""
+    import statistics
+
+    from triton_distributed_tpu.kernels import moe_utils
+    from triton_distributed_tpu.kernels.moe_reduce_rs import (
+        MoEReduceRSContext,
+        moe_reduce_rs_fused,
+    )
+    from triton_distributed_tpu.ops import shard_map_op
+    from triton_distributed_tpu.utils.benchmarking import (
+        feedback_mix,
+        measure_ops_scanned,
+    )
+
+    e, cap, mc, k, n, topk = 64, 128, 2048, 2048, 1408, 2
+    key = jax.random.key(9)
+    buckets = (jax.random.normal(key, (1, e, cap, k)) / 8
+               ).astype(jnp.bfloat16)
+    wdown = (jax.random.normal(jax.random.fold_in(key, 1), (e, k, n))
+             / 8).astype(jnp.bfloat16)
+    ids = jax.random.randint(jax.random.fold_in(key, 2), (mc, topk),
+                             0, e)
+    tw = jax.nn.softmax(jax.random.normal(
+        jax.random.fold_in(key, 3), (mc, topk)), axis=-1)
+    plan = moe_utils.plan_chunks(ids, tw, 1, e, cap)
+    cmats = plan.combine_mats.astype(jnp.bfloat16)
+
+    ctx = MoEReduceRSContext(axis="tp", world_size=world,
+                             num_experts=e, topk=topk)
+
+    def fused(bk, w_, cm):
+        return shard_map_op(
+            lambda b_, ww, c_: moe_reduce_rs_fused(b_, ww, c_, ctx),
+            mesh, in_specs=(P(), P(), P()), out_specs=P())(bk, w_, cm)
+
+    def xla(bk, w_, cm):
+        part = jnp.einsum("eck,ekn->ecn", bk[0], w_,
+                          preferred_element_type=jnp.float32)
+        return jnp.einsum("emc,ecn->mn", cm[0].astype(jnp.float32),
+                          part).astype(bk.dtype)
+
+    def mix(a, out):
+        return (feedback_mix(a[0], out[None, None]), a[1], a[2])
+
+    # ABBA: ours brackets the baseline within each repeat so drift
+    # cancels in the per-repeat pairing (same harness as
+    # flash_decode / decode_ll).
+    _, slopes = measure_ops_scanned(
+        [fused, xla, fused], (buckets, wdown, cmats), mix,
+        n_inner=16, repeats=8, return_slopes=True)
+    pair_ratios = [x / ((f1 + f2) / 2)
+                   for f1, x, f2 in zip(*slopes)]
+    ratio = statistics.median(pair_ratios)
+    t_fused = statistics.median(slopes[0] + slopes[2])
+    flops = 2 * e * cap * k * n + 2 * e * mc * cap * n
+    return (t_fused, ratio,
+            f"E={e} cap={cap} vs XLA "
+            f"({flops / t_fused / 1e12:.1f} TFLOP/s)")
+
+
 def _regime_w8a8(mesh, world):
     """Quantized inference (beyond-reference capability): int8 fused
     AG-GEMM vs the bf16 XLA composition a user would otherwise run."""
@@ -371,14 +438,20 @@ def main():
     # as the harness noise bound but does NOT gate the min — every
     # regime in the min has a real numerator (prefill vs XLA overlap
     # composition, flash_decode vs the strongest public decode
-    # kernels, w8a8 vs the bf16 composition).
+    # kernels, w8a8 vs the bf16 composition, moe_reduce_rs_fused vs
+    # the XLA epilogue composition — the known-weak regime the min
+    # now surfaces instead of hiding).
     # Runtime spans bracket each regime so a --trace-dir run (or an
     # attached jax.profiler) shows where the bench wall time went.
     from triton_distributed_tpu.observability import span
     regimes = {}
     for name, fn in [("prefill_fused", _regime_prefill),
                      ("flash_decode", _regime_flash_decode),
-                     ("w8a8", _regime_w8a8)]:
+                     ("w8a8", _regime_w8a8),
+                     # MoE in the min: the gate must SEE the weakest
+                     # regime (VERDICT r5's moe_reduce_rs debt), not
+                     # average it away behind the strong ones.
+                     ("moe", _regime_moe)]:
         with span("bench.regime", regime=name, world=world):
             regimes[name] = fn(mesh, world)
     with span("bench.regime", regime="decode_ll", world=world):

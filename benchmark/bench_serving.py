@@ -198,35 +198,61 @@ class SerialDriver:
 
 class ContinuousDriver:
     def __init__(self, model, params, args, buckets, layout="slots",
-                 prefix_cache=True):
+                 prefix_cache=True, cfg_overrides=None):
         from triton_distributed_tpu.serving import (
             ContinuousBatchingScheduler, Request, SchedulerConfig)
 
         self.Request = Request
         self.args = args
         self.layout = layout
+        cfg_kw = dict(num_slots=args.slots,
+                      max_queue=args.n_requests + 8,
+                      prefill_buckets=buckets,
+                      temperature=args.temperature,
+                      steps_per_sync=args.steps_per_sync,
+                      kv_layout=layout,
+                      page_size=args.page_size,
+                      prefix_cache=prefix_cache)
+        cfg_kw.update(cfg_overrides or {})
         # One clock everywhere: arrivals, TBT callbacks and the
         # scheduler's own timestamps all read perf_counter, so the
         # derived TTFT/makespan never mix clock epochs.
         self.sched = ContinuousBatchingScheduler(
-            model, params,
-            SchedulerConfig(num_slots=args.slots,
-                            max_queue=args.n_requests + 8,
-                            prefill_buckets=buckets,
-                            temperature=args.temperature,
-                            steps_per_sync=args.steps_per_sync,
-                            kv_layout=layout,
-                            page_size=args.page_size,
-                            prefix_cache=prefix_cache),
+            model, params, SchedulerConfig(**cfg_kw),
             clock=time.perf_counter)
         # Warm the per-bucket prefill/insert programs and the masked
         # step out of the measurement (prompt ids kept inside the
-        # vocab, same construction as SerialDriver's warm-up).
-        warm = [Request(prompt=list(np.arange(b) % (args.vocab - 1)
-                                    + 1),
-                        max_new_tokens=2)
+        # vocab, same construction as SerialDriver's warm-up).  A
+        # speculative engine additionally needs a verify round to
+        # compile: repetitive warm prompts guarantee the n-gram
+        # drafter proposes (a draft model proposes regardless), and
+        # the longer warm budget leaves it draft headroom.
+        spec = bool(cfg_kw.get("spec_k"))
+        # Spec warm streams must OUTLIVE a full verify round (max_new
+        # > k+1), or the continuing-row reconcile program compiles
+        # mid-measure — the warm asserts below catch a silent miss.
+        warm_new = 2 * cfg_kw.get("spec_k", 0) + 4 if spec else 2
+        warm = [Request(prompt=(list(np.arange(b) % 4 + 1) if spec
+                                else list(np.arange(b)
+                                          % (args.vocab - 1) + 1)),
+                        max_new_tokens=warm_new)
                 for b in buckets]
         self.sched.run(warm)
+        if spec:
+            assert self.sched._spec_proposed > 0, (
+                "speculative warm-up never took a verify dispatch — "
+                "the spec program would compile mid-measure")
+            # The PLAIN masked step is the spec engine's fallback
+            # (no proposals / near-horizon) — a max_new=1 request can
+            # never speculate (no draft budget), so this compiles it.
+            self.sched.run([Request(prompt=[1, 2, 3, 4],
+                                    max_new_tokens=1)])
+            # The warm workload is synthetic: its proposals must
+            # neither pre-trip nor pre-feed the accept-collapse
+            # throttle — measured traffic decides.
+            self.sched._spec_proposed = 0
+            self.sched._spec_accepted = 0
+            self.sched._spec_throttled = False
         self.sched.finished.clear()
         if layout == "paged":
             # The run(warm) admissions may have taken the SUFFIX path
@@ -254,8 +280,9 @@ class ContinuousDriver:
             return (0, 0)
         return (radix.hit_tokens, radix.miss_tokens)
 
-    def measure(self, schedule):
+    def measure(self, schedule, eos=None):
         args = self.args
+        eos_ids = (args.eos,) if eos is None else tuple(eos)
         last_token_t = {}
         tbt_s = []
 
@@ -268,7 +295,7 @@ class ContinuousDriver:
         h0, m0 = self._radix_stats()
         t0 = time.perf_counter()
         reqs = [self.Request(prompt=p, max_new_tokens=args.max_new,
-                             seed=s, eos_token_ids=(args.eos,),
+                             seed=s, eos_token_ids=eos_ids,
                              arrival_time=t0 + a, on_token=on_token)
                 for a, p, s in schedule]
         done = list(self.sched.run(reqs))   # copy: run() returns the
@@ -280,13 +307,37 @@ class ContinuousDriver:
         h1, m1 = self._radix_stats()
         out = {"makespan_s": last_finish - first_arrival,
                "useful_tokens": useful,
-               "ttft_s": [r.ttft for r in done], "tbt_s": tbt_s}
+               "ttft_s": [r.ttft for r in done], "tbt_s": tbt_s,
+               # token streams in SCHEDULE order (deterministic per
+               # (prompt, seed)): the spec section asserts exactness
+               # against the plain engine's
+               "streams": [list(r.generated) for r in reqs]}
         if (self.layout == "paged"
                 and getattr(self.sched.slots, "radix", None) is not None):
             hit, miss = h1 - h0, m1 - m0
             out["prefix_hit_rate"] = (hit / (hit + miss)
                                       if hit + miss else 0.0)
+        if self.sched.config.spec_k:
+            # keyed off the CONFIG, not the live drafter: a throttled
+            # engine releases its drafter mid-measure, and the row
+            # must still report the outcome that led there
+            prop = sum(r.spec_proposed for r in done)
+            acc = sum(r.spec_accepted for r in done)
+            out["spec_proposed"] = prop
+            out["spec_accepted"] = acc
+            out["spec_accept_rate"] = acc / prop if prop else 0.0
         return out
+
+    def accept_hist(self):
+        """Snapshot of the per-round accept-length histogram
+        (``serving_spec_accept_len``): (count, sum, buckets).  The
+        caller deltas two snapshots to get one trace's histogram."""
+        from triton_distributed_tpu.observability import get_registry
+        h = get_registry().snapshot().get("histograms", {}).get(
+            "serving_spec_accept_len")
+        if not h:
+            return 0, 0.0, {}
+        return h["count"], h["sum"], dict(h["buckets"])
 
 
 def pool_runs(runs):
@@ -303,18 +354,30 @@ def pool_runs(runs):
     if any("prefix_hit_rate" in r for r in runs):
         out["prefix_hit_rate"] = statistics.mean(
             r.get("prefix_hit_rate", 0.0) for r in runs)
+    if "streams" in runs[0]:
+        out["streams"] = runs[0]["streams"]
+    if any("spec_proposed" in r for r in runs):
+        prop = sum(r.get("spec_proposed", 0) for r in runs)
+        acc = sum(r.get("spec_accepted", 0) for r in runs)
+        out["spec_proposed"] = prop
+        out["spec_accepted"] = acc
+        out["spec_accept_rate"] = acc / prop if prop else 0.0
     return out
 
 
-def emit(mode, load, args, res, extra=None, trace=None):
+def emit(mode, load, args, res, extra=None, trace=None,
+         steps_per_sync=None, slots=None):
     from triton_distributed_tpu.observability import bench_record
 
     base = {"bench": "serving", "model": args.model, "mode": mode,
-            "slots": args.slots if mode != "serial" else 1,
+            "slots": (slots if slots is not None
+                      else args.slots if mode != "serial" else 1),
             "n_requests": args.n_requests, "max_new": args.max_new,
             "load_rps": load}
     if mode != "serial":
-        base["steps_per_sync"] = args.steps_per_sync
+        base["steps_per_sync"] = (args.steps_per_sync
+                                  if steps_per_sync is None
+                                  else steps_per_sync)
     if trace is not None:
         # identity dimension: shared-prefix rows never match the
         # default-trace rows in the regression gate
@@ -357,6 +420,16 @@ def main():
                     help="EOS id: streams end when sampling hits it")
     ap.add_argument("--page-size", type=int, default=16,
                     help="KV page size for the paged engine rows")
+    ap.add_argument("--spec-k", type=int, default=8,
+                    help="draft tokens per verify round for the "
+                         "speculative rows")
+    ap.add_argument("--spec-slots", type=int, default=4,
+                    help="engine slots for the speculative pairing "
+                         "(the LOW-concurrency latency regime "
+                         "speculation targets: at saturating batch, "
+                         "plain batching already fills the machine "
+                         "and trading extra draft/verify compute for "
+                         "tokens-per-dispatch rightly loses)")
     ap.add_argument("--sys-len", type=int, default=48,
                     help="shared system-prompt length for the "
                          "shared-prefix trace")
@@ -459,6 +532,115 @@ def main():
         "ttft_vs_slots": round(
             statistics.mean(paged["ttft_s"])
             / max(statistics.mean(cont["ttft_s"]), 1e-9), 3)})
+
+    # Speculative decoding: paired spec-vs-plain GREEDY engines on the
+    # identical trace, ABBA-interleaved like the serial-vs-continuous
+    # pairing.  The plain comparator syncs per token (steps_per_sync=1
+    # — the same EOS-check granularity speculation keeps: a verify
+    # round commits <= k+1 tokens and checks EOS every round; block
+    # mode trades that latency away, an orthogonal knob).  Greedy so
+    # the exactness row is meaningful — every driver must produce
+    # token-for-token identical streams (`spec_exact`, asserted here
+    # AND gated by check_bench_regression).  Two draft sources: the
+    # model-free n-gram drafter and a draft model (the toy drafts for
+    # itself here — on real hardware a tiny Qwen3 config,
+    # `ModelConfig.draft_of`, fills this slot; accept rate is then a
+    # property of the model pair, not of the machinery measured).
+    from triton_distributed_tpu.serving import BatchedDraftModelDrafter
+    load = float(args.loads.split(",")[0])
+    schedule = make_schedule(args.seed, args.n_requests, load,
+                             buckets, args.vocab)
+    greedy = dict(temperature=0.0, steps_per_sync=1,
+                  num_slots=args.spec_slots)
+    # The draft drafter is BATCHED (one masked rollout dispatch
+    # proposes for every slot — the per-request variant would pay
+    # `slots` sequential draft dispatches per round); the factory
+    # form gives it the scheduler's slot space.
+    draft_factory = lambda sched: BatchedDraftModelDrafter(  # noqa: E731
+        model, params, num_slots=sched.config.num_slots,
+        max_seq=sched.max_seq, prefill_buckets=eng_buckets)
+    spec_drivers = {
+        "plain": ContinuousDriver(
+            model, params, args, eng_buckets, cfg_overrides=greedy),
+        "spec_ngram": ContinuousDriver(
+            model, params, args, eng_buckets,
+            cfg_overrides=dict(greedy, spec_k=args.spec_k)),
+        "spec_draft": ContinuousDriver(
+            model, params, args, eng_buckets,
+            cfg_overrides=dict(greedy, spec_k=args.spec_k,
+                               spec_drafter=draft_factory)),
+    }
+    # Arm the accept-collapse throttle AFTER warm-up (a throttled
+    # engine releases its drafter for good — the synthetic warm
+    # workload must not be what pulls that trigger): measured
+    # traffic decides, and the committed row asserts it fired.
+    spec_drivers["spec_ngram"].sched.config.spec_min_accept = 0.3
+    runs = {m: [] for m in spec_drivers}
+    hists = {m: [0, 0.0, {}] for m in spec_drivers}
+    for mode in ("plain", "spec_ngram", "spec_draft",
+                 "spec_draft", "spec_ngram", "plain"):
+        drv = spec_drivers[mode]
+        c0, s0, b0 = drv.accept_hist()
+        # eos=(): speculation is a DECODE-length optimization, and
+        # the greedy toy hits the sampled-workload EOS id within a
+        # few tokens — the spec trace decodes full max_new streams
+        # (the long-generation regime the technique exists for).
+        runs[mode].append(drv.measure(schedule, eos=()))
+        c1, s1, b1 = drv.accept_hist()
+        hists[mode][0] += c1 - c0
+        hists[mode][1] += s1 - s0
+        for kk, v in b1.items():
+            hists[mode][2][kk] = (hists[mode][2].get(kk, 0)
+                                  + v - b0.get(kk, 0))
+    pooled = {m: pool_runs(rs) for m, rs in runs.items()}
+    plain = pooled["plain"]
+    emit("plain", load, args, plain, trace="spec_greedy",
+         steps_per_sync=1, slots=args.spec_slots)
+    for mode in ("spec_ngram", "spec_draft"):
+        res = pooled[mode]
+        exact = res["streams"] == plain["streams"]
+        assert exact, f"{mode} diverged from plain greedy streams"
+        speedup = res["tokens_per_s"] / plain["tokens_per_s"]
+        rounds, acc_sum, buckets = hists[mode]
+        extra = {
+            "spec_k": args.spec_k,
+            "spec_accept_rate": round(res["spec_accept_rate"], 4),
+            "spec_proposed": res["spec_proposed"],
+            "spec_accepted": res["spec_accepted"],
+            "spec_rounds": rounds,
+            # registry histograms bucket by ceil(log2(v)) with a
+            # large-negative sentinel for v <= 0: decode the keys to
+            # power-of-two UPPER BOUNDS before publishing ("0" =
+            # zero-accept rounds, "4" = accept length in (2, 4])
+            "accept_len_hist": {
+                k: v for k, v in sorted(
+                    ((("0" if int(kk) < 0 else str(2 ** int(kk))), c)
+                     for kk, c in buckets.items() if c),
+                    key=lambda kv: int(kv[0]))},
+            # Acceptance-weighted tokens per verify dispatch (1 +
+            # mean accept length): the tokens-per-model-step
+            # multiplier a memory-bound accelerator realizes.
+            "spec_tokens_per_step": round(
+                1.0 + acc_sum / rounds, 4) if rounds else None,
+            "speedup_vs_plain": round(speedup, 3),
+            "spec_exact": exact}
+        if mode == "spec_draft":
+            # The never-worse gate rides the draft pairing: its
+            # accept rate is a property of the measured machinery
+            # (the toy drafts for itself — greedy self-agreement is
+            # total), so a loss is a scheduling/dispatch regression.
+            extra["spec_beats_plain"] = speedup > 1.0
+        else:
+            # The n-gram drafter's accept rate is a property of the
+            # WORKLOAD (the toy's greedy streams are near-
+            # unpredictable); what the row asserts instead is the
+            # accept-collapse throttle: drafting must have shut
+            # itself off (spec_min_accept=0.3) and the wall cost of
+            # having probed must stay small.
+            extra["spec_throttled"] = bool(
+                spec_drivers[mode].sched._spec_throttled)
+        emit(mode, load, args, res, trace="spec_greedy",
+             steps_per_sync=1, slots=args.spec_slots, extra=extra)
 
     # Page-vs-slot admitted-concurrency sweep on the SAME KV budget
     # (the tentpole's capacity claim: >= 4x on short requests).

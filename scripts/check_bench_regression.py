@@ -58,6 +58,13 @@ MEASUREMENT_FIELDS = {
     "prefix_hit_rate", "prefix_hit_gt_90", "speedup_vs_slots",
     "ttft_vs_slots", "max_concurrent_slots", "max_concurrent_paged",
     "concurrency_vs_slots", "paged_4x_concurrency",
+    # Speculative-decoding rows (spec_greedy trace; gated by
+    # spec_checks: exactness must hold and the paired tok/s must
+    # never lose to the plain engine).
+    "spec_accept_rate", "spec_proposed", "spec_accepted",
+    "spec_rounds", "accept_len_hist", "spec_tokens_per_step",
+    "speedup_vs_plain", "spec_beats_plain", "spec_exact",
+    "spec_throttled",
     # Anomaly-baseline outputs attached by bench_record.
     "anomaly_z", "anomaly",
     # Closed-loop paired bench (bench_closed_loop.py): the chosen
@@ -242,6 +249,50 @@ def router_checks(fresh) -> tuple:
     return checked, fails
 
 
+def spec_checks(fresh) -> tuple:
+    """Gates specific to the speculative-decoding serving rows
+    (`benchmark/bench_serving.py` ``trace="spec_greedy"``):
+
+    - every row carrying ``spec_exact`` must report True —
+      speculative greedy output is TOKEN-FOR-TOKEN identical to the
+      non-speculative engine (this holds by construction of the
+      exact-match accept rule, so a failure is a rollback/key-chain
+      bug, not noise);
+    - every row carrying ``spec_beats_plain`` must report True — the
+      paired ABBA acceptance-weighted tok/s must beat the plain
+      per-token-sync engine on the committed trace.
+
+    Returns ``(n_checked, failures)``."""
+    fails = []
+    checked = 0
+    for rec in fresh:
+        if not any(f in rec for f in ("spec_exact",
+                                      "spec_beats_plain",
+                                      "spec_throttled")):
+            continue
+        checked += 1
+        if "spec_exact" in rec and rec.get("spec_exact") is not True:
+            fails.append(
+                f"spec regression: {rec.get('mode')} "
+                f"(k={rec.get('spec_k')}) streams diverged from the "
+                f"non-speculative greedy engine")
+        if ("spec_beats_plain" in rec
+                and rec.get("spec_beats_plain") is not True):
+            fails.append(
+                f"spec regression: {rec.get('mode')} "
+                f"(k={rec.get('spec_k')}, accept_rate="
+                f"{rec.get('spec_accept_rate')}) paired tok/s LOSES "
+                f"to the plain engine (speedup_vs_plain="
+                f"{rec.get('speedup_vs_plain')})")
+        if ("spec_throttled" in rec
+                and rec.get("spec_throttled") is not True):
+            fails.append(
+                f"spec regression: {rec.get('mode')} accept rate "
+                f"collapsed ({rec.get('spec_accept_rate')}) but the "
+                f"spec_min_accept throttle never fired")
+    return checked, fails
+
+
 def lineage_checks(fresh) -> tuple:
     """Gate specific to the request-lineage instrumentation
     (`observability.lineage`): every fresh row that carries a TTFT
@@ -360,12 +411,13 @@ def main() -> int:
     cl_checked, cl_fails = closed_loop_checks(fresh, base)
     rt_checked, rt_fails = router_checks(fresh)
     ln_checked, ln_fails = lineage_checks(fresh)
+    sp_checked, sp_fails = spec_checks(fresh)
 
     # Markdown summary: CI logs and PR comments read the same thing.
     print("## Bench regression check")
     print()
     verdict = ("FAIL" if regressions or cl_fails or rt_fails
-               or ln_fails else
+               or ln_fails or sp_fails else
                "OK (with anomalies)" if anomalies else "OK")
     print(f"**{verdict}** — {compared} row(s) compared, "
           f"{regressions} regression(s) beyond "
@@ -404,10 +456,18 @@ def main() -> int:
               f"{len(ln_fails)} failure(s).")
         for f in ln_fails:
             print(f"- {f}")
+    if sp_checked:
+        print()
+        print(f"Speculative gate: {sp_checked} row(s) checked "
+              f"(greedy exactness + paired never-worse tok/s), "
+              f"{len(sp_fails)} failure(s).")
+        for f in sp_fails:
+            print(f"- {f}")
     if (compared == 0 and cl_checked == 0 and rt_checked == 0
-            and ln_checked == 0):
+            and ln_checked == 0 and sp_checked == 0):
         return 2
-    return 1 if regressions or cl_fails or rt_fails or ln_fails else 0
+    return 1 if (regressions or cl_fails or rt_fails or ln_fails
+                 or sp_fails) else 0
 
 
 if __name__ == "__main__":

@@ -188,11 +188,22 @@ class smut_use_after_donate(SM.ServingHarness):
         # (missing) self.kv.cache = cache.successor()
 
 
+class smut_spec_no_rollback(SM.ServingHarness):
+    """A rejected speculative tail never rolls the KV write cursor /
+    page mapping back: the slot keeps pages mapped for KV that was
+    never committed (and the next plain engine state diverges from
+    what an accepted-prefix-only decode would hold)."""
+
+    def _rollback(self, slot, keep_positions):
+        pass                                  # (missing) kv.rollback
+
+
 SERVING_CORPUS = [
     (smut_pool_double_free, FindingKind.DOUBLE_FREE),
     (smut_release_leaks_pages, FindingKind.REFCOUNT_LEAK),
     (smut_share_cap_off_by_one, FindingKind.WRITE_SHARED_PAGE),
     (smut_use_after_donate, FindingKind.USE_AFTER_DONATE),
+    (smut_spec_no_rollback, FindingKind.SPEC_ROLLBACK),
 ]
 
 

@@ -76,6 +76,38 @@ def test_preemption_path_keeps_invariants():
     assert not h.findings
 
 
+def test_spec_op_full_reject_rolls_back():
+    """A verify dispatch with every draft rejected commits ONE token
+    and must leave the mapping exactly one-plain-step ahead — pool
+    free count and table identical to a plain decode's."""
+    h = _harness()
+    h.apply(("admit", 0))
+    twin = copy.deepcopy(h)
+    h.apply(("spec", 0))                  # K+1 writes, all rejected
+    twin.apply(("decode",))               # the plain engine's step
+    assert not h.findings and SM.audit_state(h) == []
+    assert h.kv.pool.free_pages == twin.kv.pool.free_pages
+    assert (h.kv._table == twin.kv._table).all()
+    assert h.active[0][2] == twin.active[0][2] == 1
+
+
+def test_spec_op_full_accept_commits_block():
+    h = _harness()
+    h.apply(("admit", 2))                 # max_new 3: spec_k=2 fits
+    h.apply(("spec", 2))                  # accepts 2 + bonus = 3
+    assert not h.findings
+    assert h.done == [2]                  # hit its budget, retired
+    assert SM.audit_state(h) == []
+
+
+def test_spec_op_interleaves_with_eviction():
+    h = _harness()
+    h.apply(("admit", 0))
+    h.apply(("spec", 1))
+    h.apply(("evict",))
+    assert not h.findings and SM.audit_state(h) == []
+
+
 def test_evict_op_keeps_invariants():
     h = _harness()
     h.apply(("admit", 0))

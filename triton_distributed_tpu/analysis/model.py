@@ -107,6 +107,11 @@ class FindingKind(enum.Enum):
     #: A donated cache/keys buffer is used after the dispatch that
     #: consumed it (XLA has already reused the memory).
     USE_AFTER_DONATE = "use_after_donate"
+    #: A rejected speculative tail left the KV write cursor / page
+    #: mapping ahead of the committed stream: after a verify dispatch
+    #: the slot must map exactly the pages a plain engine that decoded
+    #: only the accepted prefix would hold (`PagedKV.rollback`).
+    SPEC_ROLLBACK = "spec_rollback"
 
 
 @dataclasses.dataclass(frozen=True)

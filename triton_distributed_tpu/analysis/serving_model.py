@@ -13,7 +13,10 @@ cache replace the jitted device path, so every transition is pure
 host Python) through every interleaving of
 ``admit / decode / retire(EOS) / preempt / evict`` reachable within a
 small scope — a few requests with shared prefixes, a pool of a few
-pages — and audits four invariant families after every transition:
+pages — and audits five invariant families after every transition
+(the op set includes ``("spec", a)`` speculative verify dispatches at
+both accept extremes, so every rollback interleaves with admission,
+eviction and preemption):
 
 - **Refcount conservation** (`refcount_leak`): each page's physical
   refcount must equal its holders — private slot pages + acquired
@@ -29,6 +32,11 @@ pages — and audits four invariant families after every transition:
 - **Donation discipline** (`use_after_donate`): the cache/keys handles
   consumed by a dispatch (`engine_batched`'s ``donate_argnums``) must
   never be used again; the stub cache trips on any post-donation use.
+- **Speculative rollback** (`spec_rollback`): after a verify dispatch
+  (K+1 writes, ``accept`` drafts kept) the slot must map EXACTLY the
+  pages a plain engine that decoded only the accepted prefix would
+  hold — a rejected tail must leave refcounts, page tables and the
+  free list as if it never happened (`PagedKV.rollback`).
 
 Findings reuse `analysis.model.Finding`, the CLI exposes the check as
 ``python -m triton_distributed_tpu.analysis --check serving``, and the
@@ -129,6 +137,10 @@ class ModelScope:
     page_size: int = 2
     max_seq: int = 12
     prefix_cache: bool = True
+    #: Speculative verify width explored by the ``("spec", a)`` ops
+    #: (a ∈ {0, spec_k} — full rejection and full acceptance, the
+    #: rollback extremes).  0 disables the spec transitions.
+    spec_k: int = 2
 
 
 def default_scope() -> ModelScope:
@@ -258,12 +270,13 @@ class ServingHarness:
         self._release_slot(slot)
         self.queued[rid] = (tokens, remaining - gen)
 
-    def _prepare_pages(self) -> bool:
+    def _prepare_pages(self, writes: int = 1) -> bool:
         while True:
             ok = True
             for slot in sorted(self.active):
                 rid, s, gen, remaining, horizon, _ = self.active[slot]
-                need = min(s + gen, horizon, self.scope.max_seq)
+                need = min(s + gen + writes - 1, horizon,
+                           self.scope.max_seq)
                 if not self.kv.ensure(slot, need):
                     ok = False
                     break
@@ -287,40 +300,83 @@ class ServingHarness:
         cache.donated = True
         self.kv.cache = cache.successor()
 
+    def _check_write(self, slot: int, pos: int, horizon: int,
+                     what: str) -> None:
+        """One KV write at absolute position ``pos``: must land in a
+        private refcount-1 page, or fall through NULL only at/above
+        the horizon."""
+        from triton_distributed_tpu.models.kv_cache import NULL_PAGE
+        ps = self.scope.page_size
+        phys = int(self.kv._table[slot, pos // ps])
+        if phys == NULL_PAGE:
+            if pos < horizon:
+                self._flag(
+                    FindingKind.NULL_PAGE_WRITE,
+                    f"{what} write at position {pos} (below the "
+                    f"request horizon {horizon}) falls through a "
+                    f"NULL page-table entry — KV silently dropped")
+        else:
+            refs = int(self.kv.pool.refs[phys])
+            private = phys in self.kv._slot_pages[slot]
+            if refs != 1 or not private:
+                self._flag(
+                    FindingKind.WRITE_SHARED_PAGE,
+                    f"{what} write at position {pos} lands in "
+                    f"physical page {phys} (refcount {refs}, "
+                    f"private={private}) — violates the pages-"
+                    f"strictly-below-s-1 sharing invariant")
+
     def decode(self) -> None:
         if not self._prepare_pages():
             return
         self.kv.flush()
         self._dispatch()
-        from triton_distributed_tpu.models.kv_cache import NULL_PAGE
-        ps = self.scope.page_size
         for slot in sorted(self.active):
             row = self.active[slot]
             rid, s, gen, remaining, horizon, _ = row
             pos = s + gen - 1            # the step's KV write position
-            phys = int(self.kv._table[slot, pos // ps])
-            if phys == NULL_PAGE:
-                if pos < horizon:
-                    self._flag(
-                        FindingKind.NULL_PAGE_WRITE,
-                        f"decode write at position {pos} (below the "
-                        f"request horizon {horizon}) falls through a "
-                        f"NULL page-table entry — KV silently dropped")
-            else:
-                refs = int(self.kv.pool.refs[phys])
-                private = phys in self.kv._slot_pages[slot]
-                if refs != 1 or not private:
-                    self._flag(
-                        FindingKind.WRITE_SHARED_PAGE,
-                        f"decode write at position {pos} lands in "
-                        f"physical page {phys} (refcount {refs}, "
-                        f"private={private}) — violates the pages-"
-                        f"strictly-below-s-1 sharing invariant")
+            self._check_write(slot, pos, horizon, "decode")
             row[2] += 1
         # auto-retire rows that hit their horizon
         for slot in [sl for sl, r in self.active.items()
                      if r[2] >= r[3]]:
             self.retire(slot)
+
+    def spec_decode(self, accept: int) -> None:
+        """One speculative verify dispatch: K proposed tokens + the
+        bonus position scored in one program (K+1 writes per active
+        row), every row accepting ``accept`` drafts (capped at its
+        own remaining budget) and committing ``accept+1`` tokens; the
+        rejected tail's pages must roll back
+        (`scheduler._spec_outcome` → `PagedKV.rollback`).  Exploring
+        accept at both extremes over every interleaving models "any
+        draft agreement the drafters could produce"."""
+        K = self.scope.spec_k
+        if not self._prepare_pages(writes=K + 1):
+            return
+        self.kv.flush()
+        self._dispatch()
+        for slot in sorted(self.active):
+            row = self.active[slot]
+            rid, s, gen, remaining, horizon, _ = row
+            for j in range(K + 1):       # the verify pass's writes
+                self._check_write(slot, s + gen - 1 + j, horizon,
+                                  "spec verify")
+            # the scheduler's cap is the REMAINING budget
+            # (max_new - generated - 1), so the model never commits
+            # past a budget the real engine would have retired at
+            a = min(int(accept), remaining - gen - 1, K)
+            row[2] += a + 1
+            # the scheduler's rollback target: pages covering
+            # [0, min(offset', horizon)), offset' = off0 + a + 1
+            self._rollback(slot, min(s + row[2] - 1, horizon))
+        for slot in [sl for sl, r in self.active.items()
+                     if r[2] >= r[3]]:
+            self.retire(slot)
+
+    def _rollback(self, slot: int, keep_positions: int) -> None:
+        """Mutation seam: the real `PagedKV.rollback`."""
+        self.kv.rollback(slot, keep_positions)
 
     def retire(self, slot: int) -> None:
         rid = self.active[slot][0]
@@ -343,6 +399,17 @@ class ServingHarness:
                 out.append(("admit", rid))
         if self.active:
             out.append(("decode",))
+            K = self.scope.spec_k
+            if K and all(
+                    self.scope.max_seq - r[1] - r[2] + 1 >= K + 1
+                    for r in self.active.values()):
+                # Spec is available only with K+1 writes of max_seq
+                # headroom on every row (the scheduler's exact
+                # near-horizon fallback).  Full rejection and full
+                # acceptance — the rollback extremes; intermediates
+                # differ only in magnitude.
+                out.append(("spec", 0))
+                out.append(("spec", K))
             for slot in sorted(self.active):
                 if self.active[slot][2] >= 1:
                     out.append(("retire", slot))
@@ -355,6 +422,8 @@ class ServingHarness:
             self.admit(op[1])
         elif op[0] == "decode":
             self.decode()
+        elif op[0] == "spec":
+            self.spec_decode(op[1])
         elif op[0] == "retire":
             self.retire(op[1])
         elif op[0] == "evict":
@@ -427,6 +496,27 @@ def audit_state(harness: ServingHarness) -> List[Finding]:
                      f"radix node for page {node.page} counts "
                      f"{node.refs} live request(s) but {held} slot "
                      f"path(s) actually hold it")
+
+    # Mapping-extent invariant (the speculative-rollback audit): an
+    # active slot must map exactly the pages a plain engine at its
+    # committed position would hold — pages covering
+    # [0, min(max(s, s+gen-1), horizon)).  More is a rejected verify
+    # tail whose cursor was never rolled back (pages pinned for KV
+    # that never happened); less is a mapping hole below the cursor.
+    from triton_distributed_tpu.models.kv_cache import pages_for
+    for slot, row in harness.active.items():
+        rid, s, gen, remaining, horizon, _ = row
+        expect = pages_for(min(max(s, s + gen - 1), horizon),
+                           harness.scope.page_size)
+        mapped = int(kv._mapped[slot])
+        if mapped != expect:
+            what = ("ahead of" if mapped > expect else "behind")
+            flag(FindingKind.SPEC_ROLLBACK,
+                 f"slot {slot} (request {rid}) maps {mapped} page(s) "
+                 f"but its committed stream (s={s}, gen={gen}) "
+                 f"needs exactly {expect} — the page mapping is "
+                 f"{what} the committed KV cursor (speculative "
+                 f"rollback broken)")
 
     free = list(pool._free)
     free_set = set(free)

@@ -56,6 +56,29 @@ class ModelConfig:
                    head_dim=128, tie_word_embeddings=False)
 
     @classmethod
+    def draft_of(cls, target: "ModelConfig", **kw):
+        """A cheap DRAFT model beside ``target`` for speculative
+        decoding (`serving.speculative.DraftModelDrafter`): same
+        vocabulary (the draft must share the target's tokenizer —
+        proposals are token ids), same sequence capacity and dtype,
+        but a fraction of the depth/width, so one draft step costs a
+        small slice of a target step.  Defaults give a ~0.1B-class
+        drafter beside the 0.6B–32B Qwen3 configs; override any field
+        via ``kw``."""
+        d = dict(architecture=target.architecture,
+                 vocab_size=target.vocab_size,
+                 hidden_size=512, intermediate_size=1536,
+                 num_layers=4, num_heads=8, num_kv_heads=4,
+                 head_dim=64, rms_norm_eps=target.rms_norm_eps,
+                 rope_theta=target.rope_theta,
+                 tie_word_embeddings=True,
+                 max_seq_len=target.max_seq_len,
+                 dtype=target.dtype,
+                 quantize_kv_cache=target.quantize_kv_cache)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
     def tiny(cls, **kw):
         """Test-size config."""
         d = dict(vocab_size=256, hidden_size=128, intermediate_size=256,

@@ -832,6 +832,26 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
             "pressure": float(occ) >= PAGE_PRESSURE_OCCUPANCY,
         })
 
+    # Speculative-decoding health (the accept-rate gauge rides the
+    # heartbeats): a collapsed accept rate means verify dispatches
+    # burn K+1 model steps to commit ~1 token — the draft source has
+    # stopped predicting this workload and speculation should be
+    # retuned or disabled.  Section (and verdict note) only exist
+    # when the gauge is present, so non-speculative incidents'
+    # reports are byte-identical to before.
+    spec_health = []
+    for rank, row in sorted(rank_table.items(),
+                            key=lambda kv: int(kv[0])):
+        sv = row.get("serving") or {}
+        rate = sv.get("serving_spec_accept_rate")
+        if rate is None:
+            continue
+        spec_health.append({
+            "rank": int(rank),
+            "accept_rate": round(float(rate), 4),
+            "collapsed": float(rate) < SPEC_ACCEPT_COLLAPSE,
+        })
+
     in_flight = stall.pop("in_flight_event", None)
     report = {
         "schema": REPORT_SCHEMA,
@@ -858,6 +878,8 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
     }
     if page_pressure:
         report["page_pressure"] = page_pressure
+    if spec_health:
+        report["spec"] = spec_health
     # Key absent unless the resource consult ran (opt-in / findings
     # file) — golden incident reports stay byte-identical.
     if resource_out is not None:
@@ -889,6 +911,11 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
 #: Page occupancy at/above which doctor calls out KV page pressure.
 PAGE_PRESSURE_OCCUPANCY = 0.9
 
+#: Speculative accept rate below which the doctor calls out a
+#: collapse: each verify dispatch then spends K+1 model steps to
+#: commit barely more than 1 token.
+SPEC_ACCEPT_COLLAPSE = 0.3
+
 
 def _verdict(report: dict, in_flight: Optional[dict]) -> str:
     stall = report["stall"]
@@ -904,6 +931,15 @@ def _verdict(report: dict, in_flight: Optional[dict]) -> str:
         hot_s += (f"; KV page pressure on rank {worst['rank']} "
                   f"({worst['page_occupancy']:.0%} of pages in use, "
                   f"{worst['pages_free']} free)")
+    collapsed = [e for e in report.get("spec", [])
+                 if e["collapsed"]]
+    if collapsed:
+        worst = min(collapsed, key=lambda e: e["accept_rate"])
+        hot_s += (f"; speculative accept rate collapsed on rank "
+                  f"{worst['rank']} ({worst['accept_rate']:.0%} < "
+                  f"{SPEC_ACCEPT_COLLAPSE:.0%} — verify dispatches "
+                  f"are burning draft steps for ~1 token; retune or "
+                  f"disable the drafter)")
     # Cluster failovers: name the failed replica(s) in the verdict
     # (clause only exists when a router artifact was ingested).
     failover_s = ""
@@ -1041,6 +1077,16 @@ def render_markdown(report: dict) -> str:
                 f"| {e['pages_free'] if e['pages_free'] is not None else '-'} "
                 f"| {e['prefix_cache_pages'] if e['prefix_cache_pages'] is not None else '-'} "
                 f"| {'PRESSURE' if e['pressure'] else 'ok'} |")
+        lines.append("")
+
+    spec = report.get("spec")
+    if spec:
+        lines += ["## Speculative decoding", "",
+                  "| rank | accept rate | state |", "|---|---|---|"]
+        for e in spec:
+            lines.append(
+                f"| {e['rank']} | {e['accept_rate']:.0%} "
+                f"| {'COLLAPSED' if e['collapsed'] else 'ok'} |")
         lines.append("")
 
     stall = report["stall"]

@@ -292,7 +292,12 @@ _HEARTBEAT_GAUGES = ("serving_queue_depth", "serving_active_slots",
                      # replicas from these heartbeat fields when it
                      # has no in-process snapshot
                      # (serving.cluster.router.heartbeat_signals).
-                     "serving_decode_step_us")
+                     "serving_decode_step_us",
+                     # Speculative-decoding accept rate (absent until
+                     # the first verify round, so non-speculative
+                     # heartbeat bodies are byte-identical): the
+                     # doctor calls out a collapse below 0.3.
+                     "serving_spec_accept_rate")
 
 
 def heartbeat_payload() -> dict:

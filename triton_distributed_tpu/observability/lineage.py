@@ -88,6 +88,9 @@ HOPS = (
     "reroute",       # bounded retry exhausted; back to the router
     "admit",         # decode admission (detail.mode: local |
                      #   shipped | suffix; detail.resumed on resume)
+    "spec_verify",   # speculative verify dispatch (detail.proposed /
+                     #   detail.accepted) — names draft/verify cost in
+                     #   ttft_breakdown / TBT attribution
     "preempt",       # page pool dry: evicted mid-stream (resumes)
     "failover",      # replica drained; record re-queued with resume
     "first_token",   # the TTFT endpoint
@@ -103,6 +106,11 @@ TERMINAL_HOPS = ("retire", "reject")
 #: Hops that explain a TBT spike when they land inside the gap.
 _STALL_HOPS = ("preempt", "failover", "ship_retry", "reroute",
                "ship_nack")
+
+#: Second-tier explanation: a verify round inside the gap (spec mode
+#: records one per dispatch, so it only names a spike no lifecycle
+#: stall explains — "the draft/verify dispatch itself was the cost").
+_SPEC_HOPS = ("spec_verify",)
 
 #: Fields every lineage.jsonl line must carry (doctor/CI validation).
 LINEAGE_FIELDS = ("schema", "kind", "ts", "rank", "request_id", "hop",
@@ -524,8 +532,11 @@ def attribute_tbt(events, token_times: Sequence[float],
     lineage rides).  A gap larger than ``spike_ratio`` × the median
     gap is a spike; it is attributed to the stall hop (preempt /
     failover / ship_retry / reroute / ship_nack) whose event lands
-    inside it, else to ``step_time`` (the decode step itself got
-    slow).  Deterministic given the inputs."""
+    inside it, else — speculative mode — to a ``spec_verify`` round
+    inside it (the draft/verify dispatch itself was the cost; verify
+    hops are second-tier because every spec dispatch records one),
+    else to ``step_time`` (the decode step itself got slow).
+    Deterministic given the inputs."""
     gaps: List[Tuple[int, float, float, float]] = []
     for i in range(1, len(token_times)):
         a, b = float(token_times[i - 1]), float(token_times[i])
@@ -536,6 +547,8 @@ def attribute_tbt(events, token_times: Sequence[float],
     median = durs[(len(durs) - 1) // 2]
     stalls = [(_ts_of(e), _hop_of(e)) for e in events
               if _hop_of(e) in _STALL_HOPS]
+    verifies = [(_ts_of(e), _hop_of(e)) for e in events
+                if _hop_of(e) in _SPEC_HOPS]
     spikes = []
     for i, dur, a, b in gaps:
         if median > 0 and dur <= spike_ratio * median:
@@ -547,6 +560,11 @@ def attribute_tbt(events, token_times: Sequence[float],
             if a < ts <= b:
                 cause = hop
                 break
+        else:
+            for ts, hop in verifies:
+                if a < ts <= b:
+                    cause = hop
+                    break
         spikes.append({"token": i, "gap_ms": round(dur * 1e3, 6),
                        "cause": cause})
     return {"gaps": len(gaps),

@@ -15,6 +15,7 @@ from triton_distributed_tpu.serving.engine_batched import (  # noqa: F401
     make_masked_step_fn,
     make_paged_insert_fn,
     make_rollout_fn,
+    make_spec_verify_fn,
     make_step_fn,
     masked_sample,
     pad_prompt,
@@ -38,6 +39,12 @@ from triton_distributed_tpu.serving.scheduler import (  # noqa: F401
     SchedulerConfig,
 )
 from triton_distributed_tpu.serving.slots import SlotKV  # noqa: F401
+from triton_distributed_tpu.serving.speculative import (  # noqa: F401
+    BatchedDraftModelDrafter,
+    Drafter,
+    DraftModelDrafter,
+    NgramDrafter,
+)
 from triton_distributed_tpu.serving.toy import (  # noqa: F401
     ToyConfig,
     ToyModel,

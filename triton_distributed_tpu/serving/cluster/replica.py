@@ -25,9 +25,13 @@ Exact resume is host-side arithmetic, not device state: a slot's PRNG
 key after ``g`` generated tokens is ``split^g(PRNGKey(seed))[0]``
 (`engine_batched._split_rows` advances active rows once per executed
 step, and an in-flight request's executed steps == its streamed
-tokens), so :func:`advance_request_key` recomputes the resume key from
+tokens).  Speculative decoding keeps the accounting: the verify pass
+splits a row's key once per SCANNED position but rolls the chain back
+to exactly one split per EMITTED token (drafters consume no slot keys
+at all), so :func:`advance_request_key` recomputes the resume key from
 the router's mirrored token count alone — a DEAD replica's requests
-resume bit-exactly with nothing salvaged from the corpse.
+resume bit-exactly with nothing salvaged from the corpse, with or
+without speculation in flight.
 """
 
 from __future__ import annotations

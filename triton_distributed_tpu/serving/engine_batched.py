@@ -28,6 +28,13 @@ the tail), so no gather-at-true-length correction pass is needed.
 `Engine` builds its unmasked single-batch step/rollout from the same
 `make_step_fn`/`make_rollout_fn`, keeping one sampling/step
 composition for both the static-batch and continuous paths.
+
+A fourth program, `make_spec_verify_fn`, extends the masked block
+variant into a speculative draft–verify pass: K proposed tokens per
+slot are scored in one scanned dispatch, emitting a per-row
+accept-length plus the bonus token, with the rejected tail's KV
+cursor and PRNG key chain rolled back in-program (drafters live in
+`serving.speculative`; the scheduler's ``spec_k`` mode drives it).
 """
 
 from __future__ import annotations
@@ -182,6 +189,89 @@ def make_masked_block_fn(decode_fn, temperature: float = 0.0,
     if donate:
         return jax.jit(blockstep, donate_argnums=(2, 3))
     return jax.jit(blockstep)
+
+
+def make_spec_verify_fn(decode_fn, temperature: float = 0.0,
+                        top_k: int = 0, top_p: float = 1.0,
+                        pad_id: int = 0, k: int = 4,
+                        donate: bool = True):
+    """Speculative draft–verify pass: score ``k`` PROPOSED tokens per
+    slot in one scanned dispatch and emit a per-row accept-length plus
+    the bonus token, with the rejected tail's KV write cursor and PRNG
+    key chain rolled back inside the program.
+
+    ``(params, tokens (B,), drafts (B, k), cache, keys (B, 2),
+    active (B,) bool, n_draft (B,)) ->
+    (targets (B, k+1), accept (B,), cache, keys)``
+
+    This is the masked K-step block variant re-pointed at a proposal
+    block: the scan feeds ``[prev_token, d_1, ..., d_k]`` instead of
+    its own samples, so step ``j`` scores the target model's token
+    choice for position ``j`` under the PROPOSED context.  Each step
+    samples (or argmaxes, at temperature 0) with the row's own key
+    chain — exactly the tokens the non-speculative engine would have
+    emitted had the context matched.  The accept rule is exact-match
+    verification: row ``b`` accepts the longest prefix of its drafts
+    where ``targets[b, j] == drafts[b, j]`` (capped at ``n_draft[b]``),
+    and emits ``accept + 1`` tokens — the accepted drafts plus the
+    target's own token at the first mismatch (the correction), or the
+    bonus token when everything matched.  Because every emitted token
+    IS the target's sample under its true context and key chain, the
+    emitted stream is token-for-token identical to the non-speculative
+    engine at ANY temperature, not just greedy — rejection changes how
+    many tokens a dispatch commits, never which tokens.
+
+    Rollback (the invariant `analysis.serving_model` proves): the scan
+    wrote KV for all ``k+1`` fed tokens and split every row's key
+    ``k+1`` times, but only the accepted prefix happened.  The program
+    therefore restores ``offset = off0 + accept + 1`` (rejected
+    positions hold garbage KV above the cursor — never attended before
+    the next step overwrites them, the same masking argument that
+    makes `KVCache.reset_slot` free) and selects the key state after
+    exactly ``accept + 1`` splits from the scan's stacked key history,
+    so a slot's key chain advances ONE SPLIT PER EMITTED TOKEN — the
+    accounting `cluster.replica.advance_request_key` relies on for
+    bit-exact failover resume.  Paged mode additionally unmaps the
+    pages the rejected tail reached (`serving.pages.PagedKV.rollback`,
+    host-side).  Masked rows behave as in the masked step: pad tokens,
+    frozen offsets, frozen keys, ``accept = 0``.
+    """
+    assert k >= 1, k
+    body = _masked_body(decode_fn, temperature, top_k, top_p, pad_id)
+
+    def verify(params, tokens, drafts, cache, keys, active, n_draft):
+        off0 = cache.offset
+        keys0 = keys
+
+        def scan_body(carry, tok):
+            cache, keys = carry
+            nxt, cache, keys = body(params, tok, cache, keys, active)
+            return (cache, keys), (nxt, keys)
+
+        feed = jnp.concatenate(
+            [tokens[:, None], drafts.astype(jnp.int32)], axis=1)
+        (cache, _), (targets, key_stack) = jax.lax.scan(
+            scan_body, (cache, keys0), feed.T)
+        targets = targets.T                         # (B, k+1)
+        match = ((targets[:, :k] == drafts)
+                 & (jnp.arange(k)[None, :] < n_draft[:, None]))
+        # leading-match count: cumprod zeroes everything after the
+        # first mismatch, so the sum is the accepted prefix length
+        accept = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(
+            axis=1)
+        accept = jnp.where(active, accept, 0)
+        cache = dataclasses.replace(
+            cache, offset=jnp.where(active, off0 + accept + 1, off0))
+        rows = jnp.arange(targets.shape[0])
+        # key state after exactly accept+1 splits (key_stack[j] is the
+        # keys AFTER step j)
+        keys = jnp.where(active[:, None], key_stack[accept, rows],
+                         keys0)
+        return targets, accept, cache, keys
+
+    if donate:
+        return jax.jit(verify, donate_argnums=(3, 4))
+    return jax.jit(verify)
 
 
 def _split_rows(keys):

@@ -588,6 +588,41 @@ class PagedKV:
             self._dirty = True
         return True
 
+    def rollback(self, slot: int, keep_positions: int) -> None:
+        """Shrink slot ``slot``'s mapping to cover exactly KV
+        positions ``[0, keep_positions)`` — the speculative-rollback
+        path: a verify dispatch mapped (and wrote) pages for K+1
+        positions, but only the accepted prefix happened, so the
+        pages the rejected tail reached must unmap and free.  After
+        this, refcounts, the page table and the free list are exactly
+        what a plain engine that decoded only the accepted prefix
+        would hold (`analysis.serving_model` proves the invariant;
+        `FindingKind.SPEC_ROLLBACK` is the violation).
+
+        Only PRIVATE pages can ever be unmapped here: generation
+        positions lie beyond the prompt, so ``keep_positions >=
+        prompt_len`` keeps every shared/radix-registered page (and
+        the whole prompt mapping) untouched.  The freed pages hold
+        garbage KV from the rejected writes — never read: a future
+        owner's attention masks ``>= offset`` and its own writes
+        precede its reads, the same argument that makes `release`'s
+        data-left-in-place free."""
+        keep = pages_for(keep_positions, self.page_size)
+        assert keep >= len(self._slot_path[slot]), (
+            keep, len(self._slot_path[slot]))
+        while self._mapped[slot] > keep:
+            j = int(self._mapped[slot]) - 1
+            p = int(self._table[slot, j])
+            assert p != NULL_PAGE, (slot, j)
+            assert (self._slot_pages[slot]
+                    and self._slot_pages[slot][-1] == p), (
+                "rollback reached a non-private page")
+            self._slot_pages[slot].pop()
+            self.pool.decref([p])
+            self._table[slot, j] = NULL_PAGE
+            self._mapped[slot] = j
+            self._dirty = True
+
     def flush(self) -> None:
         """Re-ship the host page table to the device cache if any
         allocation/release changed it since the last dispatch."""

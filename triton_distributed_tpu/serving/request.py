@@ -82,6 +82,13 @@ class Request:
     resume_tokens: Optional[List[int]] = None
     resume_key: Optional[object] = None
     preemptions: int = 0
+    #: Speculative-decoding outcome (``SchedulerConfig.spec_k``):
+    #: draft tokens proposed for / accepted by this request's verify
+    #: rounds.  ``spec_accepted / spec_proposed`` is the per-request
+    #: accept rate the bench rows report; both stay 0 on the
+    #: non-speculative path.
+    spec_proposed: int = 0
+    spec_accepted: int = 0
     #: Request-lineage join key (`observability.lineage`): the id
     #: every hop this request crosses is recorded under.  The cluster
     #: sets it to the `ClusterRequest.record_id` so one user request's
@@ -162,4 +169,6 @@ class Request:
             "ttft_s": self.ttft,
             "latency_s": self.latency,
             "preemptions": self.preemptions,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
         }

@@ -10,8 +10,14 @@ Every `step()` is one scheduler iteration:
    join mid-flight; nobody waits for the batch to drain);
 2. **decode** — ONE jitted masked step for all slots
    (`engine_batched.make_masked_step_fn`); free/finished slots emit
-   the pad id and don't advance offsets or RNG keys.  Paged mode
-   first maps pages for the positions this dispatch writes
+   the pad id and don't advance offsets or RNG keys.  With
+   ``spec_k`` set, the dispatch is a speculative draft–verify round
+   instead (`make_spec_verify_fn` + `serving.speculative` drafters):
+   K proposed tokens scored in one scanned program, the accepted
+   prefix + bonus token committed per row, the rejected tail's KV
+   cursor / pages / key chain rolled back — token-for-token
+   identical output, ``1 + E[accept]`` tokens per dispatch.  Paged
+   mode first maps pages for the positions this dispatch writes
    (`PagedKV.ensure`), preempting the newest request — resumed later,
    bit-exactly — if the pool is dry even after LRU-evicting
    unreferenced prefix pages;
@@ -48,6 +54,7 @@ from triton_distributed_tpu.serving.engine_batched import (
     DEFAULT_PREFILL_BUCKETS,
     make_masked_block_fn,
     make_masked_step_fn,
+    make_spec_verify_fn,
     pad_prompt,
     pick_bucket,
     request_key,
@@ -114,6 +121,40 @@ class SchedulerConfig:
     #: relative to it (small models, CPU).  Pre-EOS tokens are
     #: identical either way.
     steps_per_sync: int = 1
+    #: Speculative decoding: draft–verify ``spec_k`` proposed tokens
+    #: per decode dispatch (`engine_batched.make_spec_verify_fn`).
+    #: 0 = off.  With it on, each dispatch scores K proposals + the
+    #: bonus position in one scanned program and commits the accepted
+    #: prefix plus one token — on average ``1 + E[accept]`` tokens per
+    #: target-model dispatch, with the rejected tail's KV cursor and
+    #: key chain rolled back so output is TOKEN-FOR-TOKEN identical to
+    #: the non-speculative engine at any temperature (the accept rule
+    #: is exact-match verification — see docs/serving.md).  Mutually
+    #: exclusive with ``steps_per_sync > 1`` (speculation IS the
+    #: multi-token dispatch; EOS is checked every round).  Rows
+    #: without a proposal this round (or near their KV horizon) fall
+    #: back to the plain masked step, bit-identically.
+    spec_k: int = 0
+    #: Draft source when ``spec_k > 0``: ``"ngram"``/None for the
+    #: model-free prompt-lookup drafter, a
+    #: `serving.speculative.Drafter` instance (e.g.
+    #: `DraftModelDrafter` wrapping a tiny model that shares the
+    #: target's tokenizer — shareable across a cluster's replicas;
+    #: state is keyed by request id), or a CALLABLE factory receiving
+    #: the scheduler (how each replica gets its own
+    #: `BatchedDraftModelDrafter` over its slot space).
+    spec_drafter: Optional[object] = None
+    #: Accept-rate floor: when the cumulative accept rate falls below
+    #: this after ``spec_probe_tokens`` proposals, drafting is
+    #: DISABLED for the scheduler's lifetime (every dispatch falls
+    #: back to the plain masked step, bit-identically) and the
+    #: throttle is recorded as a DecisionEvent — the runtime half of
+    #: the doctor's accept-collapse note: a verify round burns K+1
+    #: model steps to commit ~1 token when the draft source has
+    #: stopped predicting the workload.  0 (default) never throttles.
+    spec_min_accept: float = 0.0
+    #: Proposals to observe before `spec_min_accept` may trigger.
+    spec_probe_tokens: int = 64
     #: SLO-aware admission (closed loop, `observability.feedback`):
     #: a time-between-tokens target in milliseconds.  When set, the
     #: scheduler consults the rolling decode-step baseline before
@@ -197,6 +238,30 @@ class ContinuousBatchingScheduler:
             decode_fn, cfg.temperature, cfg.top_k, cfg.top_p,
             cfg.pad_id, block=cfg.steps_per_sync)
             if cfg.steps_per_sync > 1 else None)
+        #: Speculative verify program + drafter (``spec_k > 0``).
+        self._spec_fn = None
+        self.drafter = None
+        if cfg.spec_k:
+            if cfg.spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got "
+                                 f"{cfg.spec_k}")
+            if cfg.steps_per_sync > 1:
+                raise ValueError(
+                    "spec_k and steps_per_sync > 1 are mutually "
+                    "exclusive: speculation IS the multi-token "
+                    "dispatch (EOS is checked every verify round)")
+            from triton_distributed_tpu.serving.speculative import (
+                make_drafter)
+            self.drafter = make_drafter(cfg.spec_drafter, self)
+            self._spec_fn = make_spec_verify_fn(
+                decode_fn, cfg.temperature, cfg.top_k, cfg.top_p,
+                cfg.pad_id, k=cfg.spec_k)
+            #: Cumulative draft/verify outcome — feeds the
+            #: ``serving_spec_accept_rate`` gauge (rides heartbeats;
+            #: the doctor calls out a collapse below 0.3).
+            self._spec_proposed = 0
+            self._spec_accepted = 0
+            self._spec_throttled = False
         from triton_distributed_tpu.observability.anomaly import (
             event_key)
         #: Baseline key every measured decode step rolls into — and
@@ -585,6 +650,14 @@ class ContinuousBatchingScheduler:
             req.bucket = bucket
             req.t_admitted = now
             self._by_slot[slot] = req
+            if self.drafter is not None and not self._spec_throttled:
+                # Admission (or resume) seeds the draft state from the
+                # full committed context — same tokens that seeded the
+                # slot's input above.  A throttled engine skips the
+                # upkeep entirely (draft prefills, reconcile
+                # dispatches): the throttle is for the scheduler's
+                # lifetime, so the draft cache will never be read.
+                self.drafter.start(req, tokens)
             sp = get_tracer().span(
                 "serving.request", request_id=req.request_id,
                 prompt_len=req.prompt_len, slot=slot, bucket=bucket)
@@ -763,6 +836,13 @@ class ContinuousBatchingScheduler:
 
     def _preempt(self, slot: int) -> None:
         req = self._by_slot.pop(slot)
+        if self.drafter is not None:
+            # Draft state is rebuilt from the committed context at
+            # re-admission — nothing mid-speculation survives the
+            # preemption (the verify pass already rolled the slot's
+            # cursor and key chain back to committed state, so the
+            # snapshot below is exact).
+            self.drafter.stop(req)
         # The slot's PRNG key is the sample-chain state: snapshot it
         # so the resumed stream continues bit-exactly.
         req.resume_key = self.slots.snapshot_key(slot)
@@ -783,27 +863,150 @@ class ContinuousBatchingScheduler:
                       generated=len(req.generated),
                       preemptions=req.preemptions)
 
+    def _spec_drafts(self):
+        """Proposals for this dispatch — ``(drafts (B, K), n_draft
+        (B,))`` numpy — or None when speculation cannot help this
+        round (spec off, a row too close to its KV horizon for K+1
+        writes, or nobody proposed): the caller then takes the plain
+        masked step, bit-identically."""
+        if self._spec_fn is None or not self._by_slot:
+            return None
+        if self._spec_throttle():
+            return None
+        K = self.config.spec_k
+        for req in self._by_slot.values():
+            # The verify pass writes K+1 positions; the same
+            # near-horizon fallback `_block_size` applies to blocks.
+            if (self.max_seq - req.prompt_len - len(req.generated)
+                    + 1) < K + 1:
+                return None
+        # Proposals beyond a request's own budget are pure waste
+        # (retire truncates at max_new anyway): cap at remaining - 1
+        # — the bonus token is the +1.
+        caps = {slot: min(K, req.max_new_tokens
+                          - len(req.generated) - 1)
+                for slot, req in self._by_slot.items()}
+        eligible = {slot: self._by_slot[slot]
+                    for slot, c in caps.items() if c > 0}
+        if not eligible:
+            return None
+        if getattr(self.drafter, "batched", False):
+            # One masked rollout dispatch proposes for every slot;
+            # the draft VALUES stay on device (the verify program
+            # consumes them there — no per-round proposal sync).
+            out = self.drafter.propose_batched(eligible, K)
+            if out is None:
+                return None
+            drafts, n_draft = out
+            n_draft = n_draft.copy()
+            for slot, c in caps.items():
+                n_draft[slot] = min(int(n_draft[slot]), c)
+            if not n_draft.any():
+                return None
+            return drafts, n_draft
+        props = {slot: self.drafter.propose(req, K)
+                 for slot, req in eligible.items()}
+        drafts = np.full((self.config.num_slots, K),
+                         self.config.pad_id, np.int32)
+        n_draft = np.zeros(self.config.num_slots, np.int32)
+        for slot, p in props.items():
+            n = min(len(p), caps[slot])
+            if n > 0:
+                drafts[slot, :n] = p[:n]
+                n_draft[slot] = n
+        if not n_draft.any():
+            return None
+        return drafts, n_draft
+
+    def _spec_throttle(self) -> bool:
+        """Accept-collapse guard (``spec_min_accept``): once the
+        cumulative accept rate is measurably below the floor,
+        drafting stops — recorded ONCE as a DecisionEvent and a
+        counter, visible on the accept-rate gauge the doctor reads.
+        The fallback is the plain masked step, so throttling changes
+        dispatch shape only — never tokens."""
+        if self._spec_throttled:
+            return True
+        floor = self.config.spec_min_accept
+        if (not floor
+                or self._spec_proposed < self.config.spec_probe_tokens
+                or self._spec_accepted
+                >= floor * self._spec_proposed):
+            return False
+        self._spec_throttled = True
+        rate = self._spec_accepted / self._spec_proposed
+        name = self.drafter.name
+        # The throttle is for the scheduler's lifetime: release the
+        # drafter (a batched one pins a device-resident draft KV
+        # cache + compiled rollout/reconcile programs) and the verify
+        # program — every call site guards on `drafter is not None`,
+        # and in-flight requests simply stop being assisted.
+        self.drafter = None
+        self._spec_fn = None
+        reg = self._registry()
+        if reg:
+            reg.counter("serving_spec_throttled_total").inc()
+        from triton_distributed_tpu.observability import feedback
+        feedback.record_decision(feedback.DecisionEvent(
+            consumer="serving.speculative",
+            op=f"drafter:{name}", choice="throttle",
+            candidates=[{"name": "speculate",
+                         "score_us": round(rate, 4)},
+                        {"name": "throttle"}],
+            inputs=dict(accept_rate=round(rate, 4),
+                        min_accept=float(floor),
+                        proposed=self._spec_proposed,
+                        accepted=self._spec_accepted)))
+        return True
+
     def _decode_step(self) -> int:
         t0 = time.perf_counter()
-        k = self._block_size()
+        spec = self._spec_drafts()
+        k = 1 if spec is not None else self._block_size()
+        # Paged mode maps pages for every position this dispatch
+        # writes: K proposals + the bonus position under speculation.
+        writes = self.config.spec_k + 1 if spec is not None else k
         if self.paged:
-            self._prepare_pages(k)
+            self._prepare_pages(writes)
             if not self._by_slot:      # defensive: all preempted
                 return 0
             self.slots.flush()
-        fn = self._block_fn if k > 1 else self._step
-        toks, cache, keys = fn(
-            self.params, jnp.asarray(self._tokens), self.slots.cache,
-            self.slots.keys, self.slots.active_mask())
-        self.slots.cache = cache
-        self.slots.keys = keys
-        toks_host = np.asarray(toks)      # THE host sync (EOS check)
-        if k == 1:
-            toks_host = toks_host[:, None]
+        accept_host = n_draft = None
+        if spec is not None:
+            drafts, n_draft = spec
+            targets, accept, cache, keys = self._spec_fn(
+                self.params, jnp.asarray(self._tokens),
+                jnp.asarray(drafts), self.slots.cache,
+                self.slots.keys, self.slots.active_mask(),
+                jnp.asarray(n_draft))
+            self.slots.cache = cache
+            self.slots.keys = keys
+            toks_host = np.asarray(targets)   # THE host sync
+            accept_host = np.asarray(accept)
+            # Normalize the step metric by tokens COMMITTED, not
+            # positions scanned: serving_decode_step_ms/us feed the
+            # SLO admission baseline and the router's placement
+            # scoring as "cost per token here, now" — a collapsed
+            # drafter must read as slow (K+1 forwards, ~1 token),
+            # not as K+1 healthy steps.
+            steps = float(np.mean(
+                accept_host[list(self._by_slot)])) + 1.0
+        else:
+            fn = self._block_fn if k > 1 else self._step
+            toks, cache, keys = fn(
+                self.params, jnp.asarray(self._tokens),
+                self.slots.cache, self.slots.keys,
+                self.slots.active_mask())
+            self.slots.cache = cache
+            self.slots.keys = keys
+            toks_host = np.asarray(toks)      # THE host sync
+            if k == 1:
+                toks_host = toks_host[:, None]
+            steps = k
         now = self.clock()
         reg = self._registry()
         if reg:
-            step_ms = (time.perf_counter() - t0) * 1e3 / k
+            step_ms = (time.perf_counter() - t0) * 1e3 / steps
             reg.histogram("serving_decode_step_ms").observe(step_ms)
             # Last measured step as a gauge: rides the heartbeat
             # files, where it is the `step_us` a PEER router scores
@@ -834,13 +1037,74 @@ class ContinuousBatchingScheduler:
                 emit_kernel_event(
                     "serving.decode_step", kind="engine",
                     measured_us=step_ms * 1e3, anomaly_z=round(z, 2))
+        rows = list(self._by_slot.items())
+        if spec is not None:
+            self._spec_outcome(rows, accept_host, n_draft, now, reg)
+        retired, generated = self._commit_tokens(
+            rows, toks_host, accept_host, now, reg)
+        if reg:
+            reg.counter("serving_tokens_generated_total").inc(generated)
+        return retired
+
+    def _spec_outcome(self, rows, accept_host, n_draft, now,
+                      reg) -> None:
+        """Post-verify bookkeeping, BEFORE tokens are appended: paged
+        page rollback for the rejected tails, accept metrics, one
+        ``spec_verify`` lineage hop per active request."""
+        for slot, req in rows:
+            a = int(accept_host[slot])
+            n = int(n_draft[slot])
+            if self.paged:
+                # Restore the mapping to exactly what a plain engine
+                # that decoded only the accepted prefix would hold:
+                # pages covering [0, min(offset', horizon)) where
+                # offset' = off0 + a + 1 — the rejected tail's pages
+                # unmap and free (the rollback invariant
+                # `analysis.serving_model` proves).
+                off_new = req.prompt_len + len(req.generated) + a
+                horizon = min(req.prompt_len + req.max_new_tokens - 1,
+                              self.max_seq)
+                self.slots.rollback(slot, min(off_new, horizon))
+            req.spec_proposed += n
+            req.spec_accepted += a
+            self._spec_proposed += n
+            self._spec_accepted += a
+            if reg:
+                reg.histogram("serving_spec_accept_len").observe(a)
+                reg.counter(
+                    "serving_spec_proposed_tokens_total").inc(n)
+                reg.counter(
+                    "serving_spec_accepted_tokens_total").inc(a)
+                reg.counter(
+                    "serving_spec_rejected_tokens_total").inc(n - a)
+                self._hop(req, "spec_verify", now, proposed=n,
+                          accepted=a)
+        if reg and self._spec_proposed:
+            reg.gauge("serving_spec_accept_rate").set(
+                self._spec_accepted / self._spec_proposed)
+
+    def _commit_tokens(self, rows, toks_host, accept_host, now, reg):
+        """Append one dispatch's tokens to their requests: stream via
+        ``on_token``, check EOS / budget / KV horizon, retire, and
+        (speculative mode) reconcile the drafter with what was
+        actually committed.  A row emits ``accept + 1`` tokens under
+        speculation, else the block width; tokens decoded past a
+        retirement reason are discarded — bounded over-generation,
+        exactly as in block mode."""
         retired = 0
         generated = 0
-        rows = list(self._by_slot.items())
+        k = toks_host.shape[1]
+        batched = getattr(self.drafter, "batched", False)
+        outcomes = []
         for slot, req in rows:
-            for j in range(k):
+            count = (int(accept_host[slot]) + 1
+                     if accept_host is not None else k)
+            committed = []
+            done = False
+            for j in range(count):
                 token = int(toks_host[slot, j])
                 req.generated.append(token)
+                committed.append(token)
                 generated += 1
                 if req.t_first_token is None:
                     req.t_first_token = now
@@ -853,9 +1117,10 @@ class ContinuousBatchingScheduler:
                         # TTFT exactly (ttft_breakdown's invariant).
                         self._hop(req, "first_token", now, slot=slot)
                 elif reg:
-                    # With k>1 the whole block lands at one sync: TBT
-                    # is reported at sync granularity (the first
-                    # block token carries the gap, the rest ~0).
+                    # With a multi-token dispatch the whole batch
+                    # lands at one sync: TBT is reported at sync
+                    # granularity (the first token carries the gap,
+                    # the rest ~0).
                     reg.histogram("serving_tbt_ms").observe(
                         max(now - req.t_last_token, 0.0) * 1e3)
                 req.t_last_token = now
@@ -874,20 +1139,36 @@ class ContinuousBatchingScheduler:
                     # write of its own).
                     reason = FinishReason.KV_CAPACITY
                 if reason is not None:
-                    # Tokens the block decoded past this point are
-                    # discarded — bounded over-generation.
+                    # Tokens decoded past this point are discarded —
+                    # bounded over-generation.
                     self._retire(slot, now, reason)
                     retired += 1
+                    done = True
                     break
-            else:
-                self._tokens[slot] = int(toks_host[slot, k - 1])
-        if reg:
-            reg.counter("serving_tokens_generated_total").inc(generated)
-        return retired
+            if not done:
+                self._tokens[slot] = int(toks_host[slot, count - 1])
+                if (self.drafter is not None
+                        and not self._spec_throttled):
+                    # Continuing stream: the drafter catches up with
+                    # the committed outcome (accepted prefix kept,
+                    # rejected tail rolled back; a plain-step commit
+                    # is accept=0 with one token).  Batched drafters
+                    # reconcile every row in one dispatch set below.
+                    acc = (count - 1 if accept_host is not None
+                           else 0)
+                    if batched:
+                        outcomes.append((req, acc, committed))
+                    else:
+                        self.drafter.commit(req, acc, committed)
+        if outcomes:
+            self.drafter.commit_batched(outcomes)
+        return retired, generated
 
     def _retire(self, slot: int, now: float,
                 reason: FinishReason) -> None:
         req = self._by_slot.pop(slot)
+        if self.drafter is not None:
+            self.drafter.stop(req)
         req.state = RequestState.FINISHED
         req.finish_reason = reason
         req.t_finish = now

@@ -104,3 +104,12 @@ class SlotKV:
         self.cache = self.cache.reset_slot(slot)
         self._active[slot] = False
         self._free.append(slot)
+
+    def snapshot_key(self, slot: int) -> np.ndarray:
+        """Device fetch of a slot's current PRNG key (mirror of
+        `serving.pages.PagedKV.snapshot_key` — the key-accounting
+        tests read it on both layouts; the verify pass advances it
+        one split per EMITTED token, so after ``g`` streamed tokens
+        it equals ``split^g(PRNGKey(seed))[0]`` with or without
+        speculation)."""
+        return np.asarray(self.keys[slot]).copy()
