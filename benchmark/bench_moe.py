@@ -26,7 +26,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # repo root
 
 import argparse
-import json
 import statistics
 
 import jax
@@ -48,6 +47,7 @@ from triton_distributed_tpu.kernels.moe_reduce_rs import (
     moe_reduce_rs_fused,
 )
 from triton_distributed_tpu.kernels.quantized import quantize_sym
+from triton_distributed_tpu.observability import bench_record
 from triton_distributed_tpu.ops import shard_map_op
 from triton_distributed_tpu.utils.benchmarking import (
     feedback_mix,
@@ -56,7 +56,9 @@ from triton_distributed_tpu.utils.benchmarking import (
 
 
 def _emit(row):
-    print(json.dumps(row), flush=True)
+    # Through the metrics registry: stdout, benchmark/results/moe.json
+    # and the rolling anomaly baselines all carry the same record.
+    bench_record(row)
 
 
 def _paired_stats(slopes, self_first, self_last):
@@ -74,7 +76,13 @@ def _paired_stats(slopes, self_first, self_last):
 
 
 def bench_moe_epilogue(e, cap, mc, k, n, topk, repeats):
-    """moe_reduce_rs_fused vs staged vs XLA at world=1."""
+    """moe_reduce_rs_fused (packed combine-in-epilogue) vs staged
+    (Pallas grouped GEMM → XLA gather combine) vs pure XLA at world=1.
+
+    Both baselines use the gather-based `combine_tokens` — the
+    strongest XLA combine (topk gathers, no dense one-hot matmul), so
+    `vs_xla` measures the fused epilogue against what a user would
+    actually run, not a strawman."""
     mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
     key = jax.random.key(0)
     buckets = (jax.random.normal(key, (1, e, cap, k)) / 8
@@ -85,27 +93,31 @@ def bench_moe_epilogue(e, cap, mc, k, n, topk, repeats):
                              0, e)
     tw = jax.nn.softmax(jax.random.normal(
         jax.random.fold_in(key, 3), (mc, topk)), axis=-1)
-    plan = moe_utils.plan_chunks(ids, tw, 1, e, cap)
-    cmats = plan.combine_mats.astype(jnp.bfloat16)
+    plan = moe_utils.plan_chunks(ids, tw, 1, e, cap,
+                                 dtype=jnp.bfloat16)
+    cmatb = plan.combine_blocks
+    occupancy = int(plan.n_blocks[0]) * plan.pack_block_size
 
     ctx = MoEReduceRSContext(axis="tp", world_size=1, num_experts=e,
                              topk=topk)
 
     def fused(bk, w_, cm):
         return shard_map_op(
-            lambda b_, ww, c_: moe_reduce_rs_fused(b_, ww, c_, ctx),
+            lambda b_, ww, c_: moe_reduce_rs_fused(
+                b_, ww, plan._replace(combine_blocks=c_), ctx),
             mesh, in_specs=(P(), P(), P()), out_specs=P())(bk, w_, cm)
 
     def staged(bk, w_, cm):
         part = grouped_matmul(bk[0], w_)              # (E, cap, n)
-        return jnp.einsum("emc,ecn->mn", cm[0], part.astype(jnp.float32)
-                          ).astype(bk.dtype)
+        return moe_utils.combine_tokens(part, ids, plan.slot_of_pair[0],
+                                        tw)
 
     def xla(bk, w_, cm):
         part = jnp.einsum("eck,ekn->ecn", bk[0], w_,
-                          preferred_element_type=jnp.float32)
-        return jnp.einsum("emc,ecn->mn", cm[0].astype(jnp.float32),
-                          part).astype(bk.dtype)
+                          preferred_element_type=jnp.float32
+                          ).astype(bk.dtype)
+        return moe_utils.combine_tokens(part, ids, plan.slot_of_pair[0],
+                                        tw)
 
     # chain through buckets (feed the (mc, n) output back into the
     # bucket tensor so iterations are data-dependent); identical mix
@@ -115,7 +127,7 @@ def bench_moe_epilogue(e, cap, mc, k, n, topk, repeats):
 
     ops = [fused, staged, xla, fused]
     _, slopes = measure_ops_scanned(
-        ops, (buckets, wdown, cmats), mix,
+        ops, (buckets, wdown, cmatb), mix,
         n_inner=16, repeats=repeats, return_slopes=True)
     t_fused, ratio = _paired_stats(slopes, 0, -1)
     flops = 2 * e * cap * k * n + 2 * e * mc * cap * n
@@ -127,6 +139,8 @@ def bench_moe_epilogue(e, cap, mc, k, n, topk, repeats):
         "note": "degenerate_world1_no_rs_stage",
         "us": round(t_fused * 1e6, 1),
         "tflops": round(flops / t_fused / 1e12, 1),
+        "pack_block": plan.pack_block_size,
+        "packed_rows": occupancy, "dense_rows": e * cap,
         "vs_staged": vs_staged, "vs_staged_range": staged_rng,
         "vs_xla": vs_xla, "vs_xla_range": xla_rng,
     })

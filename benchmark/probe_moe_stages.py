@@ -1,11 +1,20 @@
 """Stage-level decomposition of the fused MoE epilogue at world=1
-(diagnostic, not part of run_all.sh): where do the 1471 µs go?
+(diagnostic, not part of run_all.sh): where do the microseconds go?
 
 Times, with the in-scan harness at the bench_moe E=64/cap=128 shape:
 - the Pallas grouped GEMM (tuned config) vs the XLA grouped einsum,
-- the combine stage alone: XLA einsum vs `emit_combine_matmul`
-  (wrapped in a bare pallas_call) in f32 vs bf16 multiplies,
-- the fused kernel vs the staged composition vs XLA end-to-end.
+- the combine stage alone: XLA gather combine vs the packed combine
+  matmul (`emit_packed_combine_matmul` in a bare pallas_call, reading
+  a packed (T, B, n) stage),
+- the fused kernel (packed combine-in-epilogue) vs the staged
+  composition vs XLA end-to-end.
+
+Every probe run emits ONE ``bench_record`` JSON line per shape with
+the per-stage medians as measurement fields (``gemm_pallas_us``,
+``combine_packed_us``, ...), so the rolling anomaly baselines and the
+doctor can attribute a future MoE regression to the GEMM, the
+combine, or the RS/harness overhead instead of only seeing the
+end-to-end number move.
 """
 
 import os
@@ -15,7 +24,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # repo root
 
 import functools
-import json
 import statistics
 
 import jax
@@ -25,7 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.kernels import moe_utils
 from triton_distributed_tpu.kernels.grouped_gemm import (
-    emit_combine_matmul,
+    emit_packed_combine_matmul,
     grouped_matmul,
 )
 from triton_distributed_tpu.kernels.matmul import MatmulConfig
@@ -33,6 +41,7 @@ from triton_distributed_tpu.kernels.moe_reduce_rs import (
     MoEReduceRSContext,
     moe_reduce_rs_fused,
 )
+from triton_distributed_tpu.observability import bench_record
 from triton_distributed_tpu.ops import shard_map_op
 from triton_distributed_tpu.utils.benchmarking import (
     feedback_mix,
@@ -44,7 +53,6 @@ E, CAP, MC, K, N, TOPK = 64, 128, 2048, 2048, 1408, 4
 
 def main():
     import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
     key = jax.random.key(0)
@@ -56,10 +64,13 @@ def main():
                              0, E)
     tw = jax.nn.softmax(jax.random.normal(
         jax.random.fold_in(key, 3), (MC, TOPK)), axis=-1)
-    plan = moe_utils.plan_chunks(ids, tw, 1, E, CAP)
-    cmats = plan.combine_mats.astype(jnp.bfloat16)
+    plan = moe_utils.plan_chunks(ids, tw, 1, E, CAP,
+                                 dtype=jnp.bfloat16)
+    t_max, block = plan.num_blocks_static, plan.pack_block_size
+    cmatb = plan.combine_blocks
     stage = (jax.random.normal(jax.random.fold_in(key, 4),
-                               (E, CAP, N)) / 8).astype(jnp.bfloat16)
+                               (t_max, block, N)) / 8
+             ).astype(jnp.bfloat16)
 
     cfg = MatmulConfig(block_m=128, block_n=1408, block_k=1024)
 
@@ -71,69 +82,97 @@ def main():
                           preferred_element_type=jnp.float32
                           ).astype(bk.dtype)
 
-    def xla_combine(cm, st):
-        return jnp.einsum("emc,ecn->mn", cm.astype(jnp.float32),
-                          st.astype(jnp.float32)).astype(st.dtype)
+    def xla_combine(cm, sp, sd):
+        # Gather-based golden combine from the dense (E, cap, N)
+        # stage (the strongest XLA combine — no one-hot matmul).
+        del cm, sp
+        return moe_utils.combine_tokens(sd, ids, plan.slot_of_pair[0],
+                                        tw)
 
-    def pallas_combine(cm, st, *, f32):
+    def packed_combine(cm, sp, sd):
+        del sd
+
         def kern(cm_ref, st_ref, o_ref):
-            emit_combine_matmul(cm_ref, st_ref, o_ref, num_experts=E,
-                                m=MC, cap=CAP, n=N, mul_f32=f32)
+            emit_packed_combine_matmul(
+                cm_ref, st_ref, o_ref, num_blocks=None, t_max=t_max,
+                block=block, mc=MC, n=N)
         return pl.pallas_call(
             kern,
-            out_shape=jax.ShapeDtypeStruct((MC, N), st.dtype),
+            out_shape=jax.ShapeDtypeStruct((MC, N), sp.dtype),
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
             out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        )(cm, st)
+        )(cm, sp)
 
     ctx = MoEReduceRSContext(axis="tp", world_size=1, num_experts=E,
                              topk=TOPK, gemm=cfg)
 
     def fused(bk, w_, cm):
         return shard_map_op(
-            lambda b_, ww, c_: moe_reduce_rs_fused(b_, ww, c_, ctx),
+            lambda b_, ww, c_: moe_reduce_rs_fused(
+                b_, ww, plan._replace(combine_blocks=c_), ctx),
             mesh, in_specs=(P(), P(), P()), out_specs=P())(bk, w_, cm)
 
     def staged(bk, w_, cm):
         part = grouped_matmul(bk[0], w_, config=cfg)
-        return jnp.einsum("emc,ecn->mn", cm[0], part.astype(jnp.float32)
-                          ).astype(bk.dtype)
+        return moe_utils.combine_tokens(part, ids, plan.slot_of_pair[0],
+                                        tw)
 
     def xla_full(bk, w_, cm):
         part = jnp.einsum("eck,ekn->ecn", bk[0], w_,
-                          preferred_element_type=jnp.float32)
-        return jnp.einsum("emc,ecn->mn", cm[0].astype(jnp.float32),
-                          part).astype(bk.dtype)
+                          preferred_element_type=jnp.float32
+                          ).astype(bk.dtype)
+        return moe_utils.combine_tokens(part, ids, plan.slot_of_pair[0],
+                                        tw)
 
-    def t_of(name, ops, args, mix, n_inner=8, repeats=4):
+    def t_of(ops, args, mix, n_inner=8, repeats=4):
         _, slopes = measure_ops_scanned(ops, args, mix,
                                         n_inner=n_inner,
                                         repeats=repeats,
                                         return_slopes=True)
-        for nm, sl in zip(name, slopes):
-            print(json.dumps({"op": nm,
-                              "us": round(statistics.median(sl) * 1e6,
-                                          1)}), flush=True)
+        return [statistics.median(sl) * 1e6 for sl in slopes]
 
     mixg = lambda a, out: (feedback_mix(a[0], out[..., :K]), a[1])
-    t_of(["pallas_grouped", "xla_grouped"],
-         [lambda b_, w_: grouped(b_, w_),
-          lambda b_, w_: xla_grouped(b_, w_)],
-         (buckets[0], wdown), mixg)
+    gemm_pallas, gemm_xla = t_of(
+        [lambda b_, w_: grouped(b_, w_),
+         lambda b_, w_: xla_grouped(b_, w_)],
+        (buckets[0], wdown), mixg)
 
-    mixc = lambda a, out: (a[0], feedback_mix(a[1], out[None].repeat(
-        E, 0)[:, :CAP]))
-    t_of(["xla_combine", "pallas_combine_f32", "pallas_combine_bf16"],
-         [lambda c_, s_: xla_combine(c_, s_),
-          lambda c_, s_: pallas_combine(c_, s_, f32=True),
-          lambda c_, s_: pallas_combine(c_, s_, f32=False)],
-         (cmats[0], stage), mixc)
+    dense_stage = (jax.random.normal(jax.random.fold_in(key, 5),
+                                     (E, CAP, N)) / 8
+                   ).astype(jnp.bfloat16)
+    mixc = lambda a, out: (
+        a[0],
+        feedback_mix(a[1], out[None, :block].repeat(t_max, 0)),
+        feedback_mix(a[2], out[None, :CAP].repeat(E, 0)))
+    combine_xla, combine_packed = t_of(
+        [xla_combine, packed_combine],
+        (cmatb[0], stage, dense_stage), mixc)
 
     mixf = lambda a, out: (feedback_mix(a[0], out[None, None, :CAP, :K]
                                         .astype(a[0].dtype)),
                            a[1], a[2])
-    t_of(["fused", "staged", "xla_full"],
-         [fused, staged, xla_full], (buckets, wdown, cmats), mixf)
+    fused_us, staged_us, xla_us = t_of(
+        [fused, staged, xla_full], (buckets, wdown, cmatb), mixf)
+
+    # ONE record per shape: stage medians ride as measurement fields
+    # (identity = bench + shape), so check_bench_regression and the
+    # anomaly baselines can attribute an end-to-end regression.
+    bench_record({
+        "bench": "moe_stage_probe", "world": 1,
+        "E": E, "cap": CAP, "mc": MC, "K": K, "N": N,
+        "us": round(fused_us, 1),
+        "staged_us": round(staged_us, 1),
+        "xla_us": round(xla_us, 1),
+        "gemm_pallas_us": round(gemm_pallas, 1),
+        "gemm_xla_us": round(gemm_xla, 1),
+        "combine_packed_us": round(combine_packed, 1),
+        "combine_xla_us": round(combine_xla, 1),
+        "epilogue_overhead_us": round(
+            max(fused_us - gemm_pallas, 0.0), 1),
+        "pack_block": block,
+        "packed_rows": int(plan.n_blocks[0]) * block,
+        "dense_rows": E * CAP,
+    })
 
 
 if __name__ == "__main__":

@@ -65,6 +65,12 @@ MEASUREMENT_FIELDS = {
     "spec_rounds", "accept_len_hist", "spec_tokens_per_step",
     "speedup_vs_plain", "spec_beats_plain", "spec_exact",
     "spec_throttled",
+    # MoE epilogue rows (bench_moe.py / probe_moe_stages.py): paired
+    # ratios are gated by moe_checks; the stage-probe decomposition
+    # and packing occupancy are run outputs.
+    "pack_block", "packed_rows", "dense_rows", "staged_us", "xla_us",
+    "gemm_pallas_us", "gemm_xla_us", "combine_packed_us",
+    "combine_xla_us", "epilogue_overhead_us",
     # Anomaly-baseline outputs attached by bench_record.
     "anomaly_z", "anomaly",
     # Closed-loop paired bench (bench_closed_loop.py): the chosen
@@ -293,6 +299,37 @@ def spec_checks(fresh) -> tuple:
     return checked, fails
 
 
+def moe_checks(fresh) -> tuple:
+    """Gates specific to the fused MoE epilogue rows
+    (`benchmark/bench_moe.py` ``bench="moe_reduce_rs_fused"``): the
+    packed combine-in-epilogue kernel must WIN — every fresh row
+    carrying the paired ratios must report ``vs_staged >= 1.0`` AND
+    ``vs_xla >= 1.0``.  This is the ISSUE-14 acceptance bar: the
+    fused kernel beating both the staged Pallas composition and the
+    XLA composition at every committed shape, so "exists but not
+    fast" (VERDICT r5) can never silently return.
+
+    Returns ``(n_checked, failures)``."""
+    fails = []
+    checked = 0
+    for rec in fresh:
+        if rec.get("bench") != "moe_reduce_rs_fused":
+            continue
+        if "vs_staged" not in rec and "vs_xla" not in rec:
+            continue
+        checked += 1
+        shape = (f"E={rec.get('E')} cap={rec.get('cap')} "
+                 f"mc={rec.get('mc')}")
+        for field, base in (("vs_staged", "staged Pallas composition"),
+                            ("vs_xla", "XLA composition")):
+            v = rec.get(field)
+            if not isinstance(v, (int, float)) or v < 1.0:
+                fails.append(
+                    f"moe regression: fused epilogue LOSES to the "
+                    f"{base} at {shape} ({field}={v})")
+    return checked, fails
+
+
 def lineage_checks(fresh) -> tuple:
     """Gate specific to the request-lineage instrumentation
     (`observability.lineage`): every fresh row that carries a TTFT
@@ -412,12 +449,13 @@ def main() -> int:
     rt_checked, rt_fails = router_checks(fresh)
     ln_checked, ln_fails = lineage_checks(fresh)
     sp_checked, sp_fails = spec_checks(fresh)
+    moe_checked, moe_fails = moe_checks(fresh)
 
     # Markdown summary: CI logs and PR comments read the same thing.
     print("## Bench regression check")
     print()
     verdict = ("FAIL" if regressions or cl_fails or rt_fails
-               or ln_fails or sp_fails else
+               or ln_fails or sp_fails or moe_fails else
                "OK (with anomalies)" if anomalies else "OK")
     print(f"**{verdict}** — {compared} row(s) compared, "
           f"{regressions} regression(s) beyond "
@@ -463,11 +501,19 @@ def main() -> int:
               f"{len(sp_fails)} failure(s).")
         for f in sp_fails:
             print(f"- {f}")
+    if moe_checked:
+        print()
+        print(f"MoE gate: {moe_checked} row(s) checked (fused "
+              f"epilogue beats staged AND XLA at every shape), "
+              f"{len(moe_fails)} failure(s).")
+        for f in moe_fails:
+            print(f"- {f}")
     if (compared == 0 and cl_checked == 0 and rt_checked == 0
-            and ln_checked == 0 and sp_checked == 0):
+            and ln_checked == 0 and sp_checked == 0
+            and moe_checked == 0):
         return 2
     return 1 if (regressions or cl_fails or rt_fails or ln_fails
-                 or sp_fails) else 0
+                 or sp_fails or moe_fails) else 0
 
 
 if __name__ == "__main__":

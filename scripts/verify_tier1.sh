@@ -662,6 +662,95 @@ if [ "$lineage_rc" -ne 0 ]; then
     [ "$rc" -eq 0 ] && rc=1
 fi
 
+# MoE smoke (ISSUE 14): (a) the ragged-packed plan's combine is exact
+# vs the gather-based staged reference on CPU; (b) the fused
+# combine-in-epilogue kernel itself runs fused-vs-staged bit-close in
+# CPU interpret mode on a small shape where this jax can execute
+# Pallas TPU interpret kernels (skips gracefully where it cannot —
+# the same availability gating as the kernel test suite); then (c)
+# the resource + comm sanitizer sweeps of all four moe_reduce_rs
+# kernel variants must report ZERO findings.
+moe_log=$(JAX_PLATFORMS=cpu python - <<'EOF' 2>&1
+import functools
+import jax
+import jax.numpy as jnp
+import numpy as np
+from triton_distributed_tpu.kernels import moe_utils
+
+world, mc, e, topk, cap, k, n, h = 1, 32, 4, 2, 16, 128, 128, 16
+key = jax.random.key(14)
+ids = jax.random.randint(key, (world * mc, topk), 0, e)
+w = jax.nn.softmax(jax.random.normal(
+    jax.random.fold_in(key, 1), (world * mc, topk)), axis=-1)
+plan = moe_utils.plan_chunks(ids, w, world, e, cap)
+
+# (a) packed plan ≡ gather-based staged combine (pure XLA, runs
+# anywhere).
+eo = jax.random.normal(jax.random.fold_in(key, 2), (e, cap, h))
+golden = moe_utils.combine_tokens(eo, ids, plan.slot_of_pair[0], w)
+dense = moe_utils.dense_combine_mats(plan, cap)
+got = jnp.einsum("emc,ech->mh", dense[0], eo).astype(golden.dtype)
+assert float(jnp.abs(got - golden).max()) < 1e-5, "packed plan drift"
+print("MOE_PLAN_EXACT=ok")
+
+# (b) interpret-mode fused-vs-staged kernel exactness, where the
+# Pallas interpret stack exists in this jax.
+try:
+    from triton_distributed_tpu.kernels.matmul import MatmulConfig
+    from triton_distributed_tpu.kernels.moe_reduce_rs import (
+        MoEReduceRSContext, moe_reduce_rs_fused)
+    from jax.sharding import Mesh, PartitionSpec as P
+    if hasattr(jax, "shard_map"):
+        smap = functools.partial(jax.shard_map, check_vma=False)
+    else:
+        from jax.experimental.shard_map import shard_map
+        smap = functools.partial(shard_map, check_rep=False)
+    buckets = jax.random.normal(jax.random.fold_in(key, 3),
+                                (world, e, cap, k), jnp.float32) / 8
+    wdown = jax.random.normal(jax.random.fold_in(key, 4), (e, k, n),
+                              jnp.float32) / 8
+    ctx = MoEReduceRSContext(axis="tp", world_size=world,
+                             num_experts=e, topk=topk,
+                             gemm=MatmulConfig(16, 128, 128))
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    fused = smap(lambda b, ww: moe_reduce_rs_fused(b, ww, plan, ctx),
+                 mesh=mesh, in_specs=(P(), P()), out_specs=P())
+    out = jax.jit(fused)(buckets, wdown)
+    part = jnp.einsum("wecK,eKn->wecn", buckets, wdown)
+    ref = moe_utils.combine_tokens(part[0], ids, plan.slot_of_pair[0],
+                                   w).astype(out.dtype)
+    err = float(jnp.abs(out - ref).max())
+    assert err < 1e-4, f"fused != staged in interpret mode ({err})"
+    print("MOE_KERNEL_EXACT=ok")
+except (AttributeError, NotImplementedError, TypeError) as exc:
+    print(f"MOE_KERNEL_EXACT=skipped (pallas interpret unavailable: "
+          f"{type(exc).__name__})")
+print("MOE_SMOKE=ok")
+EOF
+)
+moe_rc=$?
+echo "$moe_log" | tail -3
+if [ "$moe_rc" -ne 0 ]; then
+    echo "MOE_SMOKE=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+moe_sweep_ok=1
+for check in comm resources; do
+    if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+            python -m triton_distributed_tpu.analysis --check $check \
+            -k moe_reduce_rs.fused -k moe_reduce_rs.two_phase \
+            -k moe_reduce_rs.w8a8 -k moe_reduce_rs.w8a8_two_phase \
+            -q; then
+        moe_sweep_ok=0
+    fi
+done
+if [ "$moe_sweep_ok" -eq 1 ]; then
+    echo "MOE_SWEEP=ok"
+else
+    echo "MOE_SWEEP=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
 # Router bench gate: the virtual-clock router bench is deterministic
 # — re-run it and require every paired summary to hold (signal-aware
 # beats round-robin under seeded imbalance, matches it balanced).

@@ -26,7 +26,7 @@ def _random_plan(key, world, mc, e, topk, cap):
     w = jax.nn.softmax(
         jax.random.normal(jax.random.fold_in(key, 1),
                           (world * mc, topk)), axis=-1)
-    return moe_utils.plan_chunks(ids, w, world, e, cap)
+    return moe_utils.plan_chunks(ids, w, world, e, cap), ids, w
 
 
 def test_combine_matrix_matches_combine_tokens():
@@ -46,28 +46,32 @@ def test_combine_matrix_matches_combine_tokens():
 
 
 def test_moe_reduce_rs_fused_vs_staged(tp4_mesh):
-    """The single-kernel epilogue matches the staged (grouped GEMM →
-    combine → reduce-scatter) composition."""
+    """The single-kernel epilogue (packed combine-in-epilogue) matches
+    the staged (grouped GEMM → gather combine → reduce-scatter)
+    composition."""
     world, e, cap, mc, k, n = 4, 4, 16, 32, 64, 48
     key = jax.random.key(1)
     buckets = jax.random.normal(key, (world, e, cap, world * k)) / 8
     wdown = jax.random.normal(jax.random.fold_in(key, 1),
                               (e, world * k, n)) / 8
-    plan = _random_plan(jax.random.fold_in(key, 2), world, mc, e, 2, cap)
+    plan, ids, w = _random_plan(jax.random.fold_in(key, 2), world, mc,
+                                e, 2, cap)
 
     ctx = MoEReduceRSContext(axis="tp", world_size=world, num_experts=e,
                              topk=2, gemm=MatmulConfig(16, 48, 64))
     fused = shard_map_op(
-        functools.partial(moe_reduce_rs_fused, ctx=ctx),
+        functools.partial(moe_reduce_rs_fused, plan=plan, ctx=ctx),
         tp4_mesh,
-        in_specs=(P(None, None, None, "tp"), P(None, "tp", None),
-                  P(None, None, None, None)),
+        in_specs=(P(None, None, None, "tp"), P(None, "tp", None)),
         out_specs=P("tp", None))
-    got = jax.jit(fused)(buckets, wdown, plan.combine_mats)
+    got = jax.jit(fused)(buckets, wdown)
 
-    # staged golden: full-K grouped GEMM per chunk, combine, row split
+    # staged golden: full-K grouped GEMM per chunk, gather combine,
+    # row split
     partial = jnp.einsum("wecK,eKn->wecn", buckets, wdown)
-    combined = jnp.einsum("wemc,wecn->wmn", plan.combine_mats, partial)
+    combined = jax.vmap(moe_utils.combine_tokens)(
+        partial, ids.reshape(world, mc, 2), plan.slot_of_pair,
+        w.reshape(world, mc, 2))
     ref = combined.reshape(world * mc, n).astype(got.dtype)
     assert_allclose(got, ref, atol=1e-4, rtol=1e-4, name="moe-rs-fused")
 

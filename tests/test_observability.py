@@ -374,20 +374,27 @@ def test_moe_fused_vmem_guard_and_combine_dtype(monkeypatch):
                         lambda *a, **k: None)
     monkeypatch.setattr(mrs, "default_interpret", lambda *a, **k: True)
 
+    from triton_distributed_tpu.kernels import moe_utils
+
     def run(mc, n):
         buckets = jnp.zeros((world, e, cap, k), jnp.bfloat16)
         w = jnp.zeros((e, k, n), jnp.bfloat16)
-        cmat = jnp.zeros((world, e, mc, cap), jnp.float32)
-        out = mrs.moe_reduce_rs_fused(buckets, w, cmat, ctx)
+        ids = jnp.zeros((world * mc, 2), jnp.int32)
+        tw = jnp.full((world * mc, 2), 0.5, jnp.float32)
+        plan = moe_utils.plan_chunks(ids, tw, world, e, cap)
+        out = mrs.moe_reduce_rs_fused(buckets, w, plan, ctx)
         assert out.shape == (mc, n)
         return calls["kern"].func
 
     # Small chunk: single-phase pipeline fits VMEM.
     assert run(128, 512) is mrs._moe_rs_fused_kernel
-    # The f32 combine_mats were cast to the activation dtype
+    # The f32 combine_blocks were cast to the activation dtype
     # (ADVICE r5) before entering the kernel.
     cmat_op = calls["operands"][2]
     assert cmat_op.dtype == jnp.bfloat16
+    # The packed schedule tables ride as int32 SMEM operands.
+    assert calls["operands"][3].dtype == jnp.int32   # block_expert
+    assert calls["operands"][5].dtype == jnp.int32   # n_blocks
 
     # Oversized chunk: (4 + 2*itemsize)*mc*n exceeds COMM_VMEM_LIMIT
     # -> two-phase HBM-staged fallback instead of a compile failure.
@@ -435,16 +442,17 @@ def test_moe_two_phase_numerics(monkeypatch):
                                  gemm=MatmulConfig(16, 48, 64))
     with capture_events() as events:
         fused = shard_map_op(
-            functools.partial(orig, ctx=ctx), mesh,
-            in_specs=(P(None, None, None, "tp"), P(None, "tp", None),
-                      P(None, None, None, None)),
+            functools.partial(orig, plan=plan, ctx=ctx), mesh,
+            in_specs=(P(None, None, None, "tp"), P(None, "tp", None)),
             out_specs=P("tp", None))
-        got = jax.jit(fused)(buckets, wdown, plan.combine_mats)
+        got = jax.jit(fused)(buckets, wdown)
     assert any(ev.op == "moe_reduce_rs_fused"
                and ev.method == "two_phase" for ev in events)
 
     partial = jnp.einsum("wecK,eKn->wecn", buckets, wdown)
-    combined = jnp.einsum("wemc,wecn->wmn", plan.combine_mats, partial)
+    combined = jax.vmap(moe_utils.combine_tokens)(
+        partial, ids.reshape(world, mc, 2), plan.slot_of_pair,
+        w.reshape(world, mc, 2))
     ref = combined.reshape(world * mc, n).astype(got.dtype)
     assert_allclose(got, ref, atol=1e-4, rtol=1e-4,
                     name="moe-rs-two-phase")

@@ -179,13 +179,13 @@ def test_moe_reduce_rs_fused_w8a8(tp4_mesh):
     ctx = MoEReduceRSContext(axis="tp", world_size=world, num_experts=e,
                              topk=2)
     fused = shard_map_op(
-        lambda bk, w_, sws, cm: moe_reduce_rs_fused(
-            bk, w_, cm, ctx, weight_scales=sws),
+        lambda bk, w_, sws: moe_reduce_rs_fused(
+            bk, w_, plan, ctx, weight_scales=sws),
         tp4_mesh,
         in_specs=(P(None, None, None, "tp"), P(None, "tp", None),
-                  P(None, None), P(None, None, None, None)),
+                  P(None, None)),
         out_specs=P("tp", None))
-    got = jax.jit(fused)(buckets, wq, sw, plan.combine_mats)
+    got = jax.jit(fused)(buckets, wq, sw)
 
     # golden: per-shard dequantized math (quantization happens on the
     # K-shard of each rank, so quantize shard-wise like the kernel)
@@ -199,7 +199,9 @@ def test_moe_reduce_rs_fused_w8a8(tp4_mesh):
             bq_r.astype(jnp.float32) * sa_r[..., None],
             wq_r.astype(jnp.float32) * sw[:, None, :]))
     partial = sum(per)
-    combined = jnp.einsum("wemc,wecn->wmn", plan.combine_mats, partial)
+    combined = jax.vmap(moe_utils.combine_tokens)(
+        partial, ids.reshape(world, mc, 2), plan.slot_of_pair,
+        tw.reshape(world, mc, 2))
     ref = combined.reshape(world * mc, n)
     err = np.abs(np.asarray(got, np.float32) - np.asarray(ref))
     assert err.max() < 2e-3 * (float(jnp.abs(ref).max()) + 1), err.max()

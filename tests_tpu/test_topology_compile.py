@@ -317,6 +317,16 @@ def test_topo_ag_group_gemm():
              [jnp.bfloat16, jnp.bfloat16, jnp.int32])
 
 
+def _moe_plan(e, cap, mc, topk=2, seed=4):
+    from triton_distributed_tpu.kernels import moe_utils
+
+    ids = jax.random.randint(jax.random.key(seed), (WORLD * mc, topk),
+                             0, e)
+    w = jax.nn.softmax(jax.random.normal(
+        jax.random.key(seed + 1), (WORLD * mc, topk)), axis=-1)
+    return moe_utils.plan_chunks(ids, w, WORLD, e, cap)
+
+
 def test_topo_moe_reduce_rs_fused():
     from triton_distributed_tpu.kernels.moe_reduce_rs import (
         MoEReduceRSContext, moe_reduce_rs_fused)
@@ -324,13 +334,13 @@ def test_topo_moe_reduce_rs_fused():
     e, cap, mc, k, n = 4, 128, 128, 64, 128
     ctx = MoEReduceRSContext(axis="tp", world_size=WORLD, num_experts=e,
                              topk=2, gemm=MatmulConfig(128, 128, 64))
-    _compile(functools.partial(moe_reduce_rs_fused, ctx=ctx),
+    plan = _moe_plan(e, cap, mc)
+    _compile(functools.partial(moe_reduce_rs_fused, plan=plan, ctx=ctx),
              _mesh((8,), ("tp",)),
-             (P(None, None, None, "tp"), P(None, "tp", None),
-              P(None, None, None, None)),
+             (P(None, None, None, "tp"), P(None, "tp", None)),
              P("tp", None),
-             [(WORLD, e, cap, WORLD * k), (e, WORLD * k, n),
-              (WORLD, e, mc, cap)], jnp.float32)
+             [(WORLD, e, cap, WORLD * k), (e, WORLD * k, n)],
+             jnp.float32)
 
 
 def test_topo_ag_group_gemm_w8a8():
@@ -361,15 +371,15 @@ def test_topo_moe_reduce_rs_fused_w8a8():
     e, cap, mc, k, n = 4, 128, 128, 64, 128
     ctx = MoEReduceRSContext(axis="tp", world_size=WORLD, num_experts=e,
                              topk=2)
-    _compile(lambda bb, ww, ss, cm: moe_reduce_rs_fused(
-                 bb, ww, cm, ctx, weight_scales=ss),
+    plan = _moe_plan(e, cap, mc, seed=6)
+    _compile(lambda bb, ww, ss: moe_reduce_rs_fused(
+                 bb, ww, plan, ctx, weight_scales=ss),
              _mesh((8,), ("tp",)),
              (P(None, None, None, "tp"), P(None, "tp", None),
-              P(None, None), P(None, None, None, None)),
+              P(None, None)),
              P("tp", None),
-             [(WORLD, e, cap, WORLD * k), (e, WORLD * k, n), (e, n),
-              (WORLD, e, mc, cap)],
-             [jnp.bfloat16, jnp.int8, jnp.float32, jnp.bfloat16])
+             [(WORLD, e, cap, WORLD * k), (e, WORLD * k, n), (e, n)],
+             [jnp.bfloat16, jnp.int8, jnp.float32])
 
 
 # ---------------------------------------------------------------------------

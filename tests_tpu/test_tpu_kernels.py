@@ -309,18 +309,17 @@ def test_moe_fused_world1():
     ctx = MoEReduceRSContext(axis="tp", world_size=world, num_experts=e,
                              topk=2, gemm=MatmulConfig(32, 256, 256))
     fn = jax.jit(shard_map_op(
-        lambda bb, ww, cc, nn: moe_reduce_rs_fused(bb, ww, cc, ctx,
-                                                   counts=nn),
+        lambda bb, ww: moe_reduce_rs_fused(bb, ww, plan, ctx),
         mesh,
-        in_specs=(P(None, None, None, None), P(None, None, None),
-                  P(None, None, None, None), P(None, None)),
+        in_specs=(P(None, None, None, None), P(None, None, None)),
         out_specs=P(None, None)))
-    out = fn(buckets, wdown, plan.combine_mats, plan.counts)
+    out = fn(buckets, wdown)
 
     partial = jnp.einsum("weck,ekn->wecn", buckets.astype(jnp.float32),
                          wdown.astype(jnp.float32))
-    ref = jnp.einsum("wemc,wecn->wmn", plan.combine_mats,
-                     partial).reshape(world * mc, n)
+    ref = jax.vmap(moe_utils.combine_tokens)(
+        partial, ids.reshape(world, mc, 2), plan.slot_of_pair,
+        w.reshape(world, mc, 2)).reshape(world * mc, n)
     assert _rel_err(out, ref) < 2e-2
 
 

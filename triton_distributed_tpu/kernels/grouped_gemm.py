@@ -388,57 +388,61 @@ def emit_grouped_matmul_w8a8(a_ref, b_ref, sa_ref, sb_ref, o_ref, *,
     )
 
 
-def emit_grouped_combine(a_ref, b_ref, cmat_ref, acc_scr, *,
-                         num_experts, cap, mc, n, k,
-                         config: Optional[MatmulConfig] = None,
-                         count_of=None):
-    """Producer-consumer fused grouped GEMM + one-hot combine:
-    ``acc_scr[mc, n] (+)= sum_e cmat[e] (mc, cap) @ (a[e] (cap, k) @
-    b[e] (k, n))`` in ONE software pipeline — each expert's down-GEMM
-    tile is consumed by the combine matmul while the next expert's
-    weight panel streams in.
+def emit_packed_combine(a_ref, b_ref, cmatb_ref, acc_scr, *,
+                        block_expert, block_slot, num_blocks,
+                        t_max, block, mc, n, k,
+                        config: Optional[MatmulConfig] = None,
+                        sa_ref=None, sb_ref=None):
+    """Ragged-packed grouped GEMM with the topk-weighted combine IN
+    THE EPILOGUE: ``acc_scr[mc, n] (+)= sum_t cmatb[t]ᵀ (mc, B) @
+    (a[e_t, s_t] (B, k) @ b[e_t] (k, n))`` in ONE software pipeline —
+    each expert row-block's down-GEMM tile is scaled-and-accumulated
+    into the chunk output as it leaves the MXU.  The (E, cap, n)
+    partials never exist, in VMEM or HBM, and the combine's MXU work
+    hides under the weight streaming that bounds the grouped GEMM at
+    decode shapes (E=64/cap=128: weights are 360 MB vs 33 MB of
+    activations).
 
-    This is the structural win of the fused MoE epilogue over the
-    staged composition: the (E, cap, n) partials never round-trip
-    HBM (the two-phase form wrote 23 MB of gstage then re-read it
-    per combine row-block — 8× at mc=2048/bm=256), and the combine's
-    MXU work (equal FLOPs to the GEMM itself) hides under the
-    weight streaming that bounds the grouped GEMM at decode shapes
-    (E=64/cap=128: weights are 360 MB vs 33 MB of activations).
-    Measured world=1 at that shape: 1474 µs (two-phase) → ~600 µs.
+    The iteration is the *packed block schedule* of
+    `moe_utils.plan_chunks`: ``block_expert`` / ``block_slot``
+    (callables ``t -> traced int32``, typically SMEM table reads —
+    the scalar-prefetch index-table idiom of `flash_decode_paged`)
+    map packed block t onto the dense (E, cap, k) bucket tensor, so
+    no data is repacked; ``num_blocks`` (traced int32 occupancy, or
+    None) skips everything past the last occupied block.  Skipping is
+    per B-row block, not per expert: a 5-token expert costs one block
+    of MXU rows instead of its full capacity — the MegaBlocks-style
+    cure for small-expert MFU.
+
+    With int8 operands, pass ``sa_ref`` ((E, cap, SCALE_LANES) f32
+    lane-broadcast per-token scales) and ``sb_ref`` ((E, 1, n) f32
+    per-expert channel scales): the GEMM accumulates int32 and the
+    epilogue dequantizes the tile before the combine — the w8a8 path
+    gets the same single-phase fusion as bf16.
 
     The caller owns ``acc_scr`` ((mc, n) f32 VMEM, zeroed at this
     pipeline's first step) and converts/sends it after the pipeline
-    returns.  Combine multiplies run in the cmat dtype (bf16 in
-    production) with f32 accumulation — same rounding as the
-    two-phase form, whose gstage buffer was bf16.
-
-    ``count_of`` as in :func:`emit_grouped_matmul`, at whole-expert
-    granularity (the GEMM row block spans the full capacity, see
-    below): experts with an empty bucket skip both the GEMM and the
-    combine — exact, because the combine coefficients of padded
-    slots are zero.
+    returns.  Combine multiplies run in the cmatb dtype (bf16 in
+    production) with f32 accumulation — same rounding as the staged
+    form, whose stage buffer is bf16.
     """
-    cfg = (config or MatmulConfig()).resolve(cap, n, k)
+    quantized = sa_ref is not None
+    cfg = (config or MatmulConfig()).resolve(block, n, k)
     bn, bk = cfg.block_n, cfg.block_k
     nk = pl.cdiv(k, bk)
-    # The combine slices cmat along its LANE dim (cap), so the GEMM
-    # row block must span the full (128-padded) capacity — lane
-    # slices narrower than 128 are unmappable.  cap is a handful of
-    # 128-blocks in practice, so the (cap, bn) f32 tile stays small.
-    bm = cap
+    acc_dt = jnp.int32 if quantized else jnp.float32
 
-    def inner(a_blk, b_blk, c_blk, gacc_ref):
-        e = pl.program_id(0)
+    def inner(gacc_ref, a_blk, b_blk, c_blk, *rest):
+        i = pl.program_id(0)
         j = pl.program_id(1)
         kk = pl.program_id(2)
 
         @pl.when(jnp.logical_and(
-            e == 0, jnp.logical_and(j == 0, kk == 0)))
+            i == 0, jnp.logical_and(j == 0, kk == 0)))
         def _():
             acc_scr[:] = jnp.zeros_like(acc_scr)
 
-        valid = count_of(e) > 0 if count_of is not None else None
+        valid = i < num_blocks if num_blocks is not None else None
 
         def gemm_step():
             @pl.when(kk == 0)
@@ -448,13 +452,22 @@ def emit_grouped_combine(a_ref, b_ref, cmat_ref, acc_scr, *,
             gacc_ref[:] += jax.lax.dot_general(
                 a_blk[0], b_blk[0],
                 dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+                preferred_element_type=acc_dt)
 
         def combine_step():
-            cm = c_blk[0]                       # (mc, cap)
+            cm = c_blk[0]                       # (B, mc)
+            if quantized:
+                sa_blk, sb_blk = rest
+                tile = (gacc_ref[:].astype(jnp.float32)
+                        * sa_blk[0][:, :1] * sb_blk[0])
+            else:
+                tile = gacc_ref[:]
+            # (B, mc)ᵀ-contraction with (B, bn): sublane-sliced cmatb
+            # (B is the sublane dim, mc rides the lanes whole), so
+            # the pack block only needs sublane alignment, not 128.
             acc_scr[:, pl.ds(j * bn, bn)] += jax.lax.dot_general(
-                cm, gacc_ref[:].astype(cm.dtype),
-                dimension_numbers=(((1,), (0,)), ((), ())),
+                cm, tile.astype(cm.dtype),
+                dimension_numbers=(((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
         if valid is None:
@@ -464,72 +477,182 @@ def emit_grouped_combine(a_ref, b_ref, cmat_ref, acc_scr, *,
             pl.when(valid)(gemm_step)
             pl.when(jnp.logical_and(valid, kk == nk - 1))(combine_step)
 
+    in_specs = [
+        pl.BlockSpec((1, block, bk),
+                     lambda i, j, kk: (block_expert(i), block_slot(i),
+                                       kk)),
+        pl.BlockSpec((1, bk, bn),
+                     lambda i, j, kk: (block_expert(i), kk, j)),
+        pl.BlockSpec((1, block, mc), lambda i, j, kk: (i, 0, 0)),
+    ]
+    operands = [a_ref, b_ref, cmatb_ref]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, block, SCALE_LANES),
+                         lambda i, j, kk: (block_expert(i),
+                                           block_slot(i), 0)),
+            pl.BlockSpec((1, 1, bn),
+                         lambda i, j, kk: (block_expert(i), 0, j)),
+        ]
+        operands += [sa_ref, sb_ref]
+
     def run(gacc_ref):
         pipeline = pltpu.emit_pipeline(
-            functools.partial(inner, gacc_ref=gacc_ref),
-            grid=(num_experts, pl.cdiv(n, bn), nk),
-            in_specs=[
-                pl.BlockSpec((1, bm, bk), lambda g, j, kk: (g, 0, kk)),
-                pl.BlockSpec((1, bk, bn), lambda g, j, kk: (g, kk, j)),
-                pl.BlockSpec((1, mc, bm), lambda g, j, kk: (g, 0, 0)),
-            ],
+            functools.partial(inner, gacc_ref),
+            grid=(t_max, pl.cdiv(n, bn), nk),
+            in_specs=in_specs,
             out_specs=[],
         )
-        pipeline(a_ref, b_ref, cmat_ref)
+        pipeline(*operands)
 
     pl.run_scoped(
         run,
-        gacc_ref=pltpu.VMEM((bm, min(bn, n)), jnp.float32),
+        gacc_ref=pltpu.VMEM((block, min(bn, n)), acc_dt),
     )
 
 
-def emit_combine_matmul(cmat_ref, stage_ref, o_ref, *, num_experts, m,
-                        cap, n, block_m: int = 256, block_n: int = 512,
-                        mul_f32: bool = True):
-    """o[m,n] = sum_e cmat[e] (m, cap) @ stage[e] (cap, n) — the
-    topk-weighted combine expressed as an accumulating one-hot matmul
-    (gathers become MXU work; the TPU analogue of the reference's
-    topk-reduce consumer, `moe_reduce_rs.py:486`).
+def emit_packed_matmul(a_ref, b_ref, o_ref, *, block_expert,
+                       block_slot, num_blocks, t_max, block, n, k,
+                       config: Optional[MatmulConfig] = None,
+                       sa_ref=None, sb_ref=None):
+    """Ragged-packed grouped matmul into a PACKED stage
+    ``o_ref (T, B, n)`` — the HBM-staged half of the two-phase fused
+    epilogue.  Same packed block schedule, operands and optional
+    int8 dequant epilogue as :func:`emit_packed_combine`, but the
+    tile is written to its packed stage row instead of being combined
+    in VMEM: the stage holds only occupied blocks (T·B rows, ≤ the
+    dense E·cap and typically far fewer), so the HBM round-trip the
+    two-phase form pays shrinks with the packing ratio.  Blocks past
+    ``num_blocks`` write zeros (never garbage — the packed combine
+    skips them anyway, but a NaN must not survive a schedule bug)."""
+    quantized = sa_ref is not None
+    cfg = (config or MatmulConfig()).resolve(block, n, k)
+    bn, bk = cfg.block_n, cfg.block_k
+    nk = pl.cdiv(k, bk)
+    acc_dt = jnp.int32 if quantized else jnp.float32
 
-    ``mul_f32``: f32×f32 products — identical math to the staged
-    `combine_tokens` (f32 weights × f32-cast values), but Mosaic runs
-    f32 MXU matmuls at ~1/3 the bf16 rate.  False multiplies in the
-    stage dtype (f32 accumulation either way) — the combine FLOPs
-    equal the grouped GEMM's own, so this is the difference between
-    the combine costing one GEMM or three."""
-    bm = min(block_m, m)
+    def inner(gacc_ref, *refs):
+        (a_blk, b_blk, *rest), o_blk = refs[:-1], refs[-1]
+        i = pl.program_id(0)
+        kk = pl.program_id(2)
+        valid = i < num_blocks if num_blocks is not None else None
+
+        def gemm_step():
+            @pl.when(kk == 0)
+            def _():
+                gacc_ref[:] = jnp.zeros_like(gacc_ref)
+
+            gacc_ref[:] += jax.lax.dot_general(
+                a_blk[0], b_blk[0],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=acc_dt)
+
+        def write_step():
+            if quantized:
+                sa_blk, sb_blk = rest
+                tile = (gacc_ref[:].astype(jnp.float32)
+                        * sa_blk[0][:, :1] * sb_blk[0])
+            else:
+                tile = gacc_ref[:]
+            o_blk[0] = tile.astype(o_blk.dtype)
+
+        if valid is None:
+            gemm_step()
+            pl.when(kk == nk - 1)(write_step)
+        else:
+            pl.when(valid)(gemm_step)
+            pl.when(jnp.logical_and(valid, kk == nk - 1))(write_step)
+
+            @pl.when(jnp.logical_and(jnp.logical_not(valid),
+                                     kk == nk - 1))
+            def _():
+                o_blk[0] = jnp.zeros_like(o_blk[0])
+
+    in_specs = [
+        pl.BlockSpec((1, block, bk),
+                     lambda i, j, kk: (block_expert(i), block_slot(i),
+                                       kk)),
+        pl.BlockSpec((1, bk, bn),
+                     lambda i, j, kk: (block_expert(i), kk, j)),
+    ]
+    operands = [a_ref, b_ref]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, block, SCALE_LANES),
+                         lambda i, j, kk: (block_expert(i),
+                                           block_slot(i), 0)),
+            pl.BlockSpec((1, 1, bn),
+                         lambda i, j, kk: (block_expert(i), 0, j)),
+        ]
+        operands += [sa_ref, sb_ref]
+
+    def run(gacc_ref):
+        pipeline = pltpu.emit_pipeline(
+            functools.partial(inner, gacc_ref),
+            grid=(t_max, pl.cdiv(n, bn), nk),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, block, bn), lambda i, j, kk: (i, 0, j)),
+            ],
+        )
+        pipeline(*operands, o_ref)
+
+    pl.run_scoped(
+        run,
+        gacc_ref=pltpu.VMEM((block, min(bn, n)), acc_dt),
+    )
+
+
+def emit_packed_combine_matmul(cmatb_ref, stage_ref, o_ref, *,
+                               num_blocks, t_max, block, mc, n,
+                               block_m: int = 256, block_n: int = 512):
+    """``o[mc, n] = sum_t cmatb[t]ᵀ (mc, B) @ stage[t] (B, n)`` — the
+    combine half of the two-phase fused epilogue, consuming the
+    PACKED stage `emit_packed_matmul` produced.  Blocks past
+    ``num_blocks`` (traced occupancy, or None) are skipped.
+    Multiplies run in the cmatb dtype with f32 accumulation, the same
+    rounding as the single-phase epilogue."""
+    bm = min(block_m, mc)
     bn = min(block_n, n)
 
     def inner(c_blk, s_blk, o_blk, acc_ref):
-        e = pl.program_id(2)
+        i = pl.program_id(2)
 
-        @pl.when(e == 0)
+        @pl.when(i == 0)
         def _():
             acc_ref[:] = jnp.zeros_like(acc_ref)
 
-        mul_dt = jnp.float32 if mul_f32 else s_blk.dtype
-        acc_ref[:] += jax.lax.dot_general(
-            c_blk[0].astype(mul_dt), s_blk[0].astype(mul_dt),
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        valid = i < num_blocks if num_blocks is not None else None
 
-        @pl.when(e == num_experts - 1)
+        def accumulate():
+            cm = c_blk[0]                       # (B, bm)
+            acc_ref[:] += jax.lax.dot_general(
+                cm, s_blk[0].astype(cm.dtype),
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        if valid is None:
+            accumulate()
+        else:
+            pl.when(valid)(accumulate)
+
+        @pl.when(i == t_max - 1)
         def _():
             o_blk[:] = acc_ref[:].astype(o_blk.dtype)
 
     def run(acc_ref):
         pipeline = pltpu.emit_pipeline(
             functools.partial(inner, acc_ref=acc_ref),
-            grid=(pl.cdiv(m, bm), pl.cdiv(n, bn), num_experts),
+            grid=(pl.cdiv(mc, bm), pl.cdiv(n, bn), t_max),
             in_specs=[
-                pl.BlockSpec((1, bm, cap), lambda i, j, e: (e, i, 0)),
-                pl.BlockSpec((1, cap, bn), lambda i, j, e: (e, 0, j)),
+                pl.BlockSpec((1, block, bm), lambda mi, j, i: (i, 0, mi)),
+                pl.BlockSpec((1, block, bn), lambda mi, j, i: (i, 0, j)),
             ],
             out_specs=[
-                pl.BlockSpec((bm, bn), lambda i, j, e: (i, j)),
+                pl.BlockSpec((bm, bn), lambda mi, j, i: (mi, j)),
             ],
         )
-        pipeline(cmat_ref, stage_ref, o_ref)
+        pipeline(cmatb_ref, stage_ref, o_ref)
 
     pl.run_scoped(run, acc_ref=pltpu.VMEM((bm, bn), jnp.float32))
 

@@ -17,7 +17,8 @@ the reference, pass ``capacity = n_tokens * topk``.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+import math
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -123,49 +124,167 @@ def combine_matrix(expert_ids, slot_of_pair, weights, num_experts: int,
             .add(w.reshape(-1)))
 
 
+def pack_block(capacity: int) -> int:
+    """Default ragged-packing row-block: the largest power-of-two ≤ 128
+    that divides ``capacity``.  Capacity is sublane-aligned upstream
+    (16 for 2-byte, 32 for int8 — `MoEMLP.capacity`), so the result is
+    always a legal Mosaic sublane multiple for the bucket dtype."""
+    return math.gcd(capacity, 128)
+
+
+def packed_block_bound(n_pairs: int, num_experts: int, capacity: int,
+                       block: int) -> int:
+    """Static row-block budget T of a packed plan (shape-only).
+
+    Each expert occupies ``ceil(min(count_e, capacity) / block)``
+    blocks; over all experts that is bounded both by
+    ``floor(n_pairs / block) + num_experts`` (every expert wastes less
+    than one block of alignment) and by ``num_experts *
+    (capacity // block)`` (the dense capacity grid).  The min of the
+    two is tight enough that a packed plan never allocates more
+    combine rows than the dense layout did."""
+    assert capacity % block == 0, (capacity, block)
+    return max(min(n_pairs // block + num_experts,
+                   num_experts * (capacity // block)), 1)
+
+
 class ChunkPlan(NamedTuple):
     """Per-chunk (destination-rank) routing for the fused MoE epilogue.
+
+    The dense (E, cap) slot grid is *iterated* raggedly: only the
+    leading ``ceil(min(count_e, cap) / block)`` row-blocks of each
+    expert are visited, and the visit order packs all experts'
+    occupied blocks front-to-back.  Blocks are (expert, slot-block)
+    coordinates into the DENSE bucket tensor, so no data moves — the
+    packed layout is an index-table schedule (the scalar-prefetch
+    idiom of `flash_decode_paged`), the TPU analogue of MegaBlocks'
+    block-sparse ragged layout.
 
     All fields are replicated on every rank (each rank computes every
     chunk's partial output):
 
     dispatch_index: (world, E, cap) int32 — chunk-local source token
       index per expert slot (sentinel mc = empty).
-    combine_mats:   (world, E, mc, cap) — one-hot combine weights per
-      chunk, laid out expert-major for `emit_combine_matmul`.
     counts:         (world, E) int32 — true tokens per (chunk, expert)
-      bucket (≤ cap); drives empty-tile skipping in the grouped GEMMs
-      (the token-count-driven scheduling of the reference's
-      `threadblock_swizzle_ag_moe`).
+      bucket (≤ cap); drives empty-tile skipping in the AG-side
+      grouped GEMM (the token-count-driven scheduling of the
+      reference's `threadblock_swizzle_ag_moe`).
+    slot_of_pair:   (world, mc, topk) int32 — slot each (token, k)
+      pair landed in (-1 = dropped); the gather-based golden combine
+      reads this directly, so no path needs a dense one-hot.
+    block_expert:   (world, T) int32 — expert of packed block t
+      (0 padding past ``n_blocks``).
+    block_slot:     (world, T) int32 — slot-block index within that
+      expert (slot rows [block_slot·B, block_slot·B + B)).
+    n_blocks:       (world,) int32 — per-chunk packed-block occupancy.
+    combine_blocks: (world, T, B, mc) — per-packed-block combine
+      weights, transposed so the epilogue's combine matmul slices
+      along the B sublanes (mc rides the lanes whole).  Built
+      directly from the packed tables — the dense
+      (mc, E·cap) one-hot of the old `combine_mats` is never
+      materialised.
     """
 
     dispatch_index: jnp.ndarray
-    combine_mats: jnp.ndarray
     counts: jnp.ndarray
+    slot_of_pair: jnp.ndarray
+    block_expert: jnp.ndarray
+    block_slot: jnp.ndarray
+    n_blocks: jnp.ndarray
+    combine_blocks: jnp.ndarray
+
+    @property
+    def pack_block_size(self) -> int:
+        return self.combine_blocks.shape[2]
+
+    @property
+    def num_blocks_static(self) -> int:
+        return self.combine_blocks.shape[1]
+
+
+def _pack_chunk(ids, w, num_experts: int, capacity: int, block: int,
+                t_max: int, dtype):
+    """Route + pack ONE chunk (vmapped by `plan_chunks`)."""
+    mc, topk = ids.shape
+    r = route_capacity(ids, num_experts, capacity)
+    counts = jnp.minimum(r.counts, capacity).astype(jnp.int32)
+
+    # Ragged block tables: expert e owns ceil(counts_e / block)
+    # packed blocks, laid out front-to-back in expert order.
+    blocks_e = (counts + block - 1) // block            # (E,)
+    cum = jnp.cumsum(blocks_e)                          # inclusive
+    off = cum - blocks_e                                # exclusive
+    total = cum[-1]
+    t_ids = jax.lax.broadcasted_iota(jnp.int32, (t_max, 1), 0)[:, 0]
+    used = t_ids < total
+    bexp = jnp.where(
+        used,
+        jnp.searchsorted(cum, t_ids, side="right").astype(jnp.int32),
+        0)
+    bslot = jnp.where(used, t_ids - off[bexp], 0).astype(jnp.int32)
+
+    # Combine weights per packed block, scattered straight into the
+    # (T, B, mc) layout: pair (token i, slot s of expert e) lands in
+    # block off_e + s // B, row s % B, column i.  Dropped pairs
+    # (slot -1) get an out-of-range block index and mode="drop".
+    kept = r.slot_of_pair >= 0
+    safe_slot = jnp.where(kept, r.slot_of_pair, 0)
+    pair_e = ids.reshape(-1)
+    pair_s = safe_slot.reshape(-1)
+    pair_t = jnp.where(kept.reshape(-1),
+                       off[pair_e] + pair_s // block, t_max)
+    pair_row = pair_s % block
+    pair_tok = jax.lax.broadcasted_iota(
+        jnp.int32, (mc, topk), 0).reshape(-1)
+    wv = jnp.where(kept, w, 0.0).astype(dtype).reshape(-1)
+    cmatb = (jnp.zeros((t_max, block, mc), dtype)
+             .at[pair_t, pair_row, pair_tok].add(wv, mode="drop"))
+
+    return (r.dispatch_index, counts, r.slot_of_pair, bexp, bslot,
+            total.astype(jnp.int32), cmatb)
 
 
 def plan_chunks(expert_ids, weights, world: int, num_experts: int,
-                capacity: int, dtype=jnp.float32) -> ChunkPlan:
+                capacity: int, dtype=jnp.float32,
+                block: Optional[int] = None) -> ChunkPlan:
     """Build per-chunk routing plans: tokens are row-partitioned into
     `world` chunks (chunk c = rows destined for rank c after the
     reduce-scatter) and each chunk is routed independently with its
-    own capacity.  expert_ids / weights: (n_tokens, topk)."""
+    own capacity, then ragged-row-packed at ``block`` granularity
+    (default `pack_block(capacity)`).  expert_ids / weights:
+    (n_tokens, topk)."""
     n_tokens, topk = expert_ids.shape
     assert n_tokens % world == 0, (n_tokens, world)
     mc = n_tokens // world
+    block = block or pack_block(capacity)
+    t_max = packed_block_bound(mc * topk, num_experts, capacity, block)
     ids_c = expert_ids.reshape(world, mc, topk)
     w_c = weights.reshape(world, mc, topk)
 
-    def per_chunk(ids, w):
-        r = route_capacity(ids, num_experts, capacity)
-        cm = combine_matrix(ids, r.slot_of_pair, w, num_experts,
-                            capacity, dtype)
-        counts = jnp.minimum(r.counts, capacity).astype(jnp.int32)
-        return r.dispatch_index, cm.transpose(1, 0, 2), counts
+    fields = jax.vmap(
+        lambda i, w: _pack_chunk(i, w, num_experts, capacity, block,
+                                 t_max, dtype))(ids_c, w_c)
+    return ChunkPlan(*fields)
 
-    dispatch, cmats, counts = jax.vmap(per_chunk)(ids_c, w_c)
-    return ChunkPlan(dispatch_index=dispatch, combine_mats=cmats,
-                     counts=counts)
+
+def dense_combine_mats(plan: ChunkPlan, capacity: int):
+    """Reconstruct the dense (world, E, mc, cap) combine tensor from a
+    packed plan — golden/test utility only (the hot paths consume the
+    packed layout directly)."""
+    world, t_max, block, mc = plan.combine_blocks.shape
+    e = plan.counts.shape[1]
+
+    def per_chunk(bexp, bslot, nblk, cmatb):
+        t_ids = jax.lax.broadcasted_iota(jnp.int32, (t_max, 1), 0)[:, 0]
+        safe_e = jnp.where(t_ids < nblk, bexp, e)
+        dense = jnp.zeros((e, capacity // block, block, mc),
+                          plan.combine_blocks.dtype)
+        dense = dense.at[safe_e, bslot].add(cmatb, mode="drop")
+        # (E, cap/B, B, mc) -> (E, mc, cap)
+        return dense.reshape(e, capacity, mc).transpose(0, 2, 1)
+
+    return jax.vmap(per_chunk)(plan.block_expert, plan.block_slot,
+                               plan.n_blocks, plan.combine_blocks)
 
 
 def tokens_per_rank(expert_ids, num_experts: int, ep_size: int):

@@ -17,9 +17,12 @@ x is sequence(M)-sharded like TPMLP):
 3. `ag_group_gemm`: ring-allgather the buckets while the MXU runs the
    gate/up grouped GEMM per arrived chunk → (world, E, cap, 2*f_loc);
 4. gated silu (XLA fuses this elementwise stage);
-5. `moe_reduce_rs_fused`: per destination chunk, grouped down GEMM +
-   one-hot combine matmul, chunk put to its owner over ICI while the
-   next chunk computes, final VPU reduction → (mc, hidden).
+5. `moe_reduce_rs_fused`: per destination chunk, ragged-packed
+   grouped down GEMM with the topk-weighted combine folded into the
+   epilogue (each occupied expert row-block is scaled-and-accumulated
+   into the chunk output as it leaves the MXU), chunk put to its
+   owner over ICI while the next chunk computes, final VPU reduction
+   → (mc, hidden).
 
 Mode "xla" is the same math in pure XLA ops (golden / GSPMD baseline).
 """
@@ -148,7 +151,10 @@ class MoEMLP:
             ids_all, w_all, self.world_size, self.num_experts, cap)
 
     def _fwd_xla(self, x, params):
-        """Golden: same per-chunk capacity semantics, pure XLA ops."""
+        """Golden: same per-chunk capacity semantics, pure XLA ops.
+        The combine is the gather-based `combine_tokens` per chunk —
+        no path, golden included, materialises a dense (mc, E·cap)
+        one-hot per dispatch any more."""
         world = self.world_size
         mc = x.shape[0]
         cap = self.capacity(mc)
@@ -165,10 +171,11 @@ class MoEMLP:
         act = gated_silu(inter)                      # (w, E, cap, f_loc)
         partial = jnp.einsum("wecf,efh->wech", act, params["down"],
                              preferred_element_type=jnp.float32)
-        # per-chunk combine: (E, mc, cap) x (E, cap, h) summed over E
-        combined = jnp.einsum("wemc,wech->wmh",
-                              plan.combine_mats,
-                              partial).astype(x.dtype)  # (w, mc, h)
+        ids_c = ids.reshape(world, mc, self.topk)
+        w_c = w.reshape(world, mc, self.topk)
+        combined = jax.vmap(moe_utils.combine_tokens)(
+            partial, ids_c, plan.slot_of_pair, w_c)  # (w, mc, h)
+        combined = combined.astype(x.dtype)
         return jax.lax.psum_scatter(combined, self.axis,
                                     scatter_dimension=0, tiled=False)
 
@@ -176,10 +183,10 @@ class MoEMLP:
         """Stages 1-2 of the fused pipeline, shared by the bf16 and
         w8a8 paths: local routing + capacity bucketing, plus the
         per-chunk routing metadata (tiny id/weight allgather —
-        plan.counts drives empty-tile skipping in BOTH grouped GEMMs,
-        combine_mats the fused epilogue; chunk c's plan == rank c's
-        own routing, same deterministic route_capacity on the same
-        ids)."""
+        plan.counts drives empty-tile skipping in the AG grouped
+        GEMM, the packed block tables + combine_blocks the fused
+        epilogue; chunk c's plan == rank c's own routing, same
+        deterministic route_capacity on the same ids)."""
         cap = self.capacity(x.shape[0])
         ids_loc, w_loc = self._route(x, router)
         routing = moe_utils.route_capacity(ids_loc, self.num_experts,
@@ -210,13 +217,11 @@ class MoEMLP:
                               counts=plan.counts)
         # 4. activation (XLA elementwise, fused into the surroundings)
         act = gated_silu(inter)                      # (w, E, cap, f_loc)
-        # 5. the fused grouped-GEMM + combine + RS epilogue
-        # (combine_mats are cast to the activation dtype inside
+        # 5. the fused packed grouped-GEMM + combine-in-epilogue + RS
+        # (combine_blocks are cast to the activation dtype inside
         # moe_reduce_rs_fused — ADVICE r5: the combine matmul then
         # runs at the measured bf16 MXU rate, not the f32 one.)
-        return moe_reduce_rs_fused(act, params["down"],
-                                   plan.combine_mats, rs_ctx,
-                                   counts=plan.counts)
+        return moe_reduce_rs_fused(act, params["down"], plan, rs_ctx)
 
     def _fwd_w8a8(self, x, params):
         """`_fwd_fused` with int8 weights: the ring forwards int8
@@ -232,9 +237,7 @@ class MoEMLP:
             buckets, params["gate_up_q"], params["gate_up_scale"],
             ag_ctx, counts=plan.counts)
         act = gated_silu(inter)                      # (w, E, cap, f_loc)
-        return moe_reduce_rs_fused(act, params["down_q"],
-                                   plan.combine_mats, rs_ctx,
-                                   counts=plan.counts,
+        return moe_reduce_rs_fused(act, params["down_q"], plan, rs_ctx,
                                    weight_scales=params["down_scale"])
 
     def __call__(self, x, params):

@@ -77,7 +77,9 @@ _OP_TO_KERNEL = {
     ("reduce_scatter_torus", None): "torus.reduce_scatter",
     ("moe_reduce_rs_fused", "fused"): "moe_reduce_rs.fused",
     ("moe_reduce_rs_fused", "two_phase"): "moe_reduce_rs.two_phase",
-    ("moe_reduce_rs_fused", "w8a8"): "moe_reduce_rs.w8a8",
+    ("moe_reduce_rs_fused", "w8a8_fused"): "moe_reduce_rs.w8a8",
+    ("moe_reduce_rs_fused", "w8a8_two_phase"):
+        "moe_reduce_rs.w8a8_two_phase",
     ("all_to_all", "auto"): "all_to_all.plain",
     ("sp_ag_attention_fused", "fused"): "sp_ag_attention.fused",
     ("sp_ring_attention", "ring"): "sp_ag_attention.fused",
