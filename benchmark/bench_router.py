@@ -27,12 +27,27 @@ Emitted rows (one JSON line each, ``bench: "router"``):
   under seeded imbalance);
 - ``workload: "balanced"`` — the paired summary carries
   ``matches_round_robin`` (identical assignments — balanced signals
-  reproduce the rotation exactly) and ``signal_aware_never_worse``.
+  reproduce the rotation exactly) and ``signal_aware_never_worse``;
+- ``workload: "kvtier_fleet"`` — the SHARED-PREFIX fleet trace (KV
+  tier, ISSUE 15): one hot system prompt served through 1/2/4 paged
+  replicas with peer prefix shipping armed (seeded prefill baseline
+  + synthetic bus, so the ship-vs-recompute model engages
+  deterministically).  Each row carries the fleet-wide prefill work
+  in tokens (``fleet_prefill_tokens`` = prefix-cache miss tokens
+  summed over every replica), ``prefix_ships``,
+  ``zero_second_prefill`` (the shared prefix was full-prefilled
+  exactly ONCE across the whole fleet — replicas B..N served it
+  from the peer tier), ``fleet_prefill_sublinear``
+  (work(n) < n × work(1)) and ``prefix_ship_exact`` (token-for-token
+  vs the single-engine scheduler); the n=2 row also pairs against a
+  ship-disabled run (``ship_beats_recompute``: strictly fewer
+  prefill tokens with shipping on).
 
 Gate semantics (`scripts/check_bench_regression.py`
-``router_checks``): every fresh imbalance pair must report
-``signal_aware_beats_rr`` and every balanced pair
-``matches_round_robin`` + ``signal_aware_never_worse``.
+``router_checks`` + ``kvtier_checks``): every fresh imbalance pair
+must report ``signal_aware_beats_rr``, every balanced pair
+``matches_round_robin`` + ``signal_aware_never_worse``, and every
+kvtier_fleet row must hold all four KV-tier booleans.
 """
 
 import os
@@ -170,6 +185,122 @@ def run_cluster(model, params, trace, n_replicas, mode,
     }
 
 
+def kvtier_fleet_rows(model, params):
+    """The shared-prefix fleet sweep: fleet-wide prefill work must be
+    SUB-LINEAR in replica count because a prefix prefilled on replica
+    A serves every other replica through the peer tier with zero
+    second prefill (docs/serving.md "Cache hierarchy")."""
+    import tempfile
+
+    from triton_distributed_tpu.observability import (
+        feedback, get_registry)
+    from triton_distributed_tpu.observability.anomaly import (
+        WINDOW, BaselineStore)
+    from triton_distributed_tpu.serving import (
+        ContinuousBatchingScheduler, Request)
+    from triton_distributed_tpu.serving.scheduler import (
+        prefill_baseline_key)
+
+    rng = np.random.default_rng(99)
+    sysp = [int(x) for x in rng.integers(1, 61, 32)]  # 2 full pages
+    trace = [dict(prompt=sysp + [1 + i, 2 + i],
+                  max_new_tokens=4 + (i % 3), seed=500 + i,
+                  arrival_time=0.0 if i == 0 else 0.004)
+             for i in range(12)]
+    sc = SchedulerConfig(num_slots=SLOTS,
+                         prefill_buckets=(8, 16, 32, 64),
+                         kv_layout="paged", page_size=16)
+    # Seeded prefill baseline (what "recompute" is predicted to
+    # cost) + a synthetic bus: the ship-vs-recompute model engages
+    # deterministically, machine-independently.
+    store = BaselineStore(os.path.join(
+        tempfile.mkdtemp(prefix="tdt-kvtier-"), "baselines.json"))
+    for b in (16, 32, 64):
+        for _ in range(WINDOW):
+            store.observe(prefill_baseline_key(b), 5000.0)
+    # Frozen clock so the scripted snapshot never goes stale on
+    # a slow host mid-bench (machine-independence).
+    bus = feedback.synthetic_bus(store=store, ts=0.0,
+                                 clock=lambda: 0.0)
+
+    def run_fleet(n_replicas, ship):
+        from triton_distributed_tpu.observability.lineage import (
+            get_lineage_recorder)
+        get_lineage_recorder().clear()
+        get_registry().clear()
+        feedback.clear_recent_decisions()
+        cluster = ServingCluster(model, params, ClusterConfig(
+            n_replicas=n_replicas,
+            scheduler=sc,
+            router=RouterConfig(affinity_tokens=0, prefix_ship=ship),
+            step_time_s=STEP_S, prefill_time_s=PREFILL_S, bus=bus))
+        recs = [cluster.submit(**t) for t in trace]
+        done = cluster.drain()
+        assert len(done) == len(trace), [r.state for r in recs]
+        snap = get_registry().snapshot()
+        flips = sum(1 for d in feedback.recent_decisions()
+                    if d.consumer == "cluster.kv_fetch"
+                    and d.choice == "peer_ship")
+        return {
+            "streams": [r.tokens for r in
+                        sorted(done, key=lambda r: r.record_id)],
+            "replicas_used": len({r.replica_history[0]
+                                  for r in recs}),
+            "prefill_tokens": int(snap["counters"].get(
+                "serving_prefix_cache_miss_tokens_total", 0)),
+            "ships": int(snap["counters"].get(
+                "cluster_prefix_ships_total", 0)),
+            "shipped_pages": int(snap["counters"].get(
+                "cluster_prefix_pages_shipped_total", 0)),
+            "peer_hits": int(snap["counters"].get(
+                'serving_kvtier_hit_total{tier="peer"}', 0)),
+            "flips": flips,
+        }
+
+    # Single-engine reference (exactness) + the once-across-the-fleet
+    # prefill-work floor: the whole prompt once, then one private
+    # suffix (2 tokens) per later request.
+    class _C:
+        t = 0.0
+    c = _C()
+    ref_sched = ContinuousBatchingScheduler(
+        model, params, sc, clock=lambda: c.t,
+        clock_advance=lambda dt: setattr(c, "t", c.t + dt))
+    ref_done = ref_sched.run([Request(**t) for t in trace])
+    ref = [r.generated for r in sorted(ref_done,
+                                       key=lambda r: r.request_id)]
+    floor = len(trace[0]["prompt"]) + 2 * (len(trace) - 1)
+
+    base = run_fleet(1, ship=True)
+    no_ship = run_fleet(2, ship=False)
+    rows = []
+    for n in (1, 2, 4):
+        r = base if n == 1 else run_fleet(n, ship=True)
+        exact = r["streams"] == ref
+        rec = dict(
+            bench="router", workload="kvtier_fleet", n_replicas=n,
+            mode="prefix_ship",
+            fleet_prefill_tokens=r["prefill_tokens"],
+            prefix_ships=r["ships"],
+            shipped_pages=r["shipped_pages"],
+            peer_hits=r["peer_hits"],
+            kv_fetch_flips=r["flips"],
+            replicas_used=r["replicas_used"],
+            prefix_ship_exact=exact,
+            zero_second_prefill=(r["prefill_tokens"] == floor),
+            fleet_prefill_sublinear=(
+                r["prefill_tokens"] < n * base["prefill_tokens"]
+                if n > 1 else True),
+            peer_ship_flipped=(r["flips"] >= 1 if n > 1 else True),
+        )
+        if n == 2:
+            rec["prefill_tokens_no_ship"] = no_ship["prefill_tokens"]
+            rec["ship_beats_recompute"] = (
+                r["prefill_tokens"] < no_ship["prefill_tokens"])
+        rows.append(rec)
+    return rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None,
@@ -235,6 +366,10 @@ def main():
                   speedup_ttft=round(rr["mean_ttft_ms"]
                                      / sa["mean_ttft_ms"], 4),
                   signal_aware_beats_rr=sa["ms"] < rr["ms"]))
+
+    # -- KV tier: shared-prefix fleet (peer prefix shipping) ------------
+    for rec in kvtier_fleet_rows(model, params):
+        emit(rec)
 
     # -- balanced: signal-aware must match round-robin exactly ----------
     htrace = build_trace(homogeneous=True)

@@ -90,6 +90,14 @@ MEASUREMENT_FIELDS = {
     # Request-lineage TTFT decomposition (bench_router / bench_chaos
     # rows; gated for hop-sum ≡ TTFT consistency by lineage_checks).
     "hop_p50_ms", "hop_p99_ms", "hop_sum_exact",
+    # KV-tier shared-prefix fleet rows (bench_router.py
+    # workload="kvtier_fleet"; the booleans are gated by
+    # kvtier_checks).
+    "fleet_prefill_tokens", "prefix_ships", "shipped_pages",
+    "peer_hits", "kv_fetch_flips", "replicas_used",
+    "prefix_ship_exact", "zero_second_prefill",
+    "fleet_prefill_sublinear", "peer_ship_flipped",
+    "prefill_tokens_no_ship", "ship_beats_recompute",
     # Chaos bench rows (bench_chaos.py): absorption counters + the
     # overhead summary are run outputs.
     "retries", "reroutes", "duplicates", "corrupt_nacks",
@@ -330,6 +338,54 @@ def moe_checks(fresh) -> tuple:
     return checked, fails
 
 
+def kvtier_checks(fresh) -> tuple:
+    """Gates specific to the KV-tier shared-prefix fleet rows
+    (`benchmark/bench_router.py` ``workload="kvtier_fleet"`` — the
+    ISSUE-15 acceptance bars; each holds by construction of the tier,
+    so a failure is a behavior change, not noise):
+
+    - ``prefix_ship_exact`` — fleet output is token-for-token
+      identical to the single-engine scheduler;
+    - ``zero_second_prefill`` — the shared prefix was full-prefilled
+      exactly ONCE across the whole fleet (peer shipments served
+      every other replica);
+    - ``fleet_prefill_sublinear`` — fleet-wide prefill work grows
+      sub-linearly in replica count;
+    - ``peer_ship_flipped`` — the ship-vs-recompute model chose
+      ``peer_ship`` at least once (modeled ship cost beat the
+      predicted prefill cost);
+    - ``ship_beats_recompute`` (the paired n=2 row) — shipping
+      strictly reduced fleet prefill tokens vs the ship-disabled run.
+
+    Returns ``(n_checked, failures)``."""
+    fails = []
+    checked = 0
+    required = ("prefix_ship_exact", "zero_second_prefill",
+                "fleet_prefill_sublinear", "peer_ship_flipped")
+    for rec in fresh:
+        if (rec.get("bench") != "router"
+                or rec.get("workload") != "kvtier_fleet"):
+            continue
+        checked += 1
+        bools = required + (("ship_beats_recompute",)
+                            if (rec.get("n_replicas") == 2
+                                or "ship_beats_recompute" in rec)
+                            else ())
+        for field in bools:
+            # A MISSING field fails too: dropping or renaming a gate
+            # boolean in a bench refactor must break the gate, not
+            # silently disable it.
+            if rec.get(field) is not True:
+                fails.append(
+                    f"kvtier regression: kvtier_fleet "
+                    f"n_replicas={rec.get('n_replicas')} reports "
+                    f"{field}={rec.get(field)!r} "
+                    f"(fleet_prefill_tokens="
+                    f"{rec.get('fleet_prefill_tokens')}, "
+                    f"prefix_ships={rec.get('prefix_ships')})")
+    return checked, fails
+
+
 def lineage_checks(fresh) -> tuple:
     """Gate specific to the request-lineage instrumentation
     (`observability.lineage`): every fresh row that carries a TTFT
@@ -447,6 +503,7 @@ def main() -> int:
 
     cl_checked, cl_fails = closed_loop_checks(fresh, base)
     rt_checked, rt_fails = router_checks(fresh)
+    kt_checked, kt_fails = kvtier_checks(fresh)
     ln_checked, ln_fails = lineage_checks(fresh)
     sp_checked, sp_fails = spec_checks(fresh)
     moe_checked, moe_fails = moe_checks(fresh)
@@ -455,7 +512,7 @@ def main() -> int:
     print("## Bench regression check")
     print()
     verdict = ("FAIL" if regressions or cl_fails or rt_fails
-               or ln_fails or sp_fails or moe_fails else
+               or kt_fails or ln_fails or sp_fails or moe_fails else
                "OK (with anomalies)" if anomalies else "OK")
     print(f"**{verdict}** — {compared} row(s) compared, "
           f"{regressions} regression(s) beyond "
@@ -487,6 +544,14 @@ def main() -> int:
               f"parity), {len(rt_fails)} failure(s).")
         for f in rt_fails:
             print(f"- {f}")
+    if kt_checked:
+        print()
+        print(f"KV-tier gate: {kt_checked} row(s) checked (fleet "
+              f"exactness + zero second prefill + sub-linear fleet "
+              f"prefill + ship-vs-recompute flip), "
+              f"{len(kt_fails)} failure(s).")
+        for f in kt_fails:
+            print(f"- {f}")
     if ln_checked:
         print()
         print(f"Lineage gate: {ln_checked} row(s) checked (per-hop "
@@ -509,11 +574,11 @@ def main() -> int:
         for f in moe_fails:
             print(f"- {f}")
     if (compared == 0 and cl_checked == 0 and rt_checked == 0
-            and ln_checked == 0 and sp_checked == 0
-            and moe_checked == 0):
+            and kt_checked == 0 and ln_checked == 0
+            and sp_checked == 0 and moe_checked == 0):
         return 2
-    return 1 if (regressions or cl_fails or rt_fails or ln_fails
-                 or sp_fails or moe_fails) else 0
+    return 1 if (regressions or cl_fails or rt_fails or kt_fails
+                 or ln_fails or sp_fails or moe_fails) else 0
 
 
 if __name__ == "__main__":

@@ -38,7 +38,11 @@
 #  - a speculative-decoding smoke (draft-verify rounds on both KV
 #    layouts, n-gram AND draft-model sources, greedy + sampled ->
 #    token-for-token vs the non-speculative engine, exact KV
-#    rollback, accept metrics in the Prometheus render).
+#    rollback, accept metrics in the Prometheus render);
+#  - a KV-tier smoke (2-replica virtual cluster: a prefix prefilled
+#    on replica A served from replica B via peer prefix shipment with
+#    zero second prefill, bit-exact; per-tier hit counters in the
+#    Prometheus render; doctor "KV tier" section).
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -659,6 +663,111 @@ lineage_rc=$?
 echo "$lineage_log" | tail -3
 if [ "$lineage_rc" -ne 0 ]; then
     echo "LINEAGE_SMOKE=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
+# KV-tier smoke (ISSUE 15): the cluster-wide cache hierarchy end to
+# end on the virtual clock — a prefix prefilled on replica A is
+# served from replica B via a peer PREFIX shipment (real bytes + CRC
+# on the wire) with zero second prefill, token-for-token identical to
+# the single-engine scheduler; the per-tier hit counters render in
+# the Prometheus export and the doctor renders a "KV tier" section
+# from a heartbeat carrying the tier gauges.
+kvtier_log=$(JAX_PLATFORMS=cpu python - <<'EOF' 2>&1
+import json, os, tempfile
+os.environ["TDT_ANOMALY_BASELINES"] = os.path.join(
+    tempfile.mkdtemp(prefix="tdt-kvt-b-"), "baselines.json")
+import jax
+import numpy as np
+from triton_distributed_tpu.observability import (
+    feedback, get_registry, prometheus_text)
+from triton_distributed_tpu.observability.anomaly import (
+    WINDOW, BaselineStore)
+from triton_distributed_tpu.observability.doctor import (
+    diagnose, render_markdown)
+from triton_distributed_tpu.observability.exporter import (
+    heartbeat_payload)
+from triton_distributed_tpu.serving import (
+    ClusterConfig, ContinuousBatchingScheduler, Request,
+    SchedulerConfig, ServingCluster, ToyConfig, ToyModel)
+from triton_distributed_tpu.serving.cluster import RouterConfig
+from triton_distributed_tpu.serving.scheduler import (
+    prefill_baseline_key)
+
+model = ToyModel(ToyConfig(vocab_size=61, hidden=16, max_seq_len=64))
+params = model.init_params(jax.random.key(0))
+rng = np.random.default_rng(7)
+sysp = [int(x) for x in rng.integers(1, 61, 32)]  # 2 full KV pages
+trace = [dict(prompt=sysp + [1 + i, 2 + i], max_new_tokens=3 + (i % 3),
+              seed=i, arrival_time=0.0 if i == 0 else 0.004)
+         for i in range(6)]
+sc = SchedulerConfig(num_slots=2, prefill_buckets=(8, 16, 32, 64),
+                     kv_layout="paged", page_size=16)
+
+# single-engine reference (the exactness bar)
+class Clock:
+    t = 0.0
+c = Clock()
+sched = ContinuousBatchingScheduler(
+    model, params, sc, clock=lambda: c.t,
+    clock_advance=lambda dt: setattr(c, "t", c.t + dt))
+ref = [r.generated for r in
+       sorted(sched.run([Request(**t) for t in trace]),
+              key=lambda r: r.request_id)]
+
+# seeded prefill baseline + synthetic bus: the ship-vs-recompute
+# model engages deterministically
+store = BaselineStore(os.environ["TDT_ANOMALY_BASELINES"])
+for b in (16, 32, 64):
+    for _ in range(WINDOW):
+        store.observe(prefill_baseline_key(b), 5000.0)
+get_registry().clear()
+feedback.clear_recent_decisions()
+cluster = ServingCluster(model, params, ClusterConfig(
+    n_replicas=2, scheduler=sc,
+    router=RouterConfig(affinity_tokens=0),
+    bus=feedback.synthetic_bus(store=store, ts=0.0,
+                               clock=lambda: 0.0)))
+recs = [cluster.submit(**t) for t in trace]
+done = cluster.drain()
+assert len(done) == 6, [r.state for r in recs]
+toks = [r.tokens for r in sorted(done, key=lambda r: r.record_id)]
+assert toks == ref, "peer prefix shipping changed a token stream"
+
+snap = get_registry().snapshot()
+assert snap["counters"]["cluster_prefix_ships_total"] >= 1
+assert snap["counters"]['serving_kvtier_hit_total{tier="peer"}'] >= 1
+# zero second prefill: the prefix was full-prefilled ONCE fleet-wide
+miss = snap["counters"]["serving_prefix_cache_miss_tokens_total"]
+assert miss == len(trace[0]["prompt"]) + 2 * (len(trace) - 1), miss
+assert len({r.replica_history[0] for r in recs}) == 2
+assert any(d.consumer == "cluster.kv_fetch" and d.choice == "peer_ship"
+           for d in feedback.recent_decisions())
+
+text = prometheus_text()
+for needle in ('serving_kvtier_hit_total{tier="device"}',
+               'serving_kvtier_hit_total{tier="peer"}',
+               "cluster_prefix_ships_total",
+               "serving_kvtier_hit_peer"):
+    assert needle in text, needle
+
+# doctor: a heartbeat carrying the tier gauges yields a KV-tier table
+d = tempfile.mkdtemp(prefix="tdt-kvt-")
+hb = heartbeat_payload()
+assert "serving_kvtier_hit_peer" in hb["serving"], hb["serving"]
+with open(os.path.join(d, "heartbeat-rank-0.json"), "w") as f:
+    json.dump(hb, f)
+report = diagnose([d])
+assert report.get("kvtier"), report.get("kvtier")
+assert report["kvtier"][0]["hits"]["peer"] >= 1
+assert "## KV tier" in render_markdown(report)
+print("KVTIER_SMOKE=ok")
+EOF
+)
+kvtier_rc=$?
+echo "$kvtier_log" | tail -3
+if [ "$kvtier_rc" -ne 0 ]; then
+    echo "KVTIER_SMOKE=FAILED"
     [ "$rc" -eq 0 ] && rc=1
 fi
 

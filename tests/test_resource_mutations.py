@@ -198,6 +198,20 @@ class smut_spec_no_rollback(SM.ServingHarness):
         pass                                  # (missing) kv.rollback
 
 
+class smut_demote_dangling_promote(SM.ServingHarness):
+    """The spill tier LOSES parked content after a demote while the
+    radix node keeps pointing at the key: the promote on the next
+    prefix hit of that chain is dangling — it would assert (or, in a
+    tier that fabricates zeros, silently install garbage KV).  The
+    cross-tier audit must flag it from the state alone."""
+
+    def evict_one(self):
+        super().evict_one()
+        store = self.kv.spill
+        for key in list(store._store):       # drop every parked page
+            store._store.pop(key)
+
+
 SERVING_CORPUS = [
     (smut_pool_double_free, FindingKind.DOUBLE_FREE),
     (smut_release_leaks_pages, FindingKind.REFCOUNT_LEAK),
@@ -217,8 +231,23 @@ def test_serving_mutant_caught_with_right_kind(mutant, expected):
         + ("\n".join(str(f) for f in findings) or "no findings"))
 
 
+def test_tier_mutant_dangling_promote_caught():
+    """The cross-tier seeded mutation (demote-then-dangling-promote)
+    is caught with the new kind — and ONLY that kind (the defect is
+    a tier-integrity bug, not a refcount bug)."""
+    findings = SM.check_serving_model(
+        SM.tier_scope(), harness_factory=smut_demote_dangling_promote)
+    kinds = {f.kind for f in findings}
+    assert kinds == {FindingKind.TIER_CORRUPT}, (
+        "\n".join(str(f) for f in findings) or "no findings")
+
+
 def test_serving_clean_base_has_no_findings():
     assert SM.check_serving_model() == []
+
+
+def test_tier_clean_base_has_no_findings():
+    assert SM.check_serving_model(SM.tier_scope()) == []
 
 
 def test_corpus_has_at_least_eight_defect_classes():

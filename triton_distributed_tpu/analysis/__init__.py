@@ -80,6 +80,7 @@ __all__ = [
     "run_checks",
     "sweep",
     "sweep_resources",
+    "tier_scope",
 ]
 
 
@@ -91,6 +92,15 @@ def check_serving_model(*args, **kwargs):
         check_serving_model as _check)
 
     return _check(*args, **kwargs)
+
+
+def tier_scope(*args, **kwargs):
+    """Lazy facade over `analysis.serving_model.tier_scope` (the
+    cross-tier demote/promote/adopt exploration scope)."""
+    from triton_distributed_tpu.analysis.serving_model import (
+        tier_scope as _scope)
+
+    return _scope(*args, **kwargs)
 
 
 def analyze_kernel(fn, mesh_shape: Dict[str, int], *,

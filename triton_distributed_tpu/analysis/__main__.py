@@ -146,6 +146,12 @@ def main(argv=None) -> int:
     if args.check in ("serving", "all"):
         findings = analysis.check_serving_model()
         consume("serving", [("serving.paged", {}, findings)])
+        # Cross-tier scope: demote/promote/adopt interleavings over
+        # the spill tier (content round-trip, dangling promotes,
+        # refcounts across the ship seam).
+        tier_findings = analysis.check_serving_model(
+            analysis.tier_scope())
+        consume("serving", [("serving.kvtier", {}, tier_findings)])
 
     if args.json:
         payload = json.dumps({"findings": rows, "swept": swept}, indent=2)

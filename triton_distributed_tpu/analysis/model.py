@@ -112,6 +112,14 @@ class FindingKind(enum.Enum):
     #: the slot must map exactly the pages a plain engine that decoded
     #: only the accepted prefix would hold (`PagedKV.rollback`).
     SPEC_ROLLBACK = "spec_rollback"
+    #: Cross-tier integrity (the KV cache hierarchy,
+    #: `serving.kvtier`): a demoted page's parked content is gone
+    #: while its radix node still points at it (demote-then-dangling-
+    #: promote — the restore would assert or install garbage), or the
+    #: content that came back from a promote is not bit-identical to
+    #: what was demoted, or the spilled-node bookkeeping disagrees
+    #: with the tier's actual store.
+    TIER_CORRUPT = "tier_corrupt"
 
 
 @dataclasses.dataclass(frozen=True)

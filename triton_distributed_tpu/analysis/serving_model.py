@@ -64,6 +64,7 @@ __all__ = [
     "audit_state",
     "check_serving_model",
     "default_scope",
+    "tier_scope",
 ]
 
 
@@ -141,6 +142,18 @@ class ModelScope:
     #: (a ∈ {0, spec_k} — full rejection and full acceptance, the
     #: rollback extremes).  0 disables the spec transitions.
     spec_k: int = 2
+    #: Spill-tier capacity (pages parked on demote).  >0 arms the
+    #: cross-tier exploration: ``evict`` DEMOTES instead of dropping,
+    #: admissions over spilled chains PROMOTE, and the audit checks
+    #: the tier ledger — a demoted page's content must survive the
+    #: round trip bit-exactly and its parked payload must exist for
+    #: as long as a radix node points at it.
+    spill_pages: int = 0
+    #: Arm the ``("adopt", rid)`` op: a PEER PREFIX SHIPMENT for that
+    #: request's prompt lands (`PagedKV.adopt_prefix`) — exercising
+    #: refcount conservation across the ship seam (adopted pages are
+    #: tree-retained, refs-0, and must never be writable).
+    adopt: bool = False
 
 
 def default_scope() -> ModelScope:
@@ -156,6 +169,20 @@ def default_scope() -> ModelScope:
         _Req(2, (1, 2, 3, 5), 3),
         _Req(3, (1, 2, 3, 5, 6), 2),
     ), usable_pages=6)
+
+
+def tier_scope() -> ModelScope:
+    """The cross-tier scope: a pool tight enough that eviction (now a
+    DEMOTE) fires, a spill tier small enough that it fills, shared
+    prefixes whose chains round-trip through the tier on re-admission,
+    and the adopt op so peer-shipped chains interleave with demote/
+    promote/preempt.  Three requests keep the product of the extra
+    ops explorable in seconds."""
+    return ModelScope(requests=(
+        _Req(0, (1, 2, 3), 2),
+        _Req(1, (1, 2, 4, 5), 3),
+        _Req(2, (1, 2, 4, 5, 6), 2),
+    ), usable_pages=5, spill_pages=2, adopt=True, spec_k=0)
 
 
 class ServingHarness:
@@ -181,9 +208,21 @@ class ServingHarness:
             max_seq=scope.max_seq, page_size=scope.page_size,
             num_pages=scope.usable_pages,
             prefix_cache=scope.prefix_cache,
+            spill_pages=scope.spill_pages,
             insert_fn=self._record_insert)
         # numpy keys: keeps deepcopy of explored states device-free.
         self.kv.keys = np.zeros((scope.num_slots, 2), np.uint32)
+        #: Content ledger for the cross-tier audit: physical page ->
+        #: the fingerprint of the chain it holds (pure function of
+        #: the node's tree position).  The demote/promote/adopt
+        #: content seams move fingerprints instead of device arrays,
+        #: so the audit can prove "a demoted page's content survives
+        #: promote bit-exactly" without a real cache.
+        self._content: Dict[int, int] = {}
+        if scope.spill_pages or scope.adopt:
+            self.kv._write_page = self._model_write_page
+            if self.kv.radix is not None:
+                self.kv.radix.read_page = self._model_read_page
         #: rid -> (tokens to (re)prefill, remaining max_new)
         self.queued: Dict[int, Tuple[Tuple[int, ...], int]] = {
             r.rid: (r.prompt, r.max_new) for r in scope.requests}
@@ -228,6 +267,46 @@ class ServingHarness:
                     f"slot)")
         return cache.successor(), keys
 
+    # -- cross-tier content model ----------------------------------------
+
+    @staticmethod
+    def chain_fp(chain: Tuple[Tuple[int, ...], ...]) -> int:
+        """Deterministic fingerprint of a radix chain (what the page
+        holding its last chunk must contain)."""
+        import zlib
+        return zlib.crc32(repr(tuple(chain)).encode())
+
+    def _node_chain(self, node) -> Tuple:
+        chain = []
+        while node is not None and node.chunk:
+            chain.append(node.chunk)
+            node = node.parent
+        return tuple(reversed(chain))
+
+    def _model_read_page(self, page: int) -> dict:
+        """Demote-time content read (replaces `PagedKV._read_page`):
+        park the ledger fingerprint of what the page holds."""
+        return {"fp": np.asarray([self._content[int(page)]],
+                                 np.uint32)}
+
+    def _model_write_page(self, page: int, payload: dict) -> None:
+        """Promote/adopt-time content write: install the payload's
+        fingerprint as the page's content."""
+        self._content[int(page)] = int(payload["fp"][0])
+
+    def _ledger_slot(self, slot: int, shared) -> None:
+        """After an insert: the radix nodes the insert NEWLY
+        registered (beyond the matched chain) were just written by
+        the prefill — record their content.  Matched/restored nodes
+        are deliberately NOT re-stamped: a restore installed whatever
+        the tier parked (`_model_write_page`), and overwriting it
+        with the expected value would mask a corrupting tier."""
+        matched = {id(n) for n in shared}
+        for node in self.kv._slot_path[slot]:
+            if id(node) not in matched and not node.spilled:
+                self._content[int(node.page)] = self.chain_fp(
+                    self._node_chain(node))
+
     # -- ops -------------------------------------------------------------
 
     def _match_prefix(self, tokens):
@@ -251,6 +330,27 @@ class ServingHarness:
         self.active[slot] = [rid, s, 0, remaining,
                              self._horizon(rid), self._admit_seq]
         self._admit_seq += 1
+        # Content ledger: the path's NEW pages were just prefilled —
+        # each now holds its chain's bytes (restored pages keep what
+        # the tier gave back, so corruption there stays visible).
+        self._ledger_slot(slot, shared)
+
+    def adopt(self, rid: int) -> None:
+        """A peer prefix shipment for ``rid``'s prompt lands: the
+        shipped payloads carry exactly the content the chain's pages
+        hold on the home replica (same params, same positions — the
+        ledger fingerprint), and `PagedKV.adopt_prefix` installs
+        them refs-0 / tree-retained."""
+        tokens, _ = self.queued[rid]
+        ps = self.scope.page_size
+        n = (len(tokens) - 1) // ps
+        chunks = [tuple(tokens[j * ps:(j + 1) * ps])
+                  for j in range(n)]
+        payloads = [
+            {"fp": np.asarray([self.chain_fp(tuple(chunks[:j + 1]))],
+                              np.uint32)}
+            for j in range(n)]
+        self.kv.adopt_prefix(list(tokens[:n * ps]), payloads)
 
     def _gen_token(self, rid: int, pos: int) -> int:
         # Deterministic symbolic "model output": exploration needs
@@ -415,11 +515,19 @@ class ServingHarness:
                     out.append(("retire", slot))
         if self.kv.radix is not None and self.kv.radix.cached_pages:
             out.append(("evict",))
+        if self.scope.adopt and self.kv.radix is not None:
+            ps = self.scope.page_size
+            for rid in sorted(self.queued):
+                tokens = self.queued[rid][0]
+                if (len(tokens) - 1) // ps > 0:
+                    out.append(("adopt", rid))
         return out
 
     def apply(self, op: Tuple) -> None:
         if op[0] == "admit":
             self.admit(op[1])
+        elif op[0] == "adopt":
+            self.adopt(op[1])
         elif op[0] == "decode":
             self.decode()
         elif op[0] == "spec":
@@ -437,7 +545,15 @@ class ServingHarness:
         kv = self.kv
 
         def tree(node) -> Tuple:
+            # Spill/origin state is behavior-relevant (a spilled node
+            # is allocation DEMAND, an adopted node a peer-tier hit):
+            # states differing only there must not be conflated.
             return (node.chunk, int(node.page), int(node.refs),
+                    node.spilled,
+                    (node.spill_key is not None
+                     and self.kv.spill is not None
+                     and self.kv.spill.has(node.spill_key)),
+                    node.origin,
                     tuple(sorted(tree(c)
                                  for c in node.children.values())))
 
@@ -496,6 +612,56 @@ def audit_state(harness: ServingHarness) -> List[Finding]:
                      f"radix node for page {node.page} counts "
                      f"{node.refs} live request(s) but {held} slot "
                      f"path(s) actually hold it")
+
+    # Cross-tier integrity (the KV hierarchy audit): every spilled
+    # node's parked content must EXIST in the tier for as long as the
+    # node points at it (a dangling key means the promote on the next
+    # prefix hit asserts or installs garbage), survive the round trip
+    # bit-exactly (the ledger fingerprint is a pure function of the
+    # chain, so drift anywhere across demote → park → promote → adopt
+    # shows up here), and the spilled-node counter must agree with
+    # the tree.
+    if kv.radix is not None and kv.spill is not None:
+        content_armed = bool(harness.scope.spill_pages
+                             or harness.scope.adopt)
+        n_spilled = 0
+        stack = [(c, (c.chunk,))
+                 for c in kv.radix._root.children.values()]
+        while stack:
+            node, chain = stack.pop()
+            for c in node.children.values():
+                stack.append((c, chain + (c.chunk,)))
+            if node.spilled:
+                n_spilled += 1
+                if not kv.spill.has(node.spill_key):
+                    flag(FindingKind.TIER_CORRUPT,
+                         f"radix node for chain {chain} is marked "
+                         f"spilled (key {node.spill_key}) but the "
+                         f"tier no longer holds its content — the "
+                         f"promote on the next prefix hit is "
+                         f"DANGLING (demoted page lost)")
+                elif content_armed:
+                    payload = kv.spill.load(node.spill_key)
+                    fp = int(payload["fp"][0])
+                    if fp != harness.chain_fp(chain):
+                        flag(FindingKind.TIER_CORRUPT,
+                             f"parked content for chain {chain} "
+                             f"(key {node.spill_key}) does not match "
+                             f"what was demoted — the promote would "
+                             f"install wrong KV bytes")
+            elif content_armed:
+                got = harness._content.get(int(node.page))
+                if got != harness.chain_fp(chain):
+                    flag(FindingKind.TIER_CORRUPT,
+                         f"physical page {node.page} for chain "
+                         f"{chain} holds fingerprint {got} — not the "
+                         f"chain's content (a promote/adopt wrote "
+                         f"the wrong bytes back)")
+        if kv.radix.spilled_nodes != n_spilled:
+            flag(FindingKind.TIER_CORRUPT,
+                 f"spilled-node counter {kv.radix.spilled_nodes} "
+                 f"disagrees with the tree ({n_spilled} spilled "
+                 f"node(s)) — demote/promote bookkeeping drifted")
 
     # Mapping-extent invariant (the speculative-rollback audit): an
     # active slot must map exactly the pages a plain engine at its

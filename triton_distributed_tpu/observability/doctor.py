@@ -834,6 +834,44 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
             "pressure": float(occ) >= PAGE_PRESSURE_OCCUPANCY,
         })
 
+    # KV cache hierarchy (the serving_kvtier_* gauges ride the
+    # heartbeats, paged serving only): per-tier hit profile — where
+    # prefix pages actually came from (device / host spill / peer
+    # shipment / disk) — plus degraded tier reads (corrupt or lost
+    # parked content that fell back to recompute).  Section (and
+    # verdict note) only exist when the gauges are present, so
+    # pre-tier incidents' reports are byte-identical.
+    kvtier = []
+    for rank, row in sorted(rank_table.items(),
+                            key=lambda kv: int(kv[0])):
+        sv = row.get("serving") or {}
+        if sv.get("serving_kvtier_hit_device") is None:
+            continue
+        hits = {t: int(_num(sv.get(f"serving_kvtier_hit_{t}")))
+                for t in ("device", "host", "peer", "disk")}
+        missed = int(_num(sv.get("serving_kvtier_miss")))
+        fallbacks = int(_num(sv.get("serving_kvtier_fallbacks")))
+        warm_cfg = int(_num(sv.get("serving_kvtier_warm_tiers")))
+        dropped = int(_num(sv.get("serving_kvtier_dropped_evictions")))
+        served = sum(hits.values())
+        # Collapse = the warm tiers stopped earning their bytes:
+        # tier reads degraded to recompute (fallbacks — corrupt/lost
+        # parked pages), or a CONFIGURED spill tier is letting
+        # evictions destroy pages anyway (full pool under sustained
+        # pressure).  Plain misses never collapse: a paged engine
+        # with no warm tier configured (or a diverse-prompt workload
+        # that simply has no reusable prefixes) is healthy.
+        collapsed = (fallbacks > 0
+                     or (warm_cfg > 0 and dropped >= 8))
+        kvtier.append({
+            "rank": int(rank), "hits": hits, "miss": missed,
+            "fallbacks": fallbacks, "dropped_evictions": dropped,
+            "warm_configured": bool(warm_cfg),
+            "hit_rate": (round(served / (served + missed), 4)
+                         if served + missed else None),
+            "collapsed": collapsed,
+        })
+
     # Speculative-decoding health (the accept-rate gauge rides the
     # heartbeats): a collapsed accept rate means verify dispatches
     # burn K+1 model steps to commit ~1 token — the draft source has
@@ -880,6 +918,8 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
     }
     if page_pressure:
         report["page_pressure"] = page_pressure
+    if kvtier:
+        report["kvtier"] = kvtier
     if spec_health:
         report["spec"] = spec_health
     # Key absent unless the resource consult ran (opt-in / findings
@@ -933,6 +973,22 @@ def _verdict(report: dict, in_flight: Optional[dict]) -> str:
         hot_s += (f"; KV page pressure on rank {worst['rank']} "
                   f"({worst['page_occupancy']:.0%} of pages in use, "
                   f"{worst['pages_free']} free)")
+    tier_bad = [e for e in report.get("kvtier", [])
+                if e["collapsed"]]
+    if tier_bad:
+        worst = max(tier_bad, key=lambda e: (e["fallbacks"],
+                                             e["dropped_evictions"]))
+        if worst["fallbacks"]:
+            hot_s += (f"; KV tier degradation on rank "
+                      f"{worst['rank']} ({worst['fallbacks']} tier "
+                      f"read(s) fell back to recompute — corrupt or "
+                      f"lost parked pages)")
+        else:
+            hot_s += (f"; KV tier overflow on rank {worst['rank']} "
+                      f"({worst['dropped_evictions']} evicted "
+                      f"page(s) destroyed despite a configured "
+                      f"spill tier — the hierarchy is not absorbing "
+                      f"evictions)")
     collapsed = [e for e in report.get("spec", [])
                  if e["collapsed"]]
     if collapsed:
@@ -1079,6 +1135,24 @@ def render_markdown(report: dict) -> str:
                 f"| {e['pages_free'] if e['pages_free'] is not None else '-'} "
                 f"| {e['prefix_cache_pages'] if e['prefix_cache_pages'] is not None else '-'} "
                 f"| {'PRESSURE' if e['pressure'] else 'ok'} |")
+        lines.append("")
+
+    kvtier = report.get("kvtier")
+    if kvtier:
+        lines += ["## KV tier", "",
+                  "| rank | device | host | peer | disk | miss "
+                  "| degraded | dropped | hit rate | state |",
+                  "|---|---|---|---|---|---|---|---|---|---|"]
+        for e in kvtier:
+            h = e["hits"]
+            rate = (f"{e['hit_rate']:.0%}"
+                    if e["hit_rate"] is not None else "-")
+            lines.append(
+                f"| {e['rank']} | {h['device']} | {h['host']} "
+                f"| {h['peer']} | {h['disk']} | {e['miss']} "
+                f"| {e['fallbacks']} | {e['dropped_evictions']} "
+                f"| {rate} "
+                f"| {'COLLAPSED' if e['collapsed'] else 'ok'} |")
         lines.append("")
 
     spec = report.get("spec")

@@ -297,7 +297,19 @@ _HEARTBEAT_GAUGES = ("serving_queue_depth", "serving_active_slots",
                      # the first verify round, so non-speculative
                      # heartbeat bodies are byte-identical): the
                      # doctor calls out a collapse below 0.3.
-                     "serving_spec_accept_rate")
+                     "serving_spec_accept_rate",
+                     # KV-tier admission accounting (paged mode only,
+                     # absent elsewhere — same golden discipline):
+                     # the doctor's "KV tier" per-tier hit table and
+                     # its degraded-read verdict note read these.
+                     "serving_kvtier_hit_device",
+                     "serving_kvtier_hit_host",
+                     "serving_kvtier_hit_peer",
+                     "serving_kvtier_hit_disk",
+                     "serving_kvtier_miss",
+                     "serving_kvtier_fallbacks",
+                     "serving_kvtier_warm_tiers",
+                     "serving_kvtier_dropped_evictions")
 
 
 def heartbeat_payload() -> dict:

@@ -22,6 +22,10 @@ from triton_distributed_tpu.serving.engine_batched import (  # noqa: F401
     pick_bucket,
     request_key,
 )
+from triton_distributed_tpu.serving.kvtier import (  # noqa: F401
+    DiskTier,
+    KVTier,
+)
 from triton_distributed_tpu.serving.pages import (  # noqa: F401
     PagedKV,
     PagePool,

@@ -7,6 +7,7 @@ routing-signal table and the drain/failover semantics.
 
 from triton_distributed_tpu.serving.cluster.chaos import (  # noqa: F401
     FAULT_CLASSES,
+    PREFIX_SHIP_FAULTS,
     FaultEvent,
     FaultInjector,
     FaultSchedule,
@@ -24,6 +25,11 @@ from triton_distributed_tpu.serving.cluster.cluster import (  # noqa: F401
     ServingCluster,
     current_routing_table,
     role_from_env,
+)
+from triton_distributed_tpu.serving.cluster.peer_cache import (  # noqa: F401
+    PrefixDirectory,
+    PrefixShipment,
+    extract_prefix,
 )
 from triton_distributed_tpu.serving.cluster.prefill import (  # noqa: F401
     PrefillWorker,

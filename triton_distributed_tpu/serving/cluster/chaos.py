@@ -67,8 +67,23 @@ FAULTS_SCHEMA = 1
 FAULTS_FILE = "faults.jsonl"
 
 #: Every injectable fault class, in schedule-derivation order.
+#: ``prefix_ship`` targets PEER PREFIX shipments only (the KV tier's
+#: cached-prefix transfers): a seeded sub-fault of drop / corrupt /
+#: stale per shipment — the receiver must degrade that dispatch to
+#: recompute, never to wrong tokens.
 FAULT_CLASSES = ("drop", "dup", "reorder", "corrupt", "flap",
-                 "stale_hb", "skew")
+                 "stale_hb", "skew", "prefix_ship")
+
+#: Classes a bare ``FaultSchedule(seed)`` samples its armed set from.
+#: Deliberately the PR-10 seven: adding ``prefix_ship`` to the
+#: sampled set would re-derive every existing seeded schedule (the
+#: class draw shares the construction-time RNG stream) and silently
+#: change the committed 104-seed grid.  Prefix-ship schedules are
+#: armed explicitly (``classes=("prefix_ship", ...)``).
+_SAMPLED_CLASSES = FAULT_CLASSES[:7]
+
+#: Sub-faults the ``prefix_ship`` class rolls per prefix shipment.
+PREFIX_SHIP_FAULTS = ("drop", "corrupt", "stale")
 
 
 @dataclasses.dataclass
@@ -192,8 +207,10 @@ class FaultSchedule:
             else:
                 # Each seed arms 1..3 classes — across a seed sweep
                 # every class appears alone and in combination.
+                # (Sampled from the PR-10 set so existing seeded
+                # grids replay bit-identically; see _SAMPLED_CLASSES.)
                 k = 1 + rng.randrange(3)
-                classes = tuple(rng.sample(FAULT_CLASSES, k))
+                classes = tuple(rng.sample(_SAMPLED_CLASSES, k))
         self.classes: Tuple[str, ...] = tuple(classes)
         for c in self.classes:
             assert c in FAULT_CLASSES, c
@@ -245,6 +262,30 @@ class FaultSchedule:
             return None
         return armed[int(self._hash("ship.class", ship_id)
                          * len(armed))]
+
+    def prefix_fault(self, ship_id: int) -> Optional[str]:
+        """Which sub-fault (if any) hits PREFIX shipment ``ship_id``
+        when the ``prefix_ship`` class is armed: "drop" (the wire
+        eats it), "corrupt" (checksum NACK at claim) or "stale" (the
+        delivery is delayed past the prefix deadline).  Every
+        outcome must degrade the held dispatch to recompute."""
+        if "prefix_ship" not in self.classes:
+            return None
+        r = self._hash("prefix", ship_id)
+        if r >= self.ship_fault_rate:
+            return None
+        i = int(self._hash("prefix.class", ship_id)
+                * len(PREFIX_SHIP_FAULTS))
+        return PREFIX_SHIP_FAULTS[i]
+
+    def stale_delay(self, ship_id: int) -> float:
+        """Seeded extra delay for a "stale" prefix delivery.  The
+        cluster adds this ON TOP of the shipment's own deadline
+        (`ServingCluster._send` — the deadline is cluster config the
+        schedule cannot know), so a stale delivery always lands too
+        late and the dispatch degrades, whatever the deadline."""
+        return (2.0 + 2.0 * self._hash("prefix.stale", ship_id)) \
+            * max(self.reorder_delay_s, 0.01) * 10.0
 
     def reorder_delay(self, ship_id: int) -> float:
         return (0.5 + self._hash("reorder", ship_id)) \
@@ -299,19 +340,43 @@ class FaultInjector:
 
     # -- seams -------------------------------------------------------------
 
-    def on_ship(self, ship_id: int, nbytes: int,
-                now: float) -> Optional[dict]:
+    def on_ship(self, ship_id: int, nbytes: int, now: float,
+                kind: str = "kv") -> Optional[dict]:
         """Wire fault for a freshly shipped payload, or None.  The
         caller applies the action: ``{"fault": "drop"}``,
-        ``{"fault": "dup"}``, ``{"fault": "corrupt"}`` or
-        ``{"fault": "reorder", "delay_s": ...}``."""
+        ``{"fault": "dup"}``, ``{"fault": "corrupt"}``,
+        ``{"fault": "reorder", "delay_s": ...}`` or (prefix
+        shipments under the ``prefix_ship`` class)
+        ``{"fault": "stale", "delay_s": ...}``.
+
+        ``kind="prefix"`` marks a peer PREFIX shipment (KV tier):
+        the ``prefix_ship`` class rolls its own sub-fault for those
+        — recorded under fault class ``prefix_ship`` with the
+        sub-fault in inputs — while the generic wire classes keep
+        applying to both kinds (a lossy DCN does not care what the
+        bytes mean)."""
         if not self.active or not self._budget_left():
             return None
+        if kind == "prefix":
+            sub = self.schedule.prefix_fault(ship_id)
+            if sub is not None:
+                action = {"fault": sub}
+                inputs = {"nbytes": int(nbytes), "sub_fault": sub,
+                          "kind": "prefix"}
+                if sub == "stale":
+                    action["delay_s"] = self.schedule.stale_delay(
+                        ship_id)
+                    inputs["delay_s"] = round(action["delay_s"], 9)
+                self._record("prefix_ship", f"shipment:{ship_id}",
+                             now, **inputs)
+                return action
         fault = self.schedule.ship_fault(ship_id)
         if fault is None:
             return None
         action = {"fault": fault}
         inputs = {"nbytes": int(nbytes)}
+        if kind != "kv":
+            inputs["kind"] = str(kind)
         if fault == "reorder":
             action["delay_s"] = self.schedule.reorder_delay(ship_id)
             inputs["delay_s"] = round(action["delay_s"], 9)

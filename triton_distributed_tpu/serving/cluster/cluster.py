@@ -109,6 +109,20 @@ class ClusterConfig:
     #: fault injector fired) is (re)written here on every failover —
     #: the artifacts the doctor's Cluster/Chaos sections ingest.
     artifact_dir: Optional[str] = None
+    #: A peer PREFIX shipment (KV tier, docs/serving.md "Cache
+    #: hierarchy") that has not delivered intact this long after its
+    #: dispatch degrades to recompute: the request submits without
+    #: the shipped prefix (one local prefill — never a stuck request,
+    #: never wrong tokens).  One attempt, no retransmit: unlike a
+    #: full-row shipment, the fallback costs exactly what routing
+    #: would have paid anyway.
+    prefix_ship_deadline_s: float = 0.25
+    #: SignalBus the ship-vs-recompute cost model reads (predicted
+    #: prefill µs from the anomaly baselines; link busy for the wire
+    #: derate).  None = the ambient bus (opt-in via TDT_CLOSED_LOOP,
+    #: the PR-8 contract) — absent/stale signals disengage the model
+    #: bit-identically.
+    bus: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -140,6 +154,11 @@ class ClusterRequest:
     #: artifact is replica-agnostic, so the re-route attaches it
     #: directly — no second prefill, nothing new on the wire.
     ship_cache: Optional[object] = None
+    #: A peer PREFIX shipment was already attempted for this record
+    #: (KV tier): whatever its outcome — adopted, degraded to
+    #: recompute — the next dispatch never ships again, so a faulty
+    #: wire costs at most one deadline, never a loop.
+    prefix_tried: bool = False
 
     @property
     def done(self) -> bool:
@@ -195,6 +214,17 @@ class ServingCluster:
             for i in range(cfg.n_prefill_workers)]
         self.transport = VirtualTransport(wire_gbps=cfg.wire_gbps)
         self.router = ClusterRouter(cfg.router, self.replicas)
+        # KV tier, fleet half: the cluster-wide prefix directory and
+        # the cache-aware placement hook (paged replicas with a radix
+        # cache only — the slots layout has no shareable pages, so
+        # the hooks stay None and routing is untouched).
+        ref_sched = self.replicas[0].scheduler
+        if ref_sched.paged and ref_sched.slots.radix is not None:
+            from triton_distributed_tpu.serving.cluster.peer_cache \
+                import PrefixDirectory
+            self.router.directory = PrefixDirectory(
+                ref_sched.config.page_size)
+            self.router.fetch_cost_fn = self._fetch_cost
         self._pending: List[ClusterRequest] = []
         self._pending_i = 0
         self._requeue: Deque[ClusterRequest] = collections.deque()
@@ -211,6 +241,9 @@ class ServingCluster:
         self._staged_routes: Dict[int, tuple] = {}
         self._wrr = 0
         self._open = 0
+        #: Per-tick memo for the (replica-independent) ship-vs-
+        #: recompute plan — cleared at the top of every `step()`.
+        self._plan_cache: Dict[tuple, Optional[dict]] = {}
         #: Recent record ids this cluster submitted — the ownership
         #: filter `write_artifact` hands the lineage-artifact writer
         #: (the process-global recorder may also hold other engines'
@@ -292,6 +325,7 @@ class ServingCluster:
 
     def step(self) -> dict:
         now = self._clock()
+        self._plan_cache.clear()
         for rep in self.replicas:
             # The chaos seam: a suppressed write leaves the previous
             # heartbeat in place (present but stale); clock skew
@@ -428,6 +462,18 @@ class ServingCluster:
             # row crossed the wire: reuse the claimed artifact (it is
             # replica-agnostic) instead of prefilling again.
             req.shipped_kv = record.ship_cache
+        elif (not resumed and not record.prefix_tried
+                and self.router.directory is not None):
+            # KV tier, ship-vs-recompute: the chosen replica may be
+            # about to re-prefill a prefix a PEER already holds.
+            # When the cost model engages (fresh signals + prefill
+            # baseline) and peer_ship wins, the cached pages cross
+            # the wire instead and the request dispatches once they
+            # adopt — a lost/corrupt/late shipment degrades to this
+            # very recompute path at the deadline, never to wrong
+            # tokens.
+            if self._kv_fetch(record, req, rep, now):
+                return True     # staged as an in-flight prefix ship
         accepted = self._submit_to(rep, req, record)
         if accepted:
             record.ship_cache = None
@@ -478,6 +524,13 @@ class ServingCluster:
                 record.replica_history.append(rep.id)
             record.replica = rep.id
             record.state = "running"
+            if self.router.directory is not None:
+                # Route COMMIT is the one point the replica really
+                # accepted (and will radix-register) this prompt:
+                # advertise the chain fleet-wide.  Advisory — a later
+                # eviction there just makes extraction come up short.
+                self.router.directory.register(
+                    req.prompt, rep.id, self._clock())
             return True
         self._by_req.pop(req.request_id, None)
         record.replica = None
@@ -527,6 +580,219 @@ class ServingCluster:
             count_metric)
         count_metric(name, n, **labels)
 
+    # -- KV tier: ship-vs-recompute --------------------------------------
+
+    def _signal_bus(self):
+        if self.config.bus is not None:
+            return self.config.bus
+        from triton_distributed_tpu.observability import feedback
+        return feedback.ambient_bus()
+
+    def _fetch_plan(self, tokens) -> Optional[dict]:
+        """The ship-vs-recompute model's inputs for one prompt, or
+        None when it cannot ENGAGE — no directory hit, no bus, stale
+        signals, or no prefill baseline yet.  Disengaged means every
+        fetch cost is 0 and no kv_fetch decision exists: routing is
+        bit-identical to today's affinity behavior (the PR-8
+        degradation contract applied to the cache tier).
+
+        Memoized per event-loop tick: the plan is replica-independent
+        but `route()` scores it once per candidate and `_kv_fetch`
+        once more — and a backpressure-blocked dispatch re-routes
+        every tick.  One directory walk + bus read per (tick, prompt)
+        is the honest cost."""
+        directory = self.router.directory
+        if directory is None:
+            return None
+        memo_key = tuple(tokens)
+        if memo_key in self._plan_cache:
+            return self._plan_cache[memo_key]
+        plan = self._fetch_plan_uncached(tokens)
+        self._plan_cache[memo_key] = plan
+        return plan
+
+    def _fetch_plan_uncached(self, tokens) -> Optional[dict]:
+        directory = self.router.directory
+        chain, holders = directory.lookup(tokens)
+        if not chain:
+            return None
+        bus = self._signal_bus()
+        if bus is None:
+            return None
+        sig = bus.read()
+        if not sig.fresh(bus.clock(), bus.staleness_s):
+            return None
+        ref = self.replicas[0].scheduler
+        bucket = pick_bucket(len(tokens), ref.buckets)
+        if bucket is None:
+            return None
+        from triton_distributed_tpu.serving.scheduler import (
+            prefill_baseline_key)
+        prefill_us = sig.predicted_us(prefill_baseline_key(bucket))
+        if prefill_us is None:
+            return None
+        bpp = ref.slots.bytes_per_page
+        # Wire cost per page: the transport's modeled bandwidth
+        # derated to its residual share under the bus's measured
+        # link utilization — the same effective_spec idea placement
+        # scoring applies to step times.
+        gbps = self.config.wire_gbps
+        if gbps:
+            eff = gbps * max(1.0 - min(sig.busy_fraction(), 0.9), 0.1)
+            wire_us_page = bpp / (eff * 1e3)
+        else:
+            wire_us_page = 0.0
+        disk_gbps = max(self.config.router.disk_gbps, 1e-9)
+        return {
+            "chain": chain, "holders": holders,
+            "prefill_us": float(prefill_us),
+            "wire_us_page": wire_us_page,
+            "disk_us_page": bpp / (disk_gbps * 1e3),
+        }
+
+    def _local_chain(self, rep: Replica, tokens) -> tuple:
+        """(pages held locally, of which disk-resident) for ``rep``.
+        """
+        slots = rep.scheduler.slots
+        path = slots.match_prefix(list(tokens))
+        disk = 0
+        spill = getattr(slots, "spill", None)
+        if spill is not None and hasattr(spill, "tier_of"):
+            disk = sum(1 for n in path if n.spilled
+                       and spill.tier_of(n.spill_key) == "disk")
+        return len(path), disk
+
+    def _fetch_cost(self, tokens, rep) -> float:
+        """Placement-score extension (`ClusterRouter.fetch_cost_fn`):
+        the modeled µs replica ``rep`` pays to OBTAIN this prompt's
+        cached prefix — 0 where it is resident, the cheaper of
+        peer-ship and re-prefill where it is not, plus the disk
+        promote for its own disk-parked pages.  0.0 whenever the
+        model is disengaged."""
+        plan = self._fetch_plan(tokens)
+        if plan is None:
+            return 0.0
+        local, disk = self._local_chain(rep, tokens)
+        cost = disk * plan["disk_us_page"]
+        missing = len(plan["chain"]) - local
+        if missing > 0:
+            options = [plan["prefill_us"]]
+            if (self.config.router.prefix_ship
+                    and any(h != rep.id for h in plan["holders"])):
+                options.append(len(plan["chain"])
+                               * plan["wire_us_page"])
+            cost += min(options)
+        return cost
+
+    def _kv_fetch(self, record: ClusterRequest, req: Request,
+                  rep: Replica, now: float) -> bool:
+        """Decide how the CHOSEN replica obtains this prompt's cached
+        prefix — recompute (local prefill, today's behavior), load
+        its own disk tier (happens inside admission), or ship the
+        pages from a peer holder — and, when peer_ship wins, put the
+        prefix on the wire and hold the dispatch until it adopts (or
+        the deadline degrades it back to recompute).  Every engaged
+        decision is a schema-v1 ``cluster.kv_fetch`` DecisionEvent
+        with all candidate costs.  Returns True when a prefix ship
+        was staged (the caller's dispatch is deferred)."""
+        plan = self._fetch_plan(record.prompt)
+        if plan is None:
+            return False
+        local, disk = self._local_chain(rep, record.prompt)
+        missing = len(plan["chain"]) - local
+        holders = [h for h in plan["holders"]
+                   if h != rep.id and h < len(self.replicas)
+                   and self.replicas[h].routable]
+        if missing <= 0 or not holders:
+            return False
+        ship_us = len(plan["chain"]) * plan["wire_us_page"]
+        candidates = [
+            {"name": "recompute",
+             "score_us": round(plan["prefill_us"], 3)},
+            {"name": "peer_ship", "score_us": round(ship_us, 3)},
+        ]
+        costs = {"recompute": plan["prefill_us"],
+                 "peer_ship": ship_us}
+        if disk:
+            # Its own disk-parked pages promote during admission
+            # whatever else happens; the candidate prices that path.
+            costs["disk_load"] = (disk * plan["disk_us_page"]
+                                  + plan["prefill_us"])
+            candidates.append({"name": "disk_load",
+                               "score_us": round(costs["disk_load"],
+                                                 3)})
+        choice = min(costs, key=lambda k: (costs[k], k))
+        # One engaged decision per record, whatever its outcome: a
+        # backpressure-blocked dispatch retried every event-loop tick
+        # must not flood decisions.jsonl (the commit-on-accept
+        # discipline, applied to the fetch question — which is
+        # settled HERE even when the dispatch itself isn't).
+        record.prefix_tried = True
+        from triton_distributed_tpu.observability import feedback
+        feedback.record_decision(feedback.DecisionEvent(
+            consumer="cluster.kv_fetch",
+            op=f"request:{record.record_id}", choice=choice,
+            candidates=candidates,
+            inputs={"replica": rep.name,
+                    "chain_pages": len(plan["chain"]),
+                    "local_pages": local,
+                    "holders": sorted(holders),
+                    "wire_us_page": round(plan["wire_us_page"], 4)}))
+        if choice != "peer_ship" or not self.config.router.prefix_ship:
+            return False
+        # A same-chain shipment already riding the wire to this
+        # replica carries these very pages: attach as a follower —
+        # one wire crossing serves every same-prefix dispatch that
+        # piles up behind it.
+        prompt = record.prompt
+        for s in self._ships:
+            if (s.get("kind") == "prefix" and s["dst"] == rep.id
+                    and s["shipment"].tokens
+                    == prompt[:len(s["shipment"].tokens)]):
+                self._stage_prefix_job(s, record, req, rep, now,
+                                       follower=True)
+                return True
+        # Freshest routable holder wins (directory timestamps).
+        src = max(holders, key=lambda h: plan["holders"][h])
+        from triton_distributed_tpu.serving.cluster.peer_cache import (
+            extract_prefix)
+        shipment = extract_prefix(
+            self.replicas[src].scheduler.slots, record.prompt)
+        if shipment is None or shipment.pages <= local:
+            # Stale directory (the holder evicted it since):
+            # recompute — the degradation the directory's advisory
+            # contract promises.
+            self._count("cluster_prefix_ship_stale_total")
+            return False
+        ship = {
+            "kind": "prefix", "dst": rep.id, "src": src,
+            "shipment": shipment, "jobs": [], "attempt": 0,
+            "record": record, "req": req,    # lineage labels in _send
+            "deadline_at": now + self.config.prefix_ship_deadline_s,
+        }
+        self._stage_prefix_job(ship, record, req, rep, now)
+        self._send(ship, now)
+        self._ships.append(ship)
+        return True
+
+    def _stage_prefix_job(self, ship: dict, record: ClusterRequest,
+                          req: Request, rep: Replica, now: float,
+                          follower: bool = False) -> None:
+        """Attach one held dispatch to an (in-flight or about-to-send)
+        prefix shipment: the same worker-path bookkeeping —
+        commit-on-accept stage detached, record mapped for failover —
+        resolved for every job when the shipment lands or degrades."""
+        record.replica = rep.id
+        record.replica_history.append(rep.id)
+        record.state = "running"
+        self._by_req[req.request_id] = record
+        self._hop(record, "route_stage", now, "router",
+                  replica=rep.name, path="prefix_ship",
+                  src=self.replicas[ship["src"]].name,
+                  pages=ship["shipment"].pages, follower=follower)
+        ship["jobs"].append((record, req))
+        self._staged_routes[req.request_id] = self.router.take_staged()
+
     def _hop(self, record: Optional[ClusterRequest], hop: str,
              ts: float, actor: str, **detail) -> None:
         """Record one lineage hop for ``record`` (no-op for a
@@ -557,9 +823,13 @@ class ServingCluster:
         wire_s = (self.transport.ship_time_s(nbytes)
                   * self.injector.wire_factor(now))
         if attempt == 0:
+            detail = {}
+            if ship.get("kind") == "prefix":
+                detail = {"kind": "prefix",
+                          "src": self.replicas[ship["src"]].name}
             self._hop(record, "ship", now, "transport", token=token,
                       nbytes=nbytes,
-                      wire_ms=round(wire_s * 1e3, 6))
+                      wire_ms=round(wire_s * 1e3, 6), **detail)
         else:
             # The retry carries what the fault COST this request: the
             # exponential backoff plus another wire crossing, all on
@@ -577,7 +847,8 @@ class ServingCluster:
                               + self.config.ship_retry_base_s
                               * (2 ** attempt))
         self._count("cluster_kv_shipped_bytes_total", nbytes)
-        action = self.injector.on_ship(token, nbytes, now)
+        action = self.injector.on_ship(token, nbytes, now,
+                                       kind=ship.get("kind", "kv"))
         if action is None:
             return
         fault = action["fault"]
@@ -588,9 +859,19 @@ class ServingCluster:
             self.transport.corrupt(token, byte_index=token * 131)
         elif fault == "dup":
             ship["dup"] = True
-        elif fault == "reorder":
+        elif fault in ("reorder", "stale"):
             ship["ready_at"] += action["delay_s"]
             ship["timeout_at"] += action["delay_s"]
+            if fault == "stale" and "deadline_at" in ship:
+                # "stale" means TOO LATE by definition: the schedule
+                # cannot know the cluster's prefix deadline (it is
+                # config, not seed), so the injected delay is pushed
+                # past it here — the delivery always misses and the
+                # dispatch degrades to recompute, whatever deadline
+                # the operator chose.
+                ship["ready_at"] = max(
+                    ship["ready_at"],
+                    ship["deadline_at"] + action["delay_s"])
 
     def _retry_or_reroute(self, ship: dict, now: float,
                           trigger: str) -> None:
@@ -633,9 +914,107 @@ class ServingCluster:
         record.state = "queued"
         self._requeue.append(record)
 
+    def _pump_prefix(self, ship: dict, now: float) -> bool:
+        """Advance one in-flight PREFIX shipment (KV tier): deliver →
+        adopt into the destination's radix cache → dispatch the held
+        request (whose admission now finds the prefix and
+        suffix-prefills); any failure — the wire ate it, a checksum
+        NACK, a delivery past the deadline — degrades to recompute:
+        the same dispatch runs WITHOUT the prefix.  One attempt, no
+        retransmit; tokens never depend on the outcome."""
+        rep = self.replicas[ship["dst"]]
+        # Jobs whose record moved on (a failover re-queued it while
+        # the prefix rode the wire) detach — the failover path owns
+        # them now.
+        live = []
+        for record, req in ship["jobs"]:
+            if (record.state == "running"
+                    and record.replica == ship["dst"]):
+                live.append((record, req))
+            else:
+                self._by_req.pop(req.request_id, None)
+                self._staged_routes.pop(req.request_id, None)
+        ship["jobs"] = live
+        if not live or not rep.routable:
+            self.transport.drop(ship.get("token"))
+            self._ships.remove(ship)
+            for record, req in live:
+                # Destination died under the shipment: back to the
+                # router (the normal failover re-queue already took
+                # records the drain saw; these were mapped, so drain
+                # re-queued them — live is then empty — but guard
+                # anyway).
+                self._by_req.pop(req.request_id, None)
+                self._staged_routes.pop(req.request_id, None)
+                record.replica = None
+                record.state = "queued"
+                self._requeue.append(record)
+            return True
+        if now >= ship["deadline_at"]:
+            trigger = "timeout" if ship.get("lost") else "stale"
+            self._ships.remove(ship)
+            self.transport.drop(ship.get("token"))
+            self._count("cluster_prefix_ship_fallbacks_total",
+                        trigger=trigger)
+            self._finish_prefix(ship, now)
+            return True
+        if ship.get("lost") or ship["ready_at"] > now:
+            return False
+        self._ships.remove(ship)
+        try:
+            from triton_distributed_tpu.serving.cluster.peer_cache \
+                import PrefixShipment
+            shipment = self.transport.claim(
+                ship["token"], decoder=PrefixShipment.from_bytes)
+        except ShipmentCorrupt:
+            self._count("cluster_shipments_corrupt_total")
+            self._count("cluster_prefix_ship_fallbacks_total",
+                        trigger="corrupt")
+            self._hop(ship["jobs"][0][0], "ship_nack", now,
+                      "transport", token=ship["token"], kind="prefix")
+            self._finish_prefix(ship, now)
+            return True
+        if shipment is None:
+            self._count("cluster_prefix_ship_fallbacks_total",
+                        trigger="duplicate")
+            self._finish_prefix(ship, now)
+            return True
+        adopted = rep.scheduler.slots.adopt_prefix(
+            shipment.tokens, shipment.payloads)
+        self._count("cluster_prefix_ships_total")
+        self._count("cluster_prefix_pages_shipped_total",
+                    shipment.pages)
+        self._hop(ship["jobs"][0][0], "ship_deliver", now,
+                  "transport", token=ship["token"], replica=rep.name,
+                  kind="prefix", adopted=adopted,
+                  jobs=len(ship["jobs"]))
+        self._finish_prefix(ship, now)
+        return True
+
+    def _finish_prefix(self, ship: dict, now: float) -> None:
+        """Dispatch every request the prefix shipment was holding —
+        whether the prefix adopted (admission suffix-prefills
+        through the radix hit) or the ship degraded (admission
+        recomputes).  Commit-on-accept holds per job: each staged
+        route commits only when the replica takes that request."""
+        rep = self.replicas[ship["dst"]]
+        for record, req in ship["jobs"]:
+            staged = self._staged_routes.pop(req.request_id, None)
+            if self._submit_to(rep, req, record):
+                self.router.commit_staged(staged, now)
+            elif not record.done:
+                # Transient backpressure: re-route like any refused
+                # dispatch (an adopted prefix stays cached on
+                # ``rep`` — wherever the record lands, at worst it
+                # recomputes).
+                self._requeue.append(record)
+
     def _pump_ships(self, now: float) -> bool:
         progressed = False
         for ship in list(self._ships):
+            if ship.get("kind") == "prefix":
+                progressed |= self._pump_prefix(ship, now)
+                continue
             if ship.get("lost"):
                 if now >= ship["timeout_at"]:
                     self._ships.remove(ship)
@@ -736,6 +1115,12 @@ class ServingCluster:
                 record.state = "finished"
                 record.finish_reason = (req.finish_reason.value
                                         if req.finish_reason else None)
+                if self.router.directory is not None:
+                    # Retire refreshes the chain's recency: release
+                    # keeps prompt pages cached in this replica's
+                    # radix, so the directory entry stays warm.
+                    self.router.directory.register(
+                        record.prompt, rep.id, now)
                 self.finished.append(record)
             self._open -= 1
 
@@ -784,6 +1169,10 @@ class ServingCluster:
             # already unmapped — the STOPPED retirements there do not
             # touch the records.)  A dead process gets no calls.
             rep.scheduler.stop()
+        if self.router.directory is not None:
+            # Its pages are unreachable until it heals and re-earns
+            # entries through new route commits.
+            self.router.directory.purge_replica(rep.id)
         for record in sorted(victims, key=lambda r: r.record_id,
                              reverse=True):
             record.replica = None
@@ -822,8 +1211,16 @@ class ServingCluster:
             arrival = self._pending[self._pending_i].arrival_time
             if arrival > now:
                 cands.append(arrival)
-        cands.extend(s["timeout_at"] if s.get("lost")
-                     else s["ready_at"] for s in self._ships)
+        for s in self._ships:
+            if s.get("kind") == "prefix":
+                # A prefix ship resolves at delivery or, whatever the
+                # wire did (lost, stale-delayed), at its degrade
+                # deadline — never later.
+                cands.append(s["deadline_at"] if s.get("lost")
+                             else min(s["ready_at"], s["deadline_at"]))
+            else:
+                cands.append(s["timeout_at"] if s.get("lost")
+                             else s["ready_at"])
         for w in self.workers:
             if w.queue:
                 cands.append(w.busy_until)
@@ -870,6 +1267,8 @@ class ServingCluster:
         t["kv_shipped_bytes"] = self.transport.shipped_bytes
         t["shipments"] = self.transport.shipments
         t["open_requests"] = self._open
+        if self.router.directory is not None:
+            t["prefix_directory_chains"] = len(self.router.directory)
         # Whose KV is on the wire RIGHT NOW (shipment id -> record
         # id): the hung-cluster question /routing can now answer.
         t["wire_pending"] = {str(k): v for k, v in

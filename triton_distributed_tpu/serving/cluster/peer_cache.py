@@ -1,0 +1,208 @@
+"""Peer replica tier: the cluster-wide prefix directory and the
+prefix-page shipment that turns N radix-cache islands into one cache.
+
+Each replica's `RadixCache` (PR 6) is an island: a system prompt
+shared by a million users is prefilled once PER replica, because
+nothing tells replica B that replica A already holds those pages.
+This module is the fleet half of the KV tier (`serving.kvtier` is the
+single-replica half):
+
+- :class:`PrefixDirectory` — which replica holds which radix chain.
+  Maintained router-side from the events the cluster already emits:
+  a **route commit** (the replica ACCEPTED the request, so its radix
+  cache now registers the prompt's full-page chain) registers the
+  chain → replica; a **failover** purges everything the drained
+  replica held.  Entries are ADVISORY: the holder may have evicted
+  the chain since — extraction re-checks the live cache and a stale
+  entry degrades to recompute, never to wrong bytes.  LRU-bounded
+  like the affinity map (a long-running router serving diverse
+  prompts must not grow without bound).
+
+- :class:`PrefixShipment` — the cached prefix pages flattened for
+  the wire: per-page per-layer numpy payloads (exactly what
+  `PagedKV._read_page` produces — numpy round-trip is exact, and
+  replicas share params, so adopted bytes are identical to a local
+  prefill's) plus the page-chunk tokens that key them into the
+  destination's radix tree.  Rides the SAME `VirtualTransport` path
+  as PR 9's full-row `KVShipment` — bytes on the wire, monotonic
+  shipment id, CRC at claim — so the chaos harness's wire faults
+  (and the new ``prefix_ship`` fault class) apply unchanged.
+
+- :func:`extract_prefix` — read the longest cached chain prefixing a
+  prompt out of a HOME replica's `PagedKV` (device pages directly;
+  spilled nodes through the tier's verified `load`, so a corrupt
+  disk segment truncates the shipment instead of corrupting it).
+
+The destination side is `PagedKV.adopt_prefix`: the shipped pages
+register refs-0/tree-retained (tagged ``origin="peer"``), so the
+request that triggered the ship admits through the ordinary radix
+hit + suffix-only prefill — the PR 6 seam, bit-exact by the same
+argument, with ZERO prompt FLOPs spent on the shipped pages.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class PrefixShipment:
+    """One cached prefix chain flattened for the wire.
+
+    ``payloads[j]`` holds page ``j``'s per-layer arrays (the
+    `PagedKV._read_page` dict); ``tokens`` is the full-page prefix
+    (``len(tokens) == len(payloads) * page_size``).  Same
+    bytes-round-trip contract as `transport.KVShipment` (one npz
+    container), so the same transport carries both — `claim` just
+    needs this class's decoder.
+    """
+
+    kind = "prefix"
+
+    def __init__(self, tokens: Sequence[int], page_size: int,
+                 payloads: List[Dict[str, np.ndarray]]):
+        self.tokens = [int(t) for t in tokens]
+        self.page_size = int(page_size)
+        self.payloads = list(payloads)
+        assert len(self.tokens) == len(self.payloads) * self.page_size
+
+    @property
+    def pages(self) -> int:
+        return len(self.payloads)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(a.nbytes for p in self.payloads
+                   for a in p.values())
+
+    def to_bytes(self) -> bytes:
+        buf = io.BytesIO()
+        arrays = {f"p{j}.{name}": arr
+                  for j, payload in enumerate(self.payloads)
+                  for name, arr in payload.items()}
+        np.savez(buf,
+                 _meta=np.asarray([self.page_size,
+                                   len(self.payloads)], np.int64),
+                 _tokens=np.asarray(self.tokens, np.int64),
+                 **arrays)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "PrefixShipment":
+        with np.load(io.BytesIO(data)) as z:
+            meta = z["_meta"]
+            tokens = [int(t) for t in z["_tokens"]]
+            n = int(meta[1])
+            payloads: List[Dict[str, np.ndarray]] = [
+                {} for _ in range(n)]
+            for name in z.files:
+                if name.startswith("_"):
+                    continue
+                j, field = name.split(".", 1)
+                payloads[int(j[1:])][field] = z[name]
+        return cls(tokens, int(meta[0]), payloads)
+
+
+def extract_prefix(kv, tokens: Sequence[int]
+                   ) -> Optional[PrefixShipment]:
+    """The longest cached chain prefixing ``tokens``, read out of a
+    home replica's `PagedKV` as a wire-ready shipment (None = the
+    cache holds nothing usable — the directory entry was stale).
+
+    Device-resident pages read directly; spilled nodes read through
+    the tier's non-destructive verified ``load`` (the content STAYS
+    parked locally — extraction must not weaken the home's own
+    cache), so a corrupt disk segment truncates the shipment at that
+    page instead of shipping bad bytes."""
+    path = kv.match_prefix(list(tokens))
+    if not path:
+        return None
+    payloads: List[Dict[str, np.ndarray]] = []
+    for node in path:
+        if node.spilled:
+            payload = (kv.spill.load(node.spill_key)
+                       if kv.spill is not None else None)
+            if payload is None:
+                break
+        else:
+            payload = kv._read_page(node.page)
+        payloads.append(payload)
+    if not payloads:
+        return None
+    ps = kv.page_size
+    return PrefixShipment(list(tokens[:len(payloads) * ps]), ps,
+                          payloads)
+
+
+class PrefixDirectory:
+    """Advisory cluster map: prefix chain -> {replica id: last use}.
+
+    Chains are keyed by their full-page token chunks (the same
+    granularity the radix trees share at).  ``register`` is called
+    at ROUTE COMMIT — the one point the cluster knows a replica
+    really accepted (and therefore radix-registered) a prompt —
+    and ``lookup`` walks from the longest sharable chain down, so
+    the router learns the best peer coverage available.  A drained
+    replica's entries purge at failover; everything else ages out
+    LRU.  Wrong answers are safe by construction: extraction
+    re-checks the live cache (stale entry → smaller/no shipment →
+    recompute)."""
+
+    def __init__(self, page_size: int, max_entries: int = 4096):
+        self.page_size = int(page_size)
+        self.max_entries = int(max_entries)
+        #: chain (tuple of token chunks) -> {replica id: last ts}
+        self._chains: Dict[Tuple, Dict[int, float]] = {}
+
+    def __len__(self) -> int:
+        return len(self._chains)
+
+    def _chain_of(self, tokens: Sequence[int]) -> Tuple:
+        """The SHARABLE chain of ``tokens``: full pages strictly
+        below position len-1 (the `match_prefix` cap — pages that
+        get written are never shared, so never advertised)."""
+        ps = self.page_size
+        n = (len(tokens) - 1) // ps
+        return tuple(tuple(int(t) for t in tokens[j * ps:(j + 1) * ps])
+                     for j in range(n))
+
+    def register(self, tokens: Sequence[int], replica_id: int,
+                 now: float) -> None:
+        chain = self._chain_of(tokens)
+        if not chain:
+            return
+        holders = self._chains.pop(chain, None)
+        if holders is None:
+            holders = {}
+        holders[int(replica_id)] = float(now)
+        # Re-insert so dict order is recency: eviction past
+        # max_entries drops the coldest chain first.
+        self._chains[chain] = holders
+        while len(self._chains) > self.max_entries:
+            del self._chains[next(iter(self._chains))]
+
+    def lookup(self, tokens: Sequence[int]
+               ) -> Tuple[Tuple, Dict[int, float]]:
+        """Longest registered chain prefixing ``tokens`` (and its
+        holders); ``((), {})`` on a miss."""
+        chain = self._chain_of(tokens)
+        while chain:
+            holders = self._chains.get(chain)
+            if holders:
+                return chain, dict(holders)
+            chain = chain[:-1]
+        return (), {}
+
+    def purge_replica(self, replica_id: int) -> None:
+        """A drained replica's pages are unreachable: forget every
+        entry naming it (chains with no other holder drop)."""
+        rid = int(replica_id)
+        dead = []
+        for chain, holders in self._chains.items():
+            holders.pop(rid, None)
+            if not holders:
+                dead.append(chain)
+        for chain in dead:
+            del self._chains[chain]

@@ -115,6 +115,18 @@ class RouterConfig:
     #: prefixes are evicted past it (a long-running router serving
     #: diverse prompts must not grow without bound).
     affinity_max: int = 4096
+    #: KV-tier peer prefix shipping: when the ship-vs-recompute cost
+    #: model picks ``peer_ship`` for a dispatch, the cluster actually
+    #: ships the cached prefix pages from the holder instead of
+    #: re-prefilling (docs/serving.md "Cache hierarchy").  False
+    #: keeps the cost model advisory (DecisionEvents only).  Either
+    #: way the model only ENGAGES with fresh signals and a prefill
+    #: baseline — absent those, routing is bit-identical to today's
+    #: affinity behavior.
+    prefix_ship: bool = True
+    #: Modeled disk-tier read bandwidth (GB/s) for the ``disk_load``
+    #: candidate in the ship-vs-recompute score.
+    disk_gbps: float = 2.0
 
 
 #: Serving gauges a heartbeat file must carry to yield a usable
@@ -201,6 +213,16 @@ class ClusterRouter:
         #: what makes the degradation bit-identical.
         self._rr = 0
         self._affinity: Dict[Tuple[int, ...], int] = {}
+        #: Cluster-installed KV-tier hooks: the cluster-wide prefix
+        #: directory (`peer_cache.PrefixDirectory`; the cluster
+        #: registers chains at route COMMIT and purges a replica's
+        #: entries at failover) and the placement-score extension
+        #: ``fetch_cost_fn(tokens, replica) -> µs`` — the modeled
+        #: cost for that replica to OBTAIN the prompt's cached
+        #: prefix (0.0 whenever the ship-vs-recompute model cannot
+        #: engage, which keeps scoring bit-identical to today).
+        self.directory = None
+        self.fetch_cost_fn = None
         self.failovers: List[dict] = []
         self.readmits: List[dict] = []
         #: Health hysteresis: per-replica consecutive stale / fresh
@@ -334,6 +356,22 @@ class ClusterRouter:
                     + sig["active_slots"]) * eff
 
         scores = {r.id: score(sigs[r.id]) for r in alive}
+        fetch = None
+        if self.fetch_cost_fn is not None:
+            # Cache-aware placement: each candidate's score also pays
+            # the modeled cost of OBTAINING the prompt's cached
+            # prefix there (0 where it is already resident; ship /
+            # disk / recompute µs where it is not).  All-zero — the
+            # model disengaged (no directory hit, no baseline, no
+            # bandwidth) — leaves every score, and therefore the
+            # choice, bit-identical to today.
+            fetch = {r.id: float(self.fetch_cost_fn(tokens, r))
+                     for r in alive}
+            if any(fetch.values()):
+                for r in alive:
+                    scores[r.id] += fetch[r.id]
+            else:
+                fetch = None
         open_ = [r for r in alive
                  if sigs[r.id]["kv_occupancy"] < KV_FULL] or alive
         # Ties follow the rotation: candidate order starts at the
@@ -354,6 +392,9 @@ class ClusterRouter:
         inputs = {"affinity": affinity,
                   "queue_depths": {r.name: sigs[r.id]["queue_depth"]
                                    for r in alive}}
+        if fetch is not None:
+            inputs["fetch_cost_us"] = {r.name: round(fetch[r.id], 3)
+                                       for r in alive}
         candidates = [{"name": r.name,
                        "score_us": round(scores[r.id], 3)}
                       for r in alive]

@@ -189,12 +189,18 @@ class VirtualTransport:
             return 0.0
         return nbytes / (self.wire_gbps * 1e9)
 
-    def claim(self, token: int) -> Optional[KVShipment]:
+    def claim(self, token: int, decoder=None) -> Optional[KVShipment]:
         """Deserialize a delivered shipment (one-shot: the wire copy
         is dropped).  Returns ``None`` when ``token`` was already
         claimed or dropped — a DUPLICATE delivery, absorbed
         idempotently.  Raises :class:`ShipmentCorrupt` when the bytes
-        fail their sent-time checksum (the caller NACKs)."""
+        fail their sent-time checksum (the caller NACKs).
+
+        ``decoder`` rebuilds the artifact from the verified bytes
+        (default: the full-row `KVShipment`; the cluster's prefix
+        pump passes `peer_cache.PrefixShipment.from_bytes` — the
+        wire, ids, CRC and fault seams are shared, only the payload
+        schema differs)."""
         data = self._in_flight.pop(token, None)
         self._tags.pop(token, None)
         if data is None:
@@ -206,7 +212,7 @@ class VirtualTransport:
             raise ShipmentCorrupt(
                 f"shipment {token}: checksum mismatch "
                 f"({zlib.crc32(data):#010x} != {crc:#010x})")
-        return KVShipment.from_bytes(data)
+        return (decoder or KVShipment.from_bytes)(data)
 
     def drop(self, token: int) -> None:
         """Discard an in-flight shipment without deserializing it

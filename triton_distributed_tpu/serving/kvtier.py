@@ -1,0 +1,315 @@
+"""KVTier: one cache hierarchy from device pages to disk segments.
+
+PR 10's `SpillPool` gave the radix prefix cache one escape hatch —
+refcount-0 prefix pages park their CONTENT in host memory instead of
+being destroyed — but host DRAM is still a bounded budget, and when
+it fills the pool degrades to plain eviction: a system prompt shared
+by a million users is gone and the next arrival re-prefills it.  The
+reference's whole thesis is *move bytes instead of recomputing them*
+(one-sided SHMEM pulls over ICI, AG-GEMM overlap over DCN); this
+module applies it to the cache layer:
+
+    device pages  →  host SpillPool  →  peer replicas  →  disk
+    (PagePool)       (PR 10)            (cluster/peer_cache)  (here)
+
+- :class:`DiskTier` — the bottom tier: one **segment file per page**
+  under a spill directory, each carrying a CRC32 of its payload
+  bytes.  ``put`` serializes the page's per-layer numpy arrays (the
+  same ``{k<i>/v<i>[/ks<i>/vs<i>]}`` dict `PagedKV._read_page`
+  produces) through one npz container — numpy round-trip of the
+  stored dtypes is exact, so a promote is bit-identical to the
+  demoted page.  ``take``/``load`` re-verify the CRC on every read:
+  a corrupt or lost segment returns ``None`` and the caller degrades
+  to the next-cheaper source (recompute, worst case) — a bad byte on
+  disk must never reach the KV pool.
+
+- :class:`KVTier` — the demote/promote chain behind the exact
+  `SpillPool` interface `RadixCache` already drives (``put`` /
+  ``take`` / ``drop`` / ``can_accept``).  ``put`` parks in host
+  memory first; when the host pool is full, the OLDEST host page is
+  demoted onward to disk (write-back migration) to make room, and
+  only when disk is also full is the spill refused — eviction then
+  degrades to dropping the page, exactly as before.  ``take``
+  promotes from whichever tier holds the key.  ``load`` is the
+  non-destructive integrity probe the admission path uses
+  (`PagedKV.match_prefix` verifies disk-resident chain nodes BEFORE
+  admission commits to a suffix-only prefill); a verified disk read
+  is memoized so the promote that follows does not pay a second
+  disk read.
+
+The peer tier lives in `serving.cluster.peer_cache` (it needs the
+router's prefix directory and the transport); this module is the
+single-replica half of the hierarchy.  Per-tier accounting
+(``serving_kvtier_hit_total{tier=device|host|peer|disk}`` /
+``serving_kvtier_miss_total{tier=...}`` /
+``serving_kvtier_fallbacks_total``) is incremented by `PagedKV` at
+the admission seams — see docs/serving.md "Cache hierarchy".
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+import zlib
+from typing import Dict, Optional
+
+import numpy as np
+
+from triton_distributed_tpu.serving.pages import SpillPool
+
+#: The tier ladder, cheapest source first — per-page hit/miss
+#: accounting and the router's ship-vs-recompute cost model both
+#: order candidates along it.
+TIERS = ("device", "host", "peer", "disk")
+
+#: Segment header: CRC32 of the payload bytes + payload length.
+_SEG_HEADER = struct.Struct("<II")
+
+#: Verified-read memo bound: `load` caches at most this many decoded
+#: disk payloads for the promote that follows (admission may probe a
+#: chain several times before inserting; requests that never insert
+#: must not pin host memory forever).
+_LOAD_MEMO_MAX = 64
+
+
+def pack_page(payload: Dict[str, np.ndarray]) -> bytes:
+    """One page's content as npz bytes (the disk-segment / wire
+    format; numpy round-trip of the stored dtypes is exact)."""
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    return buf.getvalue()
+
+
+def unpack_page(data: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(data)) as z:
+        return {name: z[name] for name in z.files}
+
+
+class DiskTier:
+    """Disk-backed page segments with per-page CRC verification.
+
+    Bounded in PAGES like the host pool; a full tier refuses the
+    demote and the caller degrades to plain eviction.  Reads that
+    fail integrity (CRC mismatch, truncated/missing segment) return
+    ``None`` — callers treat that exactly like an evicted page.
+    """
+
+    def __init__(self, directory: str, max_pages: int):
+        assert max_pages >= 1, max_pages
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.max_pages = int(max_pages)
+        #: spill key -> segment path (the tier's index; a key absent
+        #: here is LOST whatever the filesystem holds).
+        self._index: Dict[int, str] = {}
+        self.written = 0
+        self.promoted = 0
+        self.corrupt = 0
+        self.lost = 0
+        self.rejected = 0
+
+    @property
+    def pages(self) -> int:
+        return len(self._index)
+
+    def can_accept(self) -> bool:
+        return len(self._index) < self.max_pages
+
+    def put(self, key: int, payload: Dict[str, np.ndarray]) -> bool:
+        """Write one page segment; False = tier full."""
+        if not self.can_accept():
+            self.rejected += 1
+            return False
+        data = pack_page(payload)
+        path = os.path.join(self.directory, f"page-{int(key)}.seg")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(_SEG_HEADER.pack(zlib.crc32(data), len(data)))
+                f.write(data)
+            os.replace(tmp, path)
+        except OSError:
+            # A failed write is a refused demote, never a corrupt
+            # segment the index would later trust — and the partial
+            # .tmp must not squat on the very disk space whose
+            # exhaustion likely caused the failure.
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            self.rejected += 1
+            return False
+        self._index[key] = path
+        self.written += 1
+        return True
+
+    def _read(self, key: int) -> Optional[Dict[str, np.ndarray]]:
+        path = self._index.get(key)
+        if path is None:
+            return None
+        try:
+            with open(path, "rb") as f:
+                header = f.read(_SEG_HEADER.size)
+                crc, length = _SEG_HEADER.unpack(header)
+                data = f.read(length + 1)
+        except (OSError, struct.error):
+            self.lost += 1
+            return None
+        if len(data) != length or zlib.crc32(data) != crc:
+            self.corrupt += 1
+            return None
+        try:
+            return unpack_page(data)
+        except (OSError, ValueError):
+            self.corrupt += 1
+            return None
+
+    def load(self, key: int) -> Optional[Dict[str, np.ndarray]]:
+        """Non-destructive CRC-verified read (None = corrupt/lost)."""
+        return self._read(key)
+
+    def take(self, key: int) -> Optional[Dict[str, np.ndarray]]:
+        """Promote-and-forget: verified read, then the segment is
+        dropped (whether or not the read succeeded — a corrupt
+        segment is useless and must not be retried forever)."""
+        payload = self._read(key)
+        if payload is not None:
+            self.promoted += 1
+        self.drop(key)
+        return payload
+
+    def drop(self, key: int) -> None:
+        path = self._index.pop(key, None)
+        if path is not None:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def has(self, key: int) -> bool:
+        return key in self._index
+
+
+class KVTier:
+    """Host → disk demote chain behind the `SpillPool` interface.
+
+    `RadixCache` keeps calling ``put``/``take``/``drop``/
+    ``can_accept`` exactly as it does against a bare `SpillPool`;
+    what changes is that a full host pool DEMOTES its oldest page to
+    disk instead of refusing, and ``take`` promotes from whichever
+    tier holds the key.  A disk read that fails integrity returns
+    ``None`` — `PagedKV.match_prefix` probes disk-resident nodes
+    with :meth:`load` before admission relies on them, so a bad
+    segment degrades the chain to recompute instead of tripping the
+    restore path.
+    """
+
+    def __init__(self, host: SpillPool, disk: DiskTier):
+        self.host = host
+        self.disk = disk
+        #: Verified-read memo: key -> decoded payload from a `load`
+        #: probe, consumed by the `take` that follows (insertion
+        #: ordered; bounded).
+        self._loaded: Dict[int, Dict[str, np.ndarray]] = {}
+        #: Pages promoted from DISK (the host pool tallies its own
+        #: promotes) — keeps the PR-10 spill out/in counter pairing
+        #: balanced across the whole chain.
+        self._disk_in = 0
+        self.rejected = 0
+
+    # -- SpillPool-compatible surface ------------------------------------
+
+    @property
+    def pages(self) -> int:
+        return self.host.pages + self.disk.pages
+
+    @property
+    def max_pages(self) -> int:
+        return self.host.max_pages + self.disk.max_pages
+
+    @property
+    def spilled_out(self) -> int:
+        return self.host.spilled_out
+
+    @property
+    def spilled_in(self) -> int:
+        return self.host.spilled_in + self._disk_in
+
+    def can_accept(self) -> bool:
+        return self.host.can_accept() or self.disk.can_accept()
+
+    def put(self, key: int, payload: Dict[str, np.ndarray]) -> bool:
+        """Park in host memory, demoting the OLDEST host page to disk
+        when the host pool is full (write-back migration — the page
+        most likely to be re-hit stays in the cheap tier).
+
+        Peek-then-commit: the victim leaves host memory only AFTER
+        its disk segment is durably written — a refused/failed disk
+        write refuses the INCOMING page instead (the caller degrades
+        to plain eviction), so parked content a radix node still
+        points at is never dropped on this path."""
+        if not self.host.can_accept():
+            victim = self.host.oldest_key()
+            demoted = (self.host.load(victim)
+                       if victim is not None else None)
+            if demoted is None or not self.disk.put(victim, demoted):
+                self.rejected += 1
+                return False
+            self.host.take_silent(victim)
+        return self.host.put(key, payload)
+
+    def tier_of(self, key: int) -> Optional[str]:
+        """Which tier holds ``key`` right now ("host" / "disk") —
+        feeds the per-tier hit accounting and the router's
+        disk_load candidate cost."""
+        if self.host.has(key):
+            return "host"
+        if self.disk.has(key):
+            return "disk"
+        return None
+
+    def has(self, key: int) -> bool:
+        return self.tier_of(key) is not None
+
+    def load(self, key: int) -> Optional[Dict[str, np.ndarray]]:
+        """Non-destructive verified read: the admission path's
+        integrity probe.  A verified disk payload is memoized so the
+        promote (`take`) that follows costs no second disk read."""
+        if self.host.has(key):
+            return self.host.load(key)
+        memo = self._loaded.get(key)
+        if memo is not None:
+            return memo
+        payload = self.disk.load(key)
+        if payload is not None:
+            while len(self._loaded) >= _LOAD_MEMO_MAX:
+                self._loaded.pop(next(iter(self._loaded)))
+            self._loaded[key] = payload
+        return payload
+
+    def _count_disk_in(self) -> None:
+        self._disk_in += 1
+        from triton_distributed_tpu.observability.metrics import (
+            count_metric)
+        count_metric("serving_kv_spill_in_pages_total")
+
+    def take(self, key: int) -> Optional[Dict[str, np.ndarray]]:
+        payload = self.host.take(key)
+        if payload is not None:
+            return payload
+        memo = self._loaded.pop(key, None)
+        if memo is not None:
+            self.disk.drop(key)
+            self.disk.promoted += 1
+            self._count_disk_in()
+            return memo
+        payload = self.disk.take(key)
+        if payload is not None:
+            self._count_disk_in()
+        return payload
+
+    def drop(self, key: int) -> None:
+        self._loaded.pop(key, None)
+        self.host.drop(key)
+        self.disk.drop(key)

@@ -119,10 +119,10 @@ class PagePool:
                 self._free.append(i)
 
 
-def _count_metric(name: str, n: int = 1) -> None:
+def _count_metric(name: str, n: int = 1, **labels) -> None:
     from triton_distributed_tpu.observability.metrics import (
         count_metric)
-    count_metric(name, n)
+    count_metric(name, n, **labels)
 
 
 _next_spill_key = itertools.count(1)
@@ -156,6 +156,34 @@ class SpillPool:
         return sum(a.nbytes for p in self._store.values()
                    for a in p.values())
 
+    def can_accept(self) -> bool:
+        """May one more page be parked right now?  (`RadixCache.evict`
+        checks this BEFORE the device->host page read, and
+        `serving.kvtier.KVTier` chains it: a full host pool demotes
+        onward to disk instead of refusing.)"""
+        return len(self._store) < self.max_pages
+
+    def has(self, key: int) -> bool:
+        return key in self._store
+
+    def load(self, key: int) -> Optional[dict]:
+        """Non-destructive read (the tier-integrity probe; host
+        memory never corrupts, so None here means a DANGLING key —
+        the parked content is gone while the radix node still points
+        at it)."""
+        return self._store.get(key)
+
+    def oldest_key(self) -> Optional[int]:
+        """Least-recently-parked key (dict insertion order) — the
+        write-back victim `KVTier` demotes to disk on host overflow.
+        """
+        return next(iter(self._store), None)
+
+    def take_silent(self, key: int) -> Optional[dict]:
+        """Remove without touching the spill-in counters: a
+        host→disk demotion is a migration, not a promote."""
+        return self._store.pop(key, None)
+
     def put(self, key: int, payload: dict) -> bool:
         """Park one page; False = pool full (caller evicts plainly)."""
         if len(self._store) >= self.max_pages:
@@ -179,7 +207,7 @@ class SpillPool:
 
 class _RadixNode:
     __slots__ = ("children", "parent", "chunk", "page", "refs",
-                 "last_use", "spill_key")
+                 "last_use", "spill_key", "origin")
 
     def __init__(self, parent, chunk: Tuple[int, ...], page: int):
         self.children: Dict[Tuple[int, ...], "_RadixNode"] = {}
@@ -193,6 +221,13 @@ class _RadixNode:
         #: SpillPool key when this node's page content is parked in
         #: host memory (``page`` is then NULL_PAGE); None = physical.
         self.spill_key: Optional[int] = None
+        #: Which cache tier this page's content arrived from when it
+        #: is not yet consumed locally: "peer" for a chain adopted
+        #: from a peer replica's shipment (`PagedKV.adopt_prefix`).
+        #: The FIRST admission that consumes it counts a peer-tier
+        #: hit and clears the tag (after that it is device-resident
+        #: like any cached page).
+        self.origin: Optional[str] = None
 
     @property
     def spilled(self) -> bool:
@@ -312,6 +347,38 @@ class RadixCache:
             out.append(child)
         return out
 
+    def adopt(self, parent_path: Sequence[_RadixNode],
+              chunk: Tuple[int, ...], page: int) -> _RadixNode:
+        """Register one PEER-SHIPPED page under ``parent_path``: the
+        content was written into freshly allocated physical ``page``
+        by the caller (`PagedKV.adopt_prefix`), whose allocation ref
+        BECOMES the tree's retention ref (no incref here).  Unlike
+        `extend`, the node starts at refs 0 — no live request holds
+        it yet; it is immediately evictable, exactly like a cached
+        prefix left behind by a retired request — tagged
+        ``origin="peer"`` so the first local consumption counts a
+        peer-tier hit."""
+        node = parent_path[-1] if parent_path else self._root
+        chunk = tuple(chunk)
+        assert chunk not in node.children, "adopt over an existing chain"
+        child = _RadixNode(node, chunk, int(page))
+        child.last_use = self._tick()
+        child.origin = "peer"
+        node.children[chunk] = child
+        self.cached_pages += 1
+        self._idle_pages += 1
+        return child
+
+    def drop_subtree(self, node: _RadixNode) -> None:
+        """Remove an UNHELD spilled node (and its necessarily-spilled
+        subtree) whose parked content failed its integrity probe —
+        the tier-degradation path: the chain below it recomputes.
+        """
+        assert node.spilled and node.refs == 0, (node.refs,
+                                                node.spill_key)
+        self._prune(node)
+        self.evicted_pages += 1
+
     def evictable_pages(self) -> int:
         """Pages the tree could free right now (refcount-0 nodes —
         ancestors of a refs>0 node are themselves refs>0, so every
@@ -372,7 +439,9 @@ class RadixCache:
                 # Capacity check BEFORE the device->host page copy:
                 # a full pool (its steady state under sustained
                 # pressure) must not pay a discarded read per victim.
-                if self.spill.pages < self.spill.max_pages:
+                # (`KVTier.can_accept` extends this down the chain: a
+                # full host pool still accepts by demoting to disk.)
+                if self.spill.can_accept():
                     key = next(_next_spill_key)
                     spilled = self.spill.put(
                         key, self.read_page(victim.page))
@@ -414,6 +483,8 @@ class PagedKV:
                  kv_budget_bytes: Optional[int] = None,
                  prefix_cache: bool = True,
                  spill_pages: int = 0,
+                 spill_disk_dir: Optional[str] = None,
+                 spill_disk_pages: int = 0,
                  insert_fn=None):
         self.page_size = ps = int(page_size)
         self.max_seq = int(max_seq)
@@ -443,12 +514,31 @@ class PagedKV:
                       else None)
         #: Host-memory spill (opt-in, ``spill_pages`` > 0): evicted
         #: refcount-0 prefix pages park their content here and
-        #: restore bit-exactly on the next prefix hit.
+        #: restore bit-exactly on the next prefix hit.  With
+        #: ``spill_disk_dir`` + ``spill_disk_pages`` also set, the
+        #: host pool chains onto a CRC-verified `kvtier.DiskTier`:
+        #: host overflow demotes the coldest parked page to a disk
+        #: segment instead of dropping it, and a corrupt/lost segment
+        #: degrades that chain to recompute at the match-time probe.
         self.spill: Optional[SpillPool] = None
         if spill_pages and self.radix is not None:
             self.spill = SpillPool(spill_pages)
+            if spill_disk_dir and spill_disk_pages:
+                from triton_distributed_tpu.serving.kvtier import (
+                    DiskTier, KVTier)
+                self.spill = KVTier(
+                    self.spill, DiskTier(spill_disk_dir,
+                                         spill_disk_pages))
             self.radix.spill = self.spill
             self.radix.read_page = self._read_page
+        #: Per-tier admission accounting (pages resolved per tier /
+        #: missed everywhere / tier reads degraded to recompute) —
+        #: mirrored as ``serving_kvtier_*`` gauges onto heartbeats
+        #: and as labeled ``serving_kvtier_{hit,miss}_total``
+        #: counters (docs/serving.md "Cache hierarchy").
+        self.tier_stats: Dict[str, int] = {
+            "hit_device": 0, "hit_host": 0, "hit_peer": 0,
+            "hit_disk": 0, "miss": 0, "fallbacks": 0}
         self._free: List[int] = list(range(self.num_slots))
         self._active = np.zeros(self.num_slots, bool)
         #: Host mirror of the device page table — single source of
@@ -551,12 +641,57 @@ class PagedKV:
         """Cached full pages prefixing ``tokens``, capped so every
         page containing positions >= len(tokens)-1 stays private
         (those get written: s-1 is recomputed at insert, generation
-        writes from s on)."""
+        writes from s on).
+
+        Spilled chain nodes are integrity-probed HERE (a
+        non-destructive CRC-verified `load`; host memory always
+        passes, disk segments can be corrupt or lost): a node whose
+        parked content cannot be read back is pruned and the chain
+        truncates at it — admission then recomputes the tail instead
+        of committing to a restore that would fail.  Never wrong
+        bytes, at worst a re-prefill (`serving_kvtier_fallbacks_total`
+        counts each degradation)."""
         if self.radix is None:
             return []
         path = self.radix.match(tokens)
         cap = (len(tokens) - 1) // self.page_size
-        return path[:cap]
+        path = path[:cap]
+        if self.spill is not None:
+            for i, node in enumerate(path):
+                if not node.spilled:
+                    continue
+                if self.spill.load(node.spill_key) is None:
+                    # Count the degradation ONCE, when the node is
+                    # actually dropped — the probe also runs from
+                    # router scoring and peer extraction, and a
+                    # counter incremented per probe would inflate
+                    # "tier reads fell back to recompute" with
+                    # re-observations of one lost page.  (Pruning
+                    # itself is always correct on detection: the
+                    # content is gone whoever asked.)
+                    if node.refs == 0:
+                        self.radix.drop_subtree(node)
+                        self.tier_stats["fallbacks"] += 1
+                        _count_metric("serving_kvtier_fallbacks_total")
+                    return path[:i]
+        return path
+
+    def _tier_account(self, tier: Optional[str], n: int = 1) -> None:
+        """Per-page hit/miss bookkeeping along the tier ladder: a
+        page resolved at tier X is a hit there and a miss at every
+        cheaper tier; a page resolved nowhere (fresh prefill) misses
+        all four."""
+        if n <= 0:
+            return
+        from triton_distributed_tpu.serving.kvtier import TIERS
+        missed = TIERS if tier is None else TIERS[:TIERS.index(tier)]
+        if tier is not None:
+            self.tier_stats[f"hit_{tier}"] += n
+            _count_metric("serving_kvtier_hit_total", n, tier=tier)
+        else:
+            self.tier_stats["miss"] += n
+        for t in missed:
+            _count_metric("serving_kvtier_miss_total", n, tier=t)
 
     # -- allocation ------------------------------------------------------
 
@@ -661,10 +796,19 @@ class PagedKV:
             # (the allocation ref becomes the tree's retention ref),
             # the parked content written back bit-exactly, plus this
             # request's own pool ref (acquire skipped it while the
-            # node was spilled).  can_admit budgeted these pages.
+            # node was spilled).  can_admit budgeted these pages, and
+            # the match-time probe verified each parked payload
+            # reads back intact.
             for node in shared_path:
                 if not node.spilled:
+                    # Device-resident page; a peer-adopted chain's
+                    # first local consumption counts as a peer-tier
+                    # hit (it was shipped, not prefilled here).
+                    self._tier_account(node.origin or "device")
+                    node.origin = None
                     continue
+                tier = (self.spill.tier_of(node.spill_key)
+                        if hasattr(self.spill, "tier_of") else "host")
                 ids = self._alloc(1)
                 assert ids is not None, \
                     "insert_prefill without can_admit()"
@@ -673,6 +817,7 @@ class PagedKV:
                 self._write_page(ids[0], payload)
                 self.radix.restore(node, ids[0])
                 self.pool.incref([ids[0]])
+                self._tier_account(tier or "host")
         priv = self._alloc(total_pages - c_pages)
         assert priv is not None, "insert_prefill without can_admit()"
         slot = self._free.pop(0)
@@ -715,7 +860,67 @@ class PagedKV:
                 self._slot_path[slot] = list(shared_path) + nodes
             self.radix.hit_tokens += c_pages * ps
             self.radix.miss_tokens += s - c_pages * ps
+            # Sharable pages the hierarchy did NOT hold anywhere
+            # (freshly prefilled; the never-sharable tail page is
+            # not a cache miss).
+            self._tier_account(None, max(sharable - c_pages, 0))
         return slot
+
+    def adopt_prefix(self, tokens: Sequence[int],
+                     payloads: Sequence[dict]) -> int:
+        """Install a PEER-SHIPPED prefix chain into this pool's radix
+        cache: page ``j`` of ``tokens`` gets ``payloads[j]`` (the
+        per-layer content `_read_page` produced on the home replica —
+        numpy round-trip is exact, and replicas share params, so the
+        bytes are identical to a local prefill's).
+
+        Pages this cache already holds are skipped; adoption stops at
+        the first locally-SPILLED chain node (restoring it locally is
+        the cheaper path, and extending physical pages under a
+        spilled parent would break the all-spilled-subtree pruning
+        invariant).  New pages allocate from the pool (evicting idle
+        prefix pages if needed — an adopted hot prefix is worth a
+        cold one) and register refs-0 / tree-retained, tagged
+        ``origin="peer"``, so the NEXT admission's `match_prefix`
+        consumes them like any cached prefix: suffix-only prefill,
+        zero prompt FLOPs for the shipped pages.  Returns the number
+        of pages adopted (0 = nothing fit / radix off) — a partial
+        or failed adoption is never an error, merely less reuse."""
+        if self.radix is None:
+            return 0
+        ps = self.page_size
+        n_pages = min(len(payloads), len(tokens) // ps)
+        path = self.radix.match(tokens)[:n_pages]
+        adopted = 0
+        # Pin the chain against the eviction _alloc may trigger: a
+        # freshly adopted node is an LRU-frontier LEAF, and demoting
+        # it mid-adoption would hang the next page under a spilled
+        # parent (breaking the all-spilled-subtree prune invariant).
+        # Same move insert_prefill makes before ITS allocations.
+        pinned = [n for n in path if not n.spilled]
+        if pinned:
+            self.radix.acquire(pinned)
+        try:
+            for j in range(len(path), n_pages):
+                if path and path[-1].spilled:
+                    break
+                chunk = tuple(tokens[j * ps:(j + 1) * ps])
+                ids = self._alloc(1)
+                if ids is None:
+                    break          # pool dry even after eviction
+                self._write_page(ids[0], payloads[j])
+                node = self.radix.adopt(path, chunk, ids[0])
+                self.radix.acquire([node])
+                pinned.append(node)
+                path.append(node)
+                adopted += 1
+        finally:
+            if pinned:
+                self.radix.release(pinned)
+        if adopted:
+            _count_metric("serving_kvtier_adopted_pages_total",
+                          adopted)
+        return adopted
 
     def release(self, slot: int) -> None:
         """Retire a slot: drop its radix references (pages stay cached

@@ -109,6 +109,15 @@ class SchedulerConfig:
     #: bucket, servable only via suffix prefill) alive through
     #: pressure instead of shedding it.
     spill_pages: int = 0
+    #: Disk tier below the host spill (`serving.kvtier.DiskTier`):
+    #: when BOTH are set (and ``spill_pages`` > 0 — host is the tier
+    #: above disk), host-spill overflow demotes the coldest parked
+    #: page to a CRC-verified segment file under this directory
+    #: instead of dropping it.  A corrupt or lost segment degrades
+    #: that prefix chain to recompute at the admission probe — never
+    #: wrong bytes.  See docs/serving.md "Cache hierarchy".
+    spill_disk_dir: Optional[str] = None
+    spill_disk_pages: int = 0
     pad_id: int = 0
     temperature: float = 0.0
     top_k: int = 0
@@ -169,6 +178,24 @@ class SchedulerConfig:
     slo_tbt_ms: Optional[float] = None
 
 
+def prefill_baseline_key(bucket: int) -> str:
+    """Anomaly-baseline key for one bucketed prefill.  Every measured
+    admission prefill rolls into it (the same store the decode-step
+    baseline lives in), and the cluster router's ship-vs-recompute
+    cost model reads it back as the PREDICTED prefill cost — "what
+    does prefilling this bucket cost here, now" vs "what does
+    shipping the cached pages cost over the measured wire"."""
+    from triton_distributed_tpu.observability.anomaly import event_key
+    return event_key("serving.prefill", None, (int(bucket),), 1)
+
+
+def _observe_prefill(bucket: int, ms: float) -> None:
+    from triton_distributed_tpu.observability.anomaly import (
+        get_baseline_store)
+    get_baseline_store().observe(prefill_baseline_key(bucket),
+                                 ms * 1e3)
+
+
 class ContinuousBatchingScheduler:
     """model: anything with the engine contract (`create_cache`,
     `make_prefill_fn`, `make_decode_fn`) — `models.qwen.Qwen3` or
@@ -214,7 +241,9 @@ class ContinuousBatchingScheduler:
                 page_size=cfg.page_size, num_pages=cfg.num_pages,
                 kv_budget_bytes=cfg.kv_budget_bytes,
                 prefix_cache=cfg.prefix_cache,
-                spill_pages=cfg.spill_pages)
+                spill_pages=cfg.spill_pages,
+                spill_disk_dir=cfg.spill_disk_dir,
+                spill_disk_pages=cfg.spill_disk_pages)
             decode_fn = model.make_paged_decode_fn(
                 page_size=cfg.page_size)
             sfn = getattr(model, "make_prefill_suffix_fn", None)
@@ -640,8 +669,9 @@ class ContinuousBatchingScheduler:
                         # records prefill compute, not dispatch (as
                         # Engine.serve does)
                         jax.block_until_ready(row_cache.ks[0])
-                        reg.histogram("serving_prefill_ms").observe(
-                            (time.perf_counter() - t0) * 1e3)
+                        ms = (time.perf_counter() - t0) * 1e3
+                        reg.histogram("serving_prefill_ms").observe(ms)
+                        _observe_prefill(bucket, ms)
                 slot = self.slots.insert_prefill(
                     row_cache, s, self._request_key(req))
             self._tokens[slot] = tokens[-1]
@@ -776,8 +806,9 @@ class ContinuousBatchingScheduler:
         if reg:
             jax.block_until_ready(row.ks[0])
             if t0 is not None:
-                reg.histogram("serving_prefill_ms").observe(
-                    (time.perf_counter() - t0) * 1e3)
+                ms = (time.perf_counter() - t0) * 1e3
+                reg.histogram("serving_prefill_ms").observe(ms)
+                _observe_prefill(bucket, ms)
             reg.counter("serving_prefix_cache_hit_tokens_total").inc(c)
             reg.counter("serving_prefix_cache_miss_tokens_total").inc(
                 s - c)
@@ -1205,3 +1236,19 @@ class ContinuousBatchingScheduler:
                 self.slots.page_occupancy)
             reg.gauge("serving_prefix_cache_pages").set(
                 self.slots.cached_prefix_pages)
+            # Per-tier admission accounting mirrored as gauges so the
+            # hierarchy's hit profile rides heartbeat files into the
+            # doctor's "KV tier" section (counters don't travel;
+            # gauges do — the serving_decode_step_us precedent).
+            for k, v in self.slots.tier_stats.items():
+                reg.gauge(f"serving_kvtier_{k}").set(v)
+            # Collapse inputs: is a warm (spill) tier even configured,
+            # and how many evictions destroyed pages anyway?  The
+            # doctor must never call a plain paged engine's ordinary
+            # misses a "collapse" — only a configured tier failing to
+            # absorb evictions is one.
+            reg.gauge("serving_kvtier_warm_tiers").set(
+                int(self.slots.spill is not None))
+            if self.slots.radix is not None:
+                reg.gauge("serving_kvtier_dropped_evictions").set(
+                    self.slots.radix.evicted_pages)
