@@ -1,0 +1,91 @@
+"""Capacity-planner bench: the virtual-clock SLO sweep as bench rows.
+
+A thin emitter over `observability.planner.plan` — the same seeded
+trace, the same replay on router + replicas + scheduler, the same
+`slo.evaluate_outcomes` scoring.  Emitted rows (one JSON line each,
+``bench: "planner"``):
+
+- ``workload: "cell"`` — one per (rate multiplier, replica count)
+  tried: per-class compliance/objective/p99s, cell ok flag, virtual
+  makespan;
+- ``workload: "plan"`` — one per rate: ``min_replicas`` (the
+  smallest fleet holding every class's objective), ``plan_feasible``
+  and ``plan_deterministic`` (the winning cell re-run and
+  byte-compared — a capacity answer that varies run-to-run is a
+  bug, not noise).
+
+Gate semantics (`scripts/check_bench_regression.py
+planner_checks`): every fresh plan row must be feasible AND
+deterministic, and every cell's compliance must sit in [0, 1].
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+import argparse
+import json
+
+import jax
+
+from triton_distributed_tpu.observability import planner as planner_mod
+from triton_distributed_tpu.serving import ToyConfig, ToyModel
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON lines here (committed "
+                         "copy: benchmark/results/planner.json)")
+    ap.add_argument("--replicas-max", type=int, default=4)
+    ap.add_argument("--rates", default="1.0,2.0")
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--seed", type=int, default=1234)
+    args = ap.parse_args()
+    out = open(args.out, "w") if args.out else None
+
+    def emit(rec):
+        line = json.dumps(rec)
+        print(line)
+        if out is not None:
+            out.write(line + "\n")
+
+    model = ToyModel(ToyConfig(vocab_size=61, hidden=16,
+                               max_seq_len=64))
+    params = model.init_params(jax.random.key(0))
+    rates = [float(r) for r in args.rates.split(",") if r]
+    result = planner_mod.plan(
+        model, params, replicas_max=args.replicas_max, rates=rates,
+        n_requests=args.requests, seed=args.seed)
+    for rate_row in result["rates"]:
+        rate = rate_row["rate_multiplier"]
+        for cell in rate_row["cells"]:
+            per_class = {
+                name: {"compliance": v["compliance"],
+                       "objective": v["objective"],
+                       "ok": v["ok"],
+                       "p99_ttft_ms": v["p99_ttft_ms"],
+                       "p99_tbt_ms": v["p99_tbt_ms"]}
+                for name, v in sorted(cell["classes"].items())}
+            emit(dict(bench="planner", workload="cell",
+                      rate_multiplier=rate,
+                      n_replicas=cell["n_replicas"],
+                      cell_ok=cell["ok"], ms=cell["ms"],
+                      finished=cell["finished"],
+                      per_class=per_class))
+        emit(dict(bench="planner", workload="plan",
+                  rate_multiplier=rate,
+                  replicas_max=result["replicas_max"],
+                  n_requests=result["n_requests"],
+                  seed=result["seed"],
+                  min_replicas=rate_row["min_replicas"],
+                  plan_feasible=rate_row["feasible"],
+                  plan_deterministic=rate_row["deterministic"]))
+    if out is not None:
+        out.close()
+
+
+if __name__ == "__main__":
+    main()

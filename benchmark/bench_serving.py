@@ -330,11 +330,11 @@ class ContinuousDriver:
 
     def accept_hist(self):
         """Snapshot of the per-round accept-length histogram
-        (``serving_spec_accept_len``): (count, sum, buckets).  The
+        (``serving_spec_accept_tokens``): (count, sum, buckets).  The
         caller deltas two snapshots to get one trace's histogram."""
         from triton_distributed_tpu.observability import get_registry
         h = get_registry().snapshot().get("histograms", {}).get(
-            "serving_spec_accept_len")
+            "serving_spec_accept_tokens")
         if not h:
             return 0, 0.0, {}
         return h["count"], h["sum"], dict(h["buckets"])

@@ -103,6 +103,11 @@ MEASUREMENT_FIELDS = {
     "retries", "reroutes", "duplicates", "corrupt_nacks",
     "readmits", "faults_injected", "overhead_vs_clean", "exact",
     "faults_absorbed", "worst_overhead_vs_clean", "all_exact",
+    # Capacity-planner rows (bench_planner.py): the plan answer and
+    # the per-cell verdicts are run outputs (gated for feasibility +
+    # determinism by planner_checks).
+    "per_class", "cell_ok", "finished", "min_replicas",
+    "plan_feasible", "plan_deterministic",
 }
 #: Fields that may hold the latency to compare, in preference order.
 LATENCY_FIELDS = ("us", "ms", "ms_per_step")
@@ -412,6 +417,50 @@ def lineage_checks(fresh) -> tuple:
     return checked, fails
 
 
+def planner_checks(fresh) -> tuple:
+    """Gate specific to the capacity planner (`observability.planner`
+    via ``bench_planner.py``): every fresh ``workload="plan"`` row
+    must be FEASIBLE (the sweep found a fleet that holds every
+    class's objective — the committed scenario is sized to have an
+    answer) and DETERMINISTIC (the winning cell re-run byte-compares
+    equal: a capacity answer that varies run-to-run on a virtual
+    clock is a seeded-replay bug, not noise).  Cell rows are sanity
+    checked for compliance in [0, 1].
+
+    Returns ``(n_checked, failures)``."""
+    fails = []
+    checked = 0
+    for rec in fresh:
+        if rec.get("bench") != "planner":
+            continue
+        if rec.get("workload") == "plan":
+            checked += 1
+            ident = (f"rate={rec.get('rate_multiplier')} "
+                     f"replicas_max={rec.get('replicas_max')}")
+            if rec.get("plan_feasible") is not True:
+                fails.append(
+                    f"planner regression: {ident} found NO fleet "
+                    f"size holding the SLO (min_replicas="
+                    f"{rec.get('min_replicas')})")
+            if rec.get("plan_deterministic") is not True:
+                fails.append(
+                    f"planner regression: {ident} re-run of the "
+                    f"winning cell did not byte-compare equal — the "
+                    f"seeded replay is not deterministic")
+        elif rec.get("workload") == "cell":
+            checked += 1
+            for name, v in (rec.get("per_class") or {}).items():
+                comp = v.get("compliance")
+                if not (isinstance(comp, (int, float))
+                        and 0.0 <= comp <= 1.0):
+                    fails.append(
+                        f"planner regression: cell rate="
+                        f"{rec.get('rate_multiplier')} n_replicas="
+                        f"{rec.get('n_replicas')} class {name} has "
+                        f"compliance outside [0, 1]: {comp!r}")
+    return checked, fails
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--fresh", required=True,
@@ -507,12 +556,14 @@ def main() -> int:
     ln_checked, ln_fails = lineage_checks(fresh)
     sp_checked, sp_fails = spec_checks(fresh)
     moe_checked, moe_fails = moe_checks(fresh)
+    pl_checked, pl_fails = planner_checks(fresh)
 
     # Markdown summary: CI logs and PR comments read the same thing.
     print("## Bench regression check")
     print()
     verdict = ("FAIL" if regressions or cl_fails or rt_fails
-               or kt_fails or ln_fails or sp_fails or moe_fails else
+               or kt_fails or ln_fails or sp_fails or moe_fails
+               or pl_fails else
                "OK (with anomalies)" if anomalies else "OK")
     print(f"**{verdict}** — {compared} row(s) compared, "
           f"{regressions} regression(s) beyond "
@@ -573,12 +624,21 @@ def main() -> int:
               f"{len(moe_fails)} failure(s).")
         for f in moe_fails:
             print(f"- {f}")
+    if pl_checked:
+        print()
+        print(f"Planner gate: {pl_checked} row(s) checked (plan "
+              f"feasible + deterministic, compliance in [0, 1]), "
+              f"{len(pl_fails)} failure(s).")
+        for f in pl_fails:
+            print(f"- {f}")
     if (compared == 0 and cl_checked == 0 and rt_checked == 0
             and kt_checked == 0 and ln_checked == 0
-            and sp_checked == 0 and moe_checked == 0):
+            and sp_checked == 0 and moe_checked == 0
+            and pl_checked == 0):
         return 2
     return 1 if (regressions or cl_fails or rt_fails or kt_fails
-                 or ln_fails or sp_fails or moe_fails) else 0
+                 or ln_fails or sp_fails or moe_fails
+                 or pl_fails) else 0
 
 
 if __name__ == "__main__":

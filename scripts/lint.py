@@ -14,7 +14,16 @@ Enforces the core of the ruff.toml rule set with only the stdlib:
         at import time on someone else's machine);
 - F841: locals assigned but never read inside a function, with the
         conservative exemptions ruff defaults to (underscore names,
-        tuple unpacking, augmented assigns, `locals()`/`exec` users).
+        tuple unpacking, augmented assigns, `locals()`/`exec` users);
+- M001-M003: metric naming (repo-local, AST-scoped to the
+        observability registry call sites `.counter(` / `.gauge(` /
+        `.histogram(` / `count_metric(` / `observe_metric(` with a
+        constant name): counters must end `_total`, histograms must
+        carry a unit suffix (`_ms`/`_us`/`_s`/`_seconds`/`_bytes`/
+        `_tokens`/`_pages`), gauges must NOT end `_total`.
+        Non-constant names (f-string fan-outs like
+        `f"serving_kvtier_{k}"`) are out of a static linter's reach
+        and skipped.
 
 Usage:  python scripts/lint.py [paths...]     (default: repo tree)
 Exit 0 = clean, 1 = findings.  `scripts/verify_tier1.sh` prefers
@@ -132,6 +141,71 @@ def lint_file(path: pathlib.Path) -> list[str]:
 
     problems.extend(_f821_module_level(tree, path, lines))
     problems.extend(_f841_unused_locals(tree, path, lines))
+    problems.extend(_metric_names(tree, path, lines))
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# M001-M003: metric naming at registry call sites
+# ---------------------------------------------------------------------------
+
+#: Unit suffixes a histogram name must end in — a histogram without a
+#: unit is unreadable on a dashboard (what is `accept_len` 3 OF?).
+METRIC_UNIT_SUFFIXES = ("_ms", "_us", "_s", "_seconds", "_bytes",
+                       "_tokens", "_pages")
+
+#: Method/function name -> metric kind, for call sites whose first
+#: argument is a string constant.
+_METRIC_CALLS = {
+    "counter": "counter",
+    "count_metric": "counter",
+    "gauge": "gauge",
+    "histogram": "histogram",
+    "observe_metric": "histogram",
+}
+
+
+def _metric_names(tree: ast.Module, path, lines) -> list[str]:
+    """Prometheus-style naming, enforced where metrics are BORN (the
+    registry call site) so a misnamed series never reaches a
+    dashboard: counters end `_total` (M001), histograms end in a
+    unit suffix (M002), gauges never end `_total` (M003 — a gauge
+    named like a counter lies about its semantics)."""
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fn = node.func
+        callee = (fn.attr if isinstance(fn, ast.Attribute)
+                  else fn.id if isinstance(fn, ast.Name) else None)
+        kind = _METRIC_CALLS.get(callee)
+        if kind is None:
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue      # f-string fan-outs: not statically checkable
+        name = arg.value
+        if not re.fullmatch(r"[a-z][a-z0-9_]*", name):
+            continue      # label keys etc. piped through helpers
+        lineno = node.lineno
+        if kind == "counter" and not name.endswith("_total"):
+            if not _noqa(lines, lineno, "M001"):
+                problems.append(
+                    f"{path}:{lineno}: M001 counter `{name}` must "
+                    f"end in `_total`")
+        elif kind == "histogram" and not name.endswith(
+                METRIC_UNIT_SUFFIXES):
+            if not _noqa(lines, lineno, "M002"):
+                problems.append(
+                    f"{path}:{lineno}: M002 histogram `{name}` must "
+                    f"end in a unit suffix "
+                    f"({'/'.join(METRIC_UNIT_SUFFIXES)})")
+        elif kind == "gauge" and name.endswith("_total"):
+            if not _noqa(lines, lineno, "M003"):
+                problems.append(
+                    f"{path}:{lineno}: M003 gauge `{name}` must not "
+                    f"end in `_total` (counter naming on a gauge)")
     return problems
 
 

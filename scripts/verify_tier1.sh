@@ -42,7 +42,16 @@
 #  - a KV-tier smoke (2-replica virtual cluster: a prefix prefilled
 #    on replica A served from replica B via peer prefix shipment with
 #    zero second prefill, bit-exact; per-tier hit counters in the
-#    Prometheus render; doctor "KV tier" section).
+#    Prometheus render; doctor "KV tier" section);
+#  - a metrics-reference drift check (docs/observability.md's
+#    generated table must match the scraped call sites);
+#  - an SLO smoke (2-class SLOPolicy on the virtual clock: a burn
+#    alert fires as a schema-valid DecisionEvent, cost vectors
+#    balance exactly, timeseries + slo-state + cost-joined lineage
+#    artifacts land, the doctor renders an "SLO" section, and the
+#    capacity planner answers "2 replicas" bit-exactly twice) plus
+#    the planner bench gate (every committed plan row feasible AND
+#    deterministic).
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -60,6 +69,15 @@ else
     fi
 fi
 echo "LINT=ok"
+
+# Metrics-reference drift gate: the generated table in
+# docs/observability.md must match the registry call sites the code
+# actually contains (scripts/gen_metrics_reference.py --check).
+if ! python scripts/gen_metrics_reference.py --check; then
+    echo "METRICS_REFERENCE=FAILED"
+    exit 1
+fi
+echo "METRICS_REFERENCE=ok"
 
 # Static comm-graph sanitizer sweep: every registered kernel on its
 # representative meshes must analyze clean (docs/analysis.md).
@@ -354,7 +372,7 @@ for temp in (0.0, 1.0):
             assert kv.pool.used_pages == kv.radix.cached_pages, (
                 "rollback left pages pinned")
 text = prometheus_text()
-for name in ("serving_spec_accept_len_bucket",
+for name in ("serving_spec_accept_tokens_bucket",
              "serving_spec_proposed_tokens_total",
              "serving_spec_accepted_tokens_total",
              "serving_spec_rejected_tokens_total",
@@ -857,6 +875,133 @@ if [ "$moe_sweep_ok" -eq 1 ]; then
     echo "MOE_SWEEP=ok"
 else
     echo "MOE_SWEEP=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
+# SLO smoke (ISSUE 16): the error-budget + cost observatory end to
+# end on the virtual clock — a 2-class SLOPolicy over mixed-tenant
+# traffic must fire a burn alert as a schema-valid DecisionEvent
+# naming the dominant tenant, cost vectors must balance EXACTLY
+# (rational arithmetic), write_artifact must land slo-state.json +
+# timeseries-rank-0.jsonl + cost-joined lineage.jsonl, the doctor
+# must render "SLO" and "Time series" sections with the burning
+# class in the verdict, and the capacity planner must answer
+# "2 replicas" bit-exactly across two full runs.
+slo_log=$(JAX_PLATFORMS=cpu python - <<'EOF' 2>&1
+import dataclasses, json, os, tempfile
+import jax
+from triton_distributed_tpu.observability import (
+    SLOClass, SLOPolicy, feedback, get_cost_recorder, get_registry,
+    load_timeseries, set_cost_accounting, validate_decision,
+    validate_timeseries)
+from triton_distributed_tpu.observability.doctor import (
+    diagnose, render_markdown)
+from triton_distributed_tpu.observability.lineage import (
+    get_lineage_recorder, load_lineage_costs)
+from triton_distributed_tpu.serving import (
+    ClusterConfig, SchedulerConfig, ServingCluster, ToyConfig,
+    ToyModel)
+
+model = ToyModel(ToyConfig(vocab_size=61, hidden=16, max_seq_len=64))
+params = model.init_params(jax.random.key(0))
+get_registry().clear()
+get_lineage_recorder().clear()
+feedback.clear_recent_decisions()
+set_cost_accounting(False)
+get_cost_recorder().clear()
+
+# Impossible interactive targets on the virtual clock: every web
+# request breaches, the multi-window burn rule must trip mid-drain.
+policy = SLOPolicy(
+    classes=(SLOClass("interactive", ttft_p99_ms=1e-6,
+                      tbt_p99_ms=1e-6, objective=0.9),
+             SLOClass("batch", ttft_p99_ms=1e6, tbt_p99_ms=1e6,
+                      objective=0.9)),
+    tenant_class={"web": "interactive", "bulk": "batch"},
+    windows=(0.05, 0.2), burn_alert_threshold=2.0)
+cluster = ServingCluster(model, params, ClusterConfig(
+    n_replicas=2,
+    scheduler=SchedulerConfig(num_slots=2, prefill_buckets=(8, 16)),
+    step_time_s=1e-3, prefill_time_s=2e-3,
+    slo_policy=policy, timeseries_interval_s=2e-3))
+for i, tenant in enumerate(["web", "web", "bulk", "web", "bulk",
+                            "web"]):
+    cluster.submit([1 + i, 2, 3, 4], 4 + (i % 2), seed=i,
+                   arrival_time=0.0, tenant=tenant)
+done = cluster.drain()
+assert len(done) == 6, [r.state for r in done]
+
+# One edge-triggered, schema-valid burn alert naming the tenant.
+alerts = [d for d in feedback.recent_decisions()
+          if d.consumer == "slo.burn_alert"]
+assert [a.op for a in alerts] == ["class:interactive"], alerts
+row = dataclasses.asdict(alerts[0])
+problems = validate_decision(row)
+assert not problems, (problems, row)
+assert row["inputs"]["dominant_tenant"] == "web", row["inputs"]
+
+# Exact cost balance + the per-tenant bill.
+bal = get_cost_recorder().balance()
+assert bal["exact"] is True, bal
+totals = get_cost_recorder().tenant_totals()
+assert set(totals) == {"web", "bulk"}, set(totals)
+
+# Artifacts: slo-state + timeseries + cost-joined lineage.
+d = tempfile.mkdtemp(prefix="tdt-slo-")
+cluster.write_artifact(d)
+state = json.loads(open(os.path.join(d, "slo-state.json")).read())
+assert state["classes"]["interactive"]["alerting"] is True, state
+assert state["tenant_costs"]["web"]["device_us"] > 0, state
+ts_rows = load_timeseries(os.path.join(d, "timeseries-rank-0.jsonl"))
+assert len(ts_rows) >= 2, len(ts_rows)
+for r in ts_rows:
+    assert validate_timeseries(r) == [], r
+cost_rows = load_lineage_costs(os.path.join(d, "lineage.jsonl"))
+assert cost_rows, "no cost rows joined onto lineage.jsonl"
+
+# Doctor: SLO + Time series sections, burning class in the verdict.
+report = diagnose([d])
+assert report["slo"]["burning"] == ["interactive"], report["slo"]
+assert report["slo"]["dominant_tenant"] == "web", report["slo"]
+md = render_markdown(report)
+assert "## SLO" in md and "## Time series" in md
+assert "interactive" in report["verdict"], report["verdict"]
+
+# Planner: the committed question — smallest fleet holding the SLO
+# at 1x traffic — answers "2 replicas", bit-exactly, twice.
+set_cost_accounting(False)
+get_cost_recorder().clear()
+from triton_distributed_tpu.observability.planner import plan
+kw = dict(replicas_max=3, rates=(1.0,), n_requests=24, seed=1234)
+first = plan(model, params, **kw)
+again = plan(model, params, **kw)
+assert (json.dumps(first, sort_keys=True)
+        == json.dumps(again, sort_keys=True)), "planner nondeterminism"
+rate = first["rates"][0]
+assert rate["min_replicas"] == 2, rate["min_replicas"]
+assert rate["deterministic"] is True, rate
+print("SLO_SMOKE=ok")
+EOF
+)
+slo_rc=$?
+echo "$slo_log" | tail -3
+if [ "$slo_rc" -ne 0 ]; then
+    echo "SLO_SMOKE=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
+# Planner bench gate: the capacity-planner sweep is deterministic
+# model output — re-run it and require every plan row feasible AND
+# deterministic, every cell compliance in [0, 1].
+if JAX_PLATFORMS=cpu python benchmark/bench_planner.py \
+        --out /tmp/_t1_planner.json > /dev/null \
+   && python scripts/check_bench_regression.py \
+        --fresh /tmp/_t1_planner.json \
+        --baselines /tmp/_t1_nonexistent_baselines.json > /dev/null
+then
+    echo "PLANNER_BENCH=ok"
+else
+    echo "PLANNER_BENCH=FAILED"
     [ "$rc" -eq 0 ] && rc=1
 fi
 

@@ -276,7 +276,7 @@ class TestOverflowCounters:
         for _ in range(5):
             with tracer.span("s"):
                 pass
-        assert get_registry().peek("trace_dropped_spans") == 3
+        assert get_registry().peek("trace_dropped_spans_total") == 3
 
     def test_event_ring_overflow_counts(self):
         from triton_distributed_tpu.observability.events import (
@@ -289,7 +289,7 @@ class TestOverflowCounters:
         rec = FlightRecorder(capacity=2)
         for i in range(6):
             rec.record(KernelEvent(kind="bench", op=f"e{i}"))
-        assert get_registry().peek("events_dropped") == 4
+        assert get_registry().peek("events_dropped_total") == 4
 
 
 # ---------------------------------------------------------------------------

@@ -425,7 +425,7 @@ def test_spec_metrics_and_lineage(toy):
     assert c["serving_spec_accepted_tokens_total"] == accepted
     assert (c["serving_spec_rejected_tokens_total"]
             == proposed - accepted)
-    hist = snap["histograms"]["serving_spec_accept_len"]
+    hist = snap["histograms"]["serving_spec_accept_tokens"]
     assert hist["count"] > 0
     assert snap["gauges"]["serving_spec_accept_rate"] == (
         pytest.approx(accepted / proposed))

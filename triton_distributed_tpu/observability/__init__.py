@@ -49,11 +49,35 @@ from triton_distributed_tpu.observability.lineage import (  # noqa: F401
     get_lineage_recorder,
     lineage_summaries,
     load_lineage,
+    load_lineage_costs,
     record_hop,
     set_lineage_log,
     ttft_breakdown,
     validate_lineage,
     write_lineage_artifact,
+)
+from triton_distributed_tpu.observability.costs import (  # noqa: F401
+    CostRecorder,
+    CostVector,
+    cost_accounting_enabled,
+    cost_summary,
+    get_cost_recorder,
+    set_cost_accounting,
+    tenant_cost_table,
+)
+from triton_distributed_tpu.observability.slo import (  # noqa: F401
+    SLOClass,
+    SLOPolicy,
+    SLOTracker,
+    evaluate_outcomes,
+)
+from triton_distributed_tpu.observability.timeseries import (  # noqa: F401
+    TimeSeriesRing,
+    current_timeseries,
+    load_timeseries,
+    series_trends,
+    timeseries_table,
+    validate_timeseries,
 )
 from triton_distributed_tpu.observability.audit import (  # noqa: F401
     AuditRow,

@@ -1,0 +1,363 @@
+"""Per-request, per-tenant cost attribution: what each request spent
+in device time, KV residency, wire bytes and wasted work.
+
+Lineage (PR 11) answers *where a request's latency went*; this module
+answers *what it cost to serve* — the accounting rail ROADMAP items 3
+(multi-tenant QoS) and 4 (capacity planning) both consume.  Every
+request accumulates one :class:`CostVector`:
+
+- ``prefill_us`` / ``decode_us`` / ``spec_verify_us``: device-side
+  microseconds, charged at the scheduler's existing measurement seams
+  (the same ``perf_counter`` windows that feed ``serving_prefill_ms``
+  and ``serving_decode_step_ms``).  A fused decode step's elapsed time
+  is split **exactly** (`fractions.Fraction`) across the slots that
+  ran in it, so per-tenant sums telescope to the measured totals with
+  zero float drift — the cost analogue of lineage's hop-sum ≡ TTFT
+  invariant (:meth:`CostRecorder.balance` asserts it).  Speculative
+  steps charge ``spec_verify_us`` (the draft+verify dispatch is one
+  fused window; it is charged to the verify phase, mirroring the
+  ``spec_verify`` lineage hop), non-speculative steps charge
+  ``decode_us``.
+- ``kv_page_seconds``: KV residency integrated over occupancy — each
+  decode step charges ``pages_held × Δt`` on the scheduler clock (the
+  interval since the request's previous charge), so a request that
+  parks 40 pages for 2 s costs 80 page-seconds whether or not it
+  generated tokens.
+- ``wire_bytes``: transport bytes shipped on this request's behalf
+  (the cluster's ``_send`` seam — same bytes
+  ``cluster_kv_shipped_bytes_total`` counts).
+- ``wasted_spec_tokens``: draft tokens proposed but rejected by
+  verify rounds (``n - a`` per slot per round).
+- ``reprefill_tokens``: tokens re-prefilled after a preemption or
+  failover resume (the work the page pool's pressure made the fleet
+  redo).
+
+Tenant keying: `Request.tenant` / `ClusterRequest.tenant` (default
+``"default"``).  **Golden discipline**: nothing here emits a metric,
+gauge or summary until cost accounting is *armed* — which happens
+when a non-default tenant or an `SLOPolicy` is configured (or a test
+calls :func:`set_cost_accounting`).  Unarmed runs are byte-identical
+to the pre-cost tree: no new registry keys, no new labels, no cost
+join on lineage rows.
+
+See docs/serving.md "Accounting & SLOs" for the charging-rules table.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+from fractions import Fraction
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from triton_distributed_tpu.observability.metrics import (
+    observability_enabled,
+)
+
+COST_SCHEMA = 1
+
+#: Device-time phases a request can be charged under.
+PHASES = ("prefill", "decode", "spec_verify")
+
+#: Token-waste kinds (counter suffix ↔ CostVector field).
+WASTE_KINDS = ("wasted_spec", "reprefill")
+
+_ARMED = False
+_ARMED_LOCK = threading.Lock()
+
+
+def cost_accounting_enabled() -> bool:
+    """True iff cost accounting is armed AND observability is on."""
+    return _ARMED and observability_enabled()
+
+
+def set_cost_accounting(on: bool) -> None:
+    """Arm (or disarm) cost accounting.  Arming is what the golden
+    discipline hangs off: the scheduler/cluster call sites charge
+    nothing while disarmed, so unconfigured runs stay byte-identical.
+    Auto-armed by a non-default `Request.tenant` or a configured
+    `SLOPolicy`."""
+    global _ARMED
+    with _ARMED_LOCK:
+        _ARMED = bool(on)
+
+
+def maybe_arm_for_tenant(tenant: str) -> None:
+    """Arm iff ``tenant`` is a real (non-default) tenant label."""
+    if tenant != "default":
+        set_cost_accounting(True)
+
+
+@dataclasses.dataclass
+class CostVector:
+    """One request's accumulated cost.  Device-µs and page-seconds are
+    exact rationals internally (`fractions.Fraction`) so aggregates
+    balance bit-exactly; :meth:`to_dict` rounds for JSON."""
+
+    tenant: str = "default"
+    prefill_us: Fraction = Fraction(0)
+    decode_us: Fraction = Fraction(0)
+    spec_verify_us: Fraction = Fraction(0)
+    kv_page_seconds: Fraction = Fraction(0)
+    wire_bytes: int = 0
+    wasted_spec_tokens: int = 0
+    reprefill_tokens: int = 0
+
+    @property
+    def device_us(self) -> Fraction:
+        return self.prefill_us + self.decode_us + self.spec_verify_us
+
+    def add(self, other: "CostVector") -> "CostVector":
+        """Field-wise accumulate (tenant kept from ``self``)."""
+        self.prefill_us += other.prefill_us
+        self.decode_us += other.decode_us
+        self.spec_verify_us += other.spec_verify_us
+        self.kv_page_seconds += other.kv_page_seconds
+        self.wire_bytes += other.wire_bytes
+        self.wasted_spec_tokens += other.wasted_spec_tokens
+        self.reprefill_tokens += other.reprefill_tokens
+        return self
+
+    def to_dict(self) -> dict:
+        return {
+            "tenant": self.tenant,
+            "prefill_us": round(float(self.prefill_us), 3),
+            "decode_us": round(float(self.decode_us), 3),
+            "spec_verify_us": round(float(self.spec_verify_us), 3),
+            "device_us": round(float(self.device_us), 3),
+            "kv_page_seconds": round(float(self.kv_page_seconds), 6),
+            "wire_bytes": self.wire_bytes,
+            "wasted_spec_tokens": self.wasted_spec_tokens,
+            "reprefill_tokens": self.reprefill_tokens,
+        }
+
+
+class CostRecorder:
+    """Bounded per-request cost store (process-global singleton via
+    :func:`get_cost_recorder`; tests may build private ones).
+
+    Every charge lands in the request's :class:`CostVector` AND a
+    per-phase "measured" ledger: :meth:`charge_device` adds the whole
+    measured window to the ledger once, then splits it exactly across
+    the requests that shared it — so :meth:`balance` can assert
+    Σ per-request ≡ Σ measured with ``==`` on rationals, not an
+    epsilon.  Tenant-labelled registry counters mirror the charges
+    (``serving_cost_*_total{tenant=...}``); they exist only once a
+    charge lands, which only happens while armed."""
+
+    def __init__(self, max_requests: int = 4096):
+        self._lock = threading.RLock()
+        self.max_requests = int(max_requests)
+        self._by_req: "collections.OrderedDict[Any, CostVector]" = \
+            collections.OrderedDict()
+        #: phase -> exact Fraction of measured device time (µs).
+        self.measured: Dict[str, Fraction] = {}
+        #: request_id -> scheduler-clock ts of its last KV-residency
+        #: charge (the integration grid for kv_page_seconds).
+        self._kv_last_ts: Dict[Any, float] = {}
+        self.evicted_requests = 0
+
+    # -- internals -------------------------------------------------------
+
+    def _vec(self, request_id, tenant: str) -> CostVector:
+        vec = self._by_req.get(request_id)
+        if vec is None:
+            while len(self._by_req) >= self.max_requests:
+                rid, _ = self._by_req.popitem(last=False)
+                self._kv_last_ts.pop(rid, None)
+                self.evicted_requests += 1
+            vec = self._by_req[request_id] = CostVector(tenant=tenant)
+        return vec
+
+    @staticmethod
+    def _count(name: str, n, **labels) -> None:
+        from triton_distributed_tpu.observability.metrics import (
+            count_metric)
+        count_metric(name, float(n), **labels)
+
+    # -- charging seams --------------------------------------------------
+
+    def charge_device(self, phase: str, total_us: float,
+                      shares: Sequence[Tuple[Any, str]]) -> None:
+        """Charge one measured device window: ``total_us`` is split
+        exactly (Fraction) across ``shares`` — ``(request_id,
+        tenant)`` pairs for every request that ran in the window — and
+        the whole window lands in the measured ledger once."""
+        assert phase in PHASES, phase
+        if not shares:
+            return
+        total = Fraction(total_us)
+        part = total / len(shares)
+        field = f"{phase}_us"
+        with self._lock:
+            self.measured[phase] = self.measured.get(
+                phase, Fraction(0)) + total
+            for rid, tenant in shares:
+                vec = self._vec(rid, tenant)
+                setattr(vec, field, getattr(vec, field) + part)
+                self._count("serving_cost_device_us_total",
+                            float(part), tenant=tenant, phase=phase)
+
+    def charge_kv_occupancy(self, request_id, tenant: str,
+                            pages: int, now: float) -> None:
+        """Integrate KV residency: charge ``pages × (now - last)`` on
+        the scheduler clock.  The first call only sets the grid point
+        (occupancy before a request held pages costs nothing)."""
+        with self._lock:
+            last = self._kv_last_ts.get(request_id)
+            self._kv_last_ts[request_id] = float(now)
+            if last is None:
+                self._vec(request_id, tenant)   # pin tenant + recency
+                return
+            dt = Fraction(now) - Fraction(last)
+            if dt <= 0 or pages <= 0:
+                return
+            amount = Fraction(int(pages)) * dt
+            self._vec(request_id, tenant).kv_page_seconds += amount
+            self._count("serving_cost_kv_page_seconds_total",
+                        float(amount), tenant=tenant)
+
+    def charge_wire(self, request_id, tenant: str,
+                    nbytes: int) -> None:
+        with self._lock:
+            self._vec(request_id, tenant).wire_bytes += int(nbytes)
+            self._count("serving_cost_wire_bytes_total", int(nbytes),
+                        tenant=tenant)
+
+    def charge_tokens(self, kind: str, request_id, tenant: str,
+                      n: int) -> None:
+        """Waste accounting: ``wasted_spec`` (draft tokens rejected by
+        verify) or ``reprefill`` (tokens recomputed after a
+        preemption/failover resume)."""
+        assert kind in WASTE_KINDS, kind
+        if n <= 0:
+            return
+        with self._lock:
+            vec = self._vec(request_id, tenant)
+            field = f"{kind}_tokens"
+            setattr(vec, field, getattr(vec, field) + int(n))
+            self._count(f"serving_cost_{kind}_tokens_total", int(n),
+                        tenant=tenant)
+
+    # -- views -----------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._by_req)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._by_req.clear()
+            self.measured.clear()
+            self._kv_last_ts.clear()
+            self.evicted_requests = 0
+
+    def vector_for(self, request_id) -> Optional[CostVector]:
+        with self._lock:
+            return self._by_req.get(request_id)
+
+    def summary(self, request_id) -> Optional[dict]:
+        """JSON cost summary for one request (the lineage /
+        ``/requests`` join), or None — absent key — when the request
+        was never charged."""
+        vec = self.vector_for(request_id)
+        return None if vec is None else vec.to_dict()
+
+    def request_ids(self) -> List:
+        with self._lock:
+            return list(self._by_req)
+
+    def tenant_totals(self) -> Dict[str, CostVector]:
+        """Exact per-tenant aggregate across retained requests."""
+        out: Dict[str, CostVector] = {}
+        with self._lock:
+            for vec in self._by_req.values():
+                agg = out.setdefault(vec.tenant,
+                                     CostVector(tenant=vec.tenant))
+                agg.add(vec)
+        return out
+
+    def balance(self) -> dict:
+        """The exact-arithmetic invariant: per phase,
+        Σ per-request device-µs ≡ the measured total charged at the
+        same seams — rational equality, no epsilon.  ``exact`` is the
+        AND across phases (and trivially extends to per-tenant sums:
+        tenants partition requests)."""
+        with self._lock:
+            per_req: Dict[str, Fraction] = {p: Fraction(0)
+                                            for p in PHASES}
+            for vec in self._by_req.values():
+                for p in PHASES:
+                    per_req[p] += getattr(vec, f"{p}_us")
+            phases = {}
+            exact = self.evicted_requests == 0
+            for p in PHASES:
+                measured = self.measured.get(p, Fraction(0))
+                ok = per_req[p] == measured
+                exact = exact and ok
+                phases[p] = {
+                    "charged_us": round(float(per_req[p]), 6),
+                    "measured_us": round(float(measured), 6),
+                    "exact": ok,
+                }
+        return {"schema": COST_SCHEMA, "exact": exact,
+                "phases": phases,
+                "evicted_requests": self.evicted_requests}
+
+
+_RECORDER: Optional[CostRecorder] = None
+_RECORDER_LOCK = threading.Lock()
+
+
+def get_cost_recorder() -> CostRecorder:
+    global _RECORDER
+    with _RECORDER_LOCK:
+        if _RECORDER is None:
+            _RECORDER = CostRecorder()
+        return _RECORDER
+
+
+# -- module-level charge hooks (the scheduler/cluster call these; each
+# -- is a no-op until armed, so unconfigured runs charge nothing) ------
+
+def charge_device(phase: str, total_us: float,
+                  shares: Sequence[Tuple[Any, str]]) -> None:
+    if cost_accounting_enabled():
+        get_cost_recorder().charge_device(phase, total_us, shares)
+
+
+def charge_kv_occupancy(request_id, tenant: str, pages: int,
+                        now: float) -> None:
+    if cost_accounting_enabled():
+        get_cost_recorder().charge_kv_occupancy(request_id, tenant,
+                                                pages, now)
+
+
+def charge_wire(request_id, tenant: str, nbytes: int) -> None:
+    if cost_accounting_enabled():
+        get_cost_recorder().charge_wire(request_id, tenant, nbytes)
+
+
+def charge_tokens(kind: str, request_id, tenant: str, n: int) -> None:
+    if cost_accounting_enabled():
+        get_cost_recorder().charge_tokens(kind, request_id, tenant, n)
+
+
+def cost_summary(request_id) -> Optional[dict]:
+    """Absent-key join hook for lineage's request table: None unless
+    armed AND the request was actually charged."""
+    if not cost_accounting_enabled():
+        return None
+    return get_cost_recorder().summary(request_id)
+
+
+def tenant_cost_table() -> Optional[dict]:
+    """{tenant: cost dict} for artifacts/doctor — None (absent key)
+    while disarmed or before any charge landed."""
+    if not cost_accounting_enabled():
+        return None
+    totals = get_cost_recorder().tenant_totals()
+    if not totals:
+        return None
+    return {t: v.to_dict() for t, v in sorted(totals.items())}

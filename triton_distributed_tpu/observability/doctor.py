@@ -139,6 +139,9 @@ class Artifacts:
         self.router: Optional[dict] = None
         self.faults: List[dict] = []
         self.lineage: List[dict] = []
+        self.lineage_costs: List[dict] = []
+        self.slo_state: Optional[dict] = None
+        self.timeseries: List[dict] = []
         self._discover()
 
     def _glob(self, pattern: str) -> List[str]:
@@ -199,8 +202,20 @@ class Artifacts:
         lineage_files = self._glob("lineage*.jsonl")
         if lineage_files:
             from triton_distributed_tpu.observability.lineage import (
-                load_lineage)
+                load_lineage,
+                load_lineage_costs)
             self.lineage = load_lineage(lineage_files)
+            self.lineage_costs = load_lineage_costs(lineage_files)
+        for p in self._glob("slo-state*.json"):
+            d = _load_json(p)
+            if d is not None and "classes" in d:
+                self.slo_state = d
+                break
+        ts_files = self._glob("timeseries-rank-*.jsonl")
+        if ts_files:
+            from triton_distributed_tpu.observability.timeseries \
+                import load_timeseries
+            self.timeseries = load_timeseries(ts_files)
 
     def empty(self) -> bool:
         # A router artifact alone is an incident report's worth of
@@ -213,7 +228,8 @@ class Artifacts:
         # the dominant hop from it).
         return not (self.traces or self.flights or self.heartbeats
                     or self.metrics or self.router or self.faults
-                    or self.lineage)
+                    or self.lineage or self.slo_state
+                    or self.timeseries)
 
     def ranks(self) -> List[int]:
         from triton_distributed_tpu.observability.timeline import (
@@ -293,8 +309,15 @@ def build_rank_table(art: Artifacts, now: float,
                 "method": last_ev.get("method"),
                 "age_s": round(now - float(last_ev.get("ts", 0.0)), 3),
             } if last_ev else None),
-            "dropped_spans": int(_counter(snap, "trace_dropped_spans")),
-            "dropped_events": int(_counter(snap, "events_dropped")),
+            # New names first, legacy (pre-rename) second: committed
+            # incident artifacts carry the old counter names and the
+            # doctor must keep reading them byte-identically.
+            "dropped_spans": int(
+                _counter(snap, "trace_dropped_spans_total")
+                + _counter(snap, "trace_dropped_spans")),
+            "dropped_events": int(
+                _counter(snap, "events_dropped_total")
+                + _counter(snap, "events_dropped")),
         }
         if hb.get("serving"):
             row["serving"] = hb["serving"]
@@ -722,6 +745,70 @@ def analyze_lineage(art: Artifacts, now: float) -> Optional[dict]:
     return out
 
 
+def analyze_slo(art: Artifacts) -> Optional[dict]:
+    """Ingest ``slo-state.json`` (`observability.slo`) into the
+    report: per-class compliance against objective, error budget
+    remaining, burn rates per window, and — via the cost join — the
+    tenant dominating each burning class's breaches.  None (NO report
+    key, golden reports byte-identical) without the artifact."""
+    st = art.slo_state
+    if not st:
+        return None
+    classes = []
+    burning = []
+    for name in sorted(st.get("classes", {})):
+        c = st["classes"][name]
+        row = {
+            "class": name,
+            "objective": c.get("objective"),
+            "target_ttft_ms": c.get("target_ttft_ms"),
+            "target_tbt_ms": c.get("target_tbt_ms"),
+            "requests": c.get("total", 0),
+            "breaches": c.get("breaches", 0),
+            "compliance": c.get("compliance"),
+            "budget_remaining": c.get("budget_remaining"),
+            "burn": c.get("burn", {}),
+            "alerting": bool(c.get("alerting")),
+        }
+        classes.append(row)
+        if row["alerting"]:
+            burning.append(name)
+    out = {
+        "schema": st.get("schema"),
+        "alerts_fired": st.get("alerts_fired", 0),
+        "burn_alert_threshold": st.get("burn_alert_threshold"),
+        "windows_s": st.get("windows_s"),
+        "classes": classes,
+        "burning": burning,
+    }
+    if st.get("dominant_tenant"):
+        out["dominant_tenant"] = st["dominant_tenant"]
+    # Tenant bill (cost join): who the burn is attributable to, in
+    # device-µs terms — carried only when cost accounting was armed.
+    if isinstance(st.get("tenant_costs"), dict) and st["tenant_costs"]:
+        out["tenant_costs"] = st["tenant_costs"]
+    return out
+
+
+def analyze_timeseries(art: Artifacts) -> Optional[dict]:
+    """Replay ``timeseries-rank-*.jsonl`` (`observability.timeseries`)
+    into pre-incident trends: which watched gauges were monotonically
+    rising or falling into the newest sample, over how many samples
+    and how much virtual time.  None without the artifact."""
+    rows = art.timeseries
+    if not rows:
+        return None
+    from triton_distributed_tpu.observability.timeseries import (
+        series_trends)
+    ts0 = _num(rows[0].get("ts"))
+    ts1 = _num(rows[-1].get("ts"))
+    return {
+        "samples": len(rows),
+        "span_s": round(ts1 - ts0, 6),
+        "trends": series_trends(rows),
+    }
+
+
 def analyze_links(art: Artifacts) -> dict:
     from triton_distributed_tpu.observability import links as _links
     from triton_distributed_tpu.observability.events import KernelEvent
@@ -946,6 +1033,16 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
     lineage_out = analyze_lineage(art, now)
     if lineage_out is not None:
         report["lineage"] = lineage_out
+    # SLO error budgets: key absent without an slo-state.json
+    # artifact — same golden discipline.
+    slo_out = analyze_slo(art)
+    if slo_out is not None:
+        report["slo"] = slo_out
+    # Pre-incident time series: key absent without a
+    # timeseries-rank-*.jsonl artifact — same golden discipline.
+    timeseries_out = analyze_timeseries(art)
+    if timeseries_out is not None:
+        report["timeseries"] = timeseries_out
     report["verdict"] = _verdict(report, in_flight)
     return report
 
@@ -1031,6 +1128,34 @@ def _verdict(report: dict, in_flight: Optional[dict]) -> str:
         f = lineage["in_flight"][0]
         hot_s += (f"; request {f['request_id']} still stuck in hop "
                   f"'{f['stuck_in']}' ({f['age_s']}s)")
+    # SLO burn: the verdict NAMES the burning class — and, when the
+    # cost join identified one, the tenant dominating its breaches
+    # (clause only exists when an slo-state artifact was ingested).
+    slo = report.get("slo")
+    if slo and slo.get("burning"):
+        worst = min(
+            (c for c in slo["classes"] if c["class"] in slo["burning"]),
+            key=lambda c: (c.get("budget_remaining")
+                           if c.get("budget_remaining") is not None
+                           else 0.0))
+        tenant_s = (f" — dominated by tenant "
+                    f"'{slo['dominant_tenant']}'"
+                    if slo.get("dominant_tenant") else "")
+        budget = worst.get("budget_remaining")
+        budget_s = (f", {budget:.0%} of error budget left"
+                    if isinstance(budget, (int, float)) else "")
+        hot_s += (f"; SLO class '{worst['class']}' is burning its "
+                  f"error budget{budget_s}{tenant_s}")
+    # Pre-incident trends: one clause for the longest rising run
+    # (what was building up before things broke).
+    tser = report.get("timeseries")
+    if tser and tser.get("trends"):
+        rising = [t for t in tser["trends"]
+                  if t["direction"] == "rising"]
+        if rising:
+            t = max(rising, key=lambda t: t["run"])
+            hot_s += (f"; {t['metric']} rose for {t['run']} straight "
+                      f"samples (+{t['delta']}) into the incident")
     if stall["first_stalled_rank"] is not None:
         r = stall["first_stalled_rank"]
         what = (f" inside {stall['open_span']!r}"
@@ -1301,6 +1426,66 @@ def render_markdown(report: dict) -> str:
                       f"'{f['stuck_in']}' for {f['age_s']}s"
                       for f in lineage["in_flight"]]
             lines.append("")
+
+    slo = report.get("slo")
+    if slo:
+        burn_note = (f"{len(slo['burning'])} class(es) burning: "
+                     f"{', '.join(slo['burning'])}."
+                     if slo.get("burning")
+                     else "No class is burning its budget.")
+        lines += ["## SLO", "",
+                  f"{slo.get('alerts_fired', 0)} burn alert(s) "
+                  f"fired (threshold "
+                  f"{slo.get('burn_alert_threshold')}x). {burn_note}",
+                  "",
+                  "| class | requests | compliance | objective "
+                  "| budget left | burn |",
+                  "|---|---|---|---|---|---|"]
+        for c in slo["classes"]:
+            comp = c.get("compliance")
+            budget = c.get("budget_remaining")
+            burn = c.get("burn") or {}
+            burn_s = ", ".join(
+                f"{w}={burn[w]:.2f}x" for w in sorted(burn)
+                if isinstance(burn[w], (int, float))) or "-"
+            def pct(x):
+                return "-" if x is None else format(x, ".1%")
+            lines.append(
+                f"| {c['class']} | {c['requests']} "
+                f"| {pct(comp)} | {pct(c.get('objective'))} "
+                f"| {pct(budget)} | {burn_s} |")
+        lines.append("")
+        if slo.get("dominant_tenant"):
+            lines += [f"Breaches dominated by tenant "
+                      f"'{slo['dominant_tenant']}'.", ""]
+        costs = slo.get("tenant_costs")
+        if isinstance(costs, dict) and costs:
+            lines += ["Tenant bill (cost join):", "",
+                      "| tenant | device µs | KV page-s | wire bytes "
+                      "| wasted spec | re-prefill |",
+                      "|---|---|---|---|---|---|"]
+            for t in sorted(costs):
+                v = costs[t]
+                lines.append(
+                    f"| {t} | {v.get('device_us')} "
+                    f"| {v.get('kv_page_seconds')} "
+                    f"| {v.get('wire_bytes')} "
+                    f"| {v.get('wasted_spec_tokens')} "
+                    f"| {v.get('reprefill_tokens')} |")
+            lines.append("")
+
+    tser = report.get("timeseries")
+    if tser:
+        lines += ["## Time series", "",
+                  f"{tser['samples']} retained sample(s) spanning "
+                  f"{tser['span_s']}s before the incident."]
+        if tser.get("trends"):
+            lines += ["", "| metric | trend | samples | delta "
+                      "| last |", "|---|---|---|---|---|"]
+            lines += [f"| {t['metric']} | {t['direction']} "
+                      f"| {t['run']} | {t['delta']} | {t['last']} |"
+                      for t in tser["trends"]]
+        lines.append("")
 
     hot = report["links"].get("hot") or []
     if hot:

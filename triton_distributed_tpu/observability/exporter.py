@@ -117,6 +117,28 @@ def prometheus_text(snapshot: Optional[dict] = None,
 # HTTP exposition (stdlib only)
 # ---------------------------------------------------------------------------
 
+#: Process start (module import) — the uptime origin ``/healthz``
+#: reports.  Uptime lives ONLY in the HTTP response, never in the
+#: heartbeat payload: heartbeat file bodies must stay byte-comparable
+#: across writes with identical state.
+_START_TIME = time.time()
+
+
+def build_info() -> dict:
+    """What is running: the ``tdt_build_info`` block ``/healthz``
+    serves (and the doctor can echo) so a scrape identifies the
+    build without shelling into the container."""
+    import platform
+    import sys
+    from triton_distributed_tpu import __version__
+    return {
+        "version": __version__,
+        "python": platform.python_version(),
+        "implementation": platform.python_implementation(),
+        "platform": sys.platform,
+    }
+
+
 class MetricsServer:
     """Minimal threaded HTTP server answering ``GET /metrics`` (and
     ``/healthz`` with the heartbeat payload as JSON)."""
@@ -139,7 +161,15 @@ class MetricsServer:
                     body = prometheus_text(registry=reg).encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif self.path.startswith("/healthz"):
-                    body = json.dumps(heartbeat_payload()).encode()
+                    # Hardened health body: heartbeat + build
+                    # identity + uptime.  Response-only fields — the
+                    # heartbeat FILE body is unchanged.
+                    body = json.dumps({
+                        **heartbeat_payload(),
+                        "tdt_build_info": build_info(),
+                        "uptime_s": round(time.time() - _START_TIME,
+                                          3),
+                    }).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/links"):
                     body = json.dumps(link_table(reg)).encode()
@@ -152,6 +182,12 @@ class MetricsServer:
                     ctype = "application/json"
                 elif self.path.startswith("/requests"):
                     body = json.dumps(request_table(),
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/timeseries"):
+                    from triton_distributed_tpu.observability \
+                        .timeseries import timeseries_table
+                    body = json.dumps(timeseries_table(),
                                       default=str).encode()
                     ctype = "application/json"
                 else:
@@ -309,7 +345,14 @@ _HEARTBEAT_GAUGES = ("serving_queue_depth", "serving_active_slots",
                      "serving_kvtier_miss",
                      "serving_kvtier_fallbacks",
                      "serving_kvtier_warm_tiers",
-                     "serving_kvtier_dropped_evictions")
+                     "serving_kvtier_dropped_evictions",
+                     # SLO error budgets (absent until a tracker ever
+                     # observed a request — policy-free heartbeat
+                     # bodies are byte-identical): worst burn rate
+                     # and smallest remaining budget across classes,
+                     # label-free aggregates of the per-class gauges.
+                     "serving_slo_burn_max",
+                     "serving_slo_budget_min")
 
 
 def heartbeat_payload() -> dict:

@@ -321,6 +321,15 @@ class LineageRecorder:
             if bd is not None:
                 row["ttft_ms"] = bd["ttft_ms"]
                 row["dominant_hop"] = bd["dominant_hop"]
+            # Cost join (observability.costs): what this request
+            # BILLED, next to where its time WENT.  Absent-key: only
+            # requests that were ever charged (accounting armed)
+            # carry the key, so untenanted tables are byte-identical.
+            from triton_distributed_tpu.observability.costs import (
+                cost_summary)
+            cost = cost_summary(rid)
+            if cost is not None:
+                row["cost"] = cost
             rows.append(row)
         return rows
 
@@ -426,8 +435,48 @@ def write_lineage_artifact(directory: str,
     with open(tmp, "w") as f:
         for e in events:
             f.write(json.dumps(e.to_dict(), default=str) + "\n")
+        # Cost join: one ``kind="cost"`` row per charged request at
+        # the tail (same file, same filter discipline —
+        # `load_lineage` keeps only ``kind="lineage"`` so existing
+        # readers never see these; `load_lineage_costs` reads them
+        # back).  Absent-key: untenanted runs write no cost rows and
+        # the artifact is byte-identical to the pre-cost tree.
+        from triton_distributed_tpu.observability.costs import (
+            cost_summary)
+        for rid in sorted({e.request_id for e in events},
+                          key=lambda r: str(r)):
+            cost = cost_summary(rid)
+            if cost is not None:
+                f.write(json.dumps(
+                    {"kind": "cost", "request_id": rid, **cost},
+                    default=str) + "\n")
     os.replace(tmp, path)
     return path
+
+
+def load_lineage_costs(paths) -> List[dict]:
+    """The ``kind="cost"`` join rows `write_lineage_artifact` appends
+    (empty for pre-cost artifacts), torn-line tolerant like
+    `load_lineage`."""
+    out: List[dict] = []
+    if isinstance(paths, str):
+        paths = [paths]
+    for path in paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        d = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(d, dict) and d.get("kind") == "cost":
+                        out.append(d)
+        except OSError:
+            continue
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,226 @@
+"""Virtual-clock capacity planner: the smallest fleet that holds the
+SLO.
+
+The question an error-budget dashboard (`observability.slo`) raises
+but cannot answer is "how many replicas do we need before the next
+traffic step?".  This module answers it the only way that is both
+deterministic and honest about queueing: REPLAY.  A seeded arrival
+trace is served through the real router + replicas + scheduler stack
+on the shared virtual clock (`serving.cluster`) — the same machinery
+production runs, with modeled per-step costs instead of wall time —
+once per (replica count, arrival-rate multiplier) cell.  Each cell's
+finished records are scored against the policy with
+`slo.evaluate_outcomes`, and the plan for a rate is the smallest
+replica count whose every class meets its compliance objective.
+
+Determinism is the load-bearing property: the trace is seeded, the
+clock is virtual, the toy model decodes bit-identically, so two runs
+of the same plan produce byte-identical JSON — asserted by the
+``plan_deterministic`` field (the chosen cell is re-run and compared)
+and gated in CI (`scripts/check_bench_regression.py
+planner_checks`).  A capacity answer that varies with host load is
+not a plan, it is a rumor.
+
+CLI::
+
+    python -m triton_distributed_tpu.observability.planner \
+        --replicas-max 4 --rates 1.0,2.0 --requests 24 --seed 1234
+
+`benchmark/bench_planner.py` emits the same sweep as bench rows.
+
+No SLO tracker or cost accounting is armed here: scoring goes
+through the pure `evaluate_outcomes` so a planner run leaves no
+global observability state behind (golden discipline — a test
+process can plan and still render byte-identical untenanted output).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Sequence
+
+PLANNER_SCHEMA = 1
+
+#: Modeled virtual costs — fixed so committed numbers are
+#: machine-independent (the v5e-ish 1 ms decode step the router and
+#: serving benches use).
+STEP_S = 1e-3
+PREFILL_S = 2e-3
+
+SLOTS = 4
+BUCKETS = (8, 16, 32)
+
+#: Default two-class policy for the CLI/bench sweep: an interactive
+#: class that queueing actually threatens at small fleets, and a
+#: relaxed batch class that nearly never breaches.  Tenants "web"
+#: (interactive) and "batch" alternate 2:1 in the default trace.
+DEFAULT_CLASSES = (
+    ("interactive", 5.0, 2.0, 0.90),
+    ("batch", 25.0, 40.0, 0.90),
+)
+DEFAULT_TENANT_CLASS = {"web": "interactive", "batch": "batch"}
+
+
+def default_policy():
+    from triton_distributed_tpu.observability.slo import (
+        SLOClass,
+        SLOPolicy,
+    )
+    return SLOPolicy(
+        classes=tuple(SLOClass(n, ttft_p99_ms=t, tbt_p99_ms=b,
+                               objective=o)
+                      for n, t, b, o in DEFAULT_CLASSES),
+        tenant_class=dict(DEFAULT_TENANT_CLASS),
+        default_class="batch")
+
+
+def build_trace(n_requests: int, seed: int,
+                rate_multiplier: float = 1.0,
+                tenants: Sequence[str] = ("web", "web", "batch")
+                ) -> List[dict]:
+    """Seeded arrival trace: exponential interarrivals (divided by
+    the rate multiplier — "what if traffic doubles"), varied prompt
+    lengths and budgets, tenants assigned round-robin from the
+    ``tenants`` cycle.  Deterministic given (n_requests, seed,
+    rate_multiplier)."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    trace = []
+    t = 0.0
+    for i in range(n_requests):
+        t += float(rng.exponential(0.002)) / float(rate_multiplier)
+        plen = int(rng.integers(4, 14))
+        prompt = [int(x) for x in rng.integers(1, 61, plen)]
+        gen = int(rng.integers(5, 13))
+        trace.append(dict(prompt=prompt, max_new_tokens=gen,
+                          seed=1000 + i, arrival_time=round(t, 6),
+                          tenant=tenants[i % len(tenants)]))
+    return trace
+
+
+def replay(model, params, trace: Sequence[dict], n_replicas: int,
+           policy) -> dict:
+    """Serve ``trace`` through a fresh virtual-clock cluster with
+    ``n_replicas`` and score the outcomes against ``policy``.
+    Returns the per-class `evaluate_outcomes` verdicts plus the
+    cell's virtual makespan."""
+    from triton_distributed_tpu.observability.lineage import (
+        get_lineage_recorder,
+    )
+    from triton_distributed_tpu.observability.slo import (
+        evaluate_outcomes,
+    )
+    from triton_distributed_tpu.serving import (
+        ClusterConfig,
+        SchedulerConfig,
+        ServingCluster,
+    )
+    get_lineage_recorder().clear()
+    cluster = ServingCluster(model, params, ClusterConfig(
+        n_replicas=n_replicas,
+        scheduler=SchedulerConfig(num_slots=SLOTS,
+                                  prefill_buckets=BUCKETS),
+        step_time_s=STEP_S, prefill_time_s=PREFILL_S))
+    # Tenants stay OUT of submit(): a real tenant label arms the
+    # process-global cost recorder, and the planner is a pure what-if
+    # that must leave serving state untouched.  The label only feeds
+    # the scoring below, zipped back from the trace.
+    recs = [cluster.submit(**{k: v for k, v in t.items()
+                              if k != "tenant"}) for t in trace]
+    done = cluster.drain()
+    assert len(done) == len(trace), [r.state for r in recs]
+    outcomes = []
+    for r, t in zip(recs, trace):
+        ttft = r.ttft
+        tbt = r.mean_tbt
+        outcomes.append((t["tenant"],
+                         None if ttft is None else ttft * 1e3,
+                         None if tbt is None else tbt * 1e3))
+    verdicts = evaluate_outcomes(policy, outcomes)
+    makespan = (max(r.t_finish for r in done)
+                - min(r.arrival_time for r in done))
+    return {
+        "classes": verdicts,
+        "ok": all(v["ok"] for v in verdicts.values()),
+        "ms": round(makespan * 1e3, 6),
+        "finished": len(done),
+    }
+
+
+def plan(model, params, policy=None, replicas_max: int = 4,
+         rates: Sequence[float] = (1.0, 2.0),
+         n_requests: int = 24, seed: int = 1234) -> dict:
+    """The full sweep: for each arrival-rate multiplier, grow the
+    fleet 1..replicas_max until every class holds its objective.
+    ``min_replicas`` is None (``feasible`` False) when even the
+    largest fleet cannot hold it — an honest "buy a different
+    machine" answer, never a silent clamp.  The winning cell is
+    re-run and byte-compared (``deterministic``)."""
+    policy = policy or default_policy()
+    out: Dict[str, object] = {"schema": PLANNER_SCHEMA,
+                              "replicas_max": int(replicas_max),
+                              "n_requests": int(n_requests),
+                              "seed": int(seed), "rates": []}
+    for rate in rates:
+        trace = build_trace(n_requests, seed, rate)
+        cells = []
+        chosen: Optional[int] = None
+        for n in range(1, replicas_max + 1):
+            cell = replay(model, params, trace, n, policy)
+            cells.append({"n_replicas": n, **cell})
+            if chosen is None and cell["ok"]:
+                chosen = n
+                break     # smallest fleet found; larger cells moot
+        deterministic = None
+        if chosen is not None:
+            rerun = replay(model, params, trace, chosen, policy)
+            first = next(c for c in cells
+                         if c["n_replicas"] == chosen)
+            deterministic = (
+                json.dumps({"n_replicas": chosen, **rerun},
+                           sort_keys=True)
+                == json.dumps(first, sort_keys=True))
+        out["rates"].append({
+            "rate_multiplier": float(rate),
+            "min_replicas": chosen,
+            "feasible": chosen is not None,
+            "deterministic": deterministic,
+            "cells": cells,
+        })
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Virtual-clock SLO capacity planner")
+    ap.add_argument("--replicas-max", type=int, default=4)
+    ap.add_argument("--rates", default="1.0,2.0",
+                    help="comma-separated arrival-rate multipliers")
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--out", default=None,
+                    help="also write the plan JSON here")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from triton_distributed_tpu.serving import ToyConfig, ToyModel
+    model = ToyModel(ToyConfig(vocab_size=61, hidden=16,
+                               max_seq_len=64))
+    params = model.init_params(jax.random.key(0))
+    rates = [float(r) for r in args.rates.split(",") if r]
+    result = plan(model, params, replicas_max=args.replicas_max,
+                  rates=rates, n_requests=args.requests,
+                  seed=args.seed)
+    text = json.dumps(result, indent=1, sort_keys=True)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

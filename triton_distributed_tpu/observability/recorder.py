@@ -70,7 +70,7 @@ class FlightRecorder:
                 # doctor reports can flag the dump as incomplete.
                 from triton_distributed_tpu.observability.metrics \
                     import get_registry
-                get_registry().counter("events_dropped").inc()
+                get_registry().counter("events_dropped_total").inc()
             self._ring.append(event)
 
     def events(self) -> list:

@@ -1,0 +1,305 @@
+"""Time-series retention: a bounded ring of periodic metric snapshots
+so incidents come with *trends*, not just a final value.
+
+Every other artifact in the observability stack is an endpoint: the
+registry export says where a gauge ended, the heartbeat says what the
+rank was doing last.  None can say "KV occupancy rose monotonically
+for 40 samples before the stall" — the pre-incident shape operators
+actually diagnose from.  This module retains exactly that:
+
+- :class:`TimeSeriesRing`: a bounded ring of periodic registry
+  samples on the caller's clock (`ServingCluster` drives it from its
+  virtual clock when ``ClusterConfig.timeseries_interval_s`` is set,
+  so replays retain bit-identical series).  Each sample keeps every
+  counter and gauge plus histogram count/sum — enough to reconstruct
+  rates and occupancy trends without the full bucket payload.
+- Persistence: ``timeseries-rank-<N>.jsonl`` beside the other
+  artifacts (`ServingCluster.write_artifact`), one sample per line,
+  torn-line tolerant on load like every other jsonl artifact.
+- Live view: the exporter serves the newest ring at ``/timeseries``.
+- Analysis: :func:`series_trends` finds the monotone tail runs the
+  doctor's "Time series" section renders ("occupancy rose for N
+  straight samples into the incident").
+
+Golden discipline: nothing samples, persists, or serves until a ring
+is constructed — unconfigured runs leave no new artifact and the
+``/timeseries`` endpoint reports an empty ring.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import weakref
+from typing import Dict, List, Optional, Sequence
+
+from triton_distributed_tpu.observability.metrics import (
+    MetricsRegistry,
+    _process_index,
+    get_registry,
+)
+
+TIMESERIES_SCHEMA = 1
+
+#: Fields every timeseries jsonl line must carry (doctor/CI checks).
+TIMESERIES_FIELDS = ("schema", "kind", "ts", "rank", "counters",
+                     "gauges", "histograms")
+
+
+def timeseries_filename(rank: Optional[int] = None) -> str:
+    rank = _process_index() if rank is None else rank
+    return f"timeseries-rank-{rank}.jsonl"
+
+
+class TimeSeriesRing:
+    """Bounded ring of periodic registry samples on an injected
+    clock.  ``maybe_sample(now)`` is the only ingest: it samples iff
+    ``interval_s`` elapsed since the previous sample, so a caller can
+    invoke it every scheduler step for free."""
+
+    def __init__(self, interval_s: float = 1.0, capacity: int = 256,
+                 registry: Optional[MetricsRegistry] = None):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0: {interval_s}")
+        if capacity < 2:
+            raise ValueError(f"capacity must be >= 2: {capacity}")
+        self.interval_s = float(interval_s)
+        self.capacity = int(capacity)
+        self._registry = registry
+        self._lock = threading.RLock()
+        self._samples: List[dict] = []
+        self._last_ts: Optional[float] = None
+        self.dropped_samples = 0
+        global _CURRENT
+        _CURRENT = weakref.ref(self)   # newest ring serves /timeseries
+
+    def _reg(self) -> MetricsRegistry:
+        return self._registry or get_registry()
+
+    def sample(self, now: float) -> dict:
+        """Take one sample unconditionally at clock time ``now``."""
+        snap = self._reg().snapshot()
+        row = {
+            "schema": TIMESERIES_SCHEMA,
+            "kind": "timeseries",
+            "ts": float(now),
+            "rank": snap.get("meta", {}).get("rank", 0),
+            "counters": dict(snap.get("counters", {})),
+            "gauges": dict(snap.get("gauges", {})),
+            # Histograms keep count/sum only: enough for rate and
+            # mean trends at a fraction of the bucket payload.
+            "histograms": {k: {"count": h.get("count", 0),
+                               "sum": h.get("sum", 0.0)}
+                           for k, h in
+                           snap.get("histograms", {}).items()},
+        }
+        with self._lock:
+            self._samples.append(row)
+            if len(self._samples) > self.capacity:
+                # Oldest-first eviction, counted — never silent.
+                drop = len(self._samples) - self.capacity
+                del self._samples[:drop]
+                self.dropped_samples += drop
+            self._last_ts = float(now)
+        return row
+
+    def maybe_sample(self, now: float) -> Optional[dict]:
+        """Sample iff the interval elapsed (or nothing was sampled
+        yet); the per-step call sites pay one float compare."""
+        with self._lock:
+            due = (self._last_ts is None
+                   or now - self._last_ts >= self.interval_s)
+        return self.sample(now) if due else None
+
+    # -- views -----------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    def samples(self) -> List[dict]:
+        with self._lock:
+            return list(self._samples)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._samples.clear()
+            self._last_ts = None
+            self.dropped_samples = 0
+
+    def table(self, n: Optional[int] = None) -> dict:
+        """The ``/timeseries`` endpoint body."""
+        rows = self.samples()
+        if n is not None:
+            rows = rows[-n:]
+        return {"schema": TIMESERIES_SCHEMA,
+                "rank": _process_index(),
+                "interval_s": self.interval_s,
+                "capacity": self.capacity,
+                "dropped_samples": self.dropped_samples,
+                "samples": rows}
+
+    # -- artifact --------------------------------------------------------
+
+    def write(self, directory: str,
+              rank: Optional[int] = None) -> Optional[str]:
+        """Persist the ring as ``timeseries-rank-<N>.jsonl`` (atomic
+        tmp+rename); None when the ring is empty."""
+        rows = self.samples()
+        if not rows:
+            return None
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, timeseries_filename(rank))
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row, default=str) + "\n")
+        os.replace(tmp, path)
+        return path
+
+
+_CURRENT: Optional["weakref.ref[TimeSeriesRing]"] = None
+
+
+def current_timeseries() -> Optional[TimeSeriesRing]:
+    """The newest live ring in this process (weakref, like the
+    cluster's routing-table hook), or None."""
+    ref = _CURRENT
+    ring = ref() if ref is not None else None
+    return ring
+
+
+def timeseries_table(n: Optional[int] = None) -> dict:
+    """``/timeseries`` body; an empty ring shape when no ring exists
+    (the endpoint must answer either way)."""
+    ring = current_timeseries()
+    if ring is None:
+        return {"schema": TIMESERIES_SCHEMA, "rank": _process_index(),
+                "interval_s": None, "capacity": 0,
+                "dropped_samples": 0, "samples": []}
+    return ring.table(n)
+
+
+# ---------------------------------------------------------------------------
+# Artifact load + trend analysis (doctor side)
+# ---------------------------------------------------------------------------
+
+def validate_timeseries(d: dict) -> List[str]:
+    """Schema-v1 check for one timeseries jsonl line; empty = valid."""
+    problems = []
+    for f in TIMESERIES_FIELDS:
+        if f not in d:
+            problems.append(f"missing field {f!r}")
+    if d.get("schema") != TIMESERIES_SCHEMA:
+        problems.append(f"schema {d.get('schema')!r} != "
+                        f"{TIMESERIES_SCHEMA}")
+    if d.get("kind") != "timeseries":
+        problems.append(f"kind {d.get('kind')!r} != 'timeseries'")
+    for f in ("counters", "gauges", "histograms"):
+        if f in d and not isinstance(d[f], dict):
+            problems.append(f"{f} not a dict")
+    return problems
+
+
+def load_timeseries(paths) -> List[dict]:
+    """Parse timeseries rows from jsonl file(s), skipping torn lines;
+    rows sort by (ts, stable input order)."""
+    out: List[dict] = []
+    if isinstance(paths, str):
+        paths = [paths]
+    for path in paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        d = json.loads(line)
+                    except ValueError:
+                        continue
+                    if (isinstance(d, dict)
+                            and d.get("kind") == "timeseries"):
+                        out.append(d)
+        except OSError:
+            continue
+
+    def ts(d):
+        try:
+            return float(d.get("ts", 0.0))
+        except (TypeError, ValueError):
+            return 0.0
+    out.sort(key=ts)
+    return out
+
+
+def _tail_run(values: Sequence[float]) -> Dict[str, object]:
+    """Length + direction of the monotone run ending at the last
+    sample (strict in at least one step, never reversing)."""
+    n = len(values)
+    if n < 2:
+        return {"direction": "flat", "run": n, "delta": 0.0}
+    direction = "flat"
+    run = 1
+    for i in range(n - 1, 0, -1):
+        step = values[i] - values[i - 1]
+        if step > 0:
+            if direction == "falling":
+                break
+            direction = "rising"
+        elif step < 0:
+            if direction == "rising":
+                break
+            direction = "falling"
+        run += 1
+    delta = values[-1] - values[-run]
+    return {"direction": direction, "run": run,
+            "delta": round(delta, 6)}
+
+
+#: Gauges whose pre-incident trend the doctor calls out, in priority
+#: order (occupancy and queue pressure explain most serving stalls).
+TREND_GAUGES = (
+    "serving_kv_page_occupancy",
+    "serving_slot_occupancy",
+    "serving_queue_depth",
+    "serving_kv_bytes_in_use",
+    "cluster_replicas_alive",
+)
+
+#: A rising/falling tail must cover at least this many samples to be
+#: reported as a trend (shorter runs are noise).
+TREND_MIN_RUN = 3
+
+
+def series_trends(rows: Sequence[dict],
+                  gauges: Sequence[str] = TREND_GAUGES,
+                  min_run: int = TREND_MIN_RUN) -> List[dict]:
+    """Monotone tail runs per watched gauge across loaded samples —
+    the "what was building up before the incident" table.  A gauge
+    absent from every sample yields nothing (golden discipline
+    carries through the analysis)."""
+    trends: List[dict] = []
+    for name in gauges:
+        pts = [(float(r.get("ts", 0.0)), float(r["gauges"][name]))
+               for r in rows
+               if isinstance(r.get("gauges"), dict)
+               and name in r["gauges"]]
+        if len(pts) < 2:
+            continue
+        values = [v for _, v in pts]
+        run = _tail_run(values)
+        if run["direction"] == "flat" or run["run"] < min_run:
+            continue
+        trends.append({
+            "metric": name,
+            "direction": run["direction"],
+            "run": run["run"],
+            "delta": run["delta"],
+            "last": round(values[-1], 6),
+            "span_s": round(pts[-1][0] - pts[max(0, len(pts)
+                                                 - run["run"])][0],
+                            6),
+        })
+    return trends

@@ -185,7 +185,7 @@ class SpanTracer:
                 # report built on it should say so.
                 from triton_distributed_tpu.observability.metrics \
                     import get_registry
-                get_registry().counter("trace_dropped_spans").inc()
+                get_registry().counter("trace_dropped_spans_total").inc()
             self._ring.append(s)
 
     # -- inspection ------------------------------------------------------

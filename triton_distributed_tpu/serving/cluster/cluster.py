@@ -123,6 +123,22 @@ class ClusterConfig:
     #: the PR-8 contract) — absent/stale signals disengage the model
     #: bit-identically.
     bus: Optional[object] = None
+    #: SLO error budgets (`observability.slo.SLOPolicy`): per-class
+    #: TTFT/TBT targets tracked on this cluster's clock, burn alerts
+    #: fired as DecisionEvents, ``slo-state.json`` written beside the
+    #: other artifacts.  None (default) = no tracker, no gauges, no
+    #: artifact — byte-identical to the pre-SLO tree.  Configuring a
+    #: policy also arms per-tenant cost accounting
+    #: (`observability.costs`): budgets without a bill are not
+    #: actionable.
+    slo_policy: Optional[object] = None
+    #: Time-series retention (`observability.timeseries`): sample the
+    #: metrics registry every this-many virtual seconds into a
+    #: bounded ring, persisted as ``timeseries-rank-<N>.jsonl`` by
+    #: `write_artifact` and served at ``/timeseries``.  None
+    #: (default) = no ring, no samples, no artifact.
+    timeseries_interval_s: Optional[float] = None
+    timeseries_capacity: int = 256
 
 
 @dataclasses.dataclass
@@ -136,6 +152,11 @@ class ClusterRequest:
     seed: int = 0
     arrival_time: float = 0.0
     on_token: Optional[Callable] = None
+    #: Cost/SLO attribution label (`observability.costs` bills it,
+    #: `observability.slo` maps it to a service class).  The default
+    #: keeps untenanted traffic byte-identical (accounting never
+    #: arms).
+    tenant: str = "default"
     record_id: int = dataclasses.field(
         default_factory=lambda: next(_next_record_id))
 
@@ -148,6 +169,7 @@ class ClusterRequest:
     finish_reason: Optional[str] = None
     reject_reason: Optional[str] = None
     t_first_token: Optional[float] = None
+    t_last_token: Optional[float] = None
     t_finish: Optional[float] = None
     #: A claimed-but-undelivered `KVShipment` (decode-side
     #: backpressure refused the row after it crossed the wire).  The
@@ -175,6 +197,17 @@ class ClusterRequest:
         if self.t_finish is None:
             return None
         return self.t_finish - self.arrival_time
+
+    @property
+    def mean_tbt(self) -> Optional[float]:
+        """Mean time-between-tokens over the streamed tail (what the
+        SLO tracker scores against the per-class TBT target); None
+        until two tokens streamed."""
+        if (self.t_first_token is None or self.t_last_token is None
+                or len(self.tokens) < 2):
+            return None
+        return ((self.t_last_token - self.t_first_token)
+                / (len(self.tokens) - 1))
 
 
 class _VClock:
@@ -254,6 +287,26 @@ class ServingCluster:
         self._lineage_ids: "collections.OrderedDict" = (
             collections.OrderedDict())
         self.finished: List[ClusterRequest] = []
+        #: SLO error-budget tracker (`observability.slo`) — built only
+        #: when a policy is configured; configuring one also arms
+        #: per-tenant cost accounting (budgets without a bill are not
+        #: actionable).  None = no gauges, no alerts, no artifact.
+        self.slo: Optional[object] = None
+        if cfg.slo_policy is not None:
+            from triton_distributed_tpu.observability.slo import (
+                SLOTracker)
+            from triton_distributed_tpu.observability.costs import (
+                set_cost_accounting)
+            self.slo = SLOTracker(cfg.slo_policy)
+            set_cost_accounting(True)
+        #: Time-series ring (`observability.timeseries`) sampled on
+        #: the virtual clock each `step` — None when unconfigured.
+        self.timeseries: Optional[object] = None
+        if cfg.timeseries_interval_s is not None:
+            from triton_distributed_tpu.observability.timeseries \
+                import TimeSeriesRing
+            self.timeseries = TimeSeriesRing(
+                cfg.timeseries_interval_s, cfg.timeseries_capacity)
         _register(self)
         self._update_gauges()
 
@@ -262,14 +315,22 @@ class ServingCluster:
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                eos_token_ids: Sequence[int] = (), seed: int = 0,
                arrival_time: Optional[float] = None,
-               on_token: Optional[Callable] = None) -> ClusterRequest:
+               on_token: Optional[Callable] = None,
+               tenant: str = "default") -> ClusterRequest:
         arrival = (self._clock() if arrival_time is None
                    else float(arrival_time))
+        if tenant != "default":
+            # First non-default tenant arms cost accounting for the
+            # process (golden discipline: untenanted runs never pay).
+            from triton_distributed_tpu.observability.costs import (
+                maybe_arm_for_tenant)
+            maybe_arm_for_tenant(tenant)
         record = ClusterRequest(
             prompt=[int(t) for t in prompt],
             max_new_tokens=int(max_new_tokens),
             eos_token_ids=tuple(int(t) for t in eos_token_ids),
-            seed=int(seed), arrival_time=arrival, on_token=on_token)
+            seed=int(seed), arrival_time=arrival, on_token=on_token,
+            tenant=str(tenant))
         # Kept sorted by arrival (stable for ties: submission order)
         # within the not-yet-routed tail, so the router always sees
         # the next arrival at the head whatever order clients submit.
@@ -357,6 +418,10 @@ class ServingCluster:
                 stepped += 1
         progressed |= stepped > 0
         self._health(now)
+        if self.timeseries is not None:
+            self.timeseries.maybe_sample(now)
+        if self.slo is not None:
+            self.slo.check(now)
         if not progressed:
             self._advance(now)
         return {"now": now, "stepped": stepped,
@@ -500,12 +565,14 @@ class ServingCluster:
             eos_token_ids=record.eos_token_ids, seed=record.seed,
             arrival_time=(record.arrival_time if done == 0 else now),
             on_token=self._mirror(record),
-            lineage_id=record.record_id)
+            lineage_id=record.record_id,
+            tenant=record.tenant)
 
     def _mirror(self, record: ClusterRequest):
         def cb(req, tok):
             if record.t_first_token is None:
                 record.t_first_token = self._clock()
+            record.t_last_token = self._clock()
             record.tokens.append(int(tok))
             if record.on_token is not None:
                 record.on_token(record, tok)
@@ -847,6 +914,14 @@ class ServingCluster:
                               + self.config.ship_retry_base_s
                               * (2 ** attempt))
         self._count("cluster_kv_shipped_bytes_total", nbytes)
+        if record is not None:
+            from triton_distributed_tpu.observability import costs
+            if costs.cost_accounting_enabled():
+                # Every wire crossing bills the tenant — retries too
+                # (the fault's cost lands on the bill, like the
+                # lineage hop above records it).
+                costs.charge_wire(record.record_id, record.tenant,
+                                  nbytes)
         action = self.injector.on_ship(token, nbytes, now,
                                        kind=ship.get("kind", "kv"))
         if action is None:
@@ -1121,6 +1196,18 @@ class ServingCluster:
                     # radix, so the directory entry stays warm.
                     self.router.directory.register(
                         record.prompt, rep.id, now)
+                if self.slo is not None:
+                    # The SLO outcome lands exactly once, at retire:
+                    # TTFT against the class target, mean TBT over
+                    # the streamed tail (None = unmeasured dimension,
+                    # which cannot breach).
+                    ttft = record.ttft
+                    tbt = record.mean_tbt
+                    self.slo.observe(
+                        record.tenant,
+                        None if ttft is None else ttft * 1e3,
+                        None if tbt is None else tbt * 1e3,
+                        now)
                 self.finished.append(record)
             self._open -= 1
 
@@ -1298,6 +1385,17 @@ class ServingCluster:
         # reference scheduler run in the same test process).
         write_lineage_artifact(directory,
                                request_ids=self._lineage_ids)
+        if self.timeseries is not None:
+            self.timeseries.write(directory)
+        if self.slo is not None:
+            from triton_distributed_tpu.observability.slo import (
+                SLO_STATE_FILE)
+            spath = os.path.join(directory, SLO_STATE_FILE)
+            stmp = f"{spath}.tmp.{os.getpid()}"
+            with open(stmp, "w") as f:
+                json.dump(self.slo.state_dict(self._clock()), f,
+                          indent=1, default=str)
+            os.replace(stmp, spath)
         return path
 
     def _update_gauges(self) -> None:
@@ -1306,7 +1404,7 @@ class ServingCluster:
         if not observability_enabled():
             return
         reg = get_registry()
-        reg.gauge("cluster_replicas_total").set(len(self.replicas))
+        reg.gauge("cluster_replicas_configured").set(len(self.replicas))
         reg.gauge("cluster_replicas_alive").set(
             sum(1 for r in self.replicas if r.routable))
 

@@ -62,6 +62,11 @@ class Request:
     #: Streaming hook, called as ``on_token(request, token)`` from the
     #: scheduler loop right after each token is decoded to host.
     on_token: Optional[Callable[["Request", int], None]] = None
+    #: Cost-attribution / QoS label (`observability.costs`): which
+    #: tenant this request is billed to.  The default keeps every
+    #: pre-tenant call site byte-identical (cost accounting only arms
+    #: when a non-default tenant or an SLO policy shows up).
+    tenant: str = "default"
     request_id: int = dataclasses.field(
         default_factory=lambda: next(_next_id))
 
@@ -152,8 +157,10 @@ class Request:
                               RequestState.REJECTED)
 
     def to_dict(self) -> dict:
-        """JSON-friendly summary (flight-recorder / bench reporting)."""
-        return {
+        """JSON-friendly summary (flight-recorder / bench reporting).
+        ``tenant`` rides along only when set to something non-default,
+        so untenanted summaries stay byte-identical."""
+        out = {
             "request_id": self.request_id,
             "state": self.state.value,
             "prompt_len": self.prompt_len,
@@ -172,3 +179,6 @@ class Request:
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,
         }
+        if self.tenant != "default":
+            out["tenant"] = self.tenant
+        return out

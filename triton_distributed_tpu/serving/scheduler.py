@@ -364,6 +364,13 @@ class ContinuousBatchingScheduler:
     def submit(self, req: Request) -> bool:
         """Enqueue; False = rejected with ``req.reject_reason`` set."""
         now = self.clock()
+        if req.tenant != "default":
+            # A real tenant label is the opt-in for per-tenant cost
+            # accounting (golden discipline: default-only runs never
+            # arm it, so they charge and emit nothing).
+            from triton_distributed_tpu.observability.costs import (
+                maybe_arm_for_tenant)
+            maybe_arm_for_tenant(req.tenant)
         req.t_arrival = (req.arrival_time if req.arrival_time is not None
                          else now)
         reason = None
@@ -513,6 +520,45 @@ class ContinuousBatchingScheduler:
             record_hop)
         record_hop(self._lineage_key(req), hop, ts, self.name,
                    **detail)
+
+    # -- cost attribution (observability.costs; every hook no-ops
+    # -- until a tenant/SLO policy arms accounting) ----------------------
+
+    def _charge_device(self, phase: str, us: float, reqs) -> None:
+        """Charge one measured device window, split exactly across
+        the requests that shared it (the cost analogue of the lineage
+        interval-charging rule)."""
+        from triton_distributed_tpu.observability import costs
+        if costs.cost_accounting_enabled():
+            costs.charge_device(
+                phase, us,
+                [(self._lineage_key(r), r.tenant) for r in reqs])
+
+    def _charge_tokens(self, kind: str, req: Request, n: int) -> None:
+        from triton_distributed_tpu.observability import costs
+        if costs.cost_accounting_enabled():
+            costs.charge_tokens(kind, self._lineage_key(req),
+                                req.tenant, n)
+
+    def _charge_kv_residency(self, reqs, now: float) -> None:
+        """Integrate KV page-seconds for every active request: pages
+        currently pinned × time since its previous charge.  Paged
+        mode bills the pages the request has actually filled; slot
+        mode bills the whole pinned row (that IS its footprint)."""
+        from triton_distributed_tpu.observability import costs
+        if not costs.cost_accounting_enabled():
+            return
+        page = max(self.config.page_size, 1)
+        row_pages = -(-self.max_seq // page)
+        for r in reqs:
+            if self.paged:
+                tokens = min(r.prompt_len + len(r.generated),
+                             self.max_seq)
+                pages = -(-tokens // page)
+            else:
+                pages = row_pages
+            costs.charge_kv_occupancy(self._lineage_key(r), r.tenant,
+                                      pages, now)
 
     def _can_admit_head(self) -> bool:
         if not self.paged:
@@ -672,6 +718,8 @@ class ContinuousBatchingScheduler:
                         ms = (time.perf_counter() - t0) * 1e3
                         reg.histogram("serving_prefill_ms").observe(ms)
                         _observe_prefill(bucket, ms)
+                        self._charge_device("prefill", ms * 1e3,
+                                            (req,))
                 slot = self.slots.insert_prefill(
                     row_cache, s, self._request_key(req))
             self._tokens[slot] = tokens[-1]
@@ -707,7 +755,10 @@ class ContinuousBatchingScheduler:
                 if (req.resume_tokens is not None or req.preemptions
                         or req.resume_key is not None):
                     # A preempt-and-requeue (or failover re-prefill)
-                    # resume: the "resume" half of the seam.
+                    # resume: the "resume" half of the seam.  The
+                    # tokens recomputed by this admission are the
+                    # preemption's waste bill.
+                    self._charge_tokens("reprefill", req, len(tokens))
                     self._hop(req, "admit", now, slot=slot,
                               bucket=bucket, mode=mode, resumed=True)
                 else:
@@ -809,6 +860,7 @@ class ContinuousBatchingScheduler:
                 ms = (time.perf_counter() - t0) * 1e3
                 reg.histogram("serving_prefill_ms").observe(ms)
                 _observe_prefill(bucket, ms)
+                self._charge_device("prefill", ms * 1e3, (req,))
             reg.counter("serving_prefix_cache_hit_tokens_total").inc(c)
             reg.counter("serving_prefix_cache_miss_tokens_total").inc(
                 s - c)
@@ -1037,7 +1089,8 @@ class ContinuousBatchingScheduler:
         now = self.clock()
         reg = self._registry()
         if reg:
-            step_ms = (time.perf_counter() - t0) * 1e3 / steps
+            elapsed_ms = (time.perf_counter() - t0) * 1e3
+            step_ms = elapsed_ms / steps
             reg.histogram("serving_decode_step_ms").observe(step_ms)
             # Last measured step as a gauge: rides the heartbeat
             # files, where it is the `step_us` a PEER router scores
@@ -1069,6 +1122,17 @@ class ContinuousBatchingScheduler:
                     "serving.decode_step", kind="engine",
                     measured_us=step_ms * 1e3, anomaly_z=round(z, 2))
         rows = list(self._by_slot.items())
+        if reg and rows:
+            # Cost attribution: the dispatch's measured window is
+            # split exactly across the rows that ran in it (a spec
+            # round is one fused draft+verify window — charged to the
+            # verify phase, mirroring the spec_verify lineage hop),
+            # and each row's pinned KV pages integrate page-seconds
+            # since their previous charge.
+            self._charge_device(
+                "spec_verify" if spec is not None else "decode",
+                elapsed_ms * 1e3, [r for _, r in rows])
+            self._charge_kv_residency([r for _, r in rows], now)
         if spec is not None:
             self._spec_outcome(rows, accept_host, n_draft, now, reg)
         retired, generated = self._commit_tokens(
@@ -1101,13 +1165,14 @@ class ContinuousBatchingScheduler:
             self._spec_proposed += n
             self._spec_accepted += a
             if reg:
-                reg.histogram("serving_spec_accept_len").observe(a)
+                reg.histogram("serving_spec_accept_tokens").observe(a)
                 reg.counter(
                     "serving_spec_proposed_tokens_total").inc(n)
                 reg.counter(
                     "serving_spec_accepted_tokens_total").inc(a)
                 reg.counter(
                     "serving_spec_rejected_tokens_total").inc(n - a)
+                self._charge_tokens("wasted_spec", req, n - a)
                 self._hop(req, "spec_verify", now, proposed=n,
                           accepted=a)
         if reg and self._spec_proposed:
