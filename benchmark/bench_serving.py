@@ -397,6 +397,71 @@ def emit(mode, load, args, res, extra=None, trace=None,
         bench_record(rec)
 
 
+def measure_record_overhead(model, params, args, buckets):
+    """Paired record-off / record-on cluster runs on the identical
+    trace: the price of arming `ClusterConfig.record_dir` (see
+    `observability/replay.py`) must stay in the noise (gated <= 5%
+    by `check_bench_regression.replay_checks`), and the artifact the
+    ON runs wrote must actually replay EXACT — an overhead number
+    for a recorder whose recordings don't re-execute gates nothing.
+
+    Mirrored off/on/on/off/off/on order (same drift-cancelling
+    lesson as the serial-vs-continuous pairing), min-of-3 wall time
+    per mode: recording cost is host-side Python (row buffering +
+    one atomic flush), so min-of-N isolates it from scheduler
+    jitter."""
+    import shutil
+    import tempfile
+
+    from triton_distributed_tpu.observability.replay import (
+        replay_run)
+    from triton_distributed_tpu.serving import (
+        ClusterConfig, SchedulerConfig, ServingCluster)
+
+    trace = [dict(prompt=[1 + (i % 7), 2, 3 + (i % 5)],
+                  max_new_tokens=4 + (i % 3), seed=i,
+                  arrival_time=0.002 * i)
+             for i in range(min(args.n_requests, 24))]
+    sc = SchedulerConfig(num_slots=4, prefill_buckets=buckets,
+                         temperature=0.8, top_k=8)
+
+    def run(record_dir):
+        cfg = ClusterConfig(n_replicas=2, n_prefill_workers=1,
+                            scheduler=sc, record_dir=record_dir)
+        t0 = time.perf_counter()
+        cluster = ServingCluster(model, params, cfg)
+        for t in trace:
+            cluster.submit(**t)
+        done = cluster.drain()
+        wall = time.perf_counter() - t0
+        assert len(done) == len(trace)
+        return wall
+
+    walls = {"off": [], "on": []}
+    dirs = []
+    for mode in ("off", "on", "on", "off", "off", "on"):
+        if mode == "on":
+            d = tempfile.mkdtemp(prefix="tdt-bench-replay-")
+            dirs.append(d)
+            walls[mode].append(run(d))
+        else:
+            walls[mode].append(run(""))
+    off, on = min(walls["off"]), min(walls["on"])
+    report = replay_run(dirs[-1], model=model, params=params)
+    exact = report["status"] == "EXACT"
+    for d in dirs:
+        shutil.rmtree(d, ignore_errors=True)
+
+    from triton_distributed_tpu.observability import bench_record
+    bench_record({
+        "bench": "serving", "model": args.model,
+        "metric": "replay_record", "n_requests": len(trace),
+        "record_off_s": round(off, 6), "record_on_s": round(on, 6),
+        "recording_overhead": round(on / off - 1.0, 4),
+        "recording_overhead_le_5pct": on <= off * 1.05,
+        "replay_exact": exact})
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", choices=("toy", "qwen"), default="toy")
@@ -658,6 +723,10 @@ def main():
                       peaks["paged"] / max(peaks["slots"], 1), 2),
                   "paged_4x_concurrency":
                       peaks["paged"] >= 4 * peaks["slots"]})
+
+    # Record & replay: the recording-overhead pairing (<= 5% gate)
+    # plus the replay-exactness bit on the artifact it wrote.
+    measure_record_overhead(model, params, args, eng_buckets)
 
 
 if __name__ == "__main__":

@@ -108,6 +108,11 @@ MEASUREMENT_FIELDS = {
     # determinism by planner_checks).
     "per_class", "cell_ok", "finished", "min_replicas",
     "plan_feasible", "plan_deterministic",
+    # Record & replay rows (bench_serving.py measure_record_overhead;
+    # gated by replay_checks: overhead <= 5% AND the artifact
+    # re-executes EXACT).
+    "record_off_s", "record_on_s", "recording_overhead",
+    "recording_overhead_le_5pct", "replay_exact",
 }
 #: Fields that may hold the latency to compare, in preference order.
 LATENCY_FIELDS = ("us", "ms", "ms_per_step")
@@ -461,6 +466,39 @@ def planner_checks(fresh) -> tuple:
     return checked, fails
 
 
+def replay_checks(fresh) -> tuple:
+    """Gate specific to record & replay (`observability.replay` via
+    ``bench_serving.py``'s ``metric="replay_record"`` row): arming
+    the recorder on the paired cluster trace must cost <= 5% wall
+    time (min-of-N, mirrored order — it is host-side row buffering
+    plus one atomic flush, so more than that is a hot-path
+    regression), and the artifact the ON runs wrote must have
+    re-executed EXACT — the overhead of a recorder whose recordings
+    don't reproduce their run gates nothing.
+
+    Returns ``(n_checked, failures)``."""
+    fails = []
+    checked = 0
+    for rec in fresh:
+        if (rec.get("bench") != "serving"
+                or rec.get("metric") != "replay_record"):
+            continue
+        checked += 1
+        overhead = rec.get("recording_overhead")
+        if not (isinstance(overhead, (int, float))
+                and overhead <= 0.05):
+            fails.append(
+                f"replay regression: recording overhead "
+                f"{overhead!r} exceeds 5% "
+                f"(off={rec.get('record_off_s')}s "
+                f"on={rec.get('record_on_s')}s)")
+        if rec.get("replay_exact") is not True:
+            fails.append(
+                "replay regression: the recorded run did not "
+                "re-execute EXACT from replay.jsonl")
+    return checked, fails
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--fresh", required=True,
@@ -557,13 +595,14 @@ def main() -> int:
     sp_checked, sp_fails = spec_checks(fresh)
     moe_checked, moe_fails = moe_checks(fresh)
     pl_checked, pl_fails = planner_checks(fresh)
+    rp_checked, rp_fails = replay_checks(fresh)
 
     # Markdown summary: CI logs and PR comments read the same thing.
     print("## Bench regression check")
     print()
     verdict = ("FAIL" if regressions or cl_fails or rt_fails
                or kt_fails or ln_fails or sp_fails or moe_fails
-               or pl_fails else
+               or pl_fails or rp_fails else
                "OK (with anomalies)" if anomalies else "OK")
     print(f"**{verdict}** — {compared} row(s) compared, "
           f"{regressions} regression(s) beyond "
@@ -631,14 +670,21 @@ def main() -> int:
               f"{len(pl_fails)} failure(s).")
         for f in pl_fails:
             print(f"- {f}")
+    if rp_checked:
+        print()
+        print(f"Replay gate: {rp_checked} row(s) checked "
+              f"(recording overhead <= 5% + artifact re-executes "
+              f"EXACT), {len(rp_fails)} failure(s).")
+        for f in rp_fails:
+            print(f"- {f}")
     if (compared == 0 and cl_checked == 0 and rt_checked == 0
             and kt_checked == 0 and ln_checked == 0
             and sp_checked == 0 and moe_checked == 0
-            and pl_checked == 0):
+            and pl_checked == 0 and rp_checked == 0):
         return 2
     return 1 if (regressions or cl_fails or rt_fails or kt_fails
                  or ln_fails or sp_fails or moe_fails
-                 or pl_fails) else 0
+                 or pl_fails or rp_fails) else 0
 
 
 if __name__ == "__main__":

@@ -179,7 +179,7 @@ fi
 # so any diff is a real behavior change in links/anomaly/doctor.
 doctor_rc=0
 for scenario in stalled_rank sem_leak slow_link clean \
-        lossy_transport slow_request; do
+        lossy_transport slow_request replayed_fault; do
     if ! JAX_PLATFORMS=cpu python -m \
             triton_distributed_tpu.observability.doctor \
             "tests/data/incidents/$scenario" -q \
@@ -617,6 +617,69 @@ chaos_rc=$?
 echo "$chaos_log" | tail -3
 if [ "$chaos_rc" -ne 0 ]; then
     echo "CHAOS_SMOKE=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
+# Replay smoke: record a chaotic run (record_dir armed), re-execute
+# it bit-exactly from replay.jsonl alone (EXACT at all three parity
+# levels), then counterfactually suppress the first injected fault —
+# the report must name that fault and the causality clause must
+# render.  This is the deterministic-incident contract end-to-end.
+replay_log=$(JAX_PLATFORMS=cpu python - <<'EOF' 2>&1
+import tempfile
+import jax
+from triton_distributed_tpu.serving import (
+    ClusterConfig, FaultInjector, FaultSchedule, SchedulerConfig,
+    ServingCluster, ToyConfig, ToyModel)
+from triton_distributed_tpu.serving.cluster import RouterConfig
+from triton_distributed_tpu.observability.replay import (
+    causality_clause, load_replay, replay_run)
+
+model = ToyModel(ToyConfig(vocab_size=61, hidden=16, max_seq_len=64))
+params = model.init_params(jax.random.PRNGKey(3))
+d = tempfile.mkdtemp(prefix="tdt-replay-")
+inj = FaultInjector(FaultSchedule(
+    7, classes=("drop", "dup", "corrupt", "reorder", "stale_hb"),
+    ship_fault_rate=0.5, window_s=0.03))
+cluster = ServingCluster(
+    model, params,
+    ClusterConfig(n_replicas=2, n_prefill_workers=1,
+                  scheduler=SchedulerConfig(
+                      num_slots=2, prefill_buckets=(8, 16),
+                      temperature=0.8, top_k=8),
+                  ship_retry_base_s=0.002, ship_deadline_s=0.1,
+                  router=RouterConfig(dead_after_s=0.005,
+                                      dead_checks=2,
+                                      probation_checks=2),
+                  record_dir=d, record_params_seed=3),
+    fault_injector=inj)
+for i in range(6):
+    cluster.submit([1 + i, 2, 3], 4 + (i % 3), seed=i)
+done = cluster.drain()
+assert len(done) == 6, [r.state for r in done]
+assert inj.events, "schedule injected nothing"
+
+report = replay_run(d, model=model, params=params)
+assert report["status"] == "EXACT", report["first_divergence"]
+for level, stats in report["levels"].items():
+    assert stats["divergences"] == 0, (level, stats)
+    assert stats["compared"] > 0, level
+
+faults = [r for r in load_replay(d)
+          if r.get("kind") == "fault_injected"]
+cf = replay_run(d, model=model, params=params,
+                override={"suppress_fault": int(faults[0]["index"])}
+                )["counterfactual"]
+assert cf["fault"]["fault"] == faults[0]["fault"], cf
+clause = causality_clause(cf)
+assert clause.startswith("without the "), clause
+print("REPLAY_SMOKE=ok")
+EOF
+)
+replay_rc=$?
+echo "$replay_log" | tail -3
+if [ "$replay_rc" -ne 0 ]; then
+    echo "REPLAY_SMOKE=FAILED"
     [ "$rc" -eq 0 ] && rc=1
 fi
 

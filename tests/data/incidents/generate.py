@@ -22,6 +22,13 @@ pre-computed static-analysis findings file):
   drain + probation re-admission).  The doctor's Chaos section must
   name the injected fault classes from ``faults.jsonl``, and the
   Cluster section the drained-then-re-admitted replica.
+- ``replayed_fault``: an armed run (`observability.replay`) recorded
+  its ``replay.jsonl``, and a previous ``doctor --replay`` appended
+  a counterfactual verdict (the run re-executed with the drop fault
+  suppressed).  The doctor's Replay section must summarize the
+  recording and its verdict must quote the causality clause —
+  "without the drop fault on shipment:2, request 7's TTFT is 8.1 ms
+  not 20.0 ms".
 
 Everything is deterministic (fixed base timestamp, no randomness), so
 ``report.golden.json`` files can gate drift in CI.  Run from anywhere:
@@ -48,7 +55,7 @@ WORLD = 4
 AXIS = "tp"
 
 SCENARIOS = ("stalled_rank", "sem_leak", "slow_link", "clean",
-             "lossy_transport", "slow_request")
+             "lossy_transport", "slow_request", "replayed_fault")
 
 
 def _write(scenario: str, name: str, payload, truncate_at=None):
@@ -436,6 +443,119 @@ def gen_slow_request():
             f.write(json.dumps(row) + "\n")
 
 
+def gen_replayed_fault():
+    """An armed cluster run's deterministic recording
+    (``replay.jsonl``, `observability.replay` schema 1) after a
+    ``doctor --replay`` pass: the wire dropped request 7's KV
+    shipment twice (same incident shape as ``slow_request``), the
+    recording is COMPLETE (meta first, end row present), and the
+    appended counterfactual row carries the verdict of re-executing
+    with drop fault 0 suppressed — request 7's 20 ms TTFT becomes
+    8.1 ms.  The doctor must summarize the recording in its Replay
+    section and quote the causality clause in the verdict.
+    Timestamps are VIRTUAL seconds."""
+    s = "replayed_fault"
+
+    def row(kind, **fields):
+        return {"schema": 1, "kind": kind, **fields}
+
+    sched = {"num_slots": 2, "max_queue": 16,
+             "prefill_buckets": [8, 16], "max_seq": 64,
+             "kv_layout": "slots", "temperature": 0.0, "top_k": 0,
+             "top_p": 1.0, "steps_per_sync": 1}
+    rows = [
+        row("meta",
+            config={"n_replicas": 2, "n_prefill_workers": 1,
+                    "step_time_s": 0.001, "prefill_time_s": 0.002,
+                    "wire_gbps": 25.0, "ship_retry_base_s": 0.002,
+                    "ship_max_retries": 4, "ship_deadline_s": 0.1,
+                    "prefix_ship_deadline_s": 0.25,
+                    "timeseries_interval_s": None,
+                    "timeseries_capacity": 256,
+                    "had_artifact_dir": True, "has_bus": False,
+                    "bus_staleness_s": None, "had_drafter": False,
+                    "scheduler": sched,
+                    "router": {"mode": "signal_aware"},
+                    "slo_policy": None},
+            model={"class": "ToyModel",
+                   "config": {"vocab_size": 61, "hidden": 16,
+                              "max_seq_len": 64,
+                              "quantize_kv_cache": False},
+                   "params_seed": 3},
+            faults={"seed": 42, "classes": ["drop"],
+                    "ship_fault_rate": 0.4, "flap_factor": 50.0,
+                    "skew_s": 0.05, "reorder_delay_s": 0.02,
+                    "max_faults": 32, "window": [0.004, 0.054],
+                    "victim": 7, "salt": 305419896}),
+        row("clock", seq=0,
+            t=[0.0, 0.0, 0.001, 0.0015, 0.002, 0.0028, 0.004,
+               0.0058, 0.0078, 0.0108, 0.0148, 0.019, 0.02, 0.024]),
+        row("submit", rid=7, arrival=0.0, prompt=[5, 2, 3, 9, 4, 1],
+            max_new=8, eos=[], seed=7, tenant="default", clk=1,
+            pos=1),
+        row("submit", rid=3, arrival=0.001,
+            prompt=[1, 2, 3, 4, 5, 6], max_new=8, eos=[], seed=3,
+            tenant="default", clk=1, pos=2),
+        row("submit", rid=4, arrival=0.0015,
+            prompt=[2, 2, 3, 4, 5, 7], max_new=8, eos=[], seed=4,
+            tenant="default", clk=1, pos=3),
+        row("wire", event="ship", token=2, nbytes=9472, tag=7),
+        row("fault_injected", index=0, fault="drop",
+            target="shipment:2", ts=0.0058,
+            inputs={"nbytes": 9472}),
+        row("wire", event="ship", token=5, nbytes=9472, tag=7),
+        row("fault_injected", index=1, fault="drop",
+            target="shipment:5", ts=0.0108,
+            inputs={"nbytes": 9472}),
+        row("wire", event="ship", token=6, nbytes=9472, tag=7),
+        row("wire", event="claim", token=6, outcome="ok",
+            nbytes=9472),
+        row("step", replica=1, now=0.019, dur=0.001,
+            busy_until=0.02),
+        row("finish", rid=7, state="finished",
+            tokens=[11, 7, 23, 42, 8, 19, 30, 55],
+            finish_reason="length", reject_reason=None,
+            t_first=0.02, t_last=0.024, t_finish=0.024, arrival=0.0,
+            replicas=[1], failovers=0),
+        row("hop", rid=7, hop="submit", ts=0.0, actor="cluster",
+            detail={"prompt_len": 6, "max_new": 8}),
+        row("hop", rid=7, hop="ship_retry", ts=0.0078,
+            actor="transport",
+            detail={"token": 5, "attempt": 1, "trigger": "timeout"}),
+        row("hop", rid=7, hop="first_token", ts=0.02,
+            actor="replica-1", detail={"slot": 0}),
+        row("end", clock_reads=14, rows=16, open=0),
+        # Appended by a previous `doctor --replay`: the run
+        # re-executed EXACTLY, then re-executed with drop fault 0
+        # suppressed — the divergence report blames the fault.
+        row("counterfactual", override={"suppress_fault": 0},
+            first_divergence={"level": "hops", "index": 1,
+                              "recorded": {"hop": "ship_retry"},
+                              "replayed": {"hop": "ship_deliver"}},
+            fault={"index": 0, "fault": "drop",
+                   "target": "shipment:2", "ts": 0.0058},
+            request={"rid": 7, "index": 0,
+                     "recorded_ttft_ms": 20.0,
+                     "replayed_ttft_ms": 8.1}),
+    ]
+    faults = [
+        {"schema": 1, "kind": "fault", "ts": 0.0058, "fault": "drop",
+         "target": "shipment:2", "inputs": {"nbytes": 9472},
+         "seed": 42},
+        {"schema": 1, "kind": "fault", "ts": 0.0108, "fault": "drop",
+         "target": "shipment:5", "inputs": {"nbytes": 9472},
+         "seed": 42},
+    ]
+    d = os.path.join(HERE, s)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "replay.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    with open(os.path.join(d, "faults.jsonl"), "w") as f:
+        for r in faults:
+            f.write(json.dumps(r) + "\n")
+
+
 def generate(clean_first: bool = True):
     for scenario in SCENARIOS:
         d = os.path.join(HERE, scenario)
@@ -449,6 +569,7 @@ def generate(clean_first: bool = True):
     gen_clean()
     gen_lossy_transport()
     gen_slow_request()
+    gen_replayed_fault()
     return [os.path.join(HERE, sc) for sc in SCENARIOS]
 
 

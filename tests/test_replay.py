@@ -1,0 +1,471 @@
+"""Deterministic incident record & replay
+(`observability/replay.py`) and the consolidated torn-line-tolerant
+JSONL loader (`observability/jsonl.py`) it is built on.
+
+The load-bearing assertions:
+
+- **Bit-exact replay under chaos.**  A 16-seed fault grid ×
+  {slots, paged} × {greedy, sampled}, recorded on a *jittered
+  wall-shaped clock* (every reading moves time by a seeded random
+  amount — nothing about the timeline is round or replayable by
+  luck): `replay_run` must report EXACT at all three parity levels
+  (tokens, decisions, hops), zero divergences.
+- **Torn artifacts tell the truth.**  A recording truncated at any
+  point (including mid-line) replays as INCOMPLETE with the problem
+  named — never a crash, never a half-driven replay presented as a
+  verdict.
+- **Counterfactuals name the first divergence.**  Suppressing a
+  recorded fault / pinning the route / stretching a step re-executes
+  and reports the first differing decision/hop/token plus the TTFT
+  delta — the doctor's causality clause.
+- **Golden discipline.**  Unarmed runs write nothing and record
+  nothing; ``record_dir=""`` disarms even when ``TDT_REPLAY_DIR`` is
+  set (a replay must never re-record itself).
+"""
+
+import json
+import os
+import random
+
+import jax
+import pytest
+
+from triton_distributed_tpu.observability.jsonl import (
+    load_jsonl_rows,
+    tolerant_ts,
+)
+from triton_distributed_tpu.observability.replay import (
+    REPLAY_FILE,
+    ReplayClock,
+    append_counterfactual,
+    causality_clause,
+    load_replay,
+    replay_run,
+    replay_status,
+    validate_replay,
+)
+from triton_distributed_tpu.serving import (
+    ClusterConfig,
+    FaultInjector,
+    FaultSchedule,
+    SchedulerConfig,
+    ServingCluster,
+    ToyConfig,
+    ToyModel,
+)
+from triton_distributed_tpu.serving.cluster import RouterConfig
+
+
+@pytest.fixture(autouse=True)
+def _fresh_decision_state():
+    """Same hygiene as test_cluster/test_chaos: decisions and
+    lineage must not leak across modules — and doubly so here, where
+    replay parity COMPARES those streams."""
+    from triton_distributed_tpu.observability import feedback
+    from triton_distributed_tpu.observability.lineage import (
+        get_lineage_recorder)
+    feedback.clear_recent_decisions()
+    yield
+    feedback.clear_recent_decisions()
+    get_lineage_recorder().clear()
+
+
+@pytest.fixture(scope="module")
+def toy():
+    model = ToyModel(ToyConfig(vocab_size=61, hidden=16,
+                               max_seq_len=64))
+    params = model.init_params(jax.random.PRNGKey(3))
+    return model, params
+
+
+class JitterClock:
+    """Wall-shaped deterministic clock: starts at a unix-like epoch
+    and every READ jitters time forward by a seeded random amount,
+    so the recorded timeline is irregular the way a real wall clock
+    is.  Replay never sees this object — it re-executes from the
+    recorded readings alone."""
+
+    def __init__(self, seed: int):
+        self.t = 1_700_000_000.0 + seed
+        self._rng = random.Random(seed * 7919 + 1)
+
+    def __call__(self) -> float:
+        self.t += self._rng.random() * 2e-5
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _chaos_cluster(model, params, record_dir, seed, layout="slots",
+                   temperature=0.0, **cfg_kw):
+    if layout == "paged":
+        sc = SchedulerConfig(num_slots=2, prefill_buckets=(8, 16),
+                             kv_layout="paged", page_size=8,
+                             temperature=temperature, top_k=8)
+    else:
+        sc = SchedulerConfig(num_slots=2, prefill_buckets=(8, 16),
+                             temperature=temperature, top_k=8)
+    inj = FaultInjector(FaultSchedule(
+        seed, classes=("drop", "dup", "corrupt", "reorder",
+                       "stale_hb"),
+        ship_fault_rate=0.5, window_s=0.03))
+    cfg = ClusterConfig(
+        n_replicas=2, n_prefill_workers=1, scheduler=sc,
+        router=RouterConfig(dead_after_s=0.005, dead_checks=2,
+                            probation_checks=2),
+        ship_retry_base_s=0.002, ship_deadline_s=0.1,
+        record_dir=str(record_dir), record_params_seed=3, **cfg_kw)
+    clock = JitterClock(seed)
+    return ServingCluster(model, params, cfg, clock=clock,
+                          clock_advance=clock.advance,
+                          fault_injector=inj)
+
+
+def _submit_mix(cluster, seed):
+    for i in range(4):
+        cluster.submit([1 + i, 2 + seed % 5, 3, 4 + i], 5,
+                       seed=seed * 10 + i)
+
+
+# ---------------------------------------------------------------------------
+# The grid: bit-exact replay under chaos
+# ---------------------------------------------------------------------------
+
+class TestReplayExactGrid:
+    """16 chaos seeds, each mapped across the {slots, paged} ×
+    {greedy, sampled} grid, on the jittered wall-shaped clock."""
+
+    @pytest.mark.parametrize("seed", range(16))
+    def test_replay_is_exact(self, toy, tmp_path, seed):
+        model, params = toy
+        layout = "paged" if seed % 2 else "slots"
+        temperature = 0.8 if (seed // 2) % 2 else 0.0
+        cluster = _chaos_cluster(model, params, tmp_path, seed,
+                                 layout=layout,
+                                 temperature=temperature)
+        _submit_mix(cluster, seed)
+        fin = cluster.drain()
+        assert all(r.done for r in fin)
+        report = replay_run(tmp_path, model=model, params=params)
+        assert report["status"] == "EXACT", report["first_divergence"]
+        for level in ("tokens", "decisions", "hops"):
+            assert report["levels"][level]["divergences"] == 0
+            assert report["levels"][level]["compared"] > 0, level
+
+    def test_meta_reconstruction_replays_exactly(self, toy,
+                                                 tmp_path):
+        """No model/params passed: `replay_run` rebuilds the toy
+        model from meta (class + config + params seed) and still
+        matches token-for-token."""
+        model, params = toy
+        cluster = _chaos_cluster(model, params, tmp_path, seed=5,
+                                 temperature=0.8)
+        _submit_mix(cluster, 5)
+        cluster.drain()
+        report = replay_run(tmp_path)
+        assert report["status"] == "EXACT", report["first_divergence"]
+
+    def test_explicit_arrivals_replay_exactly(self, toy, tmp_path):
+        """Pre-submitted requests (explicit ``arrival_time``, the
+        non-clock submit path) interleave identically in replay."""
+        model, params = toy
+        sc = SchedulerConfig(num_slots=2, prefill_buckets=(8, 16))
+        cfg = ClusterConfig(n_replicas=2, scheduler=sc,
+                            record_dir=str(tmp_path),
+                            record_params_seed=3)
+        cluster = ServingCluster(model, params, cfg)  # virtual clock
+        for i, t in enumerate((0.0, 0.004, 0.0005)):
+            cluster.submit([2 + i, 3, 5], 4, seed=i, arrival_time=t)
+        cluster.drain()
+        rows = load_replay(tmp_path)
+        assert all("clk" not in r for r in rows
+                   if r.get("kind") == "submit")
+        report = replay_run(tmp_path, model=model, params=params)
+        assert report["status"] == "EXACT", report["first_divergence"]
+
+    def test_failover_run_with_artifact_dir_replays(self, toy,
+                                                    tmp_path):
+        """A run that failed over (mid-run `write_artifact` calls
+        consume extra clock readings) still replays exactly — the
+        reconstruction reproduces those reads against scratch."""
+        model, params = toy
+        art = tmp_path / "art"
+        cluster = _chaos_cluster(model, params, tmp_path, seed=2,
+                                 artifact_dir=str(art))
+        _submit_mix(cluster, 2)
+        cluster.drain()
+        report = replay_run(tmp_path, model=model, params=params)
+        assert report["status"] == "EXACT", report["first_divergence"]
+
+
+# ---------------------------------------------------------------------------
+# Torn artifacts
+# ---------------------------------------------------------------------------
+
+class TestTornArtifact:
+    def _record(self, toy, tmp_path, seed=1):
+        model, params = toy
+        cluster = _chaos_cluster(model, params, tmp_path, seed)
+        _submit_mix(cluster, seed)
+        cluster.drain()
+        return os.path.join(str(tmp_path), REPLAY_FILE)
+
+    @pytest.mark.parametrize("keep", (0.0, 0.3, 0.7))
+    def test_truncated_recording_is_incomplete_not_a_crash(
+            self, toy, tmp_path, keep):
+        path = self._record(toy, tmp_path)
+        data = open(path).read()
+        with open(path, "w") as f:
+            # Cut mid-file AND mid-line: the torn tail must salvage.
+            f.write(data[:int(len(data) * keep)])
+        report = replay_run(tmp_path)
+        assert report["status"] == "INCOMPLETE"
+        assert report["problems"]
+        assert report["first_divergence"] is None
+        for level in report["levels"].values():
+            assert level == {"compared": 0, "divergences": 0}
+
+    def test_missing_meta_is_incomplete(self, toy, tmp_path):
+        path = self._record(toy, tmp_path)
+        lines = open(path).read().splitlines(True)
+        with open(path, "w") as f:
+            f.writelines(lines[1:])          # drop the meta row
+        report = replay_run(tmp_path)
+        assert report["status"] == "INCOMPLETE"
+        assert any("meta" in p for p in report["problems"])
+
+    def test_mid_run_flush_reports_open_requests(self, toy,
+                                                 tmp_path):
+        """A flush taken while requests were still open is a partial
+        run — `validate_replay` names it instead of replaying a
+        truncated workload as if it were the whole incident."""
+        model, params = toy
+        cluster = _chaos_cluster(model, params, tmp_path, seed=3)
+        _submit_mix(cluster, 3)
+        cluster.step()
+        cluster._recorder.flush(list(cluster._lineage_ids),
+                                cluster._open)
+        problems = validate_replay(load_replay(tmp_path))
+        assert any("still open" in p for p in problems)
+
+    def test_replay_clock_survives_exhaustion(self):
+        """Past the recorded stream the clock degrades to virtual
+        time, so a replay driven off a torn log still terminates."""
+        clk = ReplayClock([1.0, 2.0])
+        assert clk() == 1.0 and clk() == 2.0
+        assert clk.exhausted
+        t = clk()
+        clk.advance(0.5)
+        assert clk() == t + 0.5
+        # Monotonic guard: injected readings never run time backward.
+        clk.inject(0.0)
+        assert clk() >= t + 0.5
+
+
+# ---------------------------------------------------------------------------
+# Counterfactuals
+# ---------------------------------------------------------------------------
+
+class TestCounterfactual:
+    @pytest.fixture()
+    def recorded(self, toy, tmp_path):
+        model, params = toy
+        cluster = _chaos_cluster(model, params, tmp_path, seed=7)
+        _submit_mix(cluster, 7)
+        cluster.drain()
+        return tmp_path, model, params
+
+    def test_suppress_fault_names_first_divergence(self, recorded):
+        d, model, params = recorded
+        faults = [r for r in load_replay(d)
+                  if r.get("kind") == "fault_injected"]
+        assert faults, "seed 7 must inject at least one fault"
+        idx = int(faults[0]["index"])
+        report = replay_run(d, model=model, params=params,
+                            override={"suppress_fault": idx})
+        cf = report["counterfactual"]
+        assert cf["override"] == {"suppress_fault": idx}
+        assert cf["fault"]["fault"] == faults[0]["fault"]
+        assert cf["fault"]["target"] == faults[0]["target"]
+        if report["status"] == "DIVERGED":
+            fd = report["first_divergence"]
+            assert fd["level"] in ("decisions", "hops", "tokens")
+            assert isinstance(fd["index"], int)
+        clause = causality_clause(cf)
+        assert clause.startswith(
+            f"without the {faults[0]['fault']} fault on "
+            f"{faults[0]['target']}")
+
+    def test_pin_route_clause(self, recorded):
+        d, model, params = recorded
+        report = replay_run(d, model=model, params=params,
+                            override={"pin_route": 0})
+        clause = causality_clause(report["counterfactual"])
+        assert clause.startswith("with routing pinned to replica 0")
+
+    def test_stretch_step_clause(self, recorded):
+        d, model, params = recorded
+        report = replay_run(
+            d, model=model, params=params,
+            override={"stretch_step": {"replica": 0, "k": 1,
+                                       "factor": 50.0}})
+        clause = causality_clause(report["counterfactual"])
+        assert clause.startswith(
+            "with replica 0's step 1 stretched x50.0")
+
+    def test_appended_verdict_reaches_the_doctor(self, recorded):
+        """`append_counterfactual` + `diagnose`: the causality
+        clause lands in the report verdict (the `doctor --replay`
+        contract, without the CLI)."""
+        d, model, params = recorded
+        faults = [r for r in load_replay(d)
+                  if r.get("kind") == "fault_injected"]
+        report = replay_run(
+            d, model=model, params=params,
+            override={"suppress_fault": int(faults[0]["index"])})
+        append_counterfactual(d, report["counterfactual"])
+        rows = load_replay(d)
+        assert not validate_replay(rows)     # still COMPLETE
+        from triton_distributed_tpu.observability.doctor import (
+            diagnose)
+        doc = diagnose([str(d)])
+        assert doc["replay"]["status"] == "COMPLETE"
+        assert doc["replay"]["counterfactuals"]
+        assert "counterfactually," in doc["verdict"]
+
+    def test_baseline_replay_of_itself_never_diverges(self,
+                                                      recorded):
+        """Replaying twice (no override) is EXACT both times —
+        counterfactual divergence is attributable to the override,
+        not to replay instability."""
+        d, model, params = recorded
+        for _ in range(2):
+            report = replay_run(d, model=model, params=params)
+            assert report["status"] == "EXACT", (
+                report["first_divergence"])
+
+
+# ---------------------------------------------------------------------------
+# Golden discipline
+# ---------------------------------------------------------------------------
+
+class TestGoldenDiscipline:
+    def test_unarmed_run_records_nothing(self, toy, tmp_path):
+        model, params = toy
+        art = tmp_path / "art"
+        cfg = ClusterConfig(
+            n_replicas=2,
+            scheduler=SchedulerConfig(num_slots=2,
+                                      prefill_buckets=(8, 16)),
+            artifact_dir=str(art))
+        cluster = ServingCluster(model, params, cfg)
+        cluster.submit([1, 2, 3], 4, seed=0)
+        cluster.drain()
+        cluster.write_artifact(str(art))
+        assert cluster._recorder is None
+        assert not os.path.exists(art / REPLAY_FILE)
+
+    def test_empty_record_dir_disarms_over_env(self, toy, tmp_path,
+                                               monkeypatch):
+        """``record_dir=""`` beats ``TDT_REPLAY_DIR`` — the replay
+        cluster's own guarantee that it never re-records itself."""
+        monkeypatch.setenv("TDT_REPLAY_DIR", str(tmp_path / "env"))
+        model, params = toy
+        cfg = ClusterConfig(
+            n_replicas=2,
+            scheduler=SchedulerConfig(num_slots=2,
+                                      prefill_buckets=(8, 16)),
+            record_dir="")
+        cluster = ServingCluster(model, params, cfg)
+        assert cluster._recorder is None
+        assert not os.path.exists(tmp_path / "env")
+
+    def test_env_var_arms_recording(self, toy, tmp_path,
+                                    monkeypatch):
+        monkeypatch.setenv("TDT_REPLAY_DIR", str(tmp_path))
+        model, params = toy
+        cfg = ClusterConfig(
+            n_replicas=2,
+            scheduler=SchedulerConfig(num_slots=2,
+                                      prefill_buckets=(8, 16)),
+            record_params_seed=3)
+        cluster = ServingCluster(model, params, cfg)
+        assert cluster._recorder is not None
+        cluster.submit([1, 2, 3], 4, seed=0)
+        cluster.drain()
+        assert os.path.exists(tmp_path / REPLAY_FILE)
+        status = replay_status()
+        assert status["armed"] and status["flushes"] >= 1
+
+    def test_replay_does_not_pollute_an_armed_recorder(self, toy,
+                                                       tmp_path):
+        """A replay in a process that still holds an armed recorder
+        must not leak the replay's decisions into the recording."""
+        model, params = toy
+        cluster = _chaos_cluster(model, params, tmp_path / "a",
+                                 seed=4)
+        _submit_mix(cluster, 4)
+        cluster.drain()
+        rows_before = len(load_replay(tmp_path / "a"))
+        report = replay_run(tmp_path / "a", model=model,
+                            params=params)
+        assert report["status"] == "EXACT"
+        # The armed recorder's decision tap was detached during the
+        # replay and restored after: re-flushing now must not have
+        # grown by the replay's own decision stream.
+        cluster._recorder.flush(list(cluster._lineage_ids), 0)
+        assert len(load_replay(tmp_path / "a")) == rows_before
+
+
+# ---------------------------------------------------------------------------
+# The consolidated JSONL loader (observability/jsonl.py)
+# ---------------------------------------------------------------------------
+
+class TestConsolidatedLoader:
+    def test_salvage_and_filters(self, tmp_path):
+        p = tmp_path / "rows.jsonl"
+        p.write_text(
+            json.dumps({"kind": "a", "ts": 2.0}) + "\n"
+            + "\n"                                   # blank: skipped
+            + "[1, 2]\n"                             # non-dict: torn
+            + json.dumps({"kind": "b", "ts": 1.0}) + "\n"
+            + '{"kind": "a", "ts"')                  # torn tail
+        with pytest.warns(RuntimeWarning, match="salvaged"):
+            rows = load_jsonl_rows(str(p), sort_key=tolerant_ts)
+        assert [r["kind"] for r in rows] == ["b", "a"]
+        assert load_jsonl_rows(str(p), kind="a") == [
+            {"kind": "a", "ts": 2.0}]
+        assert load_jsonl_rows(
+            str(p), predicate=lambda d: d["ts"] < 1.5) == [
+            {"kind": "b", "ts": 1.0}]
+
+    def test_unopenable_file_contributes_nothing(self, tmp_path):
+        assert load_jsonl_rows(str(tmp_path / "missing.jsonl")) == []
+
+    def test_tolerant_ts_degrades_to_zero(self):
+        assert tolerant_ts({"ts": "7.5"}) == 7.5
+        assert tolerant_ts({"ts": "not-a-ts"}) == 0.0
+        assert tolerant_ts({}) == 0.0
+
+    def test_legacy_loaders_share_the_salvage_contract(self,
+                                                       tmp_path):
+        """The five historical loaders delegate here: same torn-line
+        salvage, same row filters."""
+        from triton_distributed_tpu.observability.feedback import (
+            load_decisions)
+        from triton_distributed_tpu.serving.cluster.chaos import (
+            load_faults)
+        p = tmp_path / "mixed.jsonl"
+        p.write_text(
+            json.dumps({"kind": "fault", "ts": 0.2, "fault": "drop",
+                        "target": "shipment:1", "inputs": {}}) + "\n"
+            + json.dumps({"kind": "decision", "ts": 0.1,
+                          "consumer": "router", "op": "place",
+                          "choice": "replica-0"}) + "\n"
+            + '{"torn": ')
+        with pytest.warns(RuntimeWarning):
+            faults = load_faults([str(p)])
+        assert [f["fault"] for f in faults] == ["drop"]
+        decisions = load_decisions([str(p)])
+        assert [d["consumer"] for d in decisions] == ["router"]

@@ -142,6 +142,7 @@ class Artifacts:
         self.lineage_costs: List[dict] = []
         self.slo_state: Optional[dict] = None
         self.timeseries: List[dict] = []
+        self.replay: List[dict] = []
         self._discover()
 
     def _glob(self, pattern: str) -> List[str]:
@@ -216,6 +217,13 @@ class Artifacts:
             from triton_distributed_tpu.observability.timeseries \
                 import load_timeseries
             self.timeseries = load_timeseries(ts_files)
+        replay_files = self._glob("replay.jsonl")
+        if replay_files:
+            from triton_distributed_tpu.observability.jsonl import (
+                load_jsonl_rows)
+            # File order preserved — the row stream IS the recorded
+            # log (sorting would scramble the clock chunks).
+            self.replay = load_jsonl_rows(replay_files)
 
     def empty(self) -> bool:
         # A router artifact alone is an incident report's worth of
@@ -229,7 +237,7 @@ class Artifacts:
         return not (self.traces or self.flights or self.heartbeats
                     or self.metrics or self.router or self.faults
                     or self.lineage or self.slo_state
-                    or self.timeseries)
+                    or self.timeseries or self.replay)
 
     def ranks(self) -> List[int]:
         from triton_distributed_tpu.observability.timeline import (
@@ -250,6 +258,9 @@ class Artifacts:
             ts.append(_num(fv.get("ts")))
         for lv in self.lineage:
             ts.append(_num(lv.get("ts")))
+        for rv in self.replay:
+            if rv.get("kind") in ("fault_injected", "hop"):
+                ts.append(_num(rv.get("ts")))
         for fl in self.flights.values():
             ts.append(float(fl.get("unix_time", 0.0)))
             for ev in fl.get("events", []):
@@ -745,6 +756,46 @@ def analyze_lineage(art: Artifacts, now: float) -> Optional[dict]:
     return out
 
 
+def analyze_replay(art: Artifacts) -> Optional[dict]:
+    """Summarize the deterministic record-&-replay artifact
+    (``replay.jsonl``, `observability.replay`): completeness, what
+    was captured, and any counterfactual verdicts a previous
+    ``doctor --replay`` (or `replay_run` caller) appended — each
+    rendered as the causality clause the verdict quotes.  This pass
+    only READS the artifact; live re-execution is the CLI's
+    ``--replay`` mode."""
+    if not art.replay:
+        return None
+    from triton_distributed_tpu.observability.replay import (
+        causality_clause, validate_replay)
+    problems = validate_replay(art.replay)
+    by_kind: Dict[str, int] = {}
+    for r in art.replay:
+        k = str(r.get("kind"))
+        by_kind[k] = by_kind.get(k, 0) + 1
+    clock_readings = sum(len(r.get("t") or []) for r in art.replay
+                         if r.get("kind") == "clock")
+    counterfactuals = []
+    for r in art.replay:
+        if r.get("kind") != "counterfactual":
+            continue
+        counterfactuals.append({
+            "override": r.get("override"),
+            "first_divergence": r.get("first_divergence"),
+            "clause": causality_clause(r),
+        })
+    return {
+        "status": "INCOMPLETE" if problems else "COMPLETE",
+        "problems": problems,
+        "rows": len(art.replay),
+        "clock_readings": clock_readings,
+        "requests": by_kind.get("submit", 0),
+        "faults": by_kind.get("fault_injected", 0),
+        "wire_events": by_kind.get("wire", 0),
+        "counterfactuals": counterfactuals,
+    }
+
+
 def analyze_slo(art: Artifacts) -> Optional[dict]:
     """Ingest ``slo-state.json`` (`observability.slo`) into the
     report: per-class compliance against objective, error budget
@@ -1043,6 +1094,11 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
     timeseries_out = analyze_timeseries(art)
     if timeseries_out is not None:
         report["timeseries"] = timeseries_out
+    # Record & replay: key absent without a replay.jsonl artifact —
+    # same golden discipline.
+    replay_out = analyze_replay(art)
+    if replay_out is not None:
+        report["replay"] = replay_out
     report["verdict"] = _verdict(report, in_flight)
     return report
 
@@ -1156,6 +1212,20 @@ def _verdict(report: dict, in_flight: Optional[dict]) -> str:
             t = max(rising, key=lambda t: t["run"])
             hot_s += (f"; {t['metric']} rose for {t['run']} straight "
                       f"samples (+{t['delta']}) into the incident")
+    # Counterfactual replay: the causality clause (clause only
+    # exists when a replay.jsonl artifact was ingested) — the
+    # verdict states what the incident would have looked like with
+    # one recorded input overridden.  A torn recording says so
+    # truthfully instead.
+    rpl = report.get("replay")
+    if rpl:
+        if rpl["status"] == "INCOMPLETE":
+            hot_s += ("; replay recording is INCOMPLETE ("
+                      + "; ".join(rpl["problems"])
+                      + ") — the run cannot be re-executed")
+        for c in rpl.get("counterfactuals", []):
+            if c.get("clause"):
+                hot_s += f"; counterfactually, {c['clause']}"
     if stall["first_stalled_rank"] is not None:
         r = stall["first_stalled_rank"]
         what = (f" inside {stall['open_span']!r}"
@@ -1487,6 +1557,20 @@ def render_markdown(report: dict) -> str:
                       for t in tser["trends"]]
         lines.append("")
 
+    rpl = report.get("replay")
+    if rpl:
+        lines += ["## Replay", "",
+                  f"Recording {rpl['status']}: {rpl['rows']} row(s) "
+                  f"— {rpl['clock_readings']} clock reading(s), "
+                  f"{rpl['requests']} request(s), "
+                  f"{rpl['wire_events']} wire event(s), "
+                  f"{rpl['faults']} fault injection(s)."]
+        if rpl.get("problems"):
+            lines += [f"- {p}" for p in rpl["problems"]]
+        for c in rpl.get("counterfactuals", []):
+            lines.append(f"- counterfactually, {c['clause']}")
+        lines.append("")
+
     hot = report["links"].get("hot") or []
     if hot:
         lines += ["## Hot ICI links", "",
@@ -1577,6 +1661,47 @@ def _parse_mesh(text):
     return axes
 
 
+def _replay_mode(dirs: Sequence[str]) -> Optional[int]:
+    """``--replay``: live re-execution of the first directory's
+    recording.  Asserts bit-exact parity; when the recording carries
+    injected faults, additionally re-executes with the first fault
+    suppressed and APPENDS the counterfactual verdict to the
+    artifact — the subsequent `diagnose` pass (and every later one
+    over the same directory) then quotes the causality clause.
+
+    Returns an exit code to stop with (4 = the replay itself
+    diverged, so no counterfactual is trustworthy), or None to
+    continue into the normal report."""
+    from triton_distributed_tpu.observability.replay import (
+        REPLAY_FILE, append_counterfactual, load_replay, replay_run)
+    target = next((d for d in dirs
+                   if os.path.exists(os.path.join(d, REPLAY_FILE))),
+                  None)
+    if target is None:
+        print(f"doctor: --replay found no {REPLAY_FILE} under "
+              f"{list(dirs)}", file=sys.stderr)
+        return 2
+    base = replay_run(target)
+    print(f"doctor: replay of {target} is {base['status']} "
+          f"({base['levels']})", file=sys.stderr)
+    if base["status"] == "INCOMPLETE":
+        return None          # diagnose reports the torn artifact
+    if base["status"] != "EXACT":
+        print("doctor: recorded run did not replay exactly — "
+              "counterfactuals would not be attributable "
+              f"(first divergence: {base['first_divergence']})",
+              file=sys.stderr)
+        return 4
+    faults = [r for r in load_replay(target)
+              if r.get("kind") == "fault_injected"]
+    if not faults:
+        return None
+    idx = int(faults[0].get("index", 0))
+    cf_run = replay_run(target, override={"suppress_fault": idx})
+    append_counterfactual(target, cf_run["counterfactual"])
+    return None
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m triton_distributed_tpu.observability.doctor",
@@ -1609,9 +1734,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--check", default=None, metavar="GOLDEN",
                     help="compare against a golden report JSON; exit "
                          "3 on drift (CI gate)")
+    ap.add_argument("--replay", action="store_true",
+                    help="re-execute the recorded run from "
+                         "replay.jsonl before diagnosing: assert "
+                         "bit-exact parity, then (when faults were "
+                         "recorded) counterfactually suppress the "
+                         "first one and append the causality verdict "
+                         "to the artifact, so the report's verdict "
+                         "names who to blame")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="suppress the markdown on stdout")
     args = ap.parse_args(argv)
+
+    if args.replay:
+        rc = _replay_mode(args.dirs)
+        if rc is not None:
+            return rc
 
     report = diagnose(args.dirs, kernel=args.kernel, mesh=args.mesh,
                       now=args.now, static=not args.no_static,

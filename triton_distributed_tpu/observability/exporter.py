@@ -190,6 +190,12 @@ class MetricsServer:
                     body = json.dumps(timeseries_table(),
                                       default=str).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/replay"):
+                    from triton_distributed_tpu.observability \
+                        .replay import replay_status
+                    body = json.dumps(replay_status(),
+                                      default=str).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return

@@ -50,7 +50,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from triton_distributed_tpu.observability.metrics import (
     observability_enabled,
@@ -389,6 +389,26 @@ class DecisionEvent:
 _RECENT: collections.deque = collections.deque(maxlen=RECENT_DECISIONS)
 _RECENT_LOCK = threading.Lock()
 
+#: In-process decision taps (`observability.replay.RunRecorder`
+#: registers one): every recorded decision is handed to each tap
+#: after it lands everywhere else.  Empty unless something armed a
+#: tap, so the untapped path costs one truthiness check.
+_TAPS: List[Callable[[DecisionEvent], None]] = []
+
+
+def add_decision_tap(fn: Callable[[DecisionEvent], None]) -> None:
+    """Register an in-process observer of every recorded decision
+    (the record/replay seam).  Idempotent per function object."""
+    if fn not in _TAPS:
+        _TAPS.append(fn)
+
+
+def remove_decision_tap(fn: Callable[[DecisionEvent], None]) -> None:
+    try:
+        _TAPS.remove(fn)
+    except ValueError:
+        pass
+
 _LOG_PATH: Optional[str] = None
 _LOG_EXPLICIT = False
 _LOG_LOCK = threading.Lock()
@@ -460,6 +480,9 @@ def record_decision(event: DecisionEvent) -> Optional[DecisionEvent]:
     with _RECENT_LOCK:
         _RECENT.append(event)
     _append_log(event)
+    if _TAPS:
+        for tap in list(_TAPS):
+            tap(event)
     return event
 
 
@@ -503,24 +526,9 @@ def validate_decision(d: dict) -> List[str]:
 def load_decisions(paths) -> List[dict]:
     """Parse decision lines from jsonl file(s), skipping torn lines
     (a rank killed mid-write must not break the doctor)."""
-    out: List[dict] = []
-    if isinstance(paths, str):
-        paths = [paths]
-    for path in paths:
-        try:
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        d = json.loads(line)
-                    except ValueError:
-                        continue
-                    if isinstance(d, dict) and "consumer" in d:
-                        out.append(d)
-        except OSError:
-            continue
-    out.sort(key=lambda d: (float(d.get("ts", 0.0)),
+    from triton_distributed_tpu.observability.jsonl import (
+        load_jsonl_rows)
+    return load_jsonl_rows(
+        paths, predicate=lambda d: "consumer" in d,
+        sort_key=lambda d: (float(d.get("ts", 0.0)),
                             int(d.get("rank", 0))))
-    return out

@@ -1,0 +1,101 @@
+"""One torn-line-tolerant JSONL reader for every artifact loader.
+
+Five loaders grew up as copy-pasted siblings — `chaos.load_faults`,
+`lineage.load_lineage` / `load_lineage_costs`,
+`feedback.load_decisions`, `timeseries.load_timeseries` — each with
+the same salvage contract: a rank killed mid-write (or a hand-edited
+artifact) must degrade to *fewer rows*, never to a crashed doctor.
+This module is that contract, once:
+
+- **salvage semantics**: an unopenable file contributes nothing
+  (``OSError`` → skip the file); a blank line is skipped; a torn or
+  malformed line (``json.loads`` failure, or a parsed non-dict) is
+  skipped; everything that parses and passes the row filter is kept;
+- **sorted torn rows**: callers pass their sort key (most use
+  :func:`tolerant_ts` — a row whose ``ts`` does not parse sorts to
+  0.0 instead of raising);
+- **warn-once**: the first torn line per file emits one
+  ``RuntimeWarning`` naming the file (forensics should say the
+  artifact was damaged), and never more — a thousand torn tails must
+  not flood a doctor run.
+
+The replay loader (`observability.replay.load_replay`) is built
+directly on this; the five legacy loaders delegate here with their
+exact historical filter/sort semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+from typing import Callable, List, Optional
+
+#: Files already warned about this process (warn-once discipline).
+_WARNED: set = set()
+
+
+def tolerant_ts(d: dict) -> float:
+    """Sort key for artifact rows: ``float(ts)`` with damaged values
+    degrading to 0.0 (a hand-edited or torn row must sort, not
+    raise)."""
+    try:
+        return float(d.get("ts", 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _warn_torn(path: str, n_torn: int) -> None:
+    if path in _WARNED:
+        return
+    _WARNED.add(path)
+    warnings.warn(
+        f"jsonl: {n_torn} torn/malformed line(s) salvaged from "
+        f"{path} (kept every parseable row)", RuntimeWarning,
+        stacklevel=3)
+
+
+def load_jsonl_rows(paths,
+                    kind: Optional[str] = None,
+                    predicate: Optional[Callable[[dict], bool]] = None,
+                    sort_key: Optional[Callable[[dict], object]] = None,
+                    ) -> List[dict]:
+    """Parse dict rows from jsonl file(s) with salvage semantics.
+
+    ``kind`` keeps only rows with ``row["kind"] == kind``;
+    ``predicate`` is an arbitrary row filter (both may be combined).
+    ``sort_key`` sorts the merged rows stably (pass
+    :func:`tolerant_ts` for the usual timestamp order); None keeps
+    file/input order — exactly the knobs the five legacy loaders
+    differed in.
+    """
+    out: List[dict] = []
+    if isinstance(paths, str):
+        paths = [paths]
+    for path in paths:
+        torn = 0
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        d = json.loads(line)
+                    except ValueError:
+                        torn += 1
+                        continue
+                    if not isinstance(d, dict):
+                        torn += 1
+                        continue
+                    if kind is not None and d.get("kind") != kind:
+                        continue
+                    if predicate is not None and not predicate(d):
+                        continue
+                    out.append(d)
+        except OSError:
+            continue
+        if torn:
+            _warn_torn(str(path), torn)
+    if sort_key is not None:
+        out.sort(key=sort_key)
+    return out

@@ -165,33 +165,10 @@ def load_lineage(paths) -> List[dict]:
     """Parse lineage lines from jsonl file(s), skipping torn lines (a
     rank killed mid-write must not break the doctor).  Rows sort by
     (ts, stable input order)."""
-    out: List[dict] = []
-    if isinstance(paths, str):
-        paths = [paths]
-    for path in paths:
-        try:
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        d = json.loads(line)
-                    except ValueError:
-                        continue
-                    if (isinstance(d, dict)
-                            and d.get("kind") == "lineage"):
-                        out.append(d)
-        except OSError:
-            continue
-
-    def ts(d):
-        try:
-            return float(d.get("ts", 0.0))
-        except (TypeError, ValueError):
-            return 0.0
-    out.sort(key=ts)
-    return out
+    from triton_distributed_tpu.observability.jsonl import (
+        load_jsonl_rows, tolerant_ts)
+    return load_jsonl_rows(paths, kind="lineage",
+                           sort_key=tolerant_ts)
 
 
 # ---------------------------------------------------------------------------
@@ -458,25 +435,9 @@ def load_lineage_costs(paths) -> List[dict]:
     """The ``kind="cost"`` join rows `write_lineage_artifact` appends
     (empty for pre-cost artifacts), torn-line tolerant like
     `load_lineage`."""
-    out: List[dict] = []
-    if isinstance(paths, str):
-        paths = [paths]
-    for path in paths:
-        try:
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        d = json.loads(line)
-                    except ValueError:
-                        continue
-                    if isinstance(d, dict) and d.get("kind") == "cost":
-                        out.append(d)
-        except OSError:
-            continue
-    return out
+    from triton_distributed_tpu.observability.jsonl import (
+        load_jsonl_rows)
+    return load_jsonl_rows(paths, kind="cost")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,1128 @@
+"""Deterministic incident record & replay: capture every
+nondeterministic input at the cluster seams, re-execute any run
+bit-exactly, and counterfactually bisect blame.
+
+The virtual cluster (`serving.cluster`) is deterministic *given* its
+inputs: tokens are a pure function of (prompt, seed), step costs are
+modeled, fault schedules are seed-pure, and wire times derive from
+the injected clock.  The only nondeterminism crosses a handful of
+injectable seams — the clock, request arrivals, `SignalBus.read()`
+snapshots, and the one wall measurement on the decode hot path
+(`ContinuousBatchingScheduler.step_timer`).  :class:`RunRecorder`
+captures exactly those seams into a schema-v1 ``replay.jsonl``
+artifact beside router-state / faults / lineage, which is sufficient
+to re-execute the run bit-exactly:
+
+- ``clock`` rows: EVERY reading of the cluster clock, in order (the
+  one stream that *drives* replay — all other rows are validation);
+- ``submit`` rows: each request's arrival, prompt, seed, tenant —
+  plus the clock-read position it interleaved at, so replay aligns
+  arrivals decision-for-decision;
+- ``step`` / ``wire`` / ``fault_injected`` / ``decision`` /
+  ``bus_read`` / ``finish`` / ``hop`` rows: what the run DID at each
+  seam, the parity targets replay asserts against;
+- a ``meta`` row carrying everything needed to rebuild the cluster
+  (config, toy-model shape + params seed, fault-schedule state) and
+  an ``end`` row whose absence marks a torn artifact.
+
+:func:`replay_run` reconstructs the cluster on a
+:class:`ReplayClock` fed from the log and asserts three levels of
+parity: token-for-token streams, decision-for-decision
+``decisions.jsonl``, hop-for-hop lineage.  *Counterfactual* replay
+re-executes with one recorded input overridden — suppress a fault
+(``{"suppress_fault": i}``), pin every route
+(``{"pin_route": replica_id}``), stretch a step
+(``{"stretch_step": {"replica": r, "k": n, "factor": f}}``) — and
+the divergence report names the first decision/token/hop that
+differs; :func:`causality_clause` renders it into the doctor's
+verdict ("without the drop fault on shipment 12, request 7's TTFT is
+8.1 ms not 20.0 ms").
+
+Golden discipline: nothing records, counts, or writes unless armed
+via ``ClusterConfig.record_dir`` or ``TDT_REPLAY_DIR`` — an unarmed
+run is byte-identical.  ``record_dir=""`` explicitly DISARMS (replay
+clusters use it so the env var can never re-arm recording inside a
+replay).
+
+Known limits (documented, never silent): a live run whose
+ship-vs-recompute model engaged through a `BaselineStore`-backed bus
+replays with the model disengaged (the store is not serialized —
+``bus_read`` rows carry ``has_store`` so the divergence is
+attributable), and only ``ClusterConfig.bus`` reads are recorded
+(the ambient closed-loop bus is not wrapped).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+REPLAY_SCHEMA = 1
+REPLAY_FILE = "replay.jsonl"
+ENV_REPLAY_DIR = "TDT_REPLAY_DIR"
+
+#: Clock readings batched per ``clock`` row (a chaos run reads the
+#: clock thousands of times; one jsonl line per reading would dwarf
+#: every other artifact).  JSON float round-trip is exact.
+CLOCK_CHUNK = 512
+
+#: Row kinds a replay.jsonl artifact may carry.
+REPLAY_KINDS = ("meta", "clock", "submit", "step", "wire",
+                "fault_injected", "decision", "bus_read", "bus_clock",
+                "finish", "hop", "end", "counterfactual")
+
+
+def _count_metric():
+    # Lazy metrics import (the doctor imports this module without
+    # jax/serving); call sites invoke `count_metric` by name so the
+    # docs scraper (`scripts/gen_metrics_reference.py`) sees them.
+    from triton_distributed_tpu.observability.metrics import (
+        count_metric)
+    return count_metric
+
+
+# ---------------------------------------------------------------------------
+# Recording
+# ---------------------------------------------------------------------------
+
+class RunRecorder:
+    """Captures one cluster run's nondeterministic inputs into
+    ``<directory>/replay.jsonl``.
+
+    The `ServingCluster` constructs one when armed, wraps its clock
+    through :meth:`wrap` BEFORE building replicas (construction
+    readings must land in the log — replay construction consumes
+    them symmetrically), and wires the seam taps
+    (:meth:`on_transport`, :meth:`on_fault`, :meth:`on_decision`).
+    Rows buffer in memory; :meth:`flush` (re)writes the artifact
+    atomically — called from ``write_artifact`` and at ``drain``
+    end, so mid-run failover artifacts carry a complete prefix of
+    the log.
+    """
+
+    def __init__(self, directory: str):
+        self.directory = str(directory)
+        self._rows: List[dict] = []
+        self._clock_buf: List[float] = []
+        self._clock_seq = 0
+        #: Global count of recorded clock readings — the ``pos``
+        #: coordinate submit rows align replay arrivals by.
+        self.clock_reads = 0
+        self._meta: Optional[dict] = None
+        self.flushes = 0
+        self._bus_wrap: Optional["_RecordingBus"] = None
+        self._decision_tap_armed = False
+        global _CURRENT_RECORDER
+        _CURRENT_RECORDER = weakref.ref(self)
+
+    # -- the clock seam --------------------------------------------------
+
+    def wrap(self, clock):
+        """Wrap the cluster clock: every reading is recorded, in
+        order.  The chunk flush happens BEFORE appending, so the
+        newest reading is always still in the buffer when
+        :meth:`record_submit` pops it back off."""
+        def reading() -> float:
+            t = float(clock())
+            if len(self._clock_buf) >= CLOCK_CHUNK:
+                self._flush_clock()
+            self._clock_buf.append(t)
+            self.clock_reads += 1
+            return t
+        return reading
+
+    def _flush_clock(self) -> None:
+        if not self._clock_buf:
+            return
+        self._rows.append({"schema": REPLAY_SCHEMA, "kind": "clock",
+                           "seq": self._clock_seq,
+                           "t": self._clock_buf})
+        self._clock_buf = []
+        self._clock_seq += 1
+        count_metric = _count_metric()
+        count_metric("replay_rows_recorded_total")
+
+    def _row(self, kind: str, **fields) -> None:
+        # Non-clock rows flush the pending readings first so file
+        # order stays chronological (replay only needs ``pos``, but
+        # a human reading the log should see it interleaved).
+        self._flush_clock()
+        row = {"schema": REPLAY_SCHEMA, "kind": kind}
+        row.update(fields)
+        self._rows.append(row)
+        count_metric = _count_metric()
+        count_metric("replay_rows_recorded_total")
+
+    # -- meta ------------------------------------------------------------
+
+    def record_meta(self, cluster, model) -> None:
+        """Everything replay needs to rebuild the cluster.  The
+        fault schedule's DERIVED state (window/victim/salt) is
+        recorded directly — reconstructing by seed alone would
+        re-run the construction RNG stream, which differs between
+        auto-sampled and explicit ``classes``."""
+        cfg = cluster.config
+        sched = dataclasses.asdict(cfg.scheduler)
+        # A drafter is an object/factory — not serializable.  Record
+        # presence; replay of drafter runs needs explicit model args.
+        had_drafter = sched.pop("spec_drafter", None) is not None
+        slo = None
+        if (cfg.slo_policy is not None
+                and dataclasses.is_dataclass(cfg.slo_policy)):
+            slo = dataclasses.asdict(cfg.slo_policy)
+        self._meta = {
+            "config": {
+                "n_replicas": cfg.n_replicas,
+                "n_prefill_workers": cfg.n_prefill_workers,
+                "step_time_s": cfg.step_time_s,
+                "prefill_time_s": cfg.prefill_time_s,
+                "wire_gbps": cfg.wire_gbps,
+                "ship_retry_base_s": cfg.ship_retry_base_s,
+                "ship_max_retries": cfg.ship_max_retries,
+                "ship_deadline_s": cfg.ship_deadline_s,
+                "prefix_ship_deadline_s": cfg.prefix_ship_deadline_s,
+                "timeseries_interval_s": cfg.timeseries_interval_s,
+                "timeseries_capacity": cfg.timeseries_capacity,
+                # Paths are machine state, presence is behavior: a
+                # live run with an artifact dir consumes extra clock
+                # readings per failover write, which replay must
+                # reproduce against a scratch directory.
+                "had_artifact_dir": bool(cfg.artifact_dir),
+                "has_bus": cfg.bus is not None,
+                "bus_staleness_s": (getattr(cfg.bus, "staleness_s",
+                                            None)
+                                    if cfg.bus is not None else None),
+                "had_drafter": had_drafter,
+                "scheduler": sched,
+                "router": dataclasses.asdict(cfg.router),
+                "slo_policy": slo,
+            },
+            "model": self._model_meta(model, cfg),
+            "faults": _schedule_state(cluster.injector),
+        }
+
+    @staticmethod
+    def _model_meta(model, cfg) -> dict:
+        mc = getattr(model, "config", None)
+        return {
+            "class": type(model).__name__,
+            "config": (dataclasses.asdict(mc)
+                       if dataclasses.is_dataclass(mc) else {}),
+            "params_seed": int(cfg.record_params_seed or 0),
+        }
+
+    # -- per-seam rows ---------------------------------------------------
+
+    def record_submit(self, record, consumed_clock: bool) -> None:
+        """One request arrival.  A ``submit(arrival_time=None)``
+        consumed one clock reading for its arrival — pop it back off
+        the buffer (``clk: 1``; replay re-injects it outside the
+        recorded stream) and stamp ``pos``: the global clock-read
+        count at submit time, the coordinate the replay driver
+        aligns this arrival at."""
+        fields = {
+            "rid": int(record.record_id),
+            "arrival": record.arrival_time,
+            "prompt": [int(t) for t in record.prompt],
+            "max_new": int(record.max_new_tokens),
+            "eos": [int(t) for t in record.eos_token_ids],
+            "seed": int(record.seed),
+            "tenant": str(record.tenant),
+        }
+        if consumed_clock and self._clock_buf:
+            self._clock_buf.pop()
+            self.clock_reads -= 1
+            fields["clk"] = 1
+        fields["pos"] = self.clock_reads
+        self._row("submit", **fields)
+
+    def record_step(self, rep, now: float) -> None:
+        """One executed replica step (its measured ``busy_until``
+        advance) — parity validation, not a replay driver input."""
+        self._row("step", replica=int(rep.id), now=float(now),
+                  dur=float(rep.last_step_s),
+                  busy_until=float(rep.busy_until))
+
+    def record_finish(self, record) -> None:
+        """One record's terminal state — the token-for-token parity
+        target."""
+        self._row("finish", rid=int(record.record_id),
+                  state=record.state,
+                  tokens=[int(t) for t in record.tokens],
+                  finish_reason=record.finish_reason,
+                  reject_reason=record.reject_reason,
+                  t_first=record.t_first_token,
+                  t_last=record.t_last_token,
+                  t_finish=record.t_finish,
+                  arrival=record.arrival_time,
+                  replicas=list(record.replica_history),
+                  failovers=int(record.failovers))
+
+    # Seam taps — the cluster wires these onto the transport
+    # (``VirtualTransport.tap``), the injector
+    # (``FaultInjector.tap``) and the process decision stream
+    # (`feedback.add_decision_tap`).
+
+    def on_transport(self, event: dict) -> None:
+        self._row("wire", **event)
+
+    def on_fault(self, event, index: int) -> None:
+        self._row("fault_injected", index=int(index),
+                  fault=event.fault, target=event.target, ts=event.ts,
+                  inputs=dict(event.inputs))
+
+    def on_decision(self, event) -> None:
+        self._row("decision", consumer=event.consumer, op=event.op,
+                  choice=event.choice,
+                  candidates=list(event.candidates),
+                  inputs=dict(event.inputs), fallback=event.fallback)
+
+    def arm_decisions(self) -> None:
+        from triton_distributed_tpu.observability.feedback import (
+            add_decision_tap)
+        add_decision_tap(self.on_decision)
+        self._decision_tap_armed = True
+
+    def close(self) -> None:
+        """Unhook the process-global decision tap (instance taps die
+        with their owners)."""
+        if self._decision_tap_armed:
+            from triton_distributed_tpu.observability.feedback import (
+                remove_decision_tap)
+            remove_decision_tap(self.on_decision)
+            self._decision_tap_armed = False
+
+    def recording_bus(self, inner):
+        """The bus wrapper `ServingCluster._signal_bus` hands out
+        when recording: delegates, records every ``read()`` snapshot
+        and ``clock()`` reading (the bus runs its OWN clock — those
+        readings must not land in the cluster clock stream)."""
+        if self._bus_wrap is None or self._bus_wrap._inner is not inner:
+            self._bus_wrap = _RecordingBus(inner, self)
+        return self._bus_wrap
+
+    # -- artifact --------------------------------------------------------
+
+    def flush(self, lineage_ids=None, open_requests: int = 0) -> str:
+        """(Re)write ``replay.jsonl`` atomically: meta, every row so
+        far, the lineage hop rows (pulled fresh each flush — lineage
+        grows), and the ``end`` row whose absence marks a torn
+        artifact.  ``open`` > 0 in the end row marks a mid-run
+        flush."""
+        self._flush_clock()
+        os.makedirs(self.directory, exist_ok=True)
+        path = os.path.join(self.directory, REPLAY_FILE)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        hops = self._hop_rows(lineage_ids)
+        with open(tmp, "w") as f:
+            f.write(json.dumps(
+                {"schema": REPLAY_SCHEMA, "kind": "meta",
+                 **(self._meta or {})}, default=str) + "\n")
+            for row in self._rows:
+                f.write(json.dumps(row, default=str) + "\n")
+            for row in hops:
+                f.write(json.dumps(row, default=str) + "\n")
+            f.write(json.dumps(
+                {"schema": REPLAY_SCHEMA, "kind": "end",
+                 "clock_reads": self.clock_reads,
+                 "rows": len(self._rows) + len(hops),
+                 "open": int(open_requests)}, default=str) + "\n")
+        os.replace(tmp, path)
+        self.flushes += 1
+        count_metric = _count_metric()
+        count_metric("replay_artifacts_written_total")
+        return path
+
+    @staticmethod
+    def _hop_rows(lineage_ids) -> List[dict]:
+        if not lineage_ids:
+            return []
+        from triton_distributed_tpu.observability.lineage import (
+            get_lineage_recorder)
+        rec = get_lineage_recorder()
+        rows: List[dict] = []
+        for rid in lineage_ids:
+            for e in rec.events_for(rid):
+                rows.append({"schema": REPLAY_SCHEMA, "kind": "hop",
+                             "rid": rid, "hop": e.hop, "ts": e.ts,
+                             "actor": e.actor,
+                             "detail": dict(e.detail)})
+        return rows
+
+
+class _RecordingBus:
+    """Recording delegate for ``ClusterConfig.bus``: same interface
+    the cluster consumes (``read`` / ``clock`` / ``staleness_s``)."""
+
+    def __init__(self, inner, recorder: RunRecorder):
+        self._inner = inner
+        self._recorder = recorder
+        self.staleness_s = float(getattr(inner, "staleness_s", 10.0))
+
+    def clock(self) -> float:
+        t = float(self._inner.clock())
+        self._recorder._row("bus_clock", t=t)
+        return t
+
+    def read(self, now=None):
+        sig = self._inner.read(now)
+        self._recorder._row(
+            "bus_read", ts=float(sig.ts),
+            link_utilization=dict(sig.link_utilization),
+            contended=list(sig.contended_links),
+            gauges=dict(sig.gauges),
+            has_store=sig.store is not None)
+        return sig
+
+
+_CURRENT_RECORDER: Optional["weakref.ref[RunRecorder]"] = None
+
+
+def current_recorder() -> Optional[RunRecorder]:
+    ref = _CURRENT_RECORDER
+    return ref() if ref is not None else None
+
+
+def replay_status() -> dict:
+    """The ``/replay`` endpoint body — recording state of the newest
+    armed recorder, or the disarmed shape (the endpoint must answer
+    either way)."""
+    r = current_recorder()
+    if r is None:
+        return {"schema": REPLAY_SCHEMA, "armed": False}
+    return {"schema": REPLAY_SCHEMA, "armed": True,
+            "directory": r.directory,
+            "clock_reads": r.clock_reads,
+            "rows": len(r._rows),
+            "pending_clock": len(r._clock_buf),
+            "flushes": r.flushes}
+
+
+def _schedule_state(injector) -> Optional[dict]:
+    """Serializable state of an injector's fault schedule (None for
+    the all-faults-off schedule — replay then builds a bare
+    injector)."""
+    s = injector.schedule
+    if not s.classes:
+        return None
+    return {"seed": s.seed, "classes": list(s.classes),
+            "ship_fault_rate": s.ship_fault_rate,
+            "flap_factor": s.flap_factor, "skew_s": s.skew_s,
+            "reorder_delay_s": s.reorder_delay_s,
+            "max_faults": s.max_faults,
+            "window": list(s.window), "victim": s.victim,
+            "salt": s._salt}
+
+
+# ---------------------------------------------------------------------------
+# Loading / validation
+# ---------------------------------------------------------------------------
+
+def load_replay(path) -> List[dict]:
+    """Parse replay rows from a ``replay.jsonl`` (or the directory
+    holding one), skipping torn lines.  FILE ORDER IS PRESERVED —
+    the row stream is the log; sorting would scramble the clock."""
+    from triton_distributed_tpu.observability.jsonl import (
+        load_jsonl_rows)
+    if os.path.isdir(path):
+        path = os.path.join(path, REPLAY_FILE)
+    return load_jsonl_rows(path)
+
+
+def validate_replay(rows) -> List[str]:
+    """Completeness/schema check; non-empty = the artifact cannot
+    drive a replay (torn log → truthful INCOMPLETE, never a crash).
+    ``counterfactual`` rows appended after ``end`` are legal."""
+    problems: List[str] = []
+    if not rows:
+        return ["empty artifact"]
+    if rows[0].get("kind") != "meta":
+        problems.append("missing meta row")
+    if not any(r.get("kind") == "end" for r in rows):
+        problems.append("missing end row (torn artifact)")
+    for r in rows:
+        if r.get("schema") != REPLAY_SCHEMA:
+            problems.append(f"schema {r.get('schema')!r} != "
+                            f"{REPLAY_SCHEMA}")
+            break
+    end = next((r for r in rows if r.get("kind") == "end"), None)
+    if end is not None and int(end.get("open") or 0) > 0:
+        problems.append(f"partial run: {end['open']} request(s) "
+                        "still open at flush")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# The replay clock
+# ---------------------------------------------------------------------------
+
+class ReplayClock:
+    """Feeds recorded clock readings back in order.
+
+    ``inject(t)`` queues a reading served BEFORE the recorded stream
+    without counting toward ``consumed`` — how the replay driver
+    hands a ``clk``-submit its popped arrival reading back.  After
+    the stream is exhausted (torn log, or the tail past the last
+    flush) the clock degrades to plain virtual time so the event
+    loop still terminates: ``advance`` is a no-op while readings
+    remain (the stream IS the timeline) and moves virtual time after.
+    A monotonic guard clamps every reading to never run backward.
+    """
+
+    def __init__(self, readings):
+        self._readings = [float(t) for t in readings]
+        self._i = 0
+        #: Recorded readings served so far — the replay driver's
+        #: alignment coordinate against submit-row ``pos``.
+        self.consumed = 0
+        self._inject: collections.deque = collections.deque()
+        self._last = self._readings[0] if self._readings else 0.0
+        self._vt: Optional[float] = None
+
+    @property
+    def exhausted(self) -> bool:
+        return self._i >= len(self._readings)
+
+    def inject(self, t: float) -> None:
+        self._inject.append(float(t))
+
+    def __call__(self) -> float:
+        if self._inject:
+            t = self._inject.popleft()
+        elif not self.exhausted:
+            t = self._readings[self._i]
+            self._i += 1
+            self.consumed += 1
+        else:
+            if self._vt is None:
+                self._vt = self._last
+            t = self._vt
+        t = max(t, self._last)
+        self._last = t
+        return t
+
+    def advance(self, dt: float) -> None:
+        if self.exhausted and not self._inject:
+            if self._vt is None:
+                self._vt = self._last
+            self._vt += float(dt)
+
+
+# ---------------------------------------------------------------------------
+# Reconstruction
+# ---------------------------------------------------------------------------
+
+def _dc_kwargs(cls, d: dict) -> dict:
+    """Constructor kwargs for dataclass ``cls`` from a loaded dict:
+    unknown keys (schema drift) dropped, lists coerced to tuples
+    (configs use tuples; json has no tuples)."""
+    names = {f.name for f in dataclasses.fields(cls)}
+    return {k: (tuple(v) if isinstance(v, list) else v)
+            for k, v in d.items() if k in names}
+
+
+def _rebuild_model(m: dict):
+    if m.get("class") != "ToyModel":
+        raise ValueError(
+            f"cannot rebuild model {m.get('class')!r} from meta; "
+            "pass model= and params= to replay_run")
+    import jax
+    from triton_distributed_tpu.serving.toy import ToyConfig, ToyModel
+    model = ToyModel(ToyConfig(**_dc_kwargs(ToyConfig,
+                                            m.get("config") or {})))
+    params = model.init_params(
+        jax.random.PRNGKey(int(m.get("params_seed") or 0)))
+    return model, params
+
+
+def _rebuild_injector(faults: Optional[dict], suppress=None):
+    from triton_distributed_tpu.serving.cluster.chaos import (
+        FaultInjector, FaultSchedule)
+    if faults is None:
+        sched = FaultSchedule.none()
+    else:
+        sched = FaultSchedule(
+            seed=faults.get("seed"),
+            classes=tuple(faults.get("classes") or ()),
+            ship_fault_rate=float(faults.get("ship_fault_rate", 0.3)),
+            flap_factor=float(faults.get("flap_factor", 50.0)),
+            skew_s=float(faults.get("skew_s", 0.05)),
+            reorder_delay_s=float(faults.get("reorder_delay_s",
+                                             0.02)),
+            max_faults=int(faults.get("max_faults", 32)))
+        # Derived state is restored verbatim — reconstruction by seed
+        # alone would replay the construction RNG differently for
+        # auto-sampled vs explicit classes.
+        sched.window = tuple(faults.get("window") or sched.window)
+        sched.victim = int(faults.get("victim", sched.victim))
+        sched._salt = int(faults.get("salt", sched._salt))
+    if suppress is not None:
+        return _CounterfactualInjector(sched, int(suppress))
+    return FaultInjector(sched)
+
+
+def _rebuild_config(mc: dict, bus, scratch_dir: Optional[str]):
+    from triton_distributed_tpu.serving.cluster.cluster import (
+        ClusterConfig)
+    from triton_distributed_tpu.serving.cluster.router import (
+        RouterConfig)
+    from triton_distributed_tpu.serving.scheduler import (
+        SchedulerConfig)
+    sched = SchedulerConfig(**_dc_kwargs(SchedulerConfig,
+                                         mc.get("scheduler") or {}))
+    router = RouterConfig(**_dc_kwargs(RouterConfig,
+                                       mc.get("router") or {}))
+    slo = None
+    if mc.get("slo_policy"):
+        from triton_distributed_tpu.observability.slo import (
+            SLOClass, SLOPolicy)
+        sp = mc["slo_policy"]
+        slo = SLOPolicy(
+            classes=tuple(SLOClass(**_dc_kwargs(SLOClass, c))
+                          for c in sp.get("classes") or ()),
+            tenant_class=dict(sp.get("tenant_class") or {}),
+            default_class=sp.get("default_class"),
+            windows=tuple(sp.get("windows") or (60.0, 300.0)),
+            burn_alert_threshold=float(
+                sp.get("burn_alert_threshold", 2.0)))
+    return ClusterConfig(
+        n_replicas=int(mc.get("n_replicas", 2)),
+        n_prefill_workers=int(mc.get("n_prefill_workers", 0)),
+        scheduler=sched, router=router,
+        step_time_s=float(mc.get("step_time_s", 1e-3)),
+        prefill_time_s=float(mc.get("prefill_time_s", 2e-3)),
+        wire_gbps=mc.get("wire_gbps"),
+        ship_retry_base_s=float(mc.get("ship_retry_base_s", 0.004)),
+        ship_max_retries=int(mc.get("ship_max_retries", 4)),
+        ship_deadline_s=float(mc.get("ship_deadline_s", 0.5)),
+        prefix_ship_deadline_s=float(
+            mc.get("prefix_ship_deadline_s", 0.25)),
+        # A live artifact dir consumed clock readings on failover
+        # writes; replay reproduces those against scratch.
+        artifact_dir=(scratch_dir if mc.get("had_artifact_dir")
+                      else None),
+        bus=bus, slo_policy=slo,
+        timeseries_interval_s=mc.get("timeseries_interval_s"),
+        timeseries_capacity=int(mc.get("timeseries_capacity", 256)),
+        # Explicit DISARM: TDT_REPLAY_DIR must never re-arm recording
+        # inside a replay.
+        record_dir="")
+
+
+class _ReplayBus:
+    """Replays recorded ``bus_read`` / ``bus_clock`` rows.  The
+    baseline store is NOT serialized (``predicted_us`` returns None
+    in replay) — ``has_store`` on the recorded rows attributes any
+    resulting kv_fetch divergence."""
+
+    def __init__(self, rows, staleness_s: float = 10.0):
+        self._reads = collections.deque(
+            r for r in rows if r.get("kind") == "bus_read")
+        self._clocks = collections.deque(
+            float(r.get("t", 0.0)) for r in rows
+            if r.get("kind") == "bus_clock")
+        self.staleness_s = float(staleness_s)
+        self._last_clock = 0.0
+        self._last_sig = None
+
+    def clock(self) -> float:
+        if self._clocks:
+            self._last_clock = self._clocks.popleft()
+        return self._last_clock
+
+    def read(self, now=None):
+        from triton_distributed_tpu.observability.feedback import (
+            Signals)
+        if self._reads:
+            r = self._reads.popleft()
+            self._last_sig = Signals(
+                ts=float(r.get("ts", 0.0)),
+                link_utilization=dict(r.get("link_utilization")
+                                      or {}),
+                contended_links=tuple(r.get("contended") or ()),
+                gauges=dict(r.get("gauges") or {}),
+                store=None)
+        if self._last_sig is None:
+            self._last_sig = Signals(ts=-1e18)
+        return self._last_sig
+
+
+# ---------------------------------------------------------------------------
+# Counterfactual overrides
+# ---------------------------------------------------------------------------
+
+class _CounterfactualInjector:
+    """A `FaultInjector` that SUPPRESSES the fault recorded at one
+    index: the seam call runs normally, and if it just recorded the
+    suppressed event the event is popped and a neutral outcome
+    returned (ship → no action, flap → factor 1.0, heartbeat →
+    healthy ``now``).  Window faults re-record once per window after
+    the pop (`FaultInjector.beat_ts` / `wire_factor` record-once
+    checks scan ``events``), so the (fault, target) signature keeps
+    suppressing matches for the rest of the run."""
+
+    def __init__(self, schedule, suppress_index: int):
+        from triton_distributed_tpu.serving.cluster.chaos import (
+            FaultInjector)
+        self._inner = FaultInjector(schedule)
+        self._suppress = int(suppress_index)
+        self._sig: Optional[Tuple[str, str]] = None
+        self.suppressed = 0
+
+    # The cluster reads/writes these on its injector.
+    @property
+    def schedule(self):
+        return self._inner.schedule
+
+    @property
+    def events(self):
+        return self._inner.events
+
+    @property
+    def by_class(self):
+        return self._inner.by_class
+
+    @property
+    def active(self):
+        return self._inner.active
+
+    @property
+    def n_replicas(self):
+        return self._inner.n_replicas
+
+    @n_replicas.setter
+    def n_replicas(self, n):
+        self._inner.n_replicas = n
+
+    @property
+    def tap(self):
+        return self._inner.tap
+
+    @tap.setter
+    def tap(self, fn):
+        self._inner.tap = fn
+
+    def write_artifact(self, directory: str) -> str:
+        return self._inner.write_artifact(directory)
+
+    def _popped(self) -> bool:
+        events = self._inner.events
+        if not events:
+            return False
+        i = len(events) - 1
+        e = events[i]
+        hit = ((self._sig is None and i == self._suppress)
+               or (self._sig is not None
+                   and (e.fault, e.target) == self._sig))
+        if not hit:
+            return False
+        events.pop()
+        self._inner.by_class[e.fault] -= 1
+        if self._sig is None:
+            self._sig = (e.fault, e.target)
+        self.suppressed += 1
+        return True
+
+    def on_ship(self, ship_id, nbytes, now, kind="kv"):
+        before = len(self._inner.events)
+        action = self._inner.on_ship(ship_id, nbytes, now, kind=kind)
+        if len(self._inner.events) > before and self._popped():
+            return None
+        return action
+
+    def wire_factor(self, now):
+        before = len(self._inner.events)
+        f = self._inner.wire_factor(now)
+        if len(self._inner.events) > before and self._popped():
+            return 1.0
+        return f
+
+    def beat_ts(self, replica_id, now):
+        before = len(self._inner.events)
+        ts = self._inner.beat_ts(replica_id, now)
+        if len(self._inner.events) > before and self._popped():
+            return now
+        return ts
+
+
+def _stretch_step(rep, k: int, factor: float) -> None:
+    """Counterfactual "what if replica ``rep``'s ``k``-th step had
+    cost ``factor``× more": monkeypatches the bound ``step`` so the
+    one stretched step re-charges the replica's timeline."""
+    orig = rep.step
+    state = {"n": 0}
+
+    def step(now):
+        out = orig(now)
+        state["n"] += 1
+        if state["n"] == k:
+            rep.last_step_s *= factor
+            rep.busy_until = now + rep.last_step_s
+        return out
+
+    rep.step = step
+
+
+# ---------------------------------------------------------------------------
+# Replay + parity
+# ---------------------------------------------------------------------------
+
+def _canon(x):
+    """JSON canonical form, so recorded rows (which round-tripped
+    through json: tuples→lists) compare equal to live objects."""
+    return json.loads(json.dumps(x, sort_keys=True, default=str))
+
+
+def _norm_op(op, index_of: Dict[int, int]):
+    """``request:<record_id>`` ops normalized to submission-order
+    indices — record ids are process-global and differ between the
+    recorded run and its replay."""
+    if isinstance(op, str) and op.startswith("request:"):
+        try:
+            rid = int(op.split(":", 1)[1])
+        except ValueError:
+            return op
+        if rid in index_of:
+            return f"request:#{index_of[rid]}"
+    return op
+
+
+def _norm_decision(d: dict, index_of: Dict[int, int]):
+    return _canon({"consumer": d.get("consumer"),
+                   "op": _norm_op(d.get("op"), index_of),
+                   "choice": d.get("choice"),
+                   "candidates": d.get("candidates"),
+                   "inputs": d.get("inputs"),
+                   "fallback": d.get("fallback")})
+
+
+def _compare(want: list, got: list):
+    divs: List[dict] = []
+    n = max(len(want), len(got))
+    for i in range(n):
+        a = want[i] if i < len(want) else None
+        b = got[i] if i < len(got) else None
+        if a != b:
+            divs.append({"index": i, "recorded": a, "replayed": b})
+    return {"compared": n, "divergences": len(divs)}, divs
+
+
+def _drive(cluster, rclock: ReplayClock, submits: List[dict],
+           max_steps: Optional[int] = None):
+    """The replay event loop: step the cluster, injecting each
+    recorded arrival when the clock-read count reaches its ``pos``
+    (every event-loop tick consumes at least one reading, so replay
+    interleaves submits between the same ticks the live run did).
+    An idle cluster force-feeds the next submit (the live driver
+    submitted it while idle too); the step budget guarantees
+    termination on any log."""
+    budget = max_steps or (10_000 + 20 * len(rclock._readings)
+                           + 100 * len(submits))
+    si = 0
+    records: List[tuple] = []
+    while si < len(submits) or cluster.has_work():
+        while si < len(submits):
+            row = submits[si]
+            pos = int(row.get("pos") or 0)
+            if (rclock.consumed < pos and not rclock.exhausted
+                    and cluster.has_work()):
+                break
+            kwargs = dict(
+                prompt=row.get("prompt") or [],
+                max_new_tokens=int(row.get("max_new") or 0),
+                eos_token_ids=tuple(row.get("eos") or ()),
+                seed=int(row.get("seed") or 0),
+                tenant=str(row.get("tenant") or "default"))
+            if row.get("clk"):
+                rclock.inject(float(row.get("arrival") or 0.0))
+                rec = cluster.submit(arrival_time=None, **kwargs)
+            else:
+                rec = cluster.submit(
+                    arrival_time=float(row.get("arrival") or 0.0),
+                    **kwargs)
+            records.append((int(row.get("rid", -1)), rec))
+            si += 1
+        if not cluster.has_work():
+            continue
+        cluster.step()
+        budget -= 1
+        if budget <= 0:
+            break
+    return records
+
+
+def _incomplete(problems: List[str]) -> dict:
+    count_metric = _count_metric()
+    count_metric("replay_runs_total", status="incomplete")
+    empty = {"compared": 0, "divergences": 0}
+    return {"schema": REPLAY_SCHEMA, "status": "INCOMPLETE",
+            "problems": list(problems),
+            "levels": {"tokens": dict(empty),
+                       "decisions": dict(empty),
+                       "hops": dict(empty)},
+            "first_divergence": None}
+
+
+def replay_run(artifact, model=None, params=None, override=None,
+               max_steps: Optional[int] = None) -> dict:
+    """Re-execute a recorded run from its ``replay.jsonl`` and
+    assert three-level parity (tokens / decisions / hops).
+
+    ``artifact``: the artifact directory or the file itself.
+    ``model``/``params``: override meta reconstruction (required for
+    non-toy models or drafter runs).  ``override``: counterfactual —
+    one of ``{"suppress_fault": i}``, ``{"pin_route": replica_id}``,
+    ``{"stretch_step": {"replica": r, "k": n, "factor": f}}``;
+    the report then carries a ``counterfactual`` section naming the
+    first divergent event and the TTFT delta of the first affected
+    request.
+
+    Returns the report dict: ``status`` ``EXACT`` / ``DIVERGED`` /
+    ``INCOMPLETE`` (a torn artifact short-circuits — truthful,
+    never a crash, and never a half-driven replay)."""
+    rows = load_replay(artifact)
+    problems = validate_replay(rows)
+    if problems:
+        return _incomplete(problems)
+    meta = rows[0]
+    mc = meta.get("config") or {}
+    readings = [t for r in rows if r.get("kind") == "clock"
+                for t in r.get("t") or []]
+    submits = [r for r in rows if r.get("kind") == "submit"]
+    rec_finish = [r for r in rows if r.get("kind") == "finish"]
+    rec_decisions = [r for r in rows if r.get("kind") == "decision"]
+    rec_hops = [r for r in rows if r.get("kind") == "hop"]
+    rec_faults = [r for r in rows
+                  if r.get("kind") == "fault_injected"]
+    bus_rows = [r for r in rows
+                if r.get("kind") in ("bus_read", "bus_clock")]
+
+    if model is None or params is None:
+        model, params = _rebuild_model(meta.get("model") or {})
+    ov = dict(override or {})
+    injector = _rebuild_injector(meta.get("faults"),
+                                 suppress=ov.get("suppress_fault"))
+    scratch = None
+    if mc.get("had_artifact_dir"):
+        import tempfile
+        scratch = tempfile.mkdtemp(prefix="tdt-replay-")
+    bus = None
+    if mc.get("has_bus"):
+        bus = _ReplayBus(bus_rows,
+                         staleness_s=float(mc.get("bus_staleness_s")
+                                           or 10.0))
+    config = _rebuild_config(mc, bus, scratch)
+    rclock = ReplayClock(readings)
+    from triton_distributed_tpu.serving.cluster.cluster import (
+        ServingCluster)
+    cluster = ServingCluster(model, params, config, clock=rclock,
+                             clock_advance=rclock.advance,
+                             fault_injector=injector)
+    for rep in cluster.replicas:
+        # Pin the one wall-clock seam: replayed step metrics must not
+        # depend on this machine's speed.
+        rep.scheduler.step_timer = lambda: 0.0
+    if "pin_route" in ov:
+        cluster.router.pin = int(ov["pin_route"])
+    if "stretch_step" in ov:
+        s = ov["stretch_step"]
+        _stretch_step(cluster.replicas[int(s["replica"])],
+                      int(s.get("k", 1)), float(s["factor"]))
+
+    # Capture the replay's decision stream in isolation: any armed
+    # recorder's tap is detached for the duration (a replay must
+    # never pollute a recording in the same process).
+    from triton_distributed_tpu.observability import feedback
+    saved = list(feedback._TAPS)
+    for t in saved:
+        feedback.remove_decision_tap(t)
+    decisions: List = []
+    feedback.add_decision_tap(decisions.append)
+    try:
+        records = _drive(cluster, rclock, submits, max_steps)
+    finally:
+        feedback.remove_decision_tap(decisions.append)
+        # remove by identity fails for a fresh bound .append — clear
+        # any leftover capture entry defensively, then restore.
+        feedback._TAPS[:] = [t for t in feedback._TAPS
+                             if t is not decisions.append]
+        for t in saved:
+            feedback.add_decision_tap(t)
+
+    rec_index = {int(r["rid"]): i for i, r in enumerate(submits)}
+    rep_index = {rec.record_id: i
+                 for i, (_, rec) in enumerate(records)}
+    rep_by_rid = {rid: rec for rid, rec in records}
+
+    # Level 1: token-for-token streams (terminal state per record,
+    # in recorded completion order).
+    want_tok, got_tok = [], []
+    for row in rec_finish:
+        rid = int(row.get("rid", -1))
+        rec = rep_by_rid.get(rid)
+        want_tok.append(_canon({
+            "i": rec_index.get(rid), "state": row.get("state"),
+            "tokens": row.get("tokens"),
+            "finish_reason": row.get("finish_reason"),
+            "reject_reason": row.get("reject_reason"),
+            "t_first": row.get("t_first"),
+            "t_finish": row.get("t_finish")}))
+        got_tok.append(None if rec is None else _canon({
+            "i": rec_index.get(rid), "state": rec.state,
+            "tokens": list(rec.tokens),
+            "finish_reason": rec.finish_reason,
+            "reject_reason": rec.reject_reason,
+            "t_first": rec.t_first_token,
+            "t_finish": rec.t_finish}))
+    tok_level, tok_divs = _compare(want_tok, got_tok)
+
+    # Level 2: decision-for-decision (ts/rank excluded — ts is
+    # wall-stamped at record time; everything decision-shaped is
+    # compared).
+    want_d = [_norm_decision(d, rec_index) for d in rec_decisions]
+    got_d = [_norm_decision(dataclasses.asdict(e), rep_index)
+             for e in decisions]
+    dec_level, dec_divs = _compare(want_d, got_d)
+
+    # Level 3: hop-for-hop lineage, grouped per request in
+    # submission order.
+    from triton_distributed_tpu.observability.lineage import (
+        get_lineage_recorder)
+    lrec = get_lineage_recorder()
+    want_h = [_canon({"i": rec_index.get(int(r.get("rid", -1))),
+                      "hop": r.get("hop"), "ts": r.get("ts"),
+                      "actor": r.get("actor"),
+                      "detail": r.get("detail")})
+              for r in rec_hops]
+    got_h = []
+    for row in submits:
+        rid = int(row.get("rid", -1))
+        rec = rep_by_rid.get(rid)
+        if rec is None:
+            continue
+        for e in lrec.events_for(rec.record_id):
+            got_h.append(_canon({"i": rec_index.get(rid),
+                                 "hop": e.hop, "ts": e.ts,
+                                 "actor": e.actor,
+                                 "detail": dict(e.detail)}))
+    hop_level, hop_divs = _compare(want_h, got_h)
+
+    levels = {"tokens": tok_level, "decisions": dec_level,
+              "hops": hop_level}
+    first = None
+    # Causal order: a divergent decision precedes its consequences.
+    for name, divs in (("decisions", dec_divs), ("hops", hop_divs),
+                       ("tokens", tok_divs)):
+        if divs:
+            first = dict(divs[0], level=name)
+            break
+    status = "EXACT" if first is None else "DIVERGED"
+    report = {"schema": REPLAY_SCHEMA, "status": status,
+              "levels": levels, "first_divergence": first,
+              "n_requests": len(submits),
+              "clock_readings": len(readings)}
+    if ov:
+        report["counterfactual"] = _counterfactual_section(
+            ov, first, submits, rec_finish, rec_faults, rec_index,
+            rep_by_rid)
+    count_metric = _count_metric()
+    count_metric("replay_runs_total", status=status.lower())
+    for name in levels:
+        if levels[name]["divergences"]:
+            count_metric("replay_divergence_total", level=name)
+    return report
+
+
+def _counterfactual_section(ov: dict, first: Optional[dict],
+                            submits, rec_finish, rec_faults,
+                            rec_index, rep_by_rid) -> dict:
+    cf: dict = {"schema": REPLAY_SCHEMA, "kind": "counterfactual",
+                "override": _canon(ov),
+                "first_divergence": first}
+    if "suppress_fault" in ov:
+        idx = int(ov["suppress_fault"])
+        frow = next((f for f in rec_faults
+                     if int(f.get("index", -1)) == idx), None)
+        if frow is not None:
+            cf["fault"] = {"index": idx, "fault": frow.get("fault"),
+                           "target": frow.get("target"),
+                           "ts": frow.get("ts")}
+    # The first request whose TTFT the override changed — the number
+    # the doctor's causality clause quotes.
+    for row in rec_finish:
+        rid = int(row.get("rid", -1))
+        rec = rep_by_rid.get(rid)
+        if rec is None:
+            continue
+        want = (None if row.get("t_first") is None
+                else float(row["t_first"])
+                - float(row.get("arrival") or 0.0))
+        got = rec.ttft
+        if want is None and got is None:
+            continue
+        if (want is None or got is None
+                or abs(want - got) > 1e-12):
+            cf["request"] = {
+                "rid": rid, "index": rec_index.get(rid),
+                "recorded_ttft_ms": (None if want is None
+                                     else round(want * 1e3, 3)),
+                "replayed_ttft_ms": (None if got is None
+                                     else round(got * 1e3, 3))}
+            break
+    return cf
+
+
+def causality_clause(cf) -> Optional[str]:
+    """Render one counterfactual row into the doctor's verdict
+    clause, e.g. "without the drop fault on shipment 12, request 7's
+    TTFT is 8.1 ms not 20.0 ms"."""
+    if not isinstance(cf, dict):
+        return None
+    ov = cf.get("override") or {}
+    if "suppress_fault" in ov:
+        f = cf.get("fault") or {}
+        what = ("without the %s fault on %s"
+                % (f.get("fault", "suppressed"),
+                   f.get("target",
+                         "event %s" % ov.get("suppress_fault"))))
+    elif "pin_route" in ov:
+        what = "with routing pinned to replica %s" % ov["pin_route"]
+    elif "stretch_step" in ov:
+        s = ov.get("stretch_step") or {}
+        what = ("with replica %s's step %s stretched x%s"
+                % (s.get("replica"), s.get("k", 1), s.get("factor")))
+    else:
+        what = "under the counterfactual override"
+    req = cf.get("request")
+    if (isinstance(req, dict)
+            and req.get("recorded_ttft_ms") is not None
+            and req.get("replayed_ttft_ms") is not None):
+        return ("%s, request %s's TTFT is %.1f ms not %.1f ms"
+                % (what, req.get("rid"),
+                   float(req["replayed_ttft_ms"]),
+                   float(req["recorded_ttft_ms"])))
+    fd = cf.get("first_divergence")
+    if isinstance(fd, dict):
+        return ("%s, the run first diverges at %s index %s"
+                % (what, fd.get("level"), fd.get("index")))
+    return "%s, the run is unchanged" % what
+
+
+def append_counterfactual(artifact, cf: dict) -> str:
+    """Append one counterfactual row to a ``replay.jsonl`` (legal
+    after the ``end`` row) — how a ``doctor --replay`` run leaves
+    its verdict beside the recording for later ``diagnose`` passes.
+    """
+    path = artifact
+    if os.path.isdir(path):
+        path = os.path.join(path, REPLAY_FILE)
+    row = {"schema": REPLAY_SCHEMA, "kind": "counterfactual"}
+    row.update({k: v for k, v in cf.items()
+                if k not in ("schema", "kind")})
+    with open(path, "a") as f:
+        f.write(json.dumps(row, default=str) + "\n")
+    return path

@@ -205,33 +205,10 @@ def validate_timeseries(d: dict) -> List[str]:
 def load_timeseries(paths) -> List[dict]:
     """Parse timeseries rows from jsonl file(s), skipping torn lines;
     rows sort by (ts, stable input order)."""
-    out: List[dict] = []
-    if isinstance(paths, str):
-        paths = [paths]
-    for path in paths:
-        try:
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        d = json.loads(line)
-                    except ValueError:
-                        continue
-                    if (isinstance(d, dict)
-                            and d.get("kind") == "timeseries"):
-                        out.append(d)
-        except OSError:
-            continue
-
-    def ts(d):
-        try:
-            return float(d.get("ts", 0.0))
-        except (TypeError, ValueError):
-            return 0.0
-    out.sort(key=ts)
-    return out
+    from triton_distributed_tpu.observability.jsonl import (
+        load_jsonl_rows, tolerant_ts)
+    return load_jsonl_rows(paths, kind="timeseries",
+                           sort_key=tolerant_ts)
 
 
 def _tail_run(values: Sequence[float]) -> Dict[str, object]:

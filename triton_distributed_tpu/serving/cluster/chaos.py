@@ -128,32 +128,9 @@ def validate_fault(d: dict) -> List[str]:
 
 def load_faults(paths) -> List[dict]:
     """Parse fault lines from jsonl file(s), skipping torn lines."""
-    out: List[dict] = []
-    if isinstance(paths, str):
-        paths = [paths]
-    for path in paths:
-        try:
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        d = json.loads(line)
-                    except ValueError:
-                        continue
-                    if isinstance(d, dict) and d.get("kind") == "fault":
-                        out.append(d)
-        except OSError:
-            continue
-
-    def ts(d):
-        try:
-            return float(d.get("ts", 0.0))
-        except (TypeError, ValueError):
-            return 0.0
-    out.sort(key=ts)
-    return out
+    from triton_distributed_tpu.observability.jsonl import (
+        load_jsonl_rows, tolerant_ts)
+    return load_jsonl_rows(paths, kind="fault", sort_key=tolerant_ts)
 
 
 def faults_by_shipment(faults) -> Dict[int, str]:
@@ -320,6 +297,12 @@ class FaultInjector:
         self.n_replicas = int(n_replicas)
         self.events: List[FaultEvent] = []
         self.by_class: Dict[str, int] = {}
+        #: Record/replay seam (`observability.replay.RunRecorder`):
+        #: called as ``tap(event, index)`` for every injection, where
+        #: ``index`` is the event's position in ``events`` — the
+        #: handle a counterfactual replay suppresses by.  None (the
+        #: default) costs one truthiness check.
+        self.tap = None
 
     @property
     def active(self) -> bool:
@@ -337,6 +320,8 @@ class FaultInjector:
         from triton_distributed_tpu.observability.metrics import (
             count_metric)
         count_metric("cluster_faults_injected_total", fault=fault)
+        if self.tap is not None:
+            self.tap(self.events[-1], len(self.events) - 1)
 
     # -- seams -------------------------------------------------------------
 

@@ -139,6 +139,19 @@ class ClusterConfig:
     #: (default) = no ring, no samples, no artifact.
     timeseries_interval_s: Optional[float] = None
     timeseries_capacity: int = 256
+    #: Record & replay (`observability.replay`): when set, a
+    #: `RunRecorder` captures every nondeterministic input crossing
+    #: the cluster seams into ``<record_dir>/replay.jsonl``, enough
+    #: to re-execute the run bit-exactly.  None (default) defers to
+    #: the ``TDT_REPLAY_DIR`` env var; empty string DISARMS even
+    #: when the env var is set (replay clusters use this so a replay
+    #: can never re-record itself).  Unarmed runs are byte-identical.
+    record_dir: Optional[str] = None
+    #: The PRNG seed the model params were initialized from —
+    #: recorded in replay meta so `replay_run` can rebuild identical
+    #: params without serializing them.  Only meaningful when
+    #: recording a `ToyModel` run.
+    record_params_seed: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -233,6 +246,19 @@ class ServingCluster:
             clock = lambda: v.t                          # noqa: E731
             clock_advance = lambda dt: setattr(           # noqa: E731
                 v, "t", v.t + dt)
+        #: Record & replay (`observability.replay`): armed via
+        #: ``record_dir`` or ``TDT_REPLAY_DIR``, the recorder wraps
+        #: the clock BEFORE anything reads it (construction readings
+        #: must land in the log — replay construction consumes them
+        #: symmetrically).  ``record_dir=""`` disarms explicitly.
+        self._recorder = None
+        rdir = (cfg.record_dir if cfg.record_dir is not None
+                else os.environ.get("TDT_REPLAY_DIR"))
+        if rdir:
+            from triton_distributed_tpu.observability.replay import (
+                RunRecorder)
+            self._recorder = RunRecorder(rdir)
+            clock = self._recorder.wrap(clock)
         self._clock = clock
         self._clock_advance = clock_advance
         self.replicas = [
@@ -247,6 +273,12 @@ class ServingCluster:
             for i in range(cfg.n_prefill_workers)]
         self.transport = VirtualTransport(wire_gbps=cfg.wire_gbps)
         self.router = ClusterRouter(cfg.router, self.replicas)
+        if self._recorder is not None:
+            # Seam taps: wire deliveries, fault injections, and the
+            # process decision stream.
+            self.transport.tap = self._recorder.on_transport
+            self.injector.tap = self._recorder.on_fault
+            self._recorder.arm_decisions()
         # KV tier, fleet half: the cluster-wide prefix directory and
         # the cache-aware placement hook (paged replicas with a radix
         # cache only — the slots layout has no shareable pages, so
@@ -309,6 +341,8 @@ class ServingCluster:
                 cfg.timeseries_interval_s, cfg.timeseries_capacity)
         _register(self)
         self._update_gauges()
+        if self._recorder is not None:
+            self._recorder.record_meta(self, model)
 
     # -- client API ------------------------------------------------------
 
@@ -331,6 +365,9 @@ class ServingCluster:
             eos_token_ids=tuple(int(t) for t in eos_token_ids),
             seed=int(seed), arrival_time=arrival, on_token=on_token,
             tenant=str(tenant))
+        if self._recorder is not None:
+            self._recorder.record_submit(
+                record, consumed_clock=arrival_time is None)
         # Kept sorted by arrival (stable for ties: submission order)
         # within the not-yet-routed tail, so the router always sees
         # the next arrival at the head whatever order clients submit.
@@ -362,6 +399,10 @@ class ServingCluster:
         returns finished records in completion order."""
         while self.has_work():
             self.step()
+        if self._recorder is not None:
+            # Armed runs without an artifact_dir still get their
+            # replay.jsonl when the run completes.
+            self._recorder.flush(list(self._lineage_ids), self._open)
         return self.finished
 
     def take_finished(self) -> List[ClusterRequest]:
@@ -414,6 +455,8 @@ class ServingCluster:
         for rep in self.replicas:
             if rep.ready(now):
                 rep.step(now)
+                if self._recorder is not None:
+                    self._recorder.record_step(rep, now)
                 self._collect_finished(rep, now)
                 stepped += 1
         progressed |= stepped > 0
@@ -640,6 +683,8 @@ class ServingCluster:
             if reject_hop:
                 self._hop(record, "reject", self._clock(), "cluster",
                           reason=record.reject_reason)
+        if self._recorder is not None:
+            self._recorder.record_finish(record)
         self._open -= 1
 
     def _count(self, name: str, n: int = 1, **labels) -> None:
@@ -651,6 +696,11 @@ class ServingCluster:
 
     def _signal_bus(self):
         if self.config.bus is not None:
+            if self._recorder is not None:
+                # Recorded runs see the bus through a recording
+                # delegate so every snapshot replays verbatim.  The
+                # ambient bus below is NOT wrapped (documented limit).
+                return self._recorder.recording_bus(self.config.bus)
             return self.config.bus
         from triton_distributed_tpu.observability import feedback
         return feedback.ambient_bus()
@@ -1209,6 +1259,8 @@ class ServingCluster:
                         None if tbt is None else tbt * 1e3,
                         now)
                 self.finished.append(record)
+            if self._recorder is not None:
+                self._recorder.record_finish(record)
             self._open -= 1
 
     # -- health / failover -----------------------------------------------
@@ -1396,6 +1448,8 @@ class ServingCluster:
                 json.dump(self.slo.state_dict(self._clock()), f,
                           indent=1, default=str)
             os.replace(stmp, spath)
+        if self._recorder is not None:
+            self._recorder.flush(list(self._lineage_ids), self._open)
         return path
 
     def _update_gauges(self) -> None:

@@ -235,6 +235,12 @@ class ClusterRouter:
         #: The last route()'s decision payload, held until the cluster
         #: confirms the dispatch landed (`commit_route`).
         self._staged: Optional[tuple] = None
+        #: Counterfactual-replay hook (`observability.replay`): a
+        #: replica id that, when set, restricts every placement to
+        #: that replica ("what if request N had landed HERE?").  A
+        #: pinned replica that is not routable/eligible falls back to
+        #: the full candidate set — a pin must steer, never wedge.
+        self.pin: Optional[int] = None
 
     def _default_signals(self, rep, now: float) -> Optional[dict]:
         fn = getattr(rep, "signals", None)
@@ -272,6 +278,8 @@ class ClusterRouter:
         alive = self._routable()
         if eligible is not None:
             alive = [r for r in alive if eligible(r)] or alive
+        if self.pin is not None:
+            alive = [r for r in alive if r.id == self.pin] or alive
         if not alive:
             return None
         k = self._rr % len(alive)

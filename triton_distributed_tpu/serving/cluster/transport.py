@@ -165,6 +165,12 @@ class VirtualTransport:
         self.shipments = 0
         self.corrupt_claims = 0
         self.duplicate_claims = 0
+        #: Record/replay seam (`observability.replay.RunRecorder`):
+        #: called with one dict per wire event — ``ship`` (token,
+        #: nbytes, tag) and ``claim`` (token, outcome: ok / corrupt /
+        #: duplicate) — so a replay can assert the wire behaved
+        #: delivery-for-delivery identically.  None costs one check.
+        self.tap = None
 
     def ship(self, shipment: KVShipment, tag=None) -> tuple:
         """Serialize one shipment onto the wire.  Returns
@@ -182,6 +188,9 @@ class VirtualTransport:
             self._tags[token] = tag
         self.shipped_bytes += len(data)
         self.shipments += 1
+        if self.tap is not None:
+            self.tap({"event": "ship", "token": token,
+                      "nbytes": len(data), "tag": tag})
         return token, len(data)
 
     def ship_time_s(self, nbytes: int) -> float:
@@ -205,13 +214,22 @@ class VirtualTransport:
         self._tags.pop(token, None)
         if data is None:
             self.duplicate_claims += 1
+            if self.tap is not None:
+                self.tap({"event": "claim", "token": token,
+                          "outcome": "duplicate"})
             return None
         crc = self._crc.pop(token)
         if zlib.crc32(data) != crc:
             self.corrupt_claims += 1
+            if self.tap is not None:
+                self.tap({"event": "claim", "token": token,
+                          "outcome": "corrupt"})
             raise ShipmentCorrupt(
                 f"shipment {token}: checksum mismatch "
                 f"({zlib.crc32(data):#010x} != {crc:#010x})")
+        if self.tap is not None:
+            self.tap({"event": "claim", "token": token,
+                      "outcome": "ok", "nbytes": len(data)})
         return (decoder or KVShipment.from_bytes)(data)
 
     def drop(self, token: int) -> None:

@@ -220,6 +220,12 @@ class ContinuousBatchingScheduler:
         #: With a virtual clock, how the idle loop moves time forward
         #: to the next arrival; with the default wall clock we sleep.
         self._clock_advance = clock_advance
+        #: The ONE wall-clock measurement on the decode hot path (the
+        #: `serving_decode_step_ms` timing around `_decode_step`).
+        #: Injectable so a deterministic replay
+        #: (`observability.replay`) can pin measured step durations —
+        #: everything else already rides the injected `clock`.
+        self.step_timer: Callable[[], float] = time.perf_counter
         max_seq = cfg.max_seq or model.config.max_seq_len
         self.max_seq = int(max_seq)
         self.buckets = tuple(sorted(
@@ -1043,7 +1049,7 @@ class ContinuousBatchingScheduler:
         return True
 
     def _decode_step(self) -> int:
-        t0 = time.perf_counter()
+        t0 = self.step_timer()
         spec = self._spec_drafts()
         k = 1 if spec is not None else self._block_size()
         # Paged mode maps pages for every position this dispatch
@@ -1089,7 +1095,7 @@ class ContinuousBatchingScheduler:
         now = self.clock()
         reg = self._registry()
         if reg:
-            elapsed_ms = (time.perf_counter() - t0) * 1e3
+            elapsed_ms = (self.step_timer() - t0) * 1e3
             step_ms = elapsed_ms / steps
             reg.histogram("serving_decode_step_ms").observe(step_ms)
             # Last measured step as a gauge: rides the heartbeat
