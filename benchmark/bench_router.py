@@ -301,6 +301,196 @@ def kvtier_fleet_rows(model, params):
     return rows
 
 
+def socket_parity_row(model, params, trace):
+    """The same cluster twice — once on `VirtualTransport` (virtual
+    clock), once as a THREADED socket fleet (real localhost TCP, wall
+    clock, `serving/cluster/net`) — under round-robin placement,
+    which is a pure function of dispatch order (the PR-8 degradation
+    contract): token streams AND routed assignments must match
+    exactly, so the wire demonstrably adds transport, not behavior.
+    """
+    import threading
+    import time as _time
+
+    from triton_distributed_tpu.serving.cluster.net import (
+        node as _node)
+    from triton_distributed_tpu.serving.cluster.net.fabric import (
+        NetFabric, _buckets, cluster_clock)
+    from triton_distributed_tpu.serving.cluster.net.node import (
+        serve_connection)
+    from triton_distributed_tpu.serving.cluster.net.remote import (
+        PrefillHost, ReplicaHost)
+    from triton_distributed_tpu.serving.cluster.net.rendezvous \
+        import Directory
+    from triton_distributed_tpu.serving.cluster.prefill import (
+        PrefillWorker)
+    from triton_distributed_tpu.serving.cluster.replica import (
+        Replica)
+
+    sc = SchedulerConfig(num_slots=SLOTS, prefill_buckets=BUCKETS)
+    cfg = ClusterConfig(
+        n_replicas=2, n_prefill_workers=1, scheduler=sc,
+        router=RouterConfig(mode="round_robin"),
+        step_time_s=STEP_S, prefill_time_s=PREFILL_S)
+
+    def run(fabric, clock):
+        cluster = ServingCluster(model, params, cfg, clock=clock,
+                                 fabric=fabric)
+        recs = [cluster.submit(t["prompt"], t["max_new_tokens"],
+                               seed=t["seed"]) for t in trace]
+        done = cluster.drain()
+        assert len(done) == len(trace), [r.state for r in recs]
+        return {
+            "assignments": [tuple(r.replica_history) for r in recs],
+            "streams": [r.tokens for r in
+                        sorted(done, key=lambda r: r.record_id)],
+            "kv_shipped_bytes": cluster.transport.shipped_bytes,
+            "shipments": cluster.transport.shipments,
+        }
+
+    virtual = run(None, None)
+
+    t0 = _time.time()
+    clock = cluster_clock(t0)
+    ranks = {0: {"role": "router", "index": 0, "addr": "-"}}
+    threads = []
+
+    def host_replica(rank, idx, srv):
+        rep = Replica(idx, model, params, sc, clock,
+                      step_time_s=cfg.step_time_s)
+        sock, _ = srv.accept()
+        srv.close()
+        serve_connection(sock, rank, ReplicaHost(rep).dispatch)
+
+    def host_prefill(rank, idx, srv):
+        w = PrefillWorker(idx, model, params, _buckets(model, sc),
+                          pad_id=sc.pad_id,
+                          prefill_time_s=cfg.prefill_time_s)
+        sock, _ = srv.accept()
+        srv.close()
+        serve_connection(sock, rank, PrefillHost(w).dispatch)
+
+    roles = [("replica", 0, host_replica),
+             ("replica", 1, host_replica),
+             ("prefill", 0, host_prefill)]
+    for rank, (role, idx, fn) in enumerate(roles, start=1):
+        srv = _node.listen()
+        ranks[rank] = {"role": role, "index": idx,
+                       "addr": _node.addr_of(srv)}
+        th = threading.Thread(target=fn, args=(rank, idx, srv),
+                              daemon=True)
+        th.start()
+        threads.append(th)
+    fabric = NetFabric(Directory(world=4, ranks=ranks, t0=t0),
+                       rank=0)
+    wall0 = _time.monotonic()
+    sock_run = run(fabric, clock)
+    wall_ms = (_time.monotonic() - wall0) * 1e3
+    fabric.shutdown()
+    for th in threads:
+        th.join(timeout=10)
+
+    return dict(
+        bench="router", workload="socket_parity", n_replicas=2,
+        n_prefill=1, mode="paired",
+        requests=len(trace),
+        kv_shipped_bytes=sock_run["kv_shipped_bytes"],
+        shipments=sock_run["shipments"],
+        # Wall time is informational ONLY (machine-dependent): the
+        # gated facts are the two exactness booleans.
+        socket_wall_ms=round(wall_ms, 3),
+        socket_matches_virtual=(sock_run["streams"]
+                                == virtual["streams"]),
+        assignments_exact=(sock_run["assignments"]
+                           == virtual["assignments"]),
+    )
+
+
+def hierarchical_rows(trace):
+    """Pod-scale routing work accounting (`net/hierarchy.py`): route
+    the committed trace through a pod front door (cells of 4) and
+    through a flat `ClusterRouter` over the same fleet, counting
+    score evaluations — the per-request placement WORK — and per-cell
+    prefix-directory growth.  Pure routing (signal-bearing stub
+    replicas, no decode): every number is a deterministic function of
+    the trace."""
+    from triton_distributed_tpu.serving.cluster import ClusterRouter
+    from triton_distributed_tpu.serving.cluster.net.hierarchy import (
+        make_pod)
+
+    class _Rep:
+        def __init__(self, rid):
+            self.id = rid
+            self.rank = rid
+            self.name = f"replica-{rid}"
+            self.dead = False
+            self.quarantined = False
+            self.hb_ts = 0.0
+            self.last_step_s = STEP_S
+            self.routed_total = 0
+
+        routable = True
+
+        def signals(self, now):
+            return {"ts": now, "queue_depth": 0.0,
+                    "active_slots": 0.0, "kv_occupancy": 0.0,
+                    "step_us": STEP_S * 1e6, "link_busy": 0.0}
+
+    rows = []
+    cell_size = 4
+    for n_replicas in (16, 32):
+        n_cells = n_replicas // cell_size
+        pod = make_pod([_Rep(i) for i in range(n_replicas)], n_cells,
+                       page_size=4)
+        pod.refresh(0.0)
+        registered = 0
+        for t in trace:
+            cell, rep = pod.route(t["prompt"], "decode", now=0.0)
+            assert rep is not None
+            pod.commit_route(0.0)
+            before = len(cell.directory)
+            cell.directory.register(t["prompt"], rep.id, now=0.0)
+            registered += len(cell.directory) - before
+        flat = ClusterRouter(RouterConfig(),
+                             [_Rep(i) for i in range(n_replicas)])
+        for t in trace:
+            assert flat.route(t["prompt"], "decode", now=0.0) \
+                is not None
+            flat.commit_route(0.0)
+        n = len(trace)
+        pod_per_req = pod.evals() / n
+        flat_per_req = flat.score_evals / n
+        cell_per_req = sum(c.router.score_evals
+                           for c in pod.cells) / n
+        max_dir = max(len(c.directory) for c in pod.cells)
+        rows.append(dict(
+            bench="router", workload="hierarchical", mode="paired",
+            n_replicas=n_replicas, n_cells=n_cells,
+            cell_size=cell_size, requests=n,
+            pod_evals_per_request=round(pod_per_req, 3),
+            flat_evals_per_request=round(flat_per_req, 3),
+            cell_evals_per_request=round(cell_per_req, 3),
+            directory_chains_total=registered,
+            directory_chains_max_cell=max_dir,
+            # Per-request CELL work is the cell size — independent of
+            # pod scale (the O(cell) claim).
+            work_o_cell=(cell_per_req == float(cell_size)),
+            # No single cell's directory holds the pod's chains.
+            directory_o_cell=(n_cells == 1
+                              or max_dir * 2 <= max(registered, 1)),
+            # Total pod routing work stays under the flat router's
+            # O(pod) — sub-linear overhead as the fleet grows.
+            sublinear_vs_flat=(pod_per_req < flat_per_req),
+        ))
+    # The pod-scale pitch in one pair of numbers: doubling the fleet
+    # doubles flat work but only adds front-door cells to pod work.
+    assert rows[1]["flat_evals_per_request"] == 2 * \
+        rows[0]["flat_evals_per_request"]
+    assert rows[1]["pod_evals_per_request"] < \
+        rows[1]["flat_evals_per_request"]
+    return rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None,
@@ -369,6 +559,19 @@ def main():
 
     # -- KV tier: shared-prefix fleet (peer prefix shipping) ------------
     for rec in kvtier_fleet_rows(model, params):
+        emit(rec)
+
+    # -- real wire: socket fleet vs virtual, assignment-exact -----------
+    sp = socket_parity_row(model, params, trace[:10])
+    assert sp["socket_matches_virtual"], (
+        "socket transport changed a token stream")
+    assert sp["assignments_exact"], (
+        "socket transport changed a routed assignment")
+    emit(sp)
+
+    # -- pod scale: hierarchical routing work vs flat -------------------
+    for rec in hierarchical_rows(trace):
+        assert rec["work_o_cell"] and rec["sublinear_vs_flat"], rec
         emit(rec)
 
     # -- balanced: signal-aware must match round-robin exactly ----------

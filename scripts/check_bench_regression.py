@@ -98,6 +98,17 @@ MEASUREMENT_FIELDS = {
     "prefix_ship_exact", "zero_second_prefill",
     "fleet_prefill_sublinear", "peer_ship_flipped",
     "prefill_tokens_no_ship", "ship_beats_recompute",
+    # Real-wire parity row (bench_router.py workload=
+    # "socket_parity"): wall time is machine-dependent by nature;
+    # the two exactness booleans are gated by router_checks.
+    "socket_wall_ms", "socket_matches_virtual", "assignments_exact",
+    # Hierarchical-routing rows (bench_router.py workload=
+    # "hierarchical"): eval/directory accounting plus the O(cell)
+    # booleans gated by router_checks.
+    "pod_evals_per_request", "flat_evals_per_request",
+    "cell_evals_per_request", "directory_chains_total",
+    "directory_chains_max_cell", "work_o_cell", "directory_o_cell",
+    "sublinear_vs_flat",
     # Chaos bench rows (bench_chaos.py): absorption counters + the
     # overhead summary are run outputs.
     "retries", "reroutes", "duplicates", "corrupt_nacks",
@@ -236,7 +247,14 @@ def router_checks(fresh) -> tuple:
     - the ``balanced`` pair must report ``matches_round_robin`` AND
       ``signal_aware_never_worse`` — balanced signals must reproduce
       the round-robin rotation exactly (the PR-8 degradation
-      contract, extended to placement).
+      contract, extended to placement);
+    - the ``socket_parity`` pair must report
+      ``socket_matches_virtual`` AND ``assignments_exact`` — the real
+      TCP cluster is token-for-token AND placement-for-placement
+      identical to the in-process virtual transport;
+    - every ``hierarchical`` pair must report ``work_o_cell``,
+      ``directory_o_cell`` AND ``sublinear_vs_flat`` — pod routing
+      work stays O(cell) while flat routing grows O(fleet).
 
     Returns ``(n_checked, failures)``."""
     fails = []
@@ -270,6 +288,41 @@ def router_checks(fresh) -> tuple:
                     "placement is WORSE than round-robin "
                     f"(speedup_makespan="
                     f"{rec.get('speedup_makespan')})")
+        elif wl == "socket_parity":
+            checked += 1
+            if not rec.get("socket_matches_virtual"):
+                fails.append(
+                    "router regression: socket_parity pair reports "
+                    "the real TCP cluster DIVERGING token-wise from "
+                    "the virtual transport")
+            if not rec.get("assignments_exact"):
+                fails.append(
+                    "router regression: socket_parity pair reports "
+                    "socket-cluster replica assignments diverging "
+                    "from the virtual run")
+        elif wl == "hierarchical":
+            checked += 1
+            if not rec.get("work_o_cell"):
+                fails.append(
+                    f"router regression: hierarchical pair "
+                    f"(n_replicas={rec.get('n_replicas')}) reports "
+                    f"per-request cell work above one cell "
+                    f"(cell_evals_per_request="
+                    f"{rec.get('cell_evals_per_request')})")
+            if not rec.get("directory_o_cell"):
+                fails.append(
+                    f"router regression: hierarchical pair "
+                    f"(n_replicas={rec.get('n_replicas')}) reports "
+                    f"a per-cell prefix directory holding more than "
+                    f"its share (directory_chains_max_cell="
+                    f"{rec.get('directory_chains_max_cell')})")
+            if not rec.get("sublinear_vs_flat"):
+                fails.append(
+                    f"router regression: hierarchical pair "
+                    f"(n_replicas={rec.get('n_replicas')}) reports "
+                    f"pod routing work NOT sublinear vs flat "
+                    f"(pod={rec.get('pod_evals_per_request')}, "
+                    f"flat={rec.get('flat_evals_per_request')})")
     return checked, fails
 
 

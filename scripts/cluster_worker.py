@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""One role process of the networked serving cluster.
+
+Spawned by ``launch.py --roles router:1,prefill:1,replica:2`` — each
+process reads its role from the environment and calls the matching
+runner in ``serving/cluster/net/fabric.py``:
+
+- **router**: rendezvous, dial the fleet, submit a seeded trace
+  (`seeded_trace` — the parity tests re-derive the identical trace
+  for the virtual run), drain, write ``<out>/results.json`` (the
+  mirrored token streams) and this rank's artifacts
+  (``<out>/rank-0/router-state.json`` + faults + lineage);
+- **replica / prefill**: host the real engine, answer the router
+  until BYE, then write this rank's lineage artifact — the doctor
+  merges all the per-rank directories into one Cluster section.
+
+``--chaos-seed`` arms a seeded fault schedule at the router (the
+window-free wire classes: drop/dup/corrupt/reorder), injected at the
+socket seam.  ``--fail-rank N`` makes rank N exit 3 before
+registering — the launch fail-fast (exit 2) test hook.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _spec_counts() -> dict:
+    out = {}
+    for part in os.environ.get("TDT_CLUSTER_SPEC", "").split(","):
+        name, _, count = part.partition(":")
+        if name.strip() and count.strip().isdigit():
+            out[name.strip()] = int(count)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True,
+                    help="run directory: results.json + per-rank "
+                         "artifact subdirectories land here")
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="trace seed (seeded_trace)")
+    ap.add_argument("--max-new", type=int, default=4)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--paged", action="store_true",
+                    help="paged KV layout (default: slots)")
+    ap.add_argument("--slots", type=int, default=3)
+    ap.add_argument("--chaos-seed", type=int, default=None,
+                    help="arm the wire fault classes with this seed")
+    ap.add_argument("--fail-rank", type=int, default=None,
+                    help="this rank exits 3 before rendezvous "
+                         "(launch fail-fast test hook)")
+    args = ap.parse_args()
+
+    rank = int(os.environ.get("TDT_PROCESS_ID", "0"))
+    role = os.environ.get("TDT_ROLE", "")
+    if args.fail_rank is not None and rank == args.fail_rank:
+        print(f"worker: rank {rank} failing on request "
+              "(--fail-rank)", file=sys.stderr, flush=True)
+        return 3
+
+    import jax
+
+    from triton_distributed_tpu.observability.lineage import (
+        write_lineage_artifact)
+    from triton_distributed_tpu.serving import (
+        ClusterConfig, SchedulerConfig, ToyConfig, ToyModel)
+    from triton_distributed_tpu.serving.cluster import (
+        FaultInjector, FaultSchedule, RouterConfig)
+    from triton_distributed_tpu.serving.cluster.net.fabric import (
+        run_role, seeded_trace)
+
+    # Every rank builds the SAME model deterministically — weights
+    # are a function of the fixed init seed, so no parameter
+    # broadcast is needed for the toy fleet.
+    model = ToyModel(ToyConfig(vocab_size=61, hidden=16,
+                               max_seq_len=64))
+    params = model.init_params(jax.random.key(0))
+    kv = ({"kv_layout": "paged", "page_size": 16}
+          if args.paged else {})
+    sc = SchedulerConfig(num_slots=args.slots,
+                         prefill_buckets=(8, 16, 32),
+                         temperature=args.temperature,
+                         top_k=args.top_k, **kv)
+    counts = _spec_counts()
+    cfg = ClusterConfig(
+        n_replicas=counts.get("replica", 1),
+        n_prefill_workers=counts.get("prefill", 0),
+        scheduler=sc,
+        router=RouterConfig(dead_after_s=5.0))
+
+    rank_dir = os.path.join(args.out, f"rank-{rank}")
+    if role == "router":
+        injector = None
+        if args.chaos_seed is not None:
+            # The window-free wire classes: pure functions of the
+            # shipment id, so wall-clock timing cannot perturb which
+            # faults fire.
+            injector = FaultInjector(FaultSchedule(
+                seed=args.chaos_seed,
+                classes=("drop", "dup", "corrupt", "reorder"),
+                ship_fault_rate=0.5))
+        cluster, fabric = run_role(model, params, cfg,
+                                   fault_injector=injector)
+        trace = seeded_trace(args.seed, args.requests,
+                             max_new=args.max_new)
+        recs = [cluster.submit(p, n, seed=s) for p, n, s in trace]
+        cluster.drain()
+        fabric.shutdown()
+        os.makedirs(args.out, exist_ok=True)
+        with open(os.path.join(args.out, "results.json"), "w") as f:
+            json.dump([{"seed": r.seed, "state": r.state,
+                        "tokens": list(r.tokens),
+                        "replicas": list(r.replica_history)}
+                       for r in recs], f, indent=1)
+        cluster.write_artifact(rank_dir)
+        bad = [r.state for r in recs if r.state != "finished"]
+        if bad:
+            print(f"worker: {len(bad)} requests not finished: {bad}",
+                  file=sys.stderr, flush=True)
+            return 1
+        return 0
+
+    # Host roles: serve until the router's BYE, then leave this
+    # rank's lineage (the hops recorded WHERE the compute ran).
+    run_role(model, params, cfg)
+    write_lineage_artifact(rank_dir)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

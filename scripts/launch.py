@@ -24,8 +24,10 @@ import glob
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
+import threading
 import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -137,6 +139,101 @@ def _run_doctor(dirs):
     except (OSError, subprocess.SubprocessError) as e:
         print(f"launch: doctor failed: {e}", file=sys.stderr,
               flush=True)
+
+
+class _RendezvousServer:
+    """The rank-directory server for ``--roles`` launches (protocol:
+    ``serving/cluster/net/rendezvous.py`` — one JSON line up per rank,
+    one directory line back once EVERY rank registered).  Lives in
+    the PARENT, stdlib-only, because the parent owns the process
+    group: when a rank dies mid-handshake the launcher aborts the
+    rendezvous (pending connections closed WITHOUT a reply, which the
+    clients surface as `RendezvousError`) and fails the launch with
+    exit 2 instead of letting the survivors block until --timeout."""
+
+    def __init__(self, world):
+        self.world = int(world)
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET,
+                             socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(self.world + 8)
+        self._srv.settimeout(0.25)
+        self.addr = f"127.0.0.1:{self._srv.getsockname()[1]}"
+        self._ranks = {}
+        self._conns = {}
+        self._lock = threading.Lock()
+        self.complete = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve,
+                                        daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while not (self._stop.is_set() or self.complete.is_set()):
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                conn.settimeout(10.0)
+                buf = b""
+                while not buf.endswith(b"\n"):
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        raise OSError("eof before registration")
+                    buf += chunk
+                reg = json.loads(buf.decode())
+                rank = int(reg["rank"])
+            except (OSError, ValueError, KeyError, TypeError):
+                conn.close()
+                continue
+            with self._lock:
+                old = self._conns.pop(rank, None)
+                self._ranks[rank] = {
+                    "role": str(reg.get("role", "")),
+                    "index": int(reg.get("index", 0)),
+                    "addr": str(reg.get("addr", ""))}
+                self._conns[rank] = conn
+                done = len(self._ranks) == self.world
+            if old is not None:
+                old.close()
+            if done:
+                self._release()
+
+    def _release(self):
+        reply = (json.dumps({
+            "ok": True, "world": self.world, "t0": time.time(),
+            "ranks": {str(r): v for r, v in self._ranks.items()}})
+            .encode() + b"\n")
+        with self._lock:
+            conns, self._conns = dict(self._conns), {}
+        for conn in conns.values():
+            try:
+                conn.sendall(reply)
+            except OSError:
+                pass
+            conn.close()
+        self.complete.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def abort(self):
+        """Close every held connection WITHOUT a reply — each blocked
+        rank fails with `RendezvousError` immediately."""
+        self._stop.set()
+        with self._lock:
+            conns, self._conns = dict(self._conns), {}
+        for conn in conns.values():
+            conn.close()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
 
 
 def _merge_traces(trace_dir):
@@ -254,7 +351,13 @@ def main() -> int:
                 rank += 1
 
     world = args.nproc * args.nnodes
+    # --roles launches get the rank-directory server: role processes
+    # rendezvous here (net/rendezvous.py) before opening their data
+    # plane, and a rank dying mid-handshake aborts the whole launch
+    # with exit 2 instead of hanging the survivors until --timeout.
+    rdv = _RendezvousServer(world) if role_of is not None else None
     procs = []
+    rank_of_pid = {}
     # Heartbeats ride under the trace dir (or wherever the user
     # already pointed TDT_HEARTBEAT_DIR) — the watchdog reads them to
     # name the stalled rank.
@@ -322,8 +425,10 @@ def main() -> int:
             env["TDT_ROLE"] = role
             env["TDT_ROLE_INDEX"] = str(idx)
             env["TDT_CLUSTER_SPEC"] = roles_spec
+            env["TDT_RENDEZVOUS"] = rdv.addr
         procs.append(subprocess.Popen(
             [sys.executable, args.script, *args.script_args], env=env))
+        rank_of_pid[procs[-1].pid] = rank
 
     rc = 0
     try:
@@ -336,7 +441,26 @@ def main() -> int:
             if p is None:
                 continue
             code = os.waitstatus_to_exitcode(status)
-            if code != 0:
+            if (rdv is not None and not rdv.complete.is_set()
+                    and code != 0):
+                # A role process DIED before the directory assembled:
+                # its peers are blocked in rendezvous and would sit
+                # there until --timeout.  Abort the handshake (their
+                # connections close without a reply -> RendezvousError
+                # in each) and fail the launch NOW.  (A clean exit 0
+                # is NOT a death: role workers that never dial the
+                # rendezvous — env-plumbing smoke runs — finish
+                # normally.)
+                role, idx = role_of[rank_of_pid.get(pid, -1)] \
+                    if rank_of_pid.get(pid, -1) in role_of \
+                    else ("?", "?")
+                print(f"launch: rank {rank_of_pid.get(pid)} "
+                      f"({role}:{idx}) exited {code} during "
+                      "rendezvous handshake; aborting launch",
+                      file=sys.stderr, flush=True)
+                rdv.abort()
+                rc = 2
+            elif code != 0:
                 rc = code
         for p in pending.values():
             p.send_signal(signal.SIGTERM)
@@ -364,6 +488,8 @@ def main() -> int:
             deadline -= 1
         rc = 130
     finally:
+        if rdv is not None:
+            rdv.abort()      # idempotent; releases port + held conns
         # SIGTERM, then escalate: a worker wedged in a collective can
         # ignore SIGTERM and outlive the launcher holding ports (ADVICE
         # r4) — poll briefly and SIGKILL survivors.

@@ -31,6 +31,11 @@
 #  - a chaos smoke (seeded lossy-wire fault schedule on the virtual
 #    clock -> token-for-token exact survivors -> schema-valid
 #    faults.jsonl -> doctor "Chaos" section names the fault classes);
+#  - a net smoke (launch.py --roles stands up REAL multi-process
+#    clusters over length-prefixed TCP: a 2-process run token-exact
+#    vs the in-process virtual transport, a 4-process seeded chaos
+#    run at the socket seam with every request finishing exactly,
+#    and one doctor invocation merging the per-rank directories);
 #  - a lineage smoke (2-replica virtual cluster -> schema-valid
 #    lineage.jsonl -> TTFT hop decomposition sums EXACTLY to the
 #    measured TTFT for every request -> doctor "Request lineage"
@@ -179,7 +184,8 @@ fi
 # so any diff is a real behavior change in links/anomaly/doctor.
 doctor_rc=0
 for scenario in stalled_rank sem_leak slow_link clean \
-        lossy_transport slow_request replayed_fault; do
+        lossy_transport slow_request replayed_fault \
+        socket_partition; do
     if ! JAX_PLATFORMS=cpu python -m \
             triton_distributed_tpu.observability.doctor \
             "tests/data/incidents/$scenario" -q \
@@ -543,6 +549,92 @@ router_rc=$?
 echo "$router_log" | tail -3
 if [ "$router_rc" -ne 0 ]; then
     echo "ROUTER_SMOKE=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
+# Net smoke: the REAL wire (ISSUE-18 NET_SMOKE gate).  launch.py
+# --roles forks genuinely separate OS processes that rendezvous over
+# TCP and speak the length-prefixed frame protocol: the 2-process run
+# must be token-for-token identical to the in-process virtual
+# transport for the same seeded trace; a 4-process run with a seeded
+# fault schedule armed at the SOCKET seam must finish every request
+# with tokens exactly matching the fault-free virtual reference while
+# wire faults demonstrably fired; and a single doctor invocation must
+# merge all the per-rank artifact directories into one Cluster view.
+net_dir=$(mktemp -d)
+net_chaos_dir=$(mktemp -d)
+net_rc=0
+JAX_PLATFORMS=cpu python scripts/launch.py --cpu \
+    --roles router:1,replica:1 --timeout 180 \
+    scripts/cluster_worker.py --out "$net_dir" \
+    --requests 5 --seed 13 >/dev/null 2>&1 || net_rc=1
+JAX_PLATFORMS=cpu python scripts/launch.py --cpu \
+    --roles router:1,prefill:1,replica:2 --timeout 180 \
+    scripts/cluster_worker.py --out "$net_chaos_dir" \
+    --requests 6 --seed 21 --chaos-seed 5 >/dev/null 2>&1 \
+    || net_rc=1
+net_log=$(JAX_PLATFORMS=cpu NET_DIR="$net_dir" \
+    NET_CHAOS_DIR="$net_chaos_dir" python - <<'EOF' 2>&1
+import json, os
+import jax
+from triton_distributed_tpu.observability import doctor
+from triton_distributed_tpu.serving import (
+    ClusterConfig, SchedulerConfig, ServingCluster, ToyConfig,
+    ToyModel)
+from triton_distributed_tpu.serving.cluster import RouterConfig
+from triton_distributed_tpu.serving.cluster.net.fabric import (
+    seeded_trace)
+
+model = ToyModel(ToyConfig(vocab_size=61, hidden=16, max_seq_len=64))
+params = model.init_params(jax.random.key(0))
+
+
+def virtual(n_replicas, n_prefill, trace):
+    """The in-process fault-free reference on the virtual clock —
+    mirrors cluster_worker.py's config exactly."""
+    sc = SchedulerConfig(num_slots=3, prefill_buckets=(8, 16, 32))
+    cluster = ServingCluster(model, params, ClusterConfig(
+        n_replicas=n_replicas, n_prefill_workers=n_prefill,
+        scheduler=sc, router=RouterConfig(dead_after_s=5.0)))
+    recs = [cluster.submit(p, n, seed=s) for p, n, s in trace]
+    cluster.drain()
+    return [list(r.tokens) for r in recs]
+
+
+# 2-process socket run == in-process virtual run, token for token.
+with open(os.path.join(os.environ["NET_DIR"], "results.json")) as f:
+    got = json.load(f)
+assert all(r["state"] == "finished" for r in got), got
+assert [r["tokens"] for r in got] == virtual(
+    1, 0, seeded_trace(13, 5)), "socket/virtual token divergence"
+
+# Chaos at the socket seam: every request finished, tokens exact vs
+# the fault-free reference, and wire faults really fired.
+with open(os.path.join(os.environ["NET_CHAOS_DIR"],
+                       "results.json")) as f:
+    chaos = json.load(f)
+assert all(r["state"] == "finished" for r in chaos), chaos
+assert [r["tokens"] for r in chaos] == virtual(
+    2, 1, seeded_trace(21, 6)), "chaos run perturbed tokens"
+with open(os.path.join(os.environ["NET_CHAOS_DIR"], "rank-0",
+                       "faults.jsonl")) as f:
+    fired = {json.loads(ln)["fault"] for ln in f if ln.strip()}
+assert fired & {"drop", "dup", "corrupt", "reorder"}, fired
+
+# One doctor invocation merges the per-rank directories.
+report = doctor.diagnose([os.environ["NET_CHAOS_DIR"]])
+md = doctor.render_markdown(report)
+assert md.count("## Cluster") == 1, md
+assert report["chaos"]["count"] >= 1, report["chaos"]
+assert report["lineage"]["events"] >= 1, report["lineage"]
+print("NET_SMOKE=ok")
+EOF
+)
+[ $? -ne 0 ] && net_rc=1
+echo "$net_log" | tail -3
+rm -rf "$net_dir" "$net_chaos_dir"
+if [ "$net_rc" -ne 0 ]; then
+    echo "NET_SMOKE=FAILED"
     [ "$rc" -eq 0 ] && rc=1
 fi
 

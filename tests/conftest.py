@@ -24,6 +24,14 @@ import pytest  # noqa: E402
 from jax.sharding import Mesh  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process/subprocess tests excluded from the "
+        "tier-1 `-m 'not slow'` sweep (covered by the NET_SMOKE "
+        "gate instead)")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -55,13 +55,15 @@ WORLD = 4
 AXIS = "tp"
 
 SCENARIOS = ("stalled_rank", "sem_leak", "slow_link", "clean",
-             "lossy_transport", "slow_request", "replayed_fault")
+             "lossy_transport", "slow_request", "replayed_fault",
+             "socket_partition")
 
 
 def _write(scenario: str, name: str, payload, truncate_at=None):
     d = os.path.join(HERE, scenario)
     os.makedirs(d, exist_ok=True)
     path = os.path.join(d, name)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
     text = json.dumps(payload, indent=1)
     if truncate_at is not None:
         text = text[:int(len(text) * truncate_at)]
@@ -556,13 +558,158 @@ def gen_replayed_fault():
             f.write(json.dumps(r) + "\n")
 
 
+def gen_socket_partition():
+    """A NETWORKED cluster run (``launch.py --roles``) that lost the
+    wire to one replica mid-flight: each surviving process left its
+    own ``rank-<N>/`` artifact directory (`scripts/cluster_worker.py`
+    layout) and the partitioned rank left NOTHING — its artifacts
+    died with its connectivity.  One doctor invocation over the run
+    root must ingest ALL the per-rank directories: the router doc
+    from ``rank-0/``, lineage concatenated across ``rank-0/`` (wire
+    hops: NACKed claims, retries, the reroute) and ``rank-1/`` (the
+    surviving replica's own enqueue/admit/retire hops, recorded where
+    the compute ran), and the chaos artifact naming the injected
+    window (a socket partition under the chaos harness = every frame
+    to the peer dropped + its heartbeats suppressed).  Timestamps are
+    CLUSTER-CLOCK seconds (``time.time() - t0``, the shared epoch all
+    ranks rendezvous onto)."""
+    s = "socket_partition"
+
+    def hop(rid, name, ts, actor, rank, **detail):
+        return {"request_id": rid, "hop": name, "ts": ts,
+                "actor": actor, "detail": detail, "rank": rank,
+                "schema": 1, "kind": "lineage"}
+
+    # Router-process lineage (rank 0): request 20 sails to the
+    # surviving replica; request 21's shipment to replica-1 NACKs
+    # (peer unreachable reads as ShipmentCorrupt), retries under the
+    # ship deadline, then reroutes to replica-0 and finishes there.
+    r0 = [
+        hop(20, "submit", 0.001, "cluster", 0, prompt_len=6,
+            max_new=8),
+        hop(20, "route_stage", 0.001, "router", 0,
+            replica="replica-0", path="worker", worker="prefill-0"),
+        hop(20, "ship", 0.0032, "transport", 0, token=0,
+            nbytes=9472, wire_ms=0.003),
+        hop(20, "ship_deliver", 0.0035, "transport", 0, token=0,
+            replica="replica-0"),
+        hop(20, "route_commit", 0.0035, "router", 0,
+            replica="replica-0", fallback=None),
+        hop(20, "first_token", 0.0045, "replica-0", 0, slot=0),
+        hop(20, "retire", 0.0125, "cluster", 0, reason="length",
+            generated=8),
+        hop(21, "submit", 0.0015, "cluster", 0, prompt_len=6,
+            max_new=8),
+        hop(21, "route_stage", 0.0015, "router", 0,
+            replica="replica-1", path="worker", worker="prefill-0"),
+        hop(21, "ship", 0.0036, "transport", 0, token=1,
+            nbytes=9472, wire_ms=0.003),
+        hop(21, "ship_nack", 0.0039, "transport", 0, token=1),
+        hop(21, "ship_retry", 0.0059, "transport", 0, token=2,
+            nbytes=9472, attempt=1, trigger="corrupt",
+            backoff_ms=2.0, wire_ms=0.003),
+        hop(21, "ship_nack", 0.0062, "transport", 0, token=2),
+        hop(21, "ship_retry", 0.0102, "transport", 0, token=3,
+            nbytes=9472, attempt=2, trigger="corrupt",
+            backoff_ms=4.0, wire_ms=0.003),
+        hop(21, "ship_nack", 0.0105, "transport", 0, token=3),
+        hop(21, "reroute", 0.0105, "transport", 0,
+            trigger="corrupt", attempts=3),
+        hop(21, "route_stage", 0.0115, "router", 0,
+            replica="replica-0", path="worker", worker="prefill-0"),
+        hop(21, "ship", 0.0137, "transport", 0, token=4,
+            nbytes=9472, wire_ms=0.003),
+        hop(21, "ship_deliver", 0.014, "transport", 0, token=4,
+            replica="replica-0"),
+        hop(21, "route_commit", 0.014, "router", 0,
+            replica="replica-0", fallback=None),
+        hop(21, "first_token", 0.015, "replica-0", 0, slot=1),
+        hop(21, "failover", 0.253, "router", 0,
+            replica="replica-1", reason="heartbeat_loss"),
+        hop(21, "retire", 0.023, "cluster", 0, reason="length",
+            generated=8),
+    ]
+    # Surviving replica's OWN lineage (rank 1): the hops its
+    # scheduler recorded in its process, joined by request id.
+    r1 = [
+        hop(20, "enqueue", 0.0035, "replica-0", 1, prompt_len=6,
+            queued=1),
+        hop(20, "admit", 0.0035, "replica-0", 1, slot=0, bucket=8,
+            mode="shipped"),
+        hop(20, "retire", 0.0125, "replica-0", 1, reason="length",
+            generated=8),
+        hop(21, "enqueue", 0.014, "replica-0", 1, prompt_len=6,
+            queued=1),
+        hop(21, "admit", 0.014, "replica-0", 1, slot=1, bucket=8,
+            mode="shipped"),
+        hop(21, "retire", 0.023, "replica-0", 1, reason="length",
+            generated=8),
+    ]
+    faults = [
+        {"schema": 1, "kind": "fault", "ts": 0.0036, "fault": "drop",
+         "target": "shipment:1", "inputs": {"nbytes": 9472},
+         "seed": 77},
+        {"schema": 1, "kind": "fault", "ts": 0.0059, "fault": "drop",
+         "target": "shipment:2", "inputs": {"nbytes": 9472},
+         "seed": 77},
+        {"schema": 1, "kind": "fault", "ts": 0.0102, "fault": "drop",
+         "target": "shipment:3", "inputs": {"nbytes": 9472},
+         "seed": 77},
+        {"schema": 1, "kind": "fault", "ts": 0.012,
+         "fault": "stale_hb", "target": "replica-1",
+         "inputs": {"window": [0.012, 0.3]}, "seed": 77},
+    ]
+    _write(s, os.path.join("rank-0", "router-state.json"), {
+        "schema": 1, "kind": "router", "ts": 0.31,
+        "mode": "signal_aware",
+        "replicas": [
+            {"id": 0, "name": "replica-0", "alive": True,
+             "quarantined": False, "fail_reason": None,
+             "hb_age_s": 0.002, "routed": 2, "queue_depth": 0,
+             "active_slots": 0, "last_step_s": 0.001},
+            {"id": 1, "name": "replica-1", "alive": False,
+             "quarantined": False, "fail_reason": "heartbeat_loss",
+             "hb_age_s": 0.298, "routed": 1, "queue_depth": 0,
+             "active_slots": 0, "last_step_s": 0.001},
+        ],
+        "failovers": [
+            {"ts": 0.253, "replica": "replica-1",
+             "reason": "heartbeat_loss", "requeued": 0,
+             "hb_age_s": 0.241},
+        ],
+        "affinity_prefixes": 0,
+        "kv_shipped_bytes": 47360, "shipments": 5,
+        "open_requests": 0,
+        "prefill_workers": [
+            {"name": "prefill-0", "queued": 0, "jobs_done": 2}],
+        "wire_pending": {},
+    })
+    base = os.path.join(HERE, s)
+    for rank, rows in ((0, r0), (1, r1)):
+        d = os.path.join(base, f"rank-{rank}")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "lineage.jsonl"), "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+    with open(os.path.join(base, "rank-0", "faults.jsonl"),
+              "w") as f:
+        for row in faults:
+            f.write(json.dumps(row) + "\n")
+
+
 def generate(clean_first: bool = True):
+    import shutil
     for scenario in SCENARIOS:
         d = os.path.join(HERE, scenario)
         if clean_first and os.path.isdir(d):
             for name in os.listdir(d):
-                if name != "report.golden.json":
-                    os.remove(os.path.join(d, name))
+                if name == "report.golden.json":
+                    continue
+                p = os.path.join(d, name)
+                if os.path.isdir(p):
+                    shutil.rmtree(p)     # per-rank subdirectories
+                else:
+                    os.remove(p)
     gen_stalled_rank()
     gen_sem_leak()
     gen_slow_link()
@@ -570,6 +717,7 @@ def generate(clean_first: bool = True):
     gen_lossy_transport()
     gen_slow_request()
     gen_replayed_fault()
+    gen_socket_partition()
     return [os.path.join(HERE, sc) for sc in SCENARIOS]
 
 

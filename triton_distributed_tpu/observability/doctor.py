@@ -123,6 +123,54 @@ def _load_json(path: str) -> Optional[dict]:
         return None
 
 
+def _merge_router_docs(docs: Sequence[dict]) -> Optional[dict]:
+    """Fold N per-process ``router-state*.json`` docs into ONE router
+    view (multi-rank cluster runs: a pod's routers each write their
+    own state).  One doc passes through untouched — single-router
+    reports, and the goldens built on them, stay byte-identical.
+
+    Merge discipline: the newest doc's scalars win; replicas merge by
+    NAME preferring the doc with the latest ``ts`` that names them;
+    failovers/readmits concatenate (deduped on (ts, replica, reason))
+    in time order; wire totals (``kv_shipped_bytes``/``shipments``)
+    SUM — each router counted its own transport."""
+    if not docs:
+        return None
+    if len(docs) == 1:
+        return docs[0]
+    docs = sorted(docs, key=lambda d: _num(d.get("ts")))
+    out = dict(docs[-1])
+    by_name: Dict[str, dict] = {}
+    for d in docs:                     # ascending ts: newest wins
+        for r in d.get("replicas", []):
+            by_name[str(r.get("name"))] = r
+    out["replicas"] = [
+        by_name[k] for k in sorted(
+            by_name,
+            key=lambda n: (_num(by_name[n].get("id"), 1e18), n))]
+    for key in ("failovers", "readmits"):
+        seen = set()
+        rows = []
+        for d in docs:
+            for f in d.get(key, []):
+                ident = (f.get("ts"), f.get("replica"),
+                         f.get("reason"))
+                if ident in seen:
+                    continue
+                seen.add(ident)
+                rows.append(f)
+        if rows:
+            out[key] = sorted(rows, key=lambda f: _num(f.get("ts")))
+        elif key in out:
+            del out[key]
+    for key in ("kv_shipped_bytes", "shipments"):
+        vals = [d.get(key) for d in docs if d.get(key) is not None]
+        if vals:
+            out[key] = sum(vals)
+    out["merged_from"] = len(docs)
+    return out
+
+
 class Artifacts:
     """Everything salvageable from one or more artifact directories."""
 
@@ -150,6 +198,13 @@ class Artifacts:
         for d in self.dirs:
             out += glob.glob(os.path.join(d, pattern))
             out += glob.glob(os.path.join(d, "heartbeats", pattern))
+            # Multi-process cluster runs leave one artifact directory
+            # per rank (``rank-<N>/``, `scripts/cluster_worker.py`);
+            # one doctor invocation over the run root must ingest all
+            # of them.
+            out += glob.glob(os.path.join(d, "rank-*", pattern))
+            out += glob.glob(os.path.join(d, "rank-*", "heartbeats",
+                                          pattern))
         return sorted(set(out))
 
     def _discover(self) -> None:
@@ -185,11 +240,12 @@ class Artifacts:
             if d is not None:
                 self.resource_findings = d
                 break
+        router_docs = []
         for p in self._glob("router-state*.json"):
             d = _load_json(p)
             if d is not None and d.get("kind") == "router":
-                self.router = d
-                break
+                router_docs.append(d)
+        self.router = _merge_router_docs(router_docs)
         decision_files = self._glob("decisions*.jsonl")
         if decision_files:
             from triton_distributed_tpu.observability.feedback import (
@@ -636,6 +692,11 @@ def analyze_cluster(art: Artifacts) -> Optional[dict]:
         # Key absent unless a probation re-admission happened, so
         # pre-hysteresis reports stay byte-identical.
         out["readmits"] = list(art.router["readmits"])
+    if art.router.get("merged_from"):
+        # Key absent for single-router artifacts, so every existing
+        # golden stays byte-identical; present, it says how many
+        # per-rank router docs this Cluster section folds together.
+        out["merged_from"] = art.router["merged_from"]
     return out
 
 

@@ -48,3 +48,11 @@ from triton_distributed_tpu.serving.cluster.transport import (  # noqa: F401
     ShipmentCorrupt,
     VirtualTransport,
 )
+
+# The networked backend (`serving.cluster.net`) is imported lazily by
+# its users — it pulls in socket plumbing that pure virtual-cluster
+# runs never need.  `SocketTransport` is re-exported here because it
+# is the `VirtualTransport` peer in the conformance contract.
+from triton_distributed_tpu.serving.cluster.net.transport import (  # noqa: F401,E402
+    SocketTransport,
+)

@@ -233,7 +233,8 @@ class ServingCluster:
                  config: Optional[ClusterConfig] = None,
                  clock: Optional[Callable[[], float]] = None,
                  clock_advance: Optional[Callable[[float], None]] = None,
-                 fault_injector: Optional[FaultInjector] = None):
+                 fault_injector: Optional[FaultInjector] = None,
+                 fabric=None):
         self.config = cfg = config or ClusterConfig()
         #: Chaos seam (`serving.cluster.chaos`): consulted at every
         #: heartbeat write and wire send.  The default injector has
@@ -261,17 +262,26 @@ class ServingCluster:
             clock = self._recorder.wrap(clock)
         self._clock = clock
         self._clock_advance = clock_advance
-        self.replicas = [
-            Replica(i, model, params, cfg.scheduler, clock,
-                    step_time_s=cfg.step_time_s)
-            for i in range(cfg.n_replicas)]
-        self.workers = [
-            PrefillWorker(i, model, params,
-                          self.replicas[0].scheduler.buckets,
-                          pad_id=cfg.scheduler.pad_id,
-                          prefill_time_s=cfg.prefill_time_s)
-            for i in range(cfg.n_prefill_workers)]
-        self.transport = VirtualTransport(wire_gbps=cfg.wire_gbps)
+        if fabric is not None:
+            # Networked mode (`net.fabric.NetFabric`): replicas and
+            # prefill workers are remote proxies over per-process
+            # channels, the transport carries real frames — the event
+            # loop below is identical either way.
+            self.replicas, self.workers, self.transport = (
+                fabric.build(model, params, cfg, clock))
+            self.injector.n_replicas = len(self.replicas)
+        else:
+            self.replicas = [
+                Replica(i, model, params, cfg.scheduler, clock,
+                        step_time_s=cfg.step_time_s)
+                for i in range(cfg.n_replicas)]
+            self.workers = [
+                PrefillWorker(i, model, params,
+                              self.replicas[0].scheduler.buckets,
+                              pad_id=cfg.scheduler.pad_id,
+                              prefill_time_s=cfg.prefill_time_s)
+                for i in range(cfg.n_prefill_workers)]
+            self.transport = VirtualTransport(wire_gbps=cfg.wire_gbps)
         self.router = ClusterRouter(cfg.router, self.replicas)
         if self._recorder is not None:
             # Seam taps: wire deliveries, fault injections, and the
@@ -974,29 +984,38 @@ class ServingCluster:
                                   nbytes)
         action = self.injector.on_ship(token, nbytes, now,
                                        kind=ship.get("kind", "kv"))
-        if action is None:
-            return
-        fault = action["fault"]
-        if fault == "drop":
-            self.transport.drop(token)
-            ship["lost"] = True
-        elif fault == "corrupt":
-            self.transport.corrupt(token, byte_index=token * 131)
-        elif fault == "dup":
-            ship["dup"] = True
-        elif fault in ("reorder", "stale"):
-            ship["ready_at"] += action["delay_s"]
-            ship["timeout_at"] += action["delay_s"]
-            if fault == "stale" and "deadline_at" in ship:
-                # "stale" means TOO LATE by definition: the schedule
-                # cannot know the cluster's prefix deadline (it is
-                # config, not seed), so the injected delay is pushed
-                # past it here — the delivery always misses and the
-                # dispatch degrades to recompute, whatever deadline
-                # the operator chose.
-                ship["ready_at"] = max(
-                    ship["ready_at"],
-                    ship["deadline_at"] + action["delay_s"])
+        if action is not None:
+            fault = action["fault"]
+            if fault == "drop":
+                self.transport.drop(token)
+                ship["lost"] = True
+            elif fault == "corrupt":
+                self.transport.corrupt(token, byte_index=token * 131)
+            elif fault == "dup":
+                ship["dup"] = True
+            elif fault in ("reorder", "stale"):
+                ship["ready_at"] += action["delay_s"]
+                ship["timeout_at"] += action["delay_s"]
+                if fault == "stale" and "deadline_at" in ship:
+                    # "stale" means TOO LATE by definition: the
+                    # schedule cannot know the cluster's prefix
+                    # deadline (it is config, not seed), so the
+                    # injected delay is pushed past it here — the
+                    # delivery always misses and the dispatch
+                    # degrades to recompute, whatever deadline the
+                    # operator chose.
+                    ship["ready_at"] = max(
+                        ship["ready_at"],
+                        ship["deadline_at"] + action["delay_s"])
+        # Networked backend: the frame leaves only AFTER the fault
+        # decision acted on the staged copy — a dropped shipment is
+        # never transmitted, a corrupted one crosses the wire with
+        # its payload byte already flipped (sent-time CRC intact), so
+        # the socket seam carries the same chaos the virtual wire
+        # models.  The virtual backend has no routing (no-op).
+        route = getattr(self.transport, "route_shipment", None)
+        if route is not None:
+            route(token, self.replicas[ship["dst"]].name)
 
     def _retry_or_reroute(self, ship: dict, now: float,
                           trigger: str) -> None:

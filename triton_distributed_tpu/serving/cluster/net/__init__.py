@@ -1,0 +1,14 @@
+"""Real networked cluster wire: framing, connections, rendezvous,
+and the `SocketTransport` backend that carries the exact
+`VirtualTransport` contract over TCP between role processes."""
+
+from triton_distributed_tpu.serving.cluster.net.frame import (  # noqa: F401
+    BYE, CALL, FrameError, HELLO, MAGIC, REPLY, SHIP, VERSION,
+    WELCOME, pack_frame, recv_frame, send_frame)
+from triton_distributed_tpu.serving.cluster.net.node import (  # noqa: F401
+    Channel, NetError, NetTimeout, addr_of, connect, listen,
+    serve_connection)
+from triton_distributed_tpu.serving.cluster.net.rendezvous import (  # noqa: F401
+    ENV_RENDEZVOUS, Directory, RendezvousError, rendezvous)
+from triton_distributed_tpu.serving.cluster.net.transport import (  # noqa: F401
+    SocketTransport, WireHost)

@@ -1,0 +1,239 @@
+"""Wiring a live multi-process cluster out of role processes.
+
+``scripts/launch.py --roles router:1,prefill:1,replica:2`` spawns one
+process per rank with `TDT_ROLE`/`TDT_ROLE_INDEX` set and the
+parent's rendezvous server in ``TDT_RENDEZVOUS``.  Each process then
+calls its role runner here:
+
+- replica / prefill ranks: :func:`run_replica` / :func:`run_prefill`
+  — open a data-plane listener, register it at the rendezvous, host
+  the real engine, and answer the router until BYE;
+- the router rank: :func:`connect_cluster` — rendezvous (no
+  listener: hosts never call the driver), build a :class:`NetFabric`
+  that dials every peer once, and construct a completely ordinary
+  `ServingCluster` whose replicas/workers/transport are the remote
+  proxies.  ``drain()``, chaos injection, artifacts, record/replay —
+  everything above the proxies is the same code the in-process
+  cluster runs.
+
+All processes share one clock epoch: the rendezvous reply carries
+``t0`` (unix time at directory assembly) and every rank's cluster
+clock is ``time.time() - t0`` (`time.monotonic` epochs are
+process-local and cannot cross the wire), so heartbeat ages, ship
+deadlines and lineage hop timestamps are comparable fleet-wide.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Dict, Optional
+
+from triton_distributed_tpu.serving.cluster.net import node as _node
+from triton_distributed_tpu.serving.cluster.net.node import (
+    Channel, NetError, serve_connection)
+from triton_distributed_tpu.serving.cluster.net.remote import (
+    PrefillHost, RemotePrefillWorker, RemoteReplica, ReplicaHost)
+from triton_distributed_tpu.serving.cluster.net.rendezvous import (
+    Directory, rendezvous)
+from triton_distributed_tpu.serving.cluster.net.transport import (
+    SocketTransport)
+
+
+def cluster_clock(t0: float):
+    """The shared-epoch wall clock every rank runs on."""
+    return lambda: time.time() - t0
+
+
+def seeded_trace(seed: int, n: int, vocab: int = 61,
+                 max_new: int = 4) -> list:
+    """A deterministic request trace: ``[(prompt, max_new, seed),
+    ...]``.  Both sides of every parity check (the socket run in a
+    worker process, the virtual run in the test/gate process) derive
+    it from the same ``seed``, so "same trace" is a number, not a
+    file."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(int(n)):
+        plen = int(rng.integers(3, 20))
+        prompt = [int(t) for t in rng.integers(1, vocab, plen)]
+        out.append((prompt, int(max_new), int(seed) * 1000 + i))
+    return out
+
+
+def _rank(rank: Optional[int]) -> int:
+    if rank is not None:
+        return int(rank)
+    return int(os.environ.get("TDT_PROCESS_ID", "0"))
+
+
+def _index(index: Optional[int]) -> int:
+    if index is not None:
+        return int(index)
+    return int(os.environ.get("TDT_ROLE_INDEX", "0"))
+
+
+def _buckets(model, sched_cfg) -> tuple:
+    """The scheduler's bucket derivation, without building one (the
+    prefill role needs buckets but hosts no decode engine)."""
+    max_seq = sched_cfg.max_seq or model.config.max_seq_len
+    return tuple(sorted(b for b in sched_cfg.prefill_buckets
+                        if b <= int(max_seq)))
+
+
+class NetFabric:
+    """The router process's view of the fleet: one dialed `Channel`
+    per peer rank, and factories for the remote proxies
+    `ServingCluster` consumes via its ``fabric=`` seam."""
+
+    def __init__(self, directory: Directory, rank: Optional[int] = None,
+                 dial_timeout_s: float = 30.0):
+        self.directory = directory
+        self.rank = _rank(rank)
+        self.channels: Dict[int, Channel] = {}
+        for r, info in sorted(directory.ranks.items()):
+            if r == self.rank or info["role"] == "router":
+                continue
+            self.channels[r] = Channel.dial(
+                info["addr"], self.rank, peer_rank=r,
+                timeout=dial_timeout_s)
+
+    def build(self, model, params, cfg, clock) -> tuple:
+        """(replicas, workers, transport) for `ServingCluster`.  The
+        reference scheduler built here stays driver-side: it answers
+        structural-reject geometry for every proxy (homogeneous
+        fleet) and never admits a request."""
+        from triton_distributed_tpu.serving.scheduler import (
+            ContinuousBatchingScheduler)
+        ref = ContinuousBatchingScheduler(model, params,
+                                          cfg.scheduler, clock=clock)
+        transport = SocketTransport(wire_gbps=cfg.wire_gbps)
+        replicas = []
+        for i, r in enumerate(self.directory.by_role("replica")):
+            ch = self.channels[r]
+            rep = RemoteReplica(i, ch, ref, clock,
+                                step_time_s=cfg.step_time_s)
+            transport.attach(rep.name, ch)
+            replicas.append(rep)
+        workers = [
+            RemotePrefillWorker(i, self.channels[r], clock,
+                                prefill_time_s=cfg.prefill_time_s)
+            for i, r in enumerate(self.directory.by_role("prefill"))]
+        return replicas, workers, transport
+
+    def shutdown(self) -> None:
+        """Orderly teardown: BYE every host (their serve loops end
+        and the role processes exit 0)."""
+        for ch in self.channels.values():
+            try:
+                ch.bye()
+            except NetError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Role runners (what the spawned processes call)
+# ---------------------------------------------------------------------------
+
+
+def connect_cluster(model, params, config, *,
+                    rank: Optional[int] = None,
+                    server: Optional[str] = None,
+                    fault_injector=None):
+    """Router-role runner: rendezvous, dial the fleet, and return
+    ``(cluster, fabric)`` — a `ServingCluster` on remote proxies and
+    the real wall clock.  Call ``fabric.shutdown()`` after the run so
+    the role processes exit."""
+    from triton_distributed_tpu.serving.cluster.cluster import (
+        ServingCluster)
+    rank = _rank(rank)
+    d = rendezvous(rank, "router", _index(None), "-", server=server)
+    clock = cluster_clock(d.t0)
+    fabric = NetFabric(d, rank)
+    cluster = ServingCluster(model, params, config, clock=clock,
+                             fault_injector=fault_injector,
+                             fabric=fabric)
+    return cluster, fabric
+
+
+def run_replica(model, params, config, *,
+                rank: Optional[int] = None,
+                index: Optional[int] = None,
+                server: Optional[str] = None,
+                host: str = "127.0.0.1",
+                accept_timeout_s: float = 120.0):
+    """Replica-role runner: host one real `Replica` and answer the
+    router until BYE/EOF.  Returns the replica (post-run
+    introspection — e.g. writing this rank's artifacts)."""
+    from triton_distributed_tpu.serving.cluster.replica import Replica
+    rank = _rank(rank)
+    index = _index(index)
+    srv = _node.listen(host)
+    d = rendezvous(rank, "replica", index, _node.addr_of(srv),
+                   server=server)
+    clock = cluster_clock(d.t0)
+    rep = Replica(index, model, params, config.scheduler, clock,
+                  step_time_s=config.step_time_s)
+    service = ReplicaHost(rep)
+    srv.settimeout(accept_timeout_s)
+    try:
+        sock, _ = srv.accept()
+    except socket.timeout:
+        raise NetError(
+            f"replica rank {rank}: router never dialed within "
+            f"{accept_timeout_s}s") from None
+    finally:
+        srv.close()
+    serve_connection(sock, rank, service.dispatch)
+    return rep
+
+
+def run_prefill(model, params, config, *,
+                rank: Optional[int] = None,
+                index: Optional[int] = None,
+                server: Optional[str] = None,
+                host: str = "127.0.0.1",
+                accept_timeout_s: float = 120.0):
+    """Prefill-role runner: host one real `PrefillWorker` and answer
+    the router until BYE/EOF."""
+    from triton_distributed_tpu.serving.cluster.prefill import (
+        PrefillWorker)
+    rank = _rank(rank)
+    index = _index(index)
+    srv = _node.listen(host)
+    rendezvous(rank, "prefill", index, _node.addr_of(srv),
+               server=server)
+    worker = PrefillWorker(index, model, params,
+                           _buckets(model, config.scheduler),
+                           pad_id=config.scheduler.pad_id,
+                           prefill_time_s=config.prefill_time_s)
+    service = PrefillHost(worker)
+    srv.settimeout(accept_timeout_s)
+    try:
+        sock, _ = srv.accept()
+    except socket.timeout:
+        raise NetError(
+            f"prefill rank {rank}: router never dialed within "
+            f"{accept_timeout_s}s") from None
+    finally:
+        srv.close()
+    serve_connection(sock, rank, service.dispatch)
+    return worker
+
+
+def run_role(model, params, config, **kw):
+    """Dispatch on `TDT_ROLE` — the one-call entry a worker script
+    uses under ``launch.py --roles``.  Router ranks get back
+    ``(cluster, fabric)``; hosts block until the run ends and return
+    their engine object."""
+    role = os.environ.get("TDT_ROLE", "")
+    if role == "router":
+        return connect_cluster(model, params, config, **kw)
+    if role == "replica":
+        return run_replica(model, params, config, **kw)
+    if role == "prefill":
+        return run_prefill(model, params, config, **kw)
+    raise NetError(f"no cluster role in environment (TDT_ROLE="
+                   f"{role!r}); launch with scripts/launch.py --roles")

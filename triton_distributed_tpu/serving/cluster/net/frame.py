@@ -1,0 +1,119 @@
+"""Length-prefixed framing for the cluster's real wire.
+
+Every message between cluster processes — KV page payloads, claim
+RPCs, heartbeat/status replies, the handshake itself — is one frame
+on a TCP stream:
+
++----------+---------+--------+----------+----------+-----------+
+| magic    | version | kind   | meta_len | body_len | meta+body |
+| 4 bytes  | 1 byte  | 1 byte | 4 bytes  | 4 bytes  | variable  |
++----------+---------+--------+----------+----------+-----------+
+
+``meta`` is a UTF-8 JSON object (small control fields: tokens, CRCs,
+request geometry); ``body`` is raw payload bytes (the npz-serialized
+`KVShipment` — NEVER JSON-wrapped, KV pages cross the wire as the
+same bytes `VirtualTransport` carries).  The fixed header makes a
+torn or misaligned stream fail loudly (bad magic) instead of
+deserializing garbage, and the two explicit lengths mean one
+``recv_exact`` per section — no in-band delimiters to escape.
+
+The frame layer is transport policy-free: integrity (CRC32 at claim),
+idempotence (one-shot claim per shipment id) and retries all live in
+:mod:`net.transport` / the cluster above it, exactly where the
+virtual backend keeps them.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+#: Stream magic — rejects cross-protocol or misaligned peers loudly.
+MAGIC = b"TDTW"
+VERSION = 1
+
+#: One struct for the fixed header: magic, version, kind, meta length,
+#: body length.
+HEADER = struct.Struct("!4sBBII")
+
+#: Frame kinds.  Control RPCs share one kind (the method rides meta)
+#: so the frame layer never grows a per-RPC enum; payload-bearing
+#: kinds are distinct because their body bytes mean different things.
+HELLO = 1       # handshake: rank/role registration
+WELCOME = 2     # handshake: the rank directory
+SHIP = 3        # a KV/prefix shipment's bytes (body = npz payload)
+CALL = 4        # RPC request (meta.method + args; body optional)
+REPLY = 5       # RPC response (meta.rid matches the CALL)
+BYE = 6         # orderly shutdown
+
+#: Refuse absurd frames before allocating for them (a corrupted
+#: length field must not trigger a multi-GB recv buffer).
+MAX_META = 1 << 20
+MAX_BODY = 1 << 30
+
+
+class FrameError(Exception):
+    """The stream violated the frame contract (bad magic/version or
+    an oversized length): the connection is unusable, tear it down."""
+
+
+def pack_frame(kind: int, meta: dict, body: bytes = b"") -> bytes:
+    mb = json.dumps(meta, separators=(",", ":")).encode()
+    return HEADER.pack(MAGIC, VERSION, kind, len(mb), len(body)) \
+        + mb + body
+
+
+def send_frame(sock: socket.socket, kind: int, meta: dict,
+               body: bytes = b"") -> int:
+    """One sendall per frame (header+meta+body coalesced): frames are
+    never interleaved mid-stream by the sender."""
+    data = pack_frame(kind, meta, body)
+    sock.sendall(data)
+    return len(data)
+
+
+def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes; None = orderly EOF at a frame
+    boundary (mid-frame EOF raises — a torn frame is an error)."""
+    if n == 0:
+        return b""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            if got == 0:
+                return None
+            raise FrameError(f"EOF mid-frame ({got}/{n} bytes)")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket
+               ) -> Optional[Tuple[int, dict, bytes]]:
+    """Next (kind, meta, body) from the stream; None = clean EOF."""
+    hdr = recv_exact(sock, HEADER.size)
+    if hdr is None:
+        return None
+    magic, version, kind, meta_len, body_len = HEADER.unpack(hdr)
+    if magic != MAGIC:
+        raise FrameError(f"bad magic {magic!r}")
+    if version != VERSION:
+        raise FrameError(f"unsupported frame version {version}")
+    if meta_len > MAX_META or body_len > MAX_BODY:
+        raise FrameError(
+            f"oversized frame (meta={meta_len}, body={body_len})")
+    meta_b = recv_exact(sock, meta_len)
+    if meta_b is None:
+        raise FrameError("EOF before frame meta")
+    try:
+        meta = json.loads(meta_b.decode())
+    except (UnicodeDecodeError, ValueError) as e:
+        raise FrameError(f"undecodable frame meta: {e}") from e
+    body = recv_exact(sock, body_len)
+    if body is None:
+        raise FrameError("EOF before frame body")
+    return kind, meta, body

@@ -1,0 +1,217 @@
+"""Connection plumbing for cluster processes: listeners, dials, and
+the one-outstanding-call RPC discipline the driver uses.
+
+Topology is a star: the router process (the driver) dials every
+replica/prefill process once and keeps that connection for the run.
+All traffic rides it — SHIP frames push KV bytes host-ward, CALL/
+REPLY frames carry every control exchange (submit, step, claim,
+heartbeat probe), and BYE ends the session.  Hosts never call the
+driver; they answer.  That makes the protocol trivially deadlock-free
+and keeps delivery ordering per-connection deterministic: a CLAIM
+issued after a SHIP on the same socket always finds the bytes
+already enqueued (TCP is FIFO), which is exactly the ordering the
+virtual transport's in-flight map provides.
+
+The driver loop is single-threaded, so RPC needs no correlation
+machinery: after a CALL, the next REPLY on that socket is the answer
+(the ``rid`` echo is asserted anyway — a desynchronized stream must
+fail loudly, not mis-pair replies).
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+from typing import Callable, Optional, Tuple
+
+from triton_distributed_tpu.serving.cluster.net.frame import (
+    BYE, CALL, FrameError, HELLO, REPLY, WELCOME, recv_frame,
+    send_frame)
+
+
+class NetError(Exception):
+    """The peer is gone or the stream broke: the caller treats the
+    remote as dead (heartbeat loss), never as a silent success."""
+
+
+class NetTimeout(NetError):
+    """An RPC exceeded its wall deadline."""
+
+
+def listen(host: str = "127.0.0.1", port: int = 0
+           ) -> socket.socket:
+    """A listening socket on an ephemeral (or given) port."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(16)
+    return srv
+
+
+def addr_of(srv: socket.socket) -> str:
+    host, port = srv.getsockname()[:2]
+    return f"{host}:{port}"
+
+
+def connect(addr: str, timeout: Optional[float] = 10.0
+            ) -> socket.socket:
+    host, port = addr.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)),
+                                    timeout=timeout)
+    # Latency over throughput: CALL/REPLY frames are tiny and the
+    # driver blocks on each reply — Nagle would add 40ms stalls.
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.settimeout(None)
+    return sock
+
+
+class Channel:
+    """The driver's end of one host connection: pushes and RPCs."""
+
+    def __init__(self, sock: socket.socket, peer_rank: int = -1):
+        self.sock = sock
+        self.peer_rank = peer_rank
+        self._rid = itertools.count()
+        self.closed = False
+
+    @classmethod
+    def dial(cls, addr: str, rank: int, peer_rank: int = -1,
+             timeout: Optional[float] = 10.0) -> "Channel":
+        """Connect and run the data-plane handshake: HELLO carries
+        the caller's rank, WELCOME must echo the peer's — a wrong
+        process on the right port fails here, not mid-run."""
+        ch = cls(connect(addr, timeout=timeout), peer_rank=peer_rank)
+        ch.sock.settimeout(timeout)
+        try:
+            send_frame(ch.sock, HELLO, {"rank": rank})
+            got = recv_frame(ch.sock)
+            if got is None or got[0] != WELCOME:
+                raise NetError(f"handshake to {addr}: no WELCOME")
+            if (peer_rank >= 0
+                    and got[1].get("rank") != peer_rank):
+                raise NetError(
+                    f"handshake to {addr}: expected rank "
+                    f"{peer_rank}, got {got[1].get('rank')!r}")
+            ch.peer_rank = int(got[1].get("rank", -1))
+        finally:
+            ch.sock.settimeout(None)
+        return ch
+
+    def push(self, kind: int, meta: dict, body: bytes = b"") -> None:
+        """Fire-and-forget frame (SHIP and fault controls)."""
+        if self.closed:
+            raise NetError("channel closed")
+        try:
+            send_frame(self.sock, kind, meta, body)
+        except OSError as e:
+            self.closed = True
+            raise NetError(f"push to rank {self.peer_rank}: {e}") \
+                from e
+
+    def call(self, method: str, meta: Optional[dict] = None,
+             body: bytes = b"",
+             timeout: Optional[float] = 30.0) -> Tuple[dict, bytes]:
+        """Synchronous RPC: one CALL out, the next REPLY back."""
+        if self.closed:
+            raise NetError("channel closed")
+        rid = next(self._rid)
+        m = dict(meta or ())
+        m["method"] = method
+        m["rid"] = rid
+        try:
+            self.sock.settimeout(timeout)
+            send_frame(self.sock, CALL, m, body)
+            got = recv_frame(self.sock)
+        except socket.timeout as e:
+            self.closed = True
+            raise NetTimeout(
+                f"call {method!r} to rank {self.peer_rank} timed "
+                f"out after {timeout}s") from e
+        except (OSError, FrameError) as e:
+            self.closed = True
+            raise NetError(
+                f"call {method!r} to rank {self.peer_rank}: {e}") \
+                from e
+        finally:
+            if not self.closed:
+                self.sock.settimeout(None)
+        if got is None or got[0] != REPLY:
+            self.closed = True
+            raise NetError(
+                f"call {method!r}: peer closed or sent kind "
+                f"{None if got is None else got[0]}")
+        rmeta, rbody = got[1], got[2]
+        if rmeta.get("rid") != rid:
+            self.closed = True
+            raise NetError(
+                f"call {method!r}: reply rid {rmeta.get('rid')} != "
+                f"{rid} (stream desynchronized)")
+        if "error" in rmeta:
+            raise NetError(
+                f"call {method!r}: remote error: {rmeta['error']}")
+        return rmeta, rbody
+
+    def bye(self) -> None:
+        if not self.closed:
+            try:
+                send_frame(self.sock, BYE, {})
+            except OSError:
+                pass
+        self.close()
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def serve_connection(sock: socket.socket, rank: int,
+                     dispatch: Callable[[int, dict, bytes],
+                                        Optional[Tuple[dict, bytes]]]
+                     ) -> None:
+    """Host side: answer one driver connection until BYE/EOF.
+
+    ``dispatch(kind, meta, body)`` handles every non-handshake frame;
+    for CALL it returns ``(reply_meta, reply_body)`` (an exception
+    becomes an ``error`` reply — the host survives a bad request, the
+    driver raises), for pushed kinds it returns None.
+    """
+    got = recv_frame(sock)
+    if got is None or got[0] != HELLO:
+        sock.close()
+        return
+    send_frame(sock, WELCOME, {"rank": rank})
+    while True:
+        try:
+            got = recv_frame(sock)
+        except (OSError, FrameError):
+            break
+        if got is None:
+            break
+        kind, meta, body = got
+        if kind == BYE:
+            break
+        if kind == CALL:
+            rid = meta.get("rid")
+            try:
+                out = dispatch(kind, meta, body)
+                rmeta, rbody = out if out is not None else ({}, b"")
+            except Exception as e:            # noqa: BLE001 — reply,
+                rmeta, rbody = {"error": f"{type(e).__name__}: {e}"
+                                }, b""        # never kill the host
+            rmeta = dict(rmeta)
+            rmeta["rid"] = rid
+            try:
+                send_frame(sock, REPLY, rmeta, rbody)
+            except OSError:
+                break
+        else:
+            try:
+                dispatch(kind, meta, body)
+            except Exception:                 # noqa: BLE001
+                # A torn push (unknown token etc.) must not take the
+                # host down — the driver's claim will see the miss.
+                pass
+    sock.close()

@@ -1,0 +1,475 @@
+"""Remote replica / prefill-worker endpoints and their driver-side
+proxies.
+
+The multi-process cluster keeps `ServingCluster`'s event loop intact
+and moves the COMPUTE out: each replica process hosts a real
+`Replica` (its own `ContinuousBatchingScheduler`, KV pool and jitted
+programs), each prefill process a real `PrefillWorker`, and the
+router process drives them through the proxies here — the same
+attribute surface (`beat`/`ready`/`step`/`signals`/`scheduler.submit`
+/`finished`/`has_work`/`stop`/`restart`) the in-process objects
+expose, backed by CALL/REPLY frames on the per-host channel.
+
+Contracts that keep the wire exact:
+
+- **Request identity**: the driver's ``request_id`` rides the submit
+  RPC and the host constructs its `Request` with it, so finished
+  entries and token streams join back to the right `ClusterRequest`
+  without translation tables.
+- **Token mirroring**: the host collects each request's streamed
+  tokens (the scheduler loop calls ``on_token`` in the replica
+  process) and every step/stop reply drains them in emission order;
+  the proxy replays them into the driver-side callbacks — the
+  record's mirrored stream is byte-identical to the local cluster's,
+  because tokens are a pure function of (prompt, seed).
+- **Finished mirroring**: replies carry retirements past a host-side
+  cursor; the proxy appends enum-reconstructed stubs to its mirrored
+  ``finished`` list, so `ServingCluster._collect_finished` and the
+  readmit ``fin_i`` bookkeeping run unchanged.
+- **Structural rejects stay driver-side**: replicas are homogeneous,
+  so `structural_reject` (pure request-geometry-vs-config) evaluates
+  on a local reference scheduler without a round trip.
+- **Failure = silence**: any RPC failure marks the proxy's process
+  dead and nothing else; the router then learns of it the only way a
+  real router can — the heartbeat stops refreshing and the liveness
+  check drains the replica through the normal failover path.
+"""
+
+from __future__ import annotations
+
+import collections
+import types
+from typing import Deque, Dict, List, Optional
+
+import numpy as np
+
+from triton_distributed_tpu.serving.cluster.net import frame as _frame
+from triton_distributed_tpu.serving.cluster.net.node import (
+    Channel, NetError)
+from triton_distributed_tpu.serving.cluster.net.transport import (
+    WireHost)
+from triton_distributed_tpu.serving.cluster.transport import KVShipment
+from triton_distributed_tpu.serving.request import (
+    FinishReason, RejectReason, Request, RequestState)
+
+
+# ---------------------------------------------------------------------------
+# Host side (replica / prefill processes)
+# ---------------------------------------------------------------------------
+
+
+class ReplicaHost:
+    """Replica-process service: one real `Replica` plus the wire
+    endpoint KV shipments land on.  `dispatch` is the handler
+    `node.serve_connection` drives."""
+
+    def __init__(self, replica):
+        self.replica = replica
+        self.wire = WireHost()
+        #: request_id -> tokens streamed since the last drain (the
+        #: scheduler loop appends via the per-request collector).
+        self._tokens: Dict[int, List[int]] = {}
+        #: Cursor into ``scheduler.finished`` — which retirements
+        #: have already been shipped to the driver.
+        self._sent = 0
+
+    def _collector(self, req, tok):
+        self._tokens.setdefault(req.request_id, []).append(int(tok))
+
+    def _drain(self) -> dict:
+        toks = {str(k): v for k, v in self._tokens.items() if v}
+        self._tokens.clear()
+        fin = self.replica.scheduler.finished
+        new = []
+        while self._sent < len(fin):
+            r = fin[self._sent]
+            self._sent += 1
+            new.append({
+                "request_id": r.request_id,
+                "state": r.state.value,
+                "finish_reason": (r.finish_reason.value
+                                  if r.finish_reason else None),
+                "reject_reason": (r.reject_reason.value
+                                  if r.reject_reason else None)})
+        return {"tokens": toks, "finished": new,
+                "has_work": self.replica.scheduler.has_work()}
+
+    def dispatch(self, kind: int, meta: dict, body: bytes):
+        if kind == _frame.SHIP:
+            return self.wire.dispatch(kind, meta, body)
+        method = meta.get("method", "")
+        if method.startswith("wire."):
+            return self.wire.dispatch(kind, meta, body)
+        rep = self.replica
+        if method == "rep.submit":
+            req = Request(
+                prompt=meta["prompt"],
+                max_new_tokens=int(meta["max_new_tokens"]),
+                eos_token_ids=tuple(meta.get("eos_token_ids", ())),
+                seed=int(meta.get("seed", 0)),
+                arrival_time=meta.get("arrival_time"),
+                on_token=self._collector,
+                tenant=meta.get("tenant", "default"),
+                request_id=int(meta["request_id"]),
+                lineage_id=meta.get("lineage_id"))
+            if meta.get("resume_key") is not None:
+                req.resume_key = np.asarray(meta["resume_key"],
+                                            dtype=np.uint32)
+            if meta.get("shipped"):
+                req.shipped_kv = KVShipment.from_bytes(body)
+            accepted = rep.scheduler.submit(req)
+            out = {"accepted": bool(accepted),
+                   "reject_reason": (req.reject_reason.value
+                                     if req.reject_reason else None)}
+            out["has_work"] = rep.scheduler.has_work()
+            return out, b""
+        if method == "rep.step":
+            now = float(meta["now"])
+            rep.step(now)
+            out = self._drain()
+            out["last_step_s"] = rep.last_step_s
+            out["signals"] = rep.signals(now)
+            return out, b""
+        if method == "rep.beat":
+            ts = float(meta["now"])
+            rep.beat(ts)
+            return {"alive": rep.alive,
+                    "has_work": rep.scheduler.has_work(),
+                    "signals": rep.signals(ts)}, b""
+        if method == "rep.stop":
+            rep.scheduler.stop()
+            return self._drain(), b""
+        if method == "rep.restart":
+            rep.scheduler.restart()
+            # Retirements the stop() minted were drained by the stop
+            # reply; keep the cursor at the list head regardless.
+            self._sent = len(rep.scheduler.finished)
+            return {"ok": True}, b""
+        if method == "rep.probe":
+            return {"step_s": rep.probe_step_s()}, b""
+        if method == "rep.kill":
+            rep.kill()
+            return {"ok": True}, b""
+        raise NetError(f"unknown method {method!r}")
+
+
+class PrefillHost:
+    """Prefill-process service: the real `PrefillWorker` compute,
+    driven one job per RPC.  Queueing and busy-time pacing stay with
+    the DRIVER's proxy (the cluster event loop owns time); the host
+    just turns a prompt into `KVShipment` bytes — and records the
+    prefill lineage hops in its own process, where the compute ran."""
+
+    def __init__(self, worker):
+        self.worker = worker
+
+    def dispatch(self, kind: int, meta: dict, body: bytes):
+        if kind != _frame.CALL:
+            return None
+        method = meta.get("method", "")
+        if method == "pf.run":
+            now = float(meta["now"])
+            stub = types.SimpleNamespace(
+                prompt=list(meta["prompt"]),
+                lineage_id=meta.get("lineage_id"))
+            w = self.worker
+            w.submit(stub, int(meta.get("dst", 0)))
+            w.busy_until = min(w.busy_until, now)
+            out = w.step(now)
+            assert out is not None
+            _req, _dst, shipment, _done = out
+            return ({"prompt_len": shipment.prompt_len,
+                     "nbytes": shipment.nbytes},
+                    shipment.to_bytes())
+        raise NetError(f"unknown method {method!r}")
+
+
+# ---------------------------------------------------------------------------
+# Driver side (router process)
+# ---------------------------------------------------------------------------
+
+
+class _FinStub(types.SimpleNamespace):
+    """A mirrored retirement: exactly the fields
+    `ServingCluster._collect_finished` reads, enums reconstructed."""
+
+
+class RemoteScheduler:
+    """The `scheduler` attribute of a `RemoteReplica`: submit/stop/
+    restart RPC through, `finished`/`has_work` mirrored from replies,
+    structural checks evaluated locally on the shared reference
+    scheduler (`ref` — pure geometry, homogeneous fleet)."""
+
+    def __init__(self, channel: Channel, ref, clock):
+        self._ch = channel
+        self._ref = ref
+        self._clock = clock
+        self.finished: List[_FinStub] = []
+        self._cbs: Dict[int, Optional[object]] = {}
+        self._has_work = False
+        self.buckets = ref.buckets
+        self.pad_id = ref.config.pad_id
+        self.paged = ref.paged
+        #: Minimal slots facade: ``radix=None`` keeps the cluster's
+        #: `PrefixDirectory` disarmed — remote radix extraction is a
+        #: follow-up tier, and the prefix machinery is advisory by
+        #: contract (tokens never depend on it).
+        self.slots = types.SimpleNamespace(radix=None)
+
+    # -- mirrored state --------------------------------------------------
+
+    def apply_reply(self, rmeta: dict) -> None:
+        """Fold one host reply into the mirror: replay drained tokens
+        into the driver-side callbacks (emission order per request),
+        then append newly-retired stubs."""
+        for rid, toks in (rmeta.get("tokens") or {}).items():
+            cb = self._cbs.get(int(rid))
+            if cb is None:
+                continue
+            for tok in toks:
+                cb(None, int(tok))
+        for f in rmeta.get("finished") or ():
+            rr = f.get("reject_reason")
+            fr = f.get("finish_reason")
+            self.finished.append(_FinStub(
+                request_id=int(f["request_id"]),
+                state=RequestState(f["state"]),
+                finish_reason=FinishReason(fr) if fr else None,
+                reject_reason=RejectReason(rr) if rr else None))
+            self._cbs.pop(int(f["request_id"]), None)
+        if "has_work" in rmeta:
+            self._has_work = bool(rmeta["has_work"])
+
+    def has_work(self) -> bool:
+        return self._has_work
+
+    # -- the scheduler surface the cluster drives ------------------------
+
+    def structural_reject(self, req: Request,
+                          full_prefill: bool = False):
+        return self._ref.structural_reject(req, full_prefill)
+
+    def submit(self, req: Request) -> bool:
+        meta = {
+            "request_id": req.request_id,
+            "prompt": list(req.prompt),
+            "max_new_tokens": req.max_new_tokens,
+            "eos_token_ids": list(req.eos_token_ids),
+            "seed": req.seed,
+            "arrival_time": req.arrival_time,
+            "tenant": req.tenant,
+            "lineage_id": req.lineage_id,
+        }
+        body = b""
+        if req.resume_key is not None:
+            meta["resume_key"] = np.asarray(req.resume_key).tolist()
+        if req.shipped_kv is not None:
+            # The artifact stays driver-side for retransmission; the
+            # accepted copy crosses inline with the submit.
+            meta["shipped"] = True
+            body = req.shipped_kv.to_bytes()
+        try:
+            rmeta, _ = self._ch.call("rep.submit", meta, body)
+        except NetError:
+            # Dead process: refuse transiently — the record re-routes
+            # and the health check drains this replica properly.
+            req.state = RequestState.REJECTED
+            req.reject_reason = RejectReason.STOPPED
+            self._has_work = False
+            return False
+        if rmeta.get("accepted"):
+            self._cbs[req.request_id] = req.on_token
+            self._has_work = True
+            return True
+        rr = rmeta.get("reject_reason")
+        req.state = RequestState.REJECTED
+        req.reject_reason = RejectReason(rr) if rr else None
+        return False
+
+    def stop(self) -> None:
+        self._has_work = False
+        try:
+            rmeta, _ = self._ch.call("rep.stop", {})
+        except NetError:
+            return
+        self.apply_reply(rmeta)
+        self._has_work = False
+
+    def restart(self) -> None:
+        try:
+            self._ch.call("rep.restart", {})
+        except NetError:
+            pass
+
+
+class RemoteReplica:
+    """Router-process proxy for one replica process: the exact
+    attribute surface `ClusterRouter` and `ServingCluster` read on a
+    local `Replica`, with step/beat as RPCs and signals mirrored."""
+
+    def __init__(self, rid: int, channel: Channel, ref, clock,
+                 step_time_s: float = 1e-3):
+        self.id = int(rid)
+        self.name = f"replica-{rid}"
+        self.rank = int(channel.peer_rank)
+        self._ch = channel
+        self._clock = clock
+        self.scheduler = RemoteScheduler(channel, ref, clock)
+        self.alive = True
+        self.dead = False
+        self.quarantined = False
+        self.fail_reason: Optional[str] = None
+        self.straggle_factor = 1.0
+        self.link_busy = 0.0
+        self.base_step_s = float(step_time_s)
+        self.last_step_s = float(step_time_s)
+        self.busy_until = 0.0
+        self.hb_ts = float(clock())
+        self.routed_total = 0
+        self.fin_i = 0
+        self._signals: Optional[dict] = None
+
+    # -- fault injection -------------------------------------------------
+
+    def kill(self) -> None:
+        self.alive = False
+        try:
+            self._ch.call("rep.kill", {})
+        except NetError:
+            pass
+
+    def inject_straggle(self, factor: float) -> None:
+        self.straggle_factor = float(factor)
+
+    # -- cluster loop ----------------------------------------------------
+
+    @property
+    def routable(self) -> bool:
+        return not self.dead and not self.quarantined
+
+    def _lost(self) -> None:
+        """The process stopped answering: model it as death — the
+        heartbeat freezes and the router's liveness check takes it
+        from here, same as a local kill()."""
+        self.alive = False
+        self.scheduler._has_work = False
+
+    def beat(self, now: float) -> None:
+        if not self.alive:
+            return
+        try:
+            rmeta, _ = self._ch.call("rep.beat", {"now": now})
+        except NetError:
+            self._lost()
+            return
+        if rmeta.get("alive"):
+            self.hb_ts = now
+        else:
+            self.alive = False
+        sig = rmeta.get("signals")
+        if sig:
+            self._signals = sig
+        self.scheduler._has_work = bool(rmeta.get("has_work"))
+
+    def ready(self, now: float) -> bool:
+        return (self.alive and not self.dead and not self.quarantined
+                and now >= self.busy_until
+                and self.scheduler.has_work())
+
+    def step(self, now: float) -> dict:
+        try:
+            rmeta, _ = self._ch.call("rep.step", {"now": now})
+        except NetError:
+            self._lost()
+            self.busy_until = now + self.base_step_s
+            return {}
+        self.scheduler.apply_reply(rmeta)
+        sig = rmeta.get("signals")
+        if sig:
+            self._signals = sig
+        # The modeled cost keeps router signals comparable across
+        # backends; the wall clock already charged the real RPC time,
+        # so busy_until never lands in the past.
+        cost = self.base_step_s * self.straggle_factor
+        self.last_step_s = max(
+            float(rmeta.get("last_step_s", cost)), cost)
+        self.busy_until = max(now + cost, self._clock())
+        return {}
+
+    # -- signals ---------------------------------------------------------
+
+    def probe_step_s(self) -> float:
+        return self.base_step_s * self.straggle_factor
+
+    def signals(self, now: float) -> dict:
+        sig = dict(self._signals or ())
+        return {
+            "ts": self.hb_ts,
+            "queue_depth": sig.get("queue_depth", 0),
+            "active_slots": sig.get("active_slots", 0),
+            "kv_occupancy": sig.get("kv_occupancy", 0.0),
+            "step_us": self.last_step_s * 1e6,
+            "link_busy": float(self.link_busy),
+        }
+
+    def table_row(self, now: float) -> dict:
+        sig = self._signals or {}
+        return {
+            "id": self.id, "name": self.name,
+            "alive": not self.dead, "quarantined": self.quarantined,
+            "fail_reason": self.fail_reason,
+            "hb_age_s": round(now - self.hb_ts, 6),
+            "routed": self.routed_total,
+            "queue_depth": sig.get("queue_depth", 0),
+            "active_slots": sig.get("active_slots", 0),
+            "last_step_s": self.last_step_s,
+        }
+
+
+class RemotePrefillWorker:
+    """Router-process proxy for one prefill process.  The queue and
+    busy-time pacing live here (the cluster's `_advance` reads them);
+    one RPC per job returns the `KVShipment` bytes, which stay
+    driver-side for bounded retransmission — exactly the artifact
+    contract the local worker keeps."""
+
+    def __init__(self, wid: int, channel: Channel, clock,
+                 prefill_time_s: float = 2e-3):
+        self.id = int(wid)
+        self.name = f"prefill-{wid}"
+        self._ch = channel
+        self._clock = clock
+        self.prefill_time_s = float(prefill_time_s)
+        self.queue: Deque[tuple] = collections.deque()
+        self.busy_until = 0.0
+        self.jobs_done = 0
+
+    def submit(self, req, dst: int) -> None:
+        self.queue.append((req, int(dst)))
+
+    def ready(self, now: float) -> bool:
+        return bool(self.queue) and now >= self.busy_until
+
+    def step(self, now: float):
+        if not self.ready(now):
+            return None
+        req, dst = self.queue.popleft()
+        meta = {"now": now, "prompt": list(req.prompt),
+                "lineage_id": req.lineage_id, "dst": dst}
+        try:
+            _rmeta, body = self._ch.call("pf.run", meta)
+        except NetError:
+            # Dead worker process: hold the job and back off — the
+            # queue drains if it heals, and the launch's first-failure
+            # teardown ends the run if it doesn't.
+            self.queue.appendleft((req, dst))
+            self.busy_until = max(now, self._clock()) + 0.1
+            return None
+        shipment = KVShipment.from_bytes(body)
+        # Wall-deadline anchoring (the clock already advanced past
+        # ``now`` while the RPC ran): done_at must not predate the
+        # present, or the ship deadline would be born expired.
+        done_at = max(now + self.prefill_time_s, self._clock())
+        self.busy_until = done_at
+        self.jobs_done += 1
+        return req, dst, shipment, done_at

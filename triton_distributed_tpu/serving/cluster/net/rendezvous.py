@@ -1,0 +1,120 @@
+"""Rank-directory handshake: how role processes find each other.
+
+``scripts/launch.py --roles`` runs the directory server in the
+PARENT (it already owns the process group, so it can fail the launch
+fast when a rank dies mid-handshake) and exports its address as
+``TDT_RENDEZVOUS``.  Each role process then:
+
+1. opens its own data-plane listener (`net.node.listen`) — the
+   address every peer will dial for frames;
+2. calls :func:`rendezvous` — one JSON line up (rank, role, index,
+   listener address), one JSON line back once EVERY rank registered:
+   the full directory plus the shared clock epoch ``t0``;
+3. builds its cluster clock as ``time.time() - t0`` — one epoch for
+   the whole cluster, so heartbeat ages, ship deadlines and lineage
+   hop timestamps are comparable across processes.
+
+The bootstrap is deliberately newline-JSON, not framed: the server
+lives in stdlib-only ``launch.py`` (which must run without this
+package on its path), and a half-open handshake should be readable
+in a packet dump.  Everything AFTER the handshake — KV pages,
+claims, heartbeats, router state — rides the framed wire
+(`net.frame`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+from typing import Optional
+
+#: Environment variable the launcher exports: ``host:port`` of the
+#: parent's rank-directory server.
+ENV_RENDEZVOUS = "TDT_RENDEZVOUS"
+
+
+class RendezvousError(Exception):
+    """The handshake failed (server gone, malformed reply, or the
+    launch was aborted because a sibling rank died)."""
+
+
+class Directory:
+    """The assembled cluster map: rank -> {role, index, addr}."""
+
+    def __init__(self, world: int, ranks: dict, t0: float):
+        self.world = int(world)
+        #: {rank(int): {"role": str, "index": int, "addr": str}}
+        self.ranks = {int(r): dict(v) for r, v in ranks.items()}
+        #: Shared clock epoch (unix time): every process's cluster
+        #: clock is ``time.time() - t0``.
+        self.t0 = float(t0)
+
+    def addr(self, rank: int) -> str:
+        return self.ranks[int(rank)]["addr"]
+
+    def by_role(self, role: str) -> list:
+        """Ranks holding ``role``, ordered by role index."""
+        out = [(v["index"], r) for r, v in self.ranks.items()
+               if v["role"] == role]
+        return [r for _, r in sorted(out)]
+
+    def to_dict(self) -> dict:
+        return {"world": self.world, "t0": self.t0,
+                "ranks": {str(r): v for r, v in self.ranks.items()}}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Directory":
+        return cls(d["world"], d["ranks"], d.get("t0", 0.0))
+
+
+def rendezvous(rank: int, role: str, index: int, addr: str,
+               server: Optional[str] = None,
+               timeout: float = 60.0) -> Directory:
+    """Register this process and block for the full directory.
+
+    ``server`` defaults to ``$TDT_RENDEZVOUS``.  The connection stays
+    open until every rank registered; the server closing it WITHOUT
+    a reply means the launch was aborted (a sibling died) — surfaced
+    as :class:`RendezvousError`, never a hang.
+    """
+    server = server or os.environ.get(ENV_RENDEZVOUS)
+    if not server:
+        raise RendezvousError(
+            f"no rendezvous server: set ${ENV_RENDEZVOUS} or pass "
+            "server=")
+    host, port = server.rsplit(":", 1)
+    try:
+        sock = socket.create_connection((host, int(port)),
+                                        timeout=timeout)
+    except OSError as e:
+        raise RendezvousError(
+            f"cannot reach rendezvous {server}: {e}") from e
+    try:
+        sock.settimeout(timeout)
+        line = json.dumps({"rank": int(rank), "role": str(role),
+                           "index": int(index), "addr": str(addr)})
+        sock.sendall(line.encode() + b"\n")
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise RendezvousError(
+                    "rendezvous aborted: server closed before the "
+                    "directory (a sibling rank died during "
+                    "handshake?)")
+            buf += chunk
+    except socket.timeout as e:
+        raise RendezvousError(
+            f"rendezvous timed out after {timeout}s") from e
+    finally:
+        sock.close()
+    try:
+        reply = json.loads(buf.decode())
+    except ValueError as e:
+        raise RendezvousError(
+            f"malformed directory reply: {e}") from e
+    if not reply.get("ok"):
+        raise RendezvousError(
+            f"rendezvous refused: {reply.get('error', 'unknown')}")
+    return Directory.from_dict(reply)

@@ -1,0 +1,307 @@
+"""`SocketTransport`: the `VirtualTransport` contract over real TCP.
+
+The interface is IDENTICAL — ``ship``/``claim``/``drop``/``corrupt``/
+``tap``, monotonic shipment ids, CRC32 verified at claim, one-shot
+idempotent claim, `ShipmentCorrupt` as the NACK — which is what lets
+`ServingCluster`, the chaos harness and the replay recorder run
+unchanged on top of it (the conformance suite in ``tests/test_net.py``
+pins both backends to one parameterized test class).
+
+What changes is WHERE the in-flight bytes live:
+
+- ``ship`` serializes and STAGES the bytes locally (sender side),
+  assigning the monotonic id and recording the sent-time CRC — the
+  same moment `VirtualTransport` does;
+- ``route_shipment(token, dst)`` — the one call the networked
+  backend adds — transmits the staged bytes as a single SHIP frame
+  to the destination host, whose `WireHost` endpoint delivers them
+  into ITS `VirtualTransport` in-flight map (`deliver`, preserving
+  the sender's id and CRC);
+- ``claim`` becomes an RPC: the host pops + CRC-verifies the bytes
+  (`claim_bytes` — the exact virtual claim discipline) and returns
+  outcome + verified bytes; the decoder runs at the caller, so the
+  decoded object lands where the cluster driver expects it.
+
+The fault seam sits exactly where the chaos contract wants it: the
+injector's decision happens between ``ship`` and ``route_shipment``,
+so ``drop`` discards the staged copy and the frame is NEVER sent,
+and ``corrupt`` flips a payload byte in the staged copy pre-transmit
+— the corrupted bytes genuinely cross the wire and fail the CRC at
+the receiver's claim.  After routing, ``drop``/``corrupt`` forward
+to the holder as RPCs (the failover path discarding in-flight KV for
+a dead peer), and a dead peer absorbs them silently — the bytes died
+with the process, which is the semantic ``drop`` asks for.
+
+A claim whose peer is unreachable raises `ShipmentCorrupt` too: the
+caller cannot distinguish "bytes mangled" from "bytes gone with the
+peer", and both demand the same response — NACK, retransmit under
+the ship deadline, reroute past it.  That folds partition handling
+into the retry machinery the cluster already has.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Optional
+
+from triton_distributed_tpu.serving.cluster.net import frame as _frame
+from triton_distributed_tpu.serving.cluster.net.node import (
+    Channel, NetError)
+from triton_distributed_tpu.serving.cluster.transport import (
+    KVShipment, ShipmentCorrupt, VirtualTransport)
+
+
+class SocketTransport:
+    """Driver-side wire: stages outbound shipments, routes them as
+    SHIP frames over per-host `Channel`\\ s, claims them back by RPC.
+
+    ``attach(dst, channel)`` registers a host; ``dst`` is whatever
+    key the caller routes by (the cluster uses replica names).  Set
+    ``default_dst`` to auto-route every ship to one host — the
+    single-peer conformance mode, where this class is exercised
+    exactly like `VirtualTransport`.
+    """
+
+    def __init__(self, wire_gbps: Optional[float] = 25.0):
+        self.wire_gbps = wire_gbps
+        self._next_token = 0
+        #: Staged (shipped, not yet transmitted): token -> (data,
+        #: crc, tag).  The chaos injector's drop/corrupt act HERE.
+        self._staged: Dict[int, tuple] = {}
+        #: Transmitted: token -> dst key (claims RPC the holder).
+        self._routed: Dict[int, object] = {}
+        self._tags: Dict[int, object] = {}
+        self._channels: Dict[object, Channel] = {}
+        self.default_dst = None
+        self.shipped_bytes = 0
+        self.shipments = 0
+        self.corrupt_claims = 0
+        self.duplicate_claims = 0
+        #: Same record/replay seam as the virtual backend: one dict
+        #: per ship/claim event, driver-side, so a socket run and a
+        #: virtual run produce comparable wire logs.
+        self.tap = None
+        #: RPC wall deadline per claim (a hung peer must surface as
+        #: a NACK, not a hung driver).
+        self.call_timeout_s = 30.0
+
+    # -- topology ---------------------------------------------------
+
+    def attach(self, dst, channel: Channel) -> None:
+        """Register the channel carrying shipments for ``dst``."""
+        self._channels[dst] = channel
+
+    def detach(self, dst) -> None:
+        self._channels.pop(dst, None)
+
+    # -- the VirtualTransport contract ------------------------------
+
+    def ship(self, shipment, tag=None) -> tuple:
+        """Serialize and stage one shipment; returns ``(token,
+        nbytes)`` with the same monotonic-id semantics as the virtual
+        backend.  Transmission happens at `route_shipment` (or
+        immediately, when ``default_dst`` is set)."""
+        data = shipment.to_bytes()
+        token = self._next_token
+        self._next_token += 1
+        self._staged[token] = (data, _crc32(data), tag)
+        if tag is not None:
+            self._tags[token] = tag
+        self.shipped_bytes += len(data)
+        self.shipments += 1
+        if self.tap is not None:
+            self.tap({"event": "ship", "token": token,
+                      "nbytes": len(data), "tag": tag})
+        if self.default_dst is not None:
+            self.route_shipment(token, self.default_dst)
+        return token, len(data)
+
+    def ship_time_s(self, nbytes: int) -> float:
+        """Keep the bandwidth MODEL for scheduling (ready times and
+        deadlines stay backend-independent); the wall clock then
+        charges whatever the real wire actually took on top."""
+        if not self.wire_gbps:
+            return 0.0
+        return nbytes / (self.wire_gbps * 1e9)
+
+    def route_shipment(self, token: int, dst) -> None:
+        """Transmit a staged shipment to its destination host as one
+        SHIP frame.  A dead channel still marks the token routed:
+        the claim will NACK (retry/reroute), never dangle."""
+        staged = self._staged.pop(token, None)
+        if staged is None:
+            return                       # dropped pre-transmit, or
+        data, crc, tag = staged          # already routed
+        self._routed[token] = dst
+        ch = self._channels.get(dst)
+        if ch is None or ch.closed:
+            return
+        try:
+            ch.push(_frame.SHIP,
+                    {"token": token, "crc": crc, "tag": tag}, data)
+        except NetError:
+            pass                         # claim surfaces the loss
+
+    def claim(self, token: int, decoder=None):
+        """One-shot claim.  Staged (never-transmitted) tokens claim
+        locally with the exact virtual discipline; routed tokens RPC
+        the holder, which pops + CRC-verifies and returns the bytes
+        — the decode happens here, at the caller."""
+        if token in self._staged:
+            data, crc, _tag = self._staged.pop(token)
+            self._tags.pop(token, None)
+            if _crc32(data) != crc:
+                self.corrupt_claims += 1
+                self._tap_claim(token, "corrupt")
+                raise ShipmentCorrupt(
+                    f"shipment {token}: checksum mismatch (staged)")
+            self._tap_claim(token, "ok", nbytes=len(data))
+            return (decoder or KVShipment.from_bytes)(data)
+        dst = self._routed.pop(token, None)
+        self._tags.pop(token, None)
+        if dst is None:
+            self.duplicate_claims += 1
+            self._tap_claim(token, "duplicate")
+            return None
+        ch = self._channels.get(dst)
+        if ch is None or ch.closed:
+            self.corrupt_claims += 1
+            self._tap_claim(token, "corrupt")
+            raise ShipmentCorrupt(
+                f"shipment {token}: peer {dst!r} unreachable")
+        try:
+            rmeta, rbody = ch.call(
+                "wire.claim", {"token": token},
+                timeout=self.call_timeout_s)
+        except NetError as e:
+            self.corrupt_claims += 1
+            self._tap_claim(token, "corrupt")
+            raise ShipmentCorrupt(
+                f"shipment {token}: wire to {dst!r} failed: {e}") \
+                from e
+        outcome = rmeta.get("outcome")
+        if outcome == "duplicate":
+            self.duplicate_claims += 1
+            self._tap_claim(token, "duplicate")
+            return None
+        if outcome != "ok":
+            self.corrupt_claims += 1
+            self._tap_claim(token, "corrupt")
+            raise ShipmentCorrupt(
+                f"shipment {token}: "
+                f"{rmeta.get('detail', 'checksum mismatch')}")
+        self._tap_claim(token, "ok", nbytes=len(rbody))
+        return (decoder or KVShipment.from_bytes)(rbody)
+
+    def drop(self, token: int) -> None:
+        """Pre-transmit: the frame is simply never sent.  Post-route:
+        tell the holder to discard its wire copy (best-effort — a
+        dead holder already dropped it)."""
+        if self._staged.pop(token, None) is not None:
+            self._tags.pop(token, None)
+            return
+        dst = self._routed.pop(token, None)
+        self._tags.pop(token, None)
+        if dst is None:
+            return
+        ch = self._channels.get(dst)
+        if ch is None or ch.closed:
+            return
+        try:
+            ch.call("wire.drop", {"token": token},
+                    timeout=self.call_timeout_s)
+        except NetError:
+            pass
+
+    def corrupt(self, token: int, byte_index: int = 0) -> bool:
+        """Pre-transmit: flip one payload byte in the STAGED copy
+        (the sent-time CRC is already recorded), so the corruption
+        genuinely rides the wire and fails at the receiver's claim.
+        Post-route: forward to the holder."""
+        staged = self._staged.get(token)
+        if staged is not None:
+            data, crc, tag = staged
+            i = byte_index % len(data)
+            mutated = (data[:i] + bytes([data[i] ^ 0xFF])
+                       + data[i + 1:])
+            self._staged[token] = (mutated, crc, tag)
+            return True
+        dst = self._routed.get(token)
+        if dst is None:
+            return False
+        ch = self._channels.get(dst)
+        if ch is None or ch.closed:
+            return False
+        try:
+            rmeta, _ = ch.call(
+                "wire.corrupt",
+                {"token": token, "byte_index": int(byte_index)},
+                timeout=self.call_timeout_s)
+        except NetError:
+            return False
+        return bool(rmeta.get("ok"))
+
+    @property
+    def pending(self) -> List[int]:
+        return sorted(set(self._staged) | set(self._routed))
+
+    def pending_tags(self) -> Dict[int, object]:
+        return {t: self._tags.get(t) for t in self.pending}
+
+    # -- internals --------------------------------------------------
+
+    def _tap_claim(self, token: int, outcome: str,
+                   nbytes: Optional[int] = None) -> None:
+        if self.tap is None:
+            return
+        ev = {"event": "claim", "token": token, "outcome": outcome}
+        if nbytes is not None:
+            ev["nbytes"] = nbytes
+        self.tap(ev)
+
+
+class WireHost:
+    """Host-side endpoint: delivered SHIP frames land in a real
+    `VirtualTransport` (sender ids and CRCs preserved), and wire RPCs
+    answer with its exact claim/drop/corrupt discipline.  Embed one
+    per role process and splice :meth:`dispatch` into the host's
+    frame loop (`node.serve_connection`)."""
+
+    #: RPC methods this endpoint answers.
+    METHODS = ("wire.claim", "wire.drop", "wire.corrupt")
+
+    def __init__(self, wire_gbps: Optional[float] = None):
+        self.vt = VirtualTransport(wire_gbps=wire_gbps)
+
+    def dispatch(self, kind: int, meta: dict, body: bytes):
+        """Handle one wire frame; returns a (meta, body) reply for
+        CALLs, None for pushes.  Non-wire frames return None so a
+        composite host dispatcher can try the next handler."""
+        if kind == _frame.SHIP:
+            self.vt.deliver(meta["token"], body,
+                            crc=meta.get("crc"),
+                            tag=meta.get("tag"))
+            return None
+        if kind != _frame.CALL:
+            return None
+        method = meta.get("method")
+        if method == "wire.claim":
+            try:
+                data = self.vt.claim_bytes(int(meta["token"]))
+            except ShipmentCorrupt as e:
+                return {"outcome": "corrupt", "detail": str(e)}, b""
+            if data is None:
+                return {"outcome": "duplicate"}, b""
+            return {"outcome": "ok"}, data
+        if method == "wire.drop":
+            self.vt.drop(int(meta["token"]))
+            return {"ok": True}, b""
+        if method == "wire.corrupt":
+            ok = self.vt.corrupt(int(meta["token"]),
+                                 int(meta.get("byte_index", 0)))
+            return {"ok": bool(ok)}, b""
+        return None
+
+
+def _crc32(data: bytes) -> int:
+    return zlib.crc32(data)

@@ -212,6 +212,11 @@ class ClusterRouter:
         #: degraded signal-aware choice and the tie-break, which is
         #: what makes the degradation bit-identical.
         self._rr = 0
+        #: Replica score evaluations performed by `_score` — the
+        #: per-request placement WORK.  The hierarchy bench reads
+        #: this to show pod-scale routing does O(cell), not O(pod),
+        #: evaluations per request.
+        self.score_evals = 0
         self._affinity: Dict[Tuple[int, ...], int] = {}
         #: Cluster-installed KV-tier hooks: the cluster-wide prefix
         #: directory (`peer_cache.PrefixDirectory`; the cluster
@@ -364,6 +369,7 @@ class ClusterRouter:
                     + sig["active_slots"]) * eff
 
         scores = {r.id: score(sigs[r.id]) for r in alive}
+        self.score_evals += len(alive)
         fetch = None
         if self.fetch_cost_fn is not None:
             # Cache-aware placement: each candidate's score also pays

@@ -198,18 +198,35 @@ class VirtualTransport:
             return 0.0
         return nbytes / (self.wire_gbps * 1e9)
 
-    def claim(self, token: int, decoder=None) -> Optional[KVShipment]:
-        """Deserialize a delivered shipment (one-shot: the wire copy
-        is dropped).  Returns ``None`` when ``token`` was already
-        claimed or dropped — a DUPLICATE delivery, absorbed
-        idempotently.  Raises :class:`ShipmentCorrupt` when the bytes
-        fail their sent-time checksum (the caller NACKs).
+    def deliver(self, token: int, data: bytes,
+                crc: Optional[int] = None, tag=None) -> None:
+        """Accept a SENDER-assigned shipment onto this endpoint's
+        in-flight map — the networked receive path (`net.transport`):
+        the peer's ``ship`` assigned the id and recorded the CRC
+        before the bytes crossed, so integrity is still judged
+        against the bytes as SENT.  Re-delivery of an id (a wire
+        duplicate arriving before the first copy was claimed) just
+        overwrites the identical copy; dedup stays where it always
+        was, at the one-shot claim."""
+        token = int(token)
+        data = bytes(data)
+        self._in_flight[token] = data
+        self._crc[token] = (zlib.crc32(data) if crc is None
+                            else int(crc) & 0xFFFFFFFF)
+        if tag is not None:
+            self._tags[token] = tag
+        # Keep local ids monotonic PAST every delivered id, so an
+        # endpoint that both receives and ships never reuses one.
+        self._next_token = max(self._next_token, token + 1)
+        self.shipped_bytes += len(data)
+        self.shipments += 1
 
-        ``decoder`` rebuilds the artifact from the verified bytes
-        (default: the full-row `KVShipment`; the cluster's prefix
-        pump passes `peer_cache.PrefixShipment.from_bytes` — the
-        wire, ids, CRC and fault seams are shared, only the payload
-        schema differs)."""
+    def claim_bytes(self, token: int) -> Optional[bytes]:
+        """The claim discipline on raw bytes: one-shot pop, sent-time
+        CRC verified, duplicate -> ``None``, mismatch -> NACK.  The
+        networked backend's host side answers claims with this (the
+        DECODE then happens wherever the caller is); :meth:`claim`
+        is this plus the decoder."""
         data = self._in_flight.pop(token, None)
         self._tags.pop(token, None)
         if data is None:
@@ -230,6 +247,23 @@ class VirtualTransport:
         if self.tap is not None:
             self.tap({"event": "claim", "token": token,
                       "outcome": "ok", "nbytes": len(data)})
+        return data
+
+    def claim(self, token: int, decoder=None) -> Optional[KVShipment]:
+        """Deserialize a delivered shipment (one-shot: the wire copy
+        is dropped).  Returns ``None`` when ``token`` was already
+        claimed or dropped — a DUPLICATE delivery, absorbed
+        idempotently.  Raises :class:`ShipmentCorrupt` when the bytes
+        fail their sent-time checksum (the caller NACKs).
+
+        ``decoder`` rebuilds the artifact from the verified bytes
+        (default: the full-row `KVShipment`; the cluster's prefix
+        pump passes `peer_cache.PrefixShipment.from_bytes` — the
+        wire, ids, CRC and fault seams are shared, only the payload
+        schema differs)."""
+        data = self.claim_bytes(token)
+        if data is None:
+            return None
         return (decoder or KVShipment.from_bytes)(data)
 
     def drop(self, token: int) -> None:
