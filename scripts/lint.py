@@ -24,6 +24,14 @@ Enforces the core of the ruff.toml rule set with only the stdlib:
         Non-constant names (f-string fan-outs like
         `f"serving_kvtier_{k}"`) are out of a static linter's reach
         and skipped.
+- W001: direct wall-clock reads (`time.time()` / `time.monotonic()` /
+        `datetime.now()` / `datetime.utcnow()`) inside the serving
+        and observability trees.  Those layers are driven by
+        injectable clocks (`now` parameters, `clock=` seams) so that
+        replay, chaos tests and the protocol model checker can run
+        them deterministically; a raw clock read bypasses every one
+        of those seams.  Deliberate reads (export timestamps, log
+        wall-stamps) carry `# noqa: W001` with a justification.
 
 Usage:  python scripts/lint.py [paths...]     (default: repo tree)
 Exit 0 = clean, 1 = findings.  `scripts/verify_tier1.sh` prefers
@@ -142,6 +150,74 @@ def lint_file(path: pathlib.Path) -> list[str]:
     problems.extend(_f821_module_level(tree, path, lines))
     problems.extend(_f841_unused_locals(tree, path, lines))
     problems.extend(_metric_names(tree, path, lines))
+    problems.extend(_wallclock_reads(tree, path, lines))
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# W001: wall-clock reads in clock-injected layers
+# ---------------------------------------------------------------------------
+
+#: Path fragments naming the layers whose code must take time as a
+#: parameter (every public entry point threads `now`): a raw clock
+#: read there silently forks simulated time from wall time and breaks
+#: replay determinism — the exact bug class the incident recorder and
+#: the protocol model checker exist to rule out.
+_WALLCLOCK_SCOPES = (
+    ("triton_distributed_tpu", "serving"),
+    ("triton_distributed_tpu", "observability"),
+)
+
+#: (module-ish receiver, attribute) pairs that read the wall clock.
+#: `time.perf_counter` is excluded: the codebase uses it only for
+#: self-timing spans whose durations are reported, never fed back
+#: into protocol state.
+_WALLCLOCK_ATTRS = {
+    ("time", "time"),
+    ("time", "monotonic"),
+    ("datetime", "now"),
+    ("datetime", "utcnow"),
+}
+
+
+def _in_wallclock_scope(path: pathlib.Path) -> bool:
+    parts = tuple(path.parts)
+    for scope in _WALLCLOCK_SCOPES:
+        for i in range(len(parts) - len(scope) + 1):
+            if parts[i:i + len(scope)] == scope:
+                return True
+    return False
+
+
+def _wallclock_reads(tree: ast.Module, path, lines) -> list[str]:
+    """Direct clock reads where the architecture says time is an
+    argument.  Receiver matching is name-based (`time.time()`,
+    `datetime.now()`, `datetime.datetime.now()`) — aliased imports
+    (`from time import time`) don't occur in-tree and a scope-blind
+    fallback shouldn't guess at them."""
+    if not _in_wallclock_scope(pathlib.Path(str(path))):
+        return []
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        recv = fn.value
+        # `time.time()` / `datetime.now()` and the spelled-out
+        # `datetime.datetime.now()`.
+        recv_name = (recv.id if isinstance(recv, ast.Name)
+                     else recv.attr if isinstance(recv, ast.Attribute)
+                     else None)
+        if (recv_name, fn.attr) not in _WALLCLOCK_ATTRS:
+            continue
+        if _noqa(lines, node.lineno, "W001"):
+            continue
+        problems.append(
+            f"{path}:{node.lineno}: W001 wall-clock read "
+            f"`{recv_name}.{fn.attr}()` in a clock-injected layer "
+            f"(thread `now` through, or `# noqa: W001` with why)")
     return problems
 
 

@@ -120,6 +120,28 @@ then
 fi
 echo "SERVING_MODEL_CHECK=ok"
 
+# Cluster protocol model check: exhaustive small-scope exploration of
+# the wire/routing/failover state machines — every interleaving of
+# delivery, loss, duplication, corruption, crash and staleness over
+# the standard scope matrix must terminate with exactly-once effects
+# (docs/analysis.md "Protocol checker").  The mutant corpus
+# (tests/test_protocol_analysis.py) proves the checker still CATCHES
+# each defect class it exists for.
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu \
+        python -m triton_distributed_tpu.analysis --check protocol -q
+then
+    echo "PROTOCOL_CHECK=FAILED"
+    exit 1
+fi
+if ! timeout -k 10 360 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_protocol_analysis.py -q \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+then
+    echo "PROTOCOL_CHECK=FAILED (mutant corpus)"
+    exit 1
+fi
+echo "PROTOCOL_CHECK=ok"
+
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 rm -f "$LOG"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

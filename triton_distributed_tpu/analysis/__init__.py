@@ -72,6 +72,7 @@ __all__ = [
     "capture_pallas_calls",
     "check_captured_call",
     "check_replay_resources",
+    "check_protocol_model",
     "check_serving_model",
     "iter_specs",
     "record_traces",
@@ -79,6 +80,7 @@ __all__ = [
     "register_resource_kernel",
     "run_checks",
     "sweep",
+    "sweep_protocol",
     "sweep_resources",
     "tier_scope",
 ]
@@ -101,6 +103,25 @@ def tier_scope(*args, **kwargs):
         tier_scope as _scope)
 
     return _scope(*args, **kwargs)
+
+
+def check_protocol_model(*args, **kwargs):
+    """Lazy facade over `analysis.protocol_model.check_protocol_model`
+    (the cluster protocol checker imports the serving cluster layer;
+    keep `analysis` importable from kernel modules without a cycle)."""
+    from triton_distributed_tpu.analysis.protocol_model import (
+        check_protocol_model as _check)
+
+    return _check(*args, **kwargs)
+
+
+def sweep_protocol(*args, **kwargs):
+    """Lazy facade over `analysis.protocol.sweep_protocol` (the fixed
+    scope matrix the tier-1 PROTOCOL_CHECK gate pins clean)."""
+    from triton_distributed_tpu.analysis.protocol import (
+        sweep_protocol as _sweep)
+
+    return _sweep(*args, **kwargs)
 
 
 def analyze_kernel(fn, mesh_shape: Dict[str, int], *,

@@ -3,6 +3,7 @@
     python -m triton_distributed_tpu.analysis              # comm sweep
     python -m triton_distributed_tpu.analysis --check resources
     python -m triton_distributed_tpu.analysis --check serving
+    python -m triton_distributed_tpu.analysis --check protocol
     python -m triton_distributed_tpu.analysis --check all
     python -m triton_distributed_tpu.analysis --list
     python -m triton_distributed_tpu.analysis -k allgather.ring
@@ -15,7 +16,10 @@
 cross-rank comm-graph sanitizer), ``resources`` (the VMEM / tiling /
 block-index-bounds abstract interpreter over every registered kernel,
 comm AND compute), ``serving`` (the paged-serving refcount/donation
-model checker), or ``all``.
+model checker), ``protocol`` (the cluster wire/routing/failover
+protocol model checker — every interleaving of deliver / drop /
+duplicate / corrupt / crash / staleness over a small scope), or
+``all``.
 
 Exit status: 0 = no findings, 1 = findings, 2 = usage error.
 `scripts/verify_tier1.sh` runs the comm + resources sweeps and the
@@ -49,7 +53,8 @@ def main(argv=None) -> int:
         description="Static comm-graph sanitizer sweep over registered "
                     "kernels.")
     parser.add_argument("--check", default="comm",
-                        choices=("comm", "resources", "serving", "all"),
+                        choices=("comm", "resources", "serving",
+                                 "protocol", "all"),
                         help="analysis family to run (default: comm)")
     parser.add_argument("-k", "--kernel", action="append", default=None,
                         help="kernel name or glob (repeatable); default: "
@@ -152,6 +157,10 @@ def main(argv=None) -> int:
         tier_findings = analysis.check_serving_model(
             analysis.tier_scope())
         consume("serving", [("serving.kvtier", {}, tier_findings)])
+    if args.check in ("protocol", "all"):
+        consume("protocol",
+                [(f"cluster.protocol.{label}", {}, findings)
+                 for label, findings in analysis.sweep_protocol()])
 
     if args.json:
         payload = json.dumps({"findings": rows, "swept": swept}, indent=2)

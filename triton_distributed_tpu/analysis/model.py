@@ -120,6 +120,30 @@ class FindingKind(enum.Enum):
     #: what was demoted, or the spilled-node bookkeeping disagrees
     #: with the tier's actual store.
     TIER_CORRUPT = "tier_corrupt"
+    # -- cluster protocol model checker (analysis.protocol_model) ------
+    #: A delivery effect applied twice: a shipment claimed under two
+    #: wire copies (or re-delivered after its reroute already
+    #: re-prefilled) double-inserted KV or double-counted metrics —
+    #: the idempotent-claim discipline was bypassed.
+    PROTO_DOUBLE_EFFECT = "proto_double_effect"
+    #: A route commit (routed counter, affinity re-home, prefix-
+    #: directory registration, DecisionEvent) landed without a
+    #: replica-accepted placement — commit-on-accept violated under
+    #: some refusal/crash ordering.
+    PROTO_PHANTOM_COMMIT = "proto_phantom_commit"
+    #: A submitted request can fail to reach a terminal state under a
+    #: fault schedule within budget: a wedged pending entry, a leaked
+    #: shipment record, or an orphaned staged route with no timer or
+    #: wire copy left to make progress.
+    PROTO_WEDGE = "proto_wedge"
+    #: Along some failover path the resume key was advanced by a
+    #: count different from the tokens actually emitted to the client
+    #: — the resumed stream would repeat or skip positions.
+    PROTO_KEY_DRIFT = "proto_key_drift"
+    #: A placement landed on a replica already verdicted dead or
+    #: quarantined (e.g. a stale cell aggregate degraded into a dead
+    #: cell instead of around it) — the dispatch can never be served.
+    PROTO_DEAD_ROUTE = "proto_dead_route"
 
 
 @dataclasses.dataclass(frozen=True)

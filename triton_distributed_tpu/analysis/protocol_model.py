@@ -1,0 +1,906 @@
+"""Small-scope exhaustive model checker over the REAL cluster
+protocol objects (`analysis.protocol` is the sweep driver / CLI
+face).
+
+The PR-7 serving model checker proved the method: drive the *real*
+host-side objects — not a re-implementation — through **every**
+interleaving reachable within a small scope, audit invariants after
+each transition, and report the first (therefore minimal) provoking
+trace.  This module applies it to the cluster seams PR 18 turned
+into a real distributed system:
+
+- the real :class:`~...transport.VirtualTransport` (or the
+  :class:`~...net.transport.SocketTransport` + `WireHost` pair over
+  an in-process loopback channel — the networked claim/NACK/partition
+  discipline) carries every shipment as genuine bytes with genuine
+  CRCs;
+- the real :class:`~...router.ClusterRouter` (or the two-level
+  :class:`~...net.hierarchy.PodFrontDoor`) makes every placement,
+  stages it, and commits it only on accept;
+- the real :class:`~...peer_cache.PrefixDirectory` learns chains at
+  commit and forgets them at failover;
+- the cluster's pump/retry/failover logic is mirrored op-for-op as
+  the harness's transition relation (`_send` / `_pump_ships` /
+  `_retry_or_reroute` / the drain path), with each nondeterministic
+  event — deliver, drop, duplicate, reorder, corrupt, crash,
+  heartbeat-staleness, retry-timer — an explicit BFS op.
+
+The abstract network is the transport's own in-flight multiset; the
+abstract clock is an integer epoch that only heartbeat steps advance
+(canonical fingerprints exclude absolute time and absolute shipment
+ids, so interleavings that differ only in bookkeeping collapse).
+
+Invariants audited after every transition (each mapped to one
+`FindingKind`):
+
+1. **delivery-effect idempotence** (`PROTO_DOUBLE_EFFECT`) — KV is
+   inserted at most once per replica-accepted placement; duplicate
+   claims absorb without effect.
+2. **commit-on-accept** (`PROTO_PHANTOM_COMMIT`) — routed counters
+   and prefix-directory registrations never exceed accepted
+   placements, under every refusal/crash ordering.
+3. **termination** (`PROTO_WEDGE`) — every request reaches exactly
+   one terminal state: no in-flight request without a wire copy,
+   timer or reroute left; no leaked shipment record or orphaned
+   staged route; no quiescent state with live replicas and an
+   unfinished request.  (A scope whose fault budget kills EVERY
+   replica excuses still-queued work: liveness presumes a routable
+   quorum.)
+4. **resume-key exactness** (`PROTO_KEY_DRIFT`) — at every
+   (re-)dispatch the `advance_request_key(seed, streamed)` count
+   equals the tokens already emitted to the client.  The key itself
+   is a pure function of that count (`replica.advance_request_key`
+   is a jitted fold over it), so the checker audits the count and
+   never dispatches jax inside the BFS.
+5. **hierarchy coherence** (`PROTO_DEAD_ROUTE`) — every placement
+   lands on a replica that is routable at decision time; stale or
+   absent cell aggregates and dead cells must degrade AROUND, never
+   INTO, a dead placement.
+
+Mutation seams are overridable harness methods (`_absorb_duplicate`,
+`_after_stage`, `_on_nack`, `_resume_key_count`, `_route`) — the
+seeded corpus in ``tests/test_protocol_analysis.py`` proves each
+invariant fires with exactly its intended kind.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import itertools
+import os
+from typing import Dict, List, Optional, Tuple
+
+from triton_distributed_tpu.analysis.model import Finding, FindingKind
+
+PROTO_KERNEL = "cluster.protocol"
+
+
+# ---------------------------------------------------------------------------
+# Scope
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolScope:
+    """Bounds of one exhaustive exploration (small-scope hypothesis:
+    protocol bugs need few requests, few replicas and few faults to
+    manifest — what they need is the *right interleaving*)."""
+
+    #: Replica count (2-3; the fault budget must not be able to kill
+    #: every replica or termination is vacuously unachievable).
+    n_replicas: int = 2
+    #: One prompt per request; shared leading tokens engage the real
+    #: affinity map and prefix directory.
+    prompts: Tuple[Tuple[int, ...], ...] = (
+        (7, 7, 7, 7, 1, 2, 3, 4),
+        (7, 7, 7, 7, 5, 6, 7, 8),
+    )
+    #: Tokens each request must stream before finishing (>=2 on one
+    #: request keeps the crash-mid-stream resume-key path reachable).
+    targets: Tuple[int, ...] = (2, 1)
+    #: "virtual" = `VirtualTransport`; "socket" = `SocketTransport`
+    #: + per-replica `WireHost` over loopback channels (the networked
+    #: claim-RPC / dead-peer-partition contract).
+    transport: str = "virtual"
+    #: Route through a two-level `PodFrontDoor` over `n_cells` cells
+    #: instead of a flat `ClusterRouter`.
+    hierarchical: bool = False
+    n_cells: int = 2
+    #: Wire-fault budget (drop / corrupt / dup / reorder / stale-hb
+    #: share it — mirrors `FaultSchedule.max_faults`).
+    max_faults: int = 1
+    #: Replica crashes allowed (strictly < n_replicas).
+    max_crashes: int = 1
+    #: Retransmissions before a shipment reroutes (the model's
+    #: `ship_max_retries`; 1 keeps the space small while exercising
+    #: both the retry and the reroute arm).
+    max_retries: int = 1
+    #: Transient backpressure refusals each request may suffer.
+    refusals: int = 1
+    #: Consecutive stale heartbeat observations before a failover
+    #: verdict (2 exercises the hysteresis: one stale beat alone
+    #: must NOT drain).
+    dead_checks: int = 2
+    page_size: int = 4
+    affinity_tokens: int = 4
+
+
+def default_scope() -> ProtocolScope:
+    return ProtocolScope()
+
+
+# ---------------------------------------------------------------------------
+# Stubs (host-only stand-ins for the heavy runtime objects; every
+# PROTOCOL object — transport, router, directory — is real)
+# ---------------------------------------------------------------------------
+
+class _StubReplica:
+    """The attribute surface `ClusterRouter` / `Cell` consume from
+    `serving.cluster.replica.Replica`, with no scheduler and no jax."""
+
+    def __init__(self, rid: int):
+        self.id = rid
+        self.name = f"replica-{rid}"
+        self.rank = rid
+        self.alive = True
+        self.dead = False
+        self.quarantined = False
+        self.fail_reason = None
+        self.hb_ts = 0.0
+        self.base_step_s = 0.01
+        self.last_step_s = 0.01
+        self.routed_total = 0
+        #: Heartbeat-staleness fault: beats suppressed for this many
+        #: upcoming heartbeat steps (`FaultInjector.beat_ts` -> None).
+        self.skip_beats = 0
+
+    @property
+    def routable(self) -> bool:
+        return not self.dead and not self.quarantined
+
+    def beat(self, now: float) -> None:
+        if self.alive:
+            self.hb_ts = now
+
+    def kill(self) -> None:
+        self.alive = False
+
+    def signals(self, now: float) -> Optional[dict]:
+        # A crashed process has no in-process snapshot: the router
+        # must degrade the WHOLE decision to round-robin.
+        if not self.alive:
+            return None
+        return {"ts": self.hb_ts, "queue_depth": 0.0,
+                "active_slots": 0.0, "kv_occupancy": 0.2,
+                "step_us": 100.0, "link_busy": 0.0}
+
+    def probe_step_s(self) -> float:
+        return self.last_step_s
+
+    def table_row(self, now: float) -> dict:
+        return {"name": self.name, "alive": self.alive}
+
+
+class _StubShipment:
+    """Tiny real-bytes payload: the transport's serialize/CRC/claim
+    discipline is exercised for real, without npz/KV weight."""
+
+    def __init__(self, payload: bytes):
+        self.payload = payload
+
+    def to_bytes(self) -> bytes:
+        return self.payload
+
+
+class _LoopbackChannel:
+    """In-process `net.node.Channel` stand-in: frames dispatch
+    synchronously into one `WireHost`.  ``closed`` models the peer
+    process dying — pushes and calls then raise `NetError`, which the
+    `SocketTransport` folds into the NACK/retry machinery exactly as
+    a real partition would."""
+
+    def __init__(self, host):
+        self.host = host
+        self.closed = False
+
+    def push(self, kind: int, meta: dict, body: bytes = b"") -> None:
+        from triton_distributed_tpu.serving.cluster.net.node import (
+            NetError)
+        if self.closed:
+            raise NetError("channel closed")
+        self.host.dispatch(kind, meta, body)
+
+    def call(self, method: str, meta: Optional[dict] = None,
+             body: bytes = b"", timeout: Optional[float] = None):
+        from triton_distributed_tpu.serving.cluster.net.node import (
+            NetError)
+        if self.closed:
+            raise NetError("channel closed")
+        m = dict(meta or ())
+        m["method"] = method
+        from triton_distributed_tpu.serving.cluster.net import (
+            frame as _frame)
+        reply = self.host.dispatch(_frame.CALL, m, body)
+        if reply is None:
+            raise NetError(f"no handler for {method!r}")
+        return reply
+
+
+class _PReq:
+    """One modeled request's protocol state (the `ClusterRequest` +
+    ship-record fields the invariants read)."""
+
+    def __init__(self, rid: int, prompt: Tuple[int, ...],
+                 target: int, refusals: int):
+        self.rid = rid
+        self.prompt = prompt
+        self.target = target
+        self.state = "queued"    # queued|shipping|running|finished
+        self.dest: Optional[int] = None
+        self.token: Optional[int] = None
+        self.staged = None       # detached route stage (uncommitted)
+        self.attempt = 0
+        self.lost = False        # wire ate the copy; timer pending
+        self.timer_armed = False  # reorder: timer races the delivery
+        self.dup_queued = False  # wire duplicated this shipment
+        self.dup_pending = False  # second copy awaiting absorption
+        self.dup_token: Optional[int] = None
+        self.corrupted = False
+        self.refusals_left = refusals
+        self.streamed = 0        # tokens emitted to the client
+        self.key_count = 0       # advance_request_key count at dispatch
+        self.inserts = 0         # KV insert effects applied
+        self.placements = 0      # replica-accepted placements
+
+    @property
+    def done(self) -> bool:
+        return self.state == "finished"
+
+
+# ---------------------------------------------------------------------------
+# Harness
+# ---------------------------------------------------------------------------
+
+class ProtocolHarness:
+    """Real protocol objects + the cluster's transition relation,
+    driven one abstract event at a time.  Mutation seams:
+    `_absorb_duplicate`, `_after_stage`, `_on_nack`,
+    `_resume_key_count`, `_route`."""
+
+    kernel = PROTO_KERNEL
+
+    def __init__(self, scope: Optional[ProtocolScope] = None):
+        from triton_distributed_tpu.serving.cluster.peer_cache import (
+            PrefixDirectory)
+        from triton_distributed_tpu.serving.cluster.router import (
+            ClusterRouter, RouterConfig)
+        self.scope = s = scope or default_scope()
+        self.epoch = 0
+        self.replicas = [_StubReplica(i) for i in range(s.n_replicas)]
+        for rep in self.replicas:
+            rep.beat(0.0)
+        cfg = RouterConfig(
+            staleness_s=100.0, dead_after_s=0.5,
+            dead_checks=s.dead_checks, probation_checks=2,
+            readmit=False, straggle_ratio=1e9,
+            affinity_tokens=s.affinity_tokens, prefix_ship=False)
+        if s.hierarchical:
+            from triton_distributed_tpu.serving.cluster.net import (
+                hierarchy)
+            n = max(1, min(s.n_cells, s.n_replicas))
+            per = (s.n_replicas + n - 1) // n
+            cells = [hierarchy.Cell(
+                i, self.replicas[i * per:(i + 1) * per],
+                router_cfg=cfg, page_size=s.page_size)
+                for i in range(n)]
+            self.front = hierarchy.PodFrontDoor(
+                [c for c in cells if c.replicas], config=cfg)
+            self.front.refresh(0.0)
+            self.router = None
+        else:
+            self.front = None
+            self.router = ClusterRouter(cfg, self.replicas)
+            self.router.directory = PrefixDirectory(s.page_size)
+        self._build_transport()
+        self.reqs = [_PReq(i, s.prompts[i], s.targets[i], s.refusals)
+                     for i in range(len(s.prompts))]
+        self.faults_left = s.max_faults
+        self.crashes_left = s.max_crashes
+        self.accepts = 0
+        self.dir_registrations = 0
+        self.dup_absorbed = 0
+        self.nacks = 0
+        self.findings: List[Finding] = []
+        self.trace: Tuple[str, ...] = ()
+
+    # -- construction ----------------------------------------------------
+
+    def _build_transport(self) -> None:
+        if self.scope.transport == "socket":
+            from triton_distributed_tpu.serving.cluster.net.transport \
+                import SocketTransport, WireHost
+            self.hosts = {r.name: WireHost() for r in self.replicas}
+            self.channels = {r.name: _LoopbackChannel(self.hosts[r.name])
+                             for r in self.replicas}
+            t = SocketTransport(wire_gbps=None)
+            for r in self.replicas:
+                t.attach(r.name, self.channels[r.name])
+            self.transport = t
+        else:
+            from triton_distributed_tpu.serving.cluster.transport \
+                import VirtualTransport
+            self.hosts = None
+            self.channels = None
+            self.transport = VirtualTransport(wire_gbps=None)
+
+    @property
+    def now(self) -> float:
+        return float(self.epoch)
+
+    def _routers(self) -> List:
+        if self.front is not None:
+            return [c.router for c in self.front.cells]
+        return [self.router]
+
+    def _cell_of(self, rep) -> Optional[object]:
+        if self.front is None:
+            return None
+        for c in self.front.cells:
+            if any(r.id == rep.id for r in c.replicas):
+                return c
+        return None
+
+    def _flag(self, kind: FindingKind, message: str) -> None:
+        self.findings.append(
+            Finding(kind, message, kernel=self.kernel))
+
+    # -- enabled transitions ---------------------------------------------
+
+    def ops(self) -> List[Tuple]:
+        out: List[Tuple] = []
+        wire = set(self.transport.pending)
+        for r in self.reqs:
+            if r.dup_pending:
+                out.append(("absorb_dup", r.rid))
+            if r.state == "queued":
+                if any(rep.routable for rep in self.replicas):
+                    out.append(("dispatch", r.rid))
+            elif r.state == "shipping":
+                in_flight = r.token is not None and r.token in wire
+                if in_flight and not r.lost:
+                    out.append(("deliver", r.rid))
+                    if r.refusals_left > 0:
+                        out.append(("refuse", r.rid))
+                    if self.faults_left > 0:
+                        out.append(("drop", r.rid))
+                        if not r.corrupted:
+                            out.append(("corrupt", r.rid))
+                        if not r.dup_queued:
+                            out.append(("dup", r.rid))
+                        if not r.timer_armed:
+                            out.append(("reorder", r.rid))
+                if r.lost or r.timer_armed:
+                    out.append(("timer", r.rid))
+            elif r.state == "running":
+                if self.replicas[r.dest].alive:
+                    out.append(("decode", r.rid))
+        if self.crashes_left > 0:
+            for rep in self.replicas:
+                if rep.alive and rep.routable:
+                    out.append(("crash", rep.id))
+        if self.faults_left > 0:
+            for rep in self.replicas:
+                if (rep.alive and rep.routable
+                        and rep.skip_beats < self.scope.dead_checks):
+                    out.append(("stale_hb", rep.id))
+        if self._health_pending():
+            out.append(("health",))
+        return out
+
+    def _health_pending(self) -> bool:
+        """A heartbeat step is only enabled when it can change
+        something — crashed/suppressed beats pending a verdict, or
+        hysteresis counters that a fresh observation would reset —
+        so the abstract clock never ticks for nothing."""
+        for rep in self.replicas:
+            if rep.routable and (not rep.alive or rep.skip_beats > 0):
+                return True
+        for router in self._routers():
+            if any(router._stale_obs.values()):
+                return True
+        return False
+
+    def describe(self, op: Tuple) -> str:
+        kind = op[0]
+        if kind in ("dispatch", "deliver", "refuse", "drop",
+                    "corrupt", "dup", "reorder", "timer",
+                    "absorb_dup", "decode"):
+            return f"{kind} r{op[1]}"
+        if kind in ("crash", "stale_hb"):
+            return f"{kind} replica-{op[1]}"
+        return "heartbeat-step"
+
+    def apply(self, op: Tuple) -> None:
+        kind = op[0]
+        if kind == "dispatch":
+            self._op_dispatch(self.reqs[op[1]])
+        elif kind == "deliver":
+            self._op_deliver(self.reqs[op[1]])
+        elif kind == "refuse":
+            self._op_deliver(self.reqs[op[1]], refuse=True)
+        elif kind == "drop":
+            r = self.reqs[op[1]]
+            self.faults_left -= 1
+            self.transport.drop(r.token)
+            r.lost = True
+        elif kind == "corrupt":
+            r = self.reqs[op[1]]
+            self.faults_left -= 1
+            self.transport.corrupt(r.token, byte_index=r.token * 131)
+            r.corrupted = True
+        elif kind == "dup":
+            self.faults_left -= 1
+            self.reqs[op[1]].dup_queued = True
+        elif kind == "reorder":
+            self.faults_left -= 1
+            self.reqs[op[1]].timer_armed = True
+        elif kind == "timer":
+            self._retry_or_reroute(self.reqs[op[1]], "timeout")
+        elif kind == "absorb_dup":
+            self._op_absorb_dup(self.reqs[op[1]])
+        elif kind == "decode":
+            self._op_decode(self.reqs[op[1]])
+        elif kind == "crash":
+            self._op_crash(self.replicas[op[1]])
+        elif kind == "stale_hb":
+            self.faults_left -= 1
+            self.replicas[op[1]].skip_beats += 1
+        elif kind == "health":
+            self._op_health()
+        else:
+            raise AssertionError(f"unknown op {op!r}")
+
+    # -- dispatch / routing ----------------------------------------------
+
+    def _route(self, r: _PReq):
+        """Place one request via the real router; returns ``(replica,
+        commit_handle)`` with the stage DETACHED (other routes stage
+        in between — the cluster's `take_staged` discipline).
+        Overridable mutation seam (`pmut_dead_route` bypasses the
+        routable filter)."""
+        if self.front is not None:
+            cell, rep = self.front.route(
+                r.prompt, f"proto:{r.rid}", self.now)
+            if rep is None:
+                return None, None
+            fstaged, self.front._staged = self.front._staged, None
+            cstaged = cell.router.take_staged()
+            return rep, ("hier", cell.id, fstaged, cstaged)
+        rep = self.router.route(r.prompt, f"proto:{r.rid}", self.now)
+        if rep is None:
+            return None, None
+        return rep, ("flat", self.router.take_staged())
+
+    def _op_dispatch(self, r: _PReq) -> None:
+        rep, commit = self._route(r)
+        if rep is None:
+            return
+        if not rep.routable:
+            how = "dead" if rep.dead else "quarantined"
+            self._flag(FindingKind.PROTO_DEAD_ROUTE,
+                       f"request {r.rid} placed on {rep.name} which "
+                       f"was already verdicted {how} — the dispatch "
+                       f"can never be served")
+            return
+        key_count = self._resume_key_count(r)
+        if key_count != r.streamed:
+            self._flag(FindingKind.PROTO_KEY_DRIFT,
+                       f"request {r.rid}: resume key advanced by "
+                       f"{key_count} but {r.streamed} token(s) were "
+                       f"already emitted to the client")
+        r.key_count = key_count
+        r.dest = rep.id
+        r.staged = commit
+        r.attempt = 0
+        r.lost = r.timer_armed = r.dup_queued = r.corrupted = False
+        r.state = "shipping"
+        self._ship(r)
+        self._after_stage(r)
+
+    def _resume_key_count(self, r: _PReq) -> int:
+        """The count a dispatch passes to ``advance_request_key`` —
+        the tokens already emitted.  Mutation seam (`pmut_key_drift`
+        skips the advancement)."""
+        return r.streamed
+
+    def _after_stage(self, r: _PReq) -> None:
+        """Commit-on-accept means NOTHING commits here.  Mutation
+        seam: `pmut_phantom_commit` commits at stage time."""
+
+    def _ship(self, r: _PReq) -> None:
+        rep = self.replicas[r.dest]
+        payload = (f"proto|rid={r.rid}|attempt={r.attempt}"
+                   f"|dest={r.dest}").encode()
+        token, _ = self.transport.ship(_StubShipment(payload),
+                                       tag=r.rid)
+        route = getattr(self.transport, "route_shipment", None)
+        if route is not None:
+            route(token, rep.name)
+        r.token = token
+
+    # -- delivery ---------------------------------------------------------
+
+    def _claim(self, token: int):
+        return self.transport.claim(token, decoder=bytes)
+
+    def _op_deliver(self, r: _PReq, refuse: bool = False) -> None:
+        from triton_distributed_tpu.serving.cluster.transport import (
+            ShipmentCorrupt)
+        rep = self.replicas[r.dest]
+        if r.dup_queued:
+            # The wire duplicated this shipment: a second copy lands
+            # after the first resolves (`_pump_ships` appends the
+            # dup_copy record at primary delivery).
+            r.dup_queued = False
+            r.dup_pending = True
+            r.dup_token = r.token
+        if not rep.routable:
+            # Destination verdicted while the shipment rode the wire:
+            # drop the copy, requeue (the `_pump_ships` moved-on arm).
+            self.transport.drop(r.token)
+            self._requeue(r)
+            return
+        try:
+            data = self._claim(r.token)
+        except ShipmentCorrupt:
+            self._on_nack(r)
+            return
+        if data is None:
+            self._absorb_duplicate(r)
+            return
+        if refuse:
+            # Transient backpressure: the stage dies uncommitted and
+            # the record re-queues — commit-on-accept's refusal arm.
+            r.refusals_left -= 1
+            self._requeue(r)
+            return
+        self._accept(r, rep)
+
+    def _accept(self, r: _PReq, rep) -> None:
+        r.inserts += 1
+        r.placements += 1
+        self.accepts += 1
+        r.token = None
+        r.lost = r.timer_armed = r.corrupted = False
+        r.state = "running"
+        self._commit(r)
+        self._register(r, rep)
+
+    def _commit(self, r: _PReq) -> None:
+        handle, r.staged = r.staged, None
+        if handle is None:
+            return
+        if handle[0] == "flat":
+            self.router.commit_staged(handle[1])
+            return
+        _, cell_id, fstaged, cstaged = handle
+        cell = next(c for c in self.front.cells if c.id == cell_id)
+        cell.router._staged = cstaged
+        self.front._staged = fstaged
+        self.front.commit_route()
+
+    def _register(self, r: _PReq, rep) -> None:
+        cell = self._cell_of(rep)
+        directory = (cell.directory if cell is not None
+                     else self.router.directory)
+        directory.register(r.prompt, rep.id, self.now)
+        self.dir_registrations += 1
+
+    def _absorb_duplicate(self, r: _PReq, data=None) -> None:
+        """A claim returned None (the id was already consumed): the
+        duplicate absorbs with NO effect.  Mutation seam:
+        `pmut_double_effect` re-applies the insert."""
+        self.dup_absorbed += 1
+
+    def _op_absorb_dup(self, r: _PReq) -> None:
+        from triton_distributed_tpu.serving.cluster.transport import (
+            ShipmentCorrupt)
+        token, r.dup_pending, r.dup_token = r.dup_token, False, None
+        try:
+            data = self._claim(token)
+        except ShipmentCorrupt:
+            data = None
+        self._absorb_duplicate(r, data)
+
+    # -- retry / reroute --------------------------------------------------
+
+    def _on_nack(self, r: _PReq) -> None:
+        """Checksum NACK (or unreachable peer, which the socket
+        backend folds into the same exception).  Mutation seam:
+        `pmut_wedge` drops the reroute."""
+        self.nacks += 1
+        self._retry_or_reroute(r, "corrupt")
+
+    def _retry_or_reroute(self, r: _PReq, trigger: str) -> None:
+        self.transport.drop(r.token)
+        if r.attempt < self.scope.max_retries:
+            r.attempt += 1
+            r.lost = r.timer_armed = r.corrupted = False
+            self._ship(r)
+            return
+        self._requeue(r)
+
+    def _requeue(self, r: _PReq) -> None:
+        """Back to the router: the stage dies uncommitted, the wire
+        copy is gone, streamed tokens are KEPT (the resume path must
+        advance the key past them)."""
+        r.state = "queued"
+        r.dest = None
+        r.token = None
+        r.staged = None
+        r.attempt = 0
+        r.lost = r.timer_armed = r.corrupted = False
+
+    # -- decode / crash / health -----------------------------------------
+
+    def _op_decode(self, r: _PReq) -> None:
+        r.streamed += 1
+        if r.streamed >= r.target:
+            r.state = "finished"
+            r.dest = None
+
+    def _op_crash(self, rep) -> None:
+        self.crashes_left -= 1
+        rep.kill()
+        if self.channels is not None:
+            self.channels[rep.name].closed = True
+
+    def _op_health(self) -> None:
+        """One heartbeat-staleness step: the abstract clock ticks,
+        live replicas beat (unless a stale fault suppresses them),
+        the real hysteresis accumulates, verdicts drain."""
+        self.epoch += 1
+        now = self.now
+        for rep in self.replicas:
+            if rep.skip_beats > 0:
+                rep.skip_beats -= 1
+            else:
+                rep.beat(now)
+        for router in self._routers():
+            for rep, reason in router.health_verdicts(now):
+                n = self._drain(rep)
+                router.note_failover(rep, reason, n, now)
+                cell = self._cell_of(rep)
+                directory = (cell.directory if cell is not None
+                             else self.router.directory)
+                directory.purge_replica(rep.id)
+        if self.front is not None:
+            self.front.refresh(now)
+
+    def _drain(self, rep) -> int:
+        n = 0
+        for r in self.reqs:
+            if r.dest != rep.id or r.state not in ("shipping",
+                                                   "running"):
+                continue
+            if r.state == "shipping" and r.token is not None:
+                if r.dup_queued:
+                    r.dup_queued = False
+                    r.dup_pending = True
+                    r.dup_token = r.token
+                self.transport.drop(r.token)
+            self._requeue(r)
+            n += 1
+        return n
+
+    # -- canonical fingerprint -------------------------------------------
+
+    def fingerprint(self) -> Tuple:
+        """Canonical state: absolute epochs, timestamps and shipment
+        ids are excluded (two states that differ only in those
+        bookkeeping values behave identically forever); what remains
+        is the protocol-visible state."""
+        wire = set(self.transport.pending)
+        now = self.now
+        reqs = tuple(
+            (r.state, r.dest, r.streamed, r.attempt, r.refusals_left,
+             r.token is not None and r.token in wire,
+             r.lost, r.timer_armed, r.dup_queued, r.dup_pending,
+             r.dup_token is not None and r.dup_token in wire,
+             r.corrupted, r.key_count, r.inserts, r.placements)
+            for r in self.reqs)
+        reps = tuple(
+            (rep.alive, rep.dead, rep.quarantined, rep.skip_beats,
+             (now - rep.hb_ts) > 0.5, rep.routed_total)
+            for rep in self.replicas)
+        routers = tuple(
+            (router._rr % max(len(router.replicas), 1),
+             tuple(sorted(router._affinity.items())),
+             tuple(sorted((k, v) for k, v
+                          in router._stale_obs.items() if v)),
+             tuple(sorted((k, v) for k, v
+                          in router._fresh_obs.items() if v)),
+             router._staged is not None)
+            for router in self._routers())
+        front = ()
+        if self.front is not None:
+            front = (
+                self.front._rr % max(len(self.front.cells), 1),
+                tuple(sorted(self.front._affinity.items())),
+                tuple((c.signals() is None,
+                       (c.signals() or {}).get("n_routable"))
+                      for c in self.front.cells),
+                self.front._staged is not None)
+        return (reqs, reps, routers, front, self.faults_left,
+                self.crashes_left, self.accepts,
+                self.dir_registrations, self.dup_absorbed)
+
+
+# ---------------------------------------------------------------------------
+# Invariant audits
+# ---------------------------------------------------------------------------
+
+def audit_state(h: ProtocolHarness) -> List[Finding]:
+    """State-independent invariants, checked after every transition."""
+    out: List[Finding] = []
+
+    def flag(kind: FindingKind, msg: str) -> None:
+        out.append(Finding(kind, msg, kernel=h.kernel))
+
+    routed_total = sum(rep.routed_total for rep in h.replicas)
+    if routed_total > h.accepts:
+        flag(FindingKind.PROTO_PHANTOM_COMMIT,
+             f"route commits ({routed_total}) exceed replica-"
+             f"accepted placements ({h.accepts}) — a refused or "
+             f"unlanded dispatch was committed")
+    if h.dir_registrations > h.accepts:
+        flag(FindingKind.PROTO_PHANTOM_COMMIT,
+             "prefix-directory registration without an accepted "
+             "placement")
+    wire = set(h.transport.pending)
+    for r in h.reqs:
+        if r.inserts > r.placements:
+            flag(FindingKind.PROTO_DOUBLE_EFFECT,
+                 f"request {r.rid}: KV insert effect applied "
+                 f"{r.inserts}x across {r.placements} accepted "
+                 f"placement(s) — a duplicate delivery was not "
+                 f"absorbed idempotently")
+        if r.state == "shipping":
+            in_flight = r.token is not None and r.token in wire
+            if not (in_flight or r.lost or r.timer_armed):
+                flag(FindingKind.PROTO_WEDGE,
+                     f"request {r.rid} awaits a delivery but no "
+                     f"wire copy, retry timer or reroute remains — "
+                     f"nothing can ever make progress")
+        if r.done and r.token is not None:
+            flag(FindingKind.PROTO_WEDGE,
+                 f"request {r.rid} is terminal but its shipment "
+                 f"record leaked")
+        if r.done and r.staged is not None:
+            flag(FindingKind.PROTO_WEDGE,
+                 f"request {r.rid} is terminal with an orphaned "
+                 f"staged route")
+    for router in h._routers():
+        if router._staged is not None:
+            flag(FindingKind.PROTO_WEDGE,
+                 "router holds a staged route outside any dispatch")
+    if h.front is not None and h.front._staged is not None:
+        flag(FindingKind.PROTO_WEDGE,
+             "front door holds a staged route outside any dispatch")
+    return out
+
+
+def audit_terminal(h: ProtocolHarness) -> List[Finding]:
+    """Termination: a quiescent state (no enabled transition) must
+    have every request terminal — unless the fault budget killed
+    every replica, which excuses still-QUEUED work (liveness
+    presumes a routable quorum; in-flight state must still have
+    been cleaned up either way)."""
+    out: List[Finding] = []
+    live = any(rep.routable for rep in h.replicas)
+    for r in h.reqs:
+        if r.done:
+            continue
+        if r.state == "queued" and not live:
+            continue
+        out.append(Finding(
+            FindingKind.PROTO_WEDGE,
+            f"request {r.rid} never terminates: quiescent in state "
+            f"'{r.state}' with no enabled transition",
+            kernel=h.kernel))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The exhaustive exploration
+# ---------------------------------------------------------------------------
+
+def check_protocol_model(scope: Optional[ProtocolScope] = None,
+                         harness_factory=None,
+                         max_states: int = 20000,
+                         max_depth: int = 26,
+                         stats: Optional[dict] = None
+                         ) -> List[Finding]:
+    """BFS over every interleaving reachable within ``scope``,
+    deduplicating via canonical fingerprints.  BFS order makes the
+    first trace that provokes a finding a MINIMAL one; it is appended
+    to the finding's message (``[trace: ...]``).  Observability hooks
+    are disabled for the duration — thousands of explored states must
+    not pollute the process-global metrics registry or decision log.
+    """
+    factory = harness_factory or ProtocolHarness
+    prev = os.environ.get("TDT_OBSERVABILITY")
+    os.environ["TDT_OBSERVABILITY"] = "0"
+    try:
+        return _explore(factory, scope, max_states, max_depth, stats)
+    finally:
+        if prev is None:
+            os.environ.pop("TDT_OBSERVABILITY", None)
+        else:
+            os.environ["TDT_OBSERVABILITY"] = prev
+
+
+def _explore(factory, scope, max_states: int, max_depth: int,
+             stats: Optional[dict] = None) -> List[Finding]:
+    from triton_distributed_tpu.serving.cluster.transport import (
+        ShipmentCorrupt)
+    root = factory(scope or default_scope())
+    seen = {root.fingerprint()}
+    frontier = [(root, 0)]
+    found: Dict[Tuple, Tuple[Finding, Tuple[str, ...]]] = {}
+    states = 0
+
+    def collect(h: ProtocolHarness, extra=()) -> None:
+        for f in itertools.chain(h.findings, extra):
+            key = (f.kind, f.message)
+            if key not in found:
+                found[key] = (f, h.trace)
+        h.findings = []
+
+    collect(root, audit_state(root))
+    while frontier and states < max_states:
+        state, depth = frontier.pop(0)
+        enabled = state.ops()
+        if not enabled:
+            collect(state, audit_terminal(state))
+            continue
+        if depth >= max_depth:
+            continue
+        for op in enabled:
+            child = copy.deepcopy(state)
+            child.trace = child.trace + (child.describe(op),)
+            ok = True
+            try:
+                child.apply(op)
+            except ShipmentCorrupt as e:
+                # A NACK the pump did not fold into retry/reroute is
+                # itself a protocol bug: the request would wedge.
+                child._flag(FindingKind.PROTO_WEDGE,
+                            f"unhandled wire NACK escaped the pump "
+                            f"({e})")
+                ok = False
+            except (AssertionError, RuntimeError, KeyError,
+                    IndexError, TypeError) as e:
+                child._flag(FindingKind.PROTO_WEDGE,
+                            f"protocol transition crashed "
+                            f"({type(e).__name__}: {e})")
+                ok = False
+            collect(child, audit_state(child) if ok else ())
+            states += 1
+            if not ok:
+                continue
+            fp = child.fingerprint()
+            if fp not in seen:
+                seen.add(fp)
+                frontier.append((child, depth + 1))
+    if stats is not None:
+        stats["states"] = states
+        stats["unique"] = len(seen)
+        stats["exhausted"] = not frontier
+    out = []
+    for (kind, msg), (f, trace) in found.items():
+        if trace:
+            f = dataclasses.replace(
+                f, message=f"{msg} [trace: {' -> '.join(trace)}]")
+        out.append(f)
+    return sorted(out, key=lambda f: (f.kind.value, f.message))

@@ -349,7 +349,7 @@ def observe_bench(rec: dict, us: float, *, store=None,
             "anomaly_flags_total",
             op=str(rec.get("bench", "bench"))).inc()
     if persist:
-        now = time.monotonic()
+        now = time.monotonic()  # noqa: W001 (save-throttle timer, never in a report)
         if now - _LAST_SAVE >= SAVE_INTERVAL_S or not _LAST_SAVE:
             store.save()
             _LAST_SAVE = now

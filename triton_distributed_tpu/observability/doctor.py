@@ -183,6 +183,7 @@ class Artifacts:
         self.metrics: Dict[int, dict] = {}
         self.static_findings: Optional[dict] = None
         self.resource_findings: Optional[dict] = None
+        self.protocol_findings: Optional[dict] = None
         self.decisions: List[dict] = []
         self.router: Optional[dict] = None
         self.faults: List[dict] = []
@@ -239,6 +240,11 @@ class Artifacts:
             d = _load_json(p)
             if d is not None:
                 self.resource_findings = d
+                break
+        for p in self._glob("protocol-findings.json"):
+            d = _load_json(p)
+            if d is not None:
+                self.protocol_findings = d
                 break
         router_docs = []
         for p in self._glob("router-state*.json"):
@@ -590,6 +596,66 @@ def run_resource_analysis(art: Artifacts, stall: dict,
             "every block index (including page-table indirection) "
             "stays in bounds; an overflow here implies a runtime "
             "cause (corrupted table, stale autotune config)")
+    return out
+
+
+#: Protocol-finding kinds that mean "a partition/crash interleaving
+#: could have wedged or double-applied a request" (vs the advisory
+#: resume-key drift, which corrupts output but still terminates).
+_PROTOCOL_WEDGY = ("proto_wedge", "proto_double_effect",
+                   "proto_dead_route", "proto_phantom_commit")
+
+
+def run_protocol_analysis(art: Artifacts,
+                          enabled: bool = False) -> Optional[dict]:
+    """Consult the cluster protocol model checker
+    (`analysis.protocol_model`): could the partition/crash pattern in
+    this incident have wedged a request, double-applied a delivery or
+    routed onto a dead replica?  Mirrors `run_resource_analysis`: a
+    shipped ``protocol-findings.json`` wins; otherwise the standard
+    scope matrix (`analysis.protocol.sweep_protocol`) runs live.
+    Opt-in (``--protocol`` / a findings file) so existing golden
+    incident reports stay byte-identical — the section key is simply
+    absent."""
+    if not (enabled or art.protocol_findings is not None):
+        return None
+    out: dict = {"findings": [], "source": None}
+    if art.protocol_findings is not None:
+        out["findings"] = art.protocol_findings.get("findings", [])
+        out["source"] = "artifact"
+    else:
+        try:
+            from triton_distributed_tpu import analysis
+            rows = []
+            for label, findings in analysis.sweep_protocol():
+                rows += [{
+                    "scope": label,
+                    "kind": f.kind.value,
+                    "message": f.message,
+                } for f in findings]
+            out["findings"] = rows
+            out["source"] = "live"
+        except Exception as e:
+            out["source"] = f"unavailable ({type(e).__name__})"
+            return out
+    bad = [f for f in out["findings"]
+           if f.get("kind") in _PROTOCOL_WEDGY]
+    if bad:
+        f = bad[0]
+        out["could_wedge"] = True
+        out["verdict"] = (
+            f"protocol checker says a partition/crash interleaving "
+            f"CAN wedge or double-apply a request: [{f.get('kind')}] "
+            f"{f.get('message')}")
+    elif out["source"] and not str(out["source"]).startswith(
+            "unavailable"):
+        out["could_wedge"] = False
+        out["verdict"] = (
+            "protocol sweep is clean — every in-scope interleaving of "
+            "delivery, loss, duplication, corruption, crash and "
+            "staleness terminates with exactly-once effects; a wedged "
+            "request here implies a cause outside the modeled scope "
+            "(resource exhaustion, an unmodeled fault)")
     return out
 
 
@@ -961,7 +1027,8 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
              now: Optional[float] = None,
              interval: Optional[float] = None,
              static: bool = True,
-             resources: bool = False) -> Optional[dict]:
+             resources: bool = False,
+             protocol: bool = False) -> Optional[dict]:
     """Build the full incident report dict (None when the directories
     hold no artifacts at all)."""
     from triton_distributed_tpu.observability.anomaly import (
@@ -984,6 +1051,7 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
                                      mesh=mesh, enabled=static)
     resource_out = run_resource_analysis(art, stall, kernel=kernel,
                                          mesh=mesh, enabled=resources)
+    protocol_out = run_protocol_analysis(art, enabled=protocol)
     link_out = analyze_links(art)
     # Baselines pinned to the artifact dir: the report must not change
     # with whatever ambient baseline file the operator's CWD holds.
@@ -1125,6 +1193,10 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
     # file) — golden incident reports stay byte-identical.
     if resource_out is not None:
         report["resources"] = resource_out
+    # Protocol consult: key absent unless opted in (--protocol / a
+    # protocol-findings.json artifact) — same golden discipline.
+    if protocol_out is not None:
+        report["protocol"] = protocol_out
     # Control decisions: key absent when no decisions artifact (and
     # no heartbeat-carried summaries) exist — same golden discipline.
     decision_out = analyze_decisions(art, now)
@@ -1303,6 +1375,9 @@ def _verdict(report: dict, in_flight: Optional[dict]) -> str:
         resource_out = report.get("resources") or {}
         if resource_out.get("verdict"):
             verdict += f". {resource_out['verdict']}"
+        protocol_out = report.get("protocol") or {}
+        if protocol_out.get("verdict"):
+            verdict += f". {protocol_out['verdict']}"
         return verdict + hot_s + "."
     stragglers = report.get("stragglers") or []
     anomalies = report.get("anomalies") or []
@@ -1460,6 +1535,18 @@ def render_markdown(report: dict) -> str:
                          f"{f.get('message')}")
         if resource_out.get("verdict"):
             lines.append(f"- **{resource_out['verdict']}**")
+        lines.append("")
+
+    protocol_out = report.get("protocol")
+    if protocol_out:
+        lines += ["## Static protocol check", ""]
+        lines += [f"- source: {protocol_out.get('source')}"]
+        for f in protocol_out.get("findings", [])[:5]:
+            lines.append(f"- [{f.get('kind')}] "
+                         f"scope={f.get('scope') or '-'} "
+                         f"{f.get('message')}")
+        if protocol_out.get("verdict"):
+            lines.append(f"- **{protocol_out['verdict']}**")
         lines.append("")
 
     dec = report.get("decisions")
@@ -1792,6 +1879,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "(VMEM/tiling/bounds) for the in-flight "
                          "kernel; a shipped resource-findings.json "
                          "enables this automatically")
+    ap.add_argument("--protocol", action="store_true",
+                    help="also consult the cluster protocol model "
+                         "checker (wire/routing/failover "
+                         "interleavings); a shipped "
+                         "protocol-findings.json enables this "
+                         "automatically")
     ap.add_argument("--check", default=None, metavar="GOLDEN",
                     help="compare against a golden report JSON; exit "
                          "3 on drift (CI gate)")
@@ -1814,7 +1907,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     report = diagnose(args.dirs, kernel=args.kernel, mesh=args.mesh,
                       now=args.now, static=not args.no_static,
-                      resources=args.resources)
+                      resources=args.resources,
+                      protocol=args.protocol)
     if report is None:
         print(f"doctor: no artifacts found under {args.dirs}",
               file=sys.stderr)

@@ -123,7 +123,7 @@ def emit_event(event: KernelEvent) -> Optional[KernelEvent]:
     if not observability_enabled():
         return None
     if not event.ts:
-        event.ts = time.time()
+        event.ts = time.time()  # noqa: W001 (export stamp default; callers may set ts)
     from triton_distributed_tpu.observability.metrics import _process_index
     event.rank = _process_index()
 

@@ -121,7 +121,7 @@ def prometheus_text(snapshot: Optional[dict] = None,
 #: reports.  Uptime lives ONLY in the HTTP response, never in the
 #: heartbeat payload: heartbeat file bodies must stay byte-comparable
 #: across writes with identical state.
-_START_TIME = time.time()
+_START_TIME = time.time()  # noqa: W001 (process-start anchor for uptime_s only)
 
 
 def build_info() -> dict:
@@ -167,7 +167,7 @@ class MetricsServer:
                     body = json.dumps({
                         **heartbeat_payload(),
                         "tdt_build_info": build_info(),
-                        "uptime_s": round(time.time() - _START_TIME,
+                        "uptime_s": round(time.time() - _START_TIME,  # noqa: W001 (HTTP-response uptime, never persisted)
                                           3),
                     }).encode()
                     ctype = "application/json"
@@ -372,7 +372,7 @@ def heartbeat_payload() -> dict:
         "schema": 1,
         "rank": _process_index(),
         "pid": os.getpid(),
-        "unix_time": time.time(),
+        "unix_time": time.time(),  # noqa: W001 (heartbeat wall-stamp for humans)
         "step": tracing.current_step(),
         "last_span": last.name if last is not None else None,
         "open_spans": [s.name for s in tracer.open_spans()],
@@ -502,7 +502,7 @@ def rank_health_report(directory: str, now: Optional[float] = None,
     ``STALE_INTERVALS`` × interval).  This is what the launcher prints
     when its ``--timeout`` watchdog fires, so a 124 exit names the
     stalled rank instead of just a number."""
-    now = time.time() if now is None else now
+    now = time.time() if now is None else now  # noqa: W001 (default when no `now` injected)
     beats = read_heartbeats(directory)
     ranks = {}
     for rank, hb in sorted(beats.items()):

@@ -123,7 +123,7 @@ class Signals:
 
     def fresh(self, now: Optional[float] = None,
               staleness_s: float = STALENESS_S) -> bool:
-        now = time.time() if now is None else now
+        now = time.time() if now is None else now  # noqa: W001 (default when no `now` injected)
         return (now - self.ts) <= staleness_s
 
     # -- link view -------------------------------------------------------
@@ -461,7 +461,7 @@ def record_decision(event: DecisionEvent) -> Optional[DecisionEvent]:
     from triton_distributed_tpu.observability.metrics import (
         _process_index, get_registry)
     if not event.ts:
-        event.ts = time.time()
+        event.ts = time.time()  # noqa: W001 (export stamp default; callers may set ts)
     event.rank = _process_index()
     reg = get_registry()
     reg.counter("decisions_total", consumer=event.consumer,

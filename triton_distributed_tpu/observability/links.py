@@ -372,7 +372,7 @@ class LinkTracker:
         if not lks:
             return {}
         t0, t1 = _event_interval(event)
-        now = t0 or time.time()
+        now = t0 or time.time()  # noqa: W001 (fallback for trace-time events w/o host ts)
         # Trace-time events (no host measurement) fire back-to-back
         # during jit compilation — only measured occurrences can claim
         # two collectives actually ran concurrently on a link.
@@ -413,7 +413,7 @@ class LinkTracker:
 
     def window_bytes(self, now: Optional[float] = None
                      ) -> Dict[Link, int]:
-        now = time.time() if now is None else now
+        now = time.time() if now is None else now  # noqa: W001 (default when no `now` injected)
         cutoff = now - self.WINDOW_S
         out: Dict[Link, int] = {}
         with self._lock:
@@ -429,7 +429,7 @@ class LinkTracker:
         rolling window.  ``contended`` marks links with a cross-op
         contention record inside the window (the live analogue of
         :func:`detect_contention`)."""
-        now = time.time() if now is None else now
+        now = time.time() if now is None else now  # noqa: W001 (default when no `now` injected)
         cutoff = now - self.WINDOW_S
         bw = _link_bytes_per_s()
         denom = bw * self.WINDOW_S

@@ -194,7 +194,7 @@ class MetricsRegistry:
         out["meta"] = {
             "rank": _process_index(),
             "world": _process_count(),
-            "unix_time": time.time(),
+            "unix_time": time.time(),  # noqa: W001 (dump-file wall-stamp for humans)
             "schema": 1,
         }
         return out

@@ -105,7 +105,7 @@ class FlightRecorder:
             "schema": 1,
             "rank": _process_index(),
             "pid": os.getpid(),
-            "unix_time": time.time(),
+            "unix_time": time.time(),  # noqa: W001 (incident-report wall-stamp for humans)
             "reason": reason,
             "events": [e.to_dict() for e in self.events()],
             "metrics": get_registry().snapshot(),

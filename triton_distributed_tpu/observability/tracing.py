@@ -52,7 +52,7 @@ DEFAULT_RING = 16384
 #: shared clock :mod:`.timeline` merges on (same-host ranks share it
 #: exactly; cross-host skew is whatever NTP leaves, carried in the
 #: export metadata so the merge can report it).
-_CLOCK_BASE = time.time() - time.perf_counter()
+_CLOCK_BASE = time.time() - time.perf_counter()  # noqa: W001 (perf_counter epoch anchor, export metadata)
 
 try:  # spans mirror into XLA traces when a profiler is attached
     from jax.profiler import TraceAnnotation as _TraceAnnotation
@@ -113,7 +113,7 @@ class Span:
         dur = self.dur
         args = dict(self.attrs)
         if dur is None:
-            dur = max((now or time.time()) - self.ts, 0.0)
+            dur = max((now or time.time()) - self.ts, 0.0)  # noqa: W001 (default when no `now` injected)
             args["open"] = True
         return {"name": self.name, "ph": "X", "cat": "span",
                 "ts": round(self.ts * 1e6, 3),
@@ -243,7 +243,7 @@ class SpanTracer:
                 "pid": os.getpid(),
                 "clock": "unix-us",
                 "clock_base_unix": _CLOCK_BASE,
-                "export_unix_time": time.time(),
+                "export_unix_time": time.time(),  # noqa: W001 (export wall-stamp for humans)
             },
         }
 

@@ -308,6 +308,14 @@ class ServingCluster:
         #: the next replica step instead of spinning at `now`.
         self._blocked = False
         self._ships: List[dict] = []
+        #: Injectable delivery/timer arbiter for the ship pump (the
+        #: protocol model checker's seam, mirroring ``pages.py``'s
+        #: ``insert_fn``): when set, ``arbiter(ship, now) -> bool``
+        #: is consulted before each in-flight shipment is advanced —
+        #: returning False holds that shipment back this pass, so an
+        #: external scheduler can drive deliveries and retry timers
+        #: one event at a time in any order.  None costs one check.
+        self.ship_arbiter = None
         self._by_req: Dict[int, ClusterRequest] = {}
         #: request_id -> the router stage a worker dispatch detached
         #: (`ClusterRouter.take_staged`); committed only when the
@@ -1156,6 +1164,9 @@ class ServingCluster:
     def _pump_ships(self, now: float) -> bool:
         progressed = False
         for ship in list(self._ships):
+            if (self.ship_arbiter is not None
+                    and not self.ship_arbiter(ship, now)):
+                continue
             if ship.get("kind") == "prefix":
                 progressed |= self._pump_prefix(ship, now)
                 continue

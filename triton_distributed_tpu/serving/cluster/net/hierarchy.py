@@ -61,7 +61,7 @@ class CellRouter(ClusterRouter):
                       n_alive: int) -> None:
         self.decisions.append({
             "schema": 1, "consumer": "cluster.router",
-            "ts": round(time.time(), 6), "rank": 0,
+            "ts": round(time.time(), 6), "rank": 0,  # noqa: W001 (decision-log wall-stamp, not routing state)
             "op": op, "choice": choice.name,
             "candidates": list(candidates),
             "inputs": dict(inputs, alive=n_alive,
@@ -270,7 +270,7 @@ class PodFrontDoor:
                 del self._affinity[next(iter(self._affinity))]
         event = {
             "schema": 1, "consumer": POD_CONSUMER,
-            "ts": round(time.time(), 6), "rank": 0,
+            "ts": round(time.time(), 6), "rank": 0,  # noqa: W001 (decision-log wall-stamp, not routing state)
             "op": op, "choice": cell.name,
             "candidates": list(candidates),
             "inputs": {"alive": n_alive,

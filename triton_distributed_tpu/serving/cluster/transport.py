@@ -171,6 +171,15 @@ class VirtualTransport:
         #: duplicate) — so a replay can assert the wire behaved
         #: delivery-for-delivery identically.  None costs one check.
         self.tap = None
+        #: Injectable delivery/timer scheduler seam (the protocol
+        #: model checker's abstract network — `analysis.protocol_model`
+        #: — mirrors `pages.py`'s ``insert_fn`` seam): when set, every
+        #: ``ship``/``deliver`` notifies ``scheduler.on_wire(token,
+        #: nbytes, tag)`` so an external scheduler owns WHEN (and in
+        #: what order) the in-flight copy is claimed, without this
+        #: class growing any scheduling policy of its own.  None costs
+        #: one check per ship.
+        self.scheduler = None
 
     def ship(self, shipment: KVShipment, tag=None) -> tuple:
         """Serialize one shipment onto the wire.  Returns
@@ -191,6 +200,8 @@ class VirtualTransport:
         if self.tap is not None:
             self.tap({"event": "ship", "token": token,
                       "nbytes": len(data), "tag": tag})
+        if self.scheduler is not None:
+            self.scheduler.on_wire(token, len(data), tag)
         return token, len(data)
 
     def ship_time_s(self, nbytes: int) -> float:
@@ -220,6 +231,8 @@ class VirtualTransport:
         self._next_token = max(self._next_token, token + 1)
         self.shipped_bytes += len(data)
         self.shipments += 1
+        if self.scheduler is not None:
+            self.scheduler.on_wire(token, len(data), tag)
 
     def claim_bytes(self, token: int) -> Optional[bytes]:
         """The claim discipline on raw bytes: one-shot pop, sent-time
