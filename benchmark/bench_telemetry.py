@@ -1,0 +1,150 @@
+"""Paired plane-off-vs-plane-on serving run — the fleet-telemetry
+acceptance benchmark.
+
+The telemetry plane's contract is "observation never perturbs the
+serving path": arming `ClusterConfig.telemetry_interval_s` may add
+host-side snapshot/encode work per cadence tick, but it must not
+change a single token and must stay cheap.  Both halves are gated
+here over the same seeded virtual-clock cluster trace:
+
+- **Exact token parity** — the ON run's per-request token streams
+  byte-compare equal to the OFF run's (``telemetry_token_parity``).
+  This is exactness, not a latency measurement, so it gates hard.
+- **Bounded overhead** — min-of-N wall time with the plane armed is
+  within 10% of plane-off (mirrored run order, min-of-N: the plane
+  is dict snapshots plus delta encoding on a cadence, so more than
+  that is a hot-path regression, not noise).
+
+Emitted rows (one JSON line each, ``bench: "telemetry"``): one row
+per mode with its wall time, then the paired summary
+``check_bench_regression.telemetry_checks`` gates.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+import argparse
+import json
+import time
+
+import jax
+
+from triton_distributed_tpu.serving import (
+    ClusterConfig,
+    SchedulerConfig,
+    ServingCluster,
+    ToyConfig,
+    ToyModel,
+)
+
+#: Enough requests that the plane ticks through several cadences and
+#: at least one keyframe cycle on the virtual clock.
+N_REQUESTS = 10
+N_RUNS = 3
+
+
+def _trace():
+    gens = [6, 9, 7, 11, 6, 8, 10, 7, 9, 6][:N_REQUESTS]
+    return [dict(prompt=[1 + i, 2 + (i % 3), 3, 4, 5 + (i % 2)],
+                 max_new_tokens=g, seed=100 + i,
+                 arrival_time=0.002 * (i % 4))
+            for i, g in enumerate(gens)]
+
+
+def _run(toy, telemetry_interval_s):
+    """One full cluster trace; returns (tokens, wall_s, fleet)."""
+    model, params = toy
+    sc = SchedulerConfig(num_slots=3, prefill_buckets=(8, 16, 32),
+                         temperature=0.8, top_k=8)
+    cluster = ServingCluster(
+        model, params,
+        ClusterConfig(n_replicas=2, scheduler=sc,
+                      telemetry_interval_s=telemetry_interval_s))
+    t0 = time.perf_counter()
+    for t in _trace():
+        cluster.submit(**t)
+    done = cluster.drain()
+    wall = time.perf_counter() - t0
+    tokens = [r.tokens for r in sorted(done,
+                                       key=lambda r: r.record_id)]
+    return tokens, wall, cluster.fleet
+
+
+def sweep(out):
+    rows = []
+
+    def emit(rec):
+        rows.append(rec)
+        line = json.dumps(rec)
+        print(line)
+        if out is not None:
+            out.write(line + "\n")
+
+    model = ToyModel(ToyConfig(vocab_size=61, hidden=16,
+                               max_seq_len=64))
+    params = model.init_params(jax.random.key(0))
+    toy = (model, params)
+
+    # Warm the jit caches off the books so neither mode pays
+    # first-compile inside its measurement.
+    _run(toy, None)
+
+    off_s, on_s = [], []
+    tokens_off = tokens_on = None
+    frames = sources = alerts = 0
+    for i in range(N_RUNS):
+        # Mirrored order so drift (thermal, page cache) cancels.
+        order = (("off", "on") if i % 2 == 0 else ("on", "off"))
+        for mode in order:
+            if mode == "off":
+                tokens_off, wall, _ = _run(toy, None)
+                off_s.append(wall)
+            else:
+                tokens_on, wall, fleet = _run(toy, 0.25)
+                on_s.append(wall)
+                frames = fleet.collector.folded
+                sources = len(fleet.collector.sources())
+                alerts = len(fleet.engine.events)
+
+    for mode, walls in (("off", off_s), ("on", on_s)):
+        emit({"bench": "telemetry", "workload": "paired_trace",
+              "mode": mode, "n_requests": N_REQUESTS,
+              "s": round(min(walls), 4),
+              "samples_s": [round(w, 4) for w in walls]})
+
+    overhead = min(on_s) / min(off_s) - 1.0
+    emit({"bench": "telemetry", "workload": "paired_trace",
+          "mode": "paired", "n_requests": N_REQUESTS,
+          "telemetry_off_s": round(min(off_s), 4),
+          "telemetry_on_s": round(min(on_s), 4),
+          "telemetry_overhead": round(overhead, 4),
+          "telemetry_overhead_le_10pct": overhead <= 0.10,
+          "telemetry_token_parity": tokens_on == tokens_off,
+          "frames_published": frames,
+          "telemetry_sources": sources,
+          "telemetry_alerts_fired": alerts})
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="also append the JSON lines here (the "
+                         "committed copy lives at "
+                         "benchmark/results/telemetry.json)")
+    args = ap.parse_args()
+    out = open(args.out, "w") if args.out else None
+    rows = sweep(out)
+    if out is not None:
+        out.close()
+    paired = [r for r in rows if r.get("mode") == "paired"]
+    assert all(r["telemetry_token_parity"] for r in paired), paired
+    assert all(r["frames_published"] > 0 for r in paired), paired
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -124,6 +124,13 @@ MEASUREMENT_FIELDS = {
     # re-executes EXACT).
     "record_off_s", "record_on_s", "recording_overhead",
     "recording_overhead_le_5pct", "replay_exact",
+    # Fleet-telemetry paired rows (bench_telemetry.py): wall times
+    # are machine-dependent by nature; the parity/overhead booleans
+    # are gated by telemetry_checks.
+    "s", "samples_s", "telemetry_off_s", "telemetry_on_s",
+    "telemetry_overhead", "telemetry_overhead_le_10pct",
+    "telemetry_token_parity", "frames_published",
+    "telemetry_sources", "telemetry_alerts_fired",
 }
 #: Fields that may hold the latency to compare, in preference order.
 LATENCY_FIELDS = ("us", "ms", "ms_per_step")
@@ -552,6 +559,47 @@ def replay_checks(fresh) -> tuple:
     return checked, fails
 
 
+def telemetry_checks(fresh) -> tuple:
+    """Gate specific to the fleet telemetry plane
+    (`observability.telemetry` via ``bench_telemetry.py``): every
+    fresh ``mode="paired"`` row must report EXACT token parity — the
+    plane-armed run's token streams byte-compare equal to the
+    plane-off run's (observation never perturbs serving; this holds
+    by construction since the plane only reads the event loop's
+    ``now``, so a failure is a clock read or scheduling perturbation
+    sneaking into the hot path) — plus bounded overhead (the armed
+    run's min-of-N wall time within 10% of plane-off) and a
+    non-empty plane (frames actually published and folded: a plane
+    that observes nothing gates nothing).
+
+    Returns ``(n_checked, failures)``."""
+    fails = []
+    checked = 0
+    for rec in fresh:
+        if (rec.get("bench") != "telemetry"
+                or rec.get("mode") != "paired"):
+            continue
+        checked += 1
+        if rec.get("telemetry_token_parity") is not True:
+            fails.append(
+                "telemetry regression: the plane-armed run's token "
+                "streams do NOT match the plane-off run's — "
+                "observation perturbed the serving path")
+        overhead = rec.get("telemetry_overhead")
+        if not (isinstance(overhead, (int, float))
+                and overhead <= 0.10):
+            fails.append(
+                f"telemetry regression: plane overhead {overhead!r} "
+                f"exceeds 10% "
+                f"(off={rec.get('telemetry_off_s')}s "
+                f"on={rec.get('telemetry_on_s')}s)")
+        if not rec.get("frames_published"):
+            fails.append(
+                "telemetry regression: the armed run folded ZERO "
+                "frames — the plane observed nothing")
+    return checked, fails
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--fresh", required=True,
@@ -649,13 +697,14 @@ def main() -> int:
     moe_checked, moe_fails = moe_checks(fresh)
     pl_checked, pl_fails = planner_checks(fresh)
     rp_checked, rp_fails = replay_checks(fresh)
+    tl_checked, tl_fails = telemetry_checks(fresh)
 
     # Markdown summary: CI logs and PR comments read the same thing.
     print("## Bench regression check")
     print()
     verdict = ("FAIL" if regressions or cl_fails or rt_fails
                or kt_fails or ln_fails or sp_fails or moe_fails
-               or pl_fails or rp_fails else
+               or pl_fails or rp_fails or tl_fails else
                "OK (with anomalies)" if anomalies else "OK")
     print(f"**{verdict}** — {compared} row(s) compared, "
           f"{regressions} regression(s) beyond "
@@ -730,14 +779,22 @@ def main() -> int:
               f"EXACT), {len(rp_fails)} failure(s).")
         for f in rp_fails:
             print(f"- {f}")
+    if tl_checked:
+        print()
+        print(f"Telemetry gate: {tl_checked} paired row(s) checked "
+              f"(exact token parity + overhead <= 10% + non-empty "
+              f"plane), {len(tl_fails)} failure(s).")
+        for f in tl_fails:
+            print(f"- {f}")
     if (compared == 0 and cl_checked == 0 and rt_checked == 0
             and kt_checked == 0 and ln_checked == 0
             and sp_checked == 0 and moe_checked == 0
-            and pl_checked == 0 and rp_checked == 0):
+            and pl_checked == 0 and rp_checked == 0
+            and tl_checked == 0):
         return 2
     return 1 if (regressions or cl_fails or rt_fails or kt_fails
                  or ln_fails or sp_fails or moe_fails
-                 or pl_fails or rp_fails) else 0
+                 or pl_fails or rp_fails or tl_fails) else 0
 
 
 if __name__ == "__main__":

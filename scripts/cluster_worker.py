@@ -70,6 +70,8 @@ def main() -> int:
 
     import jax
 
+    from triton_distributed_tpu.observability.exporter import (
+        maybe_start_metrics_server)
     from triton_distributed_tpu.observability.lineage import (
         write_lineage_artifact)
     from triton_distributed_tpu.serving import (
@@ -91,6 +93,10 @@ def main() -> int:
                          prefill_buckets=(8, 16, 32),
                          temperature=args.temperature,
                          top_k=args.top_k, **kv)
+    # Per-rank /metrics + endpoint advertisement (no-ops when
+    # TDT_METRICS_PORT is unset; launch.py offsets the port per rank
+    # and points TDT_PORTS_DIR at the run directory).
+    maybe_start_metrics_server()
     counts = _spec_counts()
     cfg = ClusterConfig(
         n_replicas=counts.get("replica", 1),

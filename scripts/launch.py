@@ -262,6 +262,45 @@ def _merge_traces(trace_dir):
               flush=True)
 
 
+def _offset_port(base: str, rank: int) -> str:
+    """Per-rank metrics port: ``0`` (ephemeral) stays ``0`` for every
+    rank, a numeric base offsets by rank, anything malformed passes
+    through (the exporter already survives a bad value)."""
+    try:
+        port = int(base)
+    except ValueError:
+        return base
+    return base if port == 0 else str(port + rank)
+
+
+def _merge_ports(ports_dir):
+    """Fold the per-rank ``ports-rank-<N>.json`` endpoint files the
+    exporters advertised into one ``ports.json`` — the single file
+    the watch CLI / fleet collector read to find the fleet."""
+    import glob as _glob
+    import json as _json
+    ranks = []
+    for path in sorted(_glob.glob(os.path.join(
+            ports_dir, "ports-rank-*.json"))):
+        try:
+            with open(path) as f:
+                ranks.append(_json.load(f))
+        except (OSError, ValueError):
+            continue
+    if not ranks:
+        return
+    ranks.sort(key=lambda r: r.get("rank", 0))
+    path = os.path.join(ports_dir, "ports.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            _json.dump({"schema": 1, "ranks": ranks}, f, indent=1)
+        os.replace(tmp, path)
+    except OSError as e:
+        print(f"launch: ports merge failed: {e}", file=sys.stderr,
+              flush=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nproc", type=int, default=1,
@@ -364,6 +403,16 @@ def main() -> int:
     hb_dir = (os.path.join(args.trace_dir, "heartbeats")
               if args.trace_dir
               else os.environ.get("TDT_HEARTBEAT_DIR"))
+    # Metrics-endpoint discovery: every rank binds its OWN port (the
+    # parent's TDT_METRICS_PORT is offset by rank below — inheriting
+    # it verbatim made every role process race for the same bind and
+    # all but one silently lose their /metrics).  Each rank
+    # advertises its actual endpoint into ports_dir
+    # (ports-rank-<N>.json, exporter-side), merged to ports.json
+    # after the run so the fleet collector / watch CLI can find the
+    # fleet without guessing.
+    ports_dir = (args.trace_dir if args.trace_dir
+                 else os.environ.get("TDT_PORTS_DIR"))
 
     def _kill_group(sig=signal.SIGTERM):
         for p in procs:
@@ -420,6 +469,11 @@ def main() -> int:
             env["TDT_HEARTBEAT_DIR"] = hb_dir
         if args.cpu:
             env["JAX_PLATFORMS"] = "cpu"
+        base_port = os.environ.get("TDT_METRICS_PORT")
+        if base_port and world > 1:
+            env["TDT_METRICS_PORT"] = _offset_port(base_port, rank)
+        if ports_dir:
+            env["TDT_PORTS_DIR"] = ports_dir
         if role_of is not None:
             role, idx = role_of[rank]
             env["TDT_ROLE"] = role
@@ -506,6 +560,8 @@ def main() -> int:
         # Group fully reaped: merge whatever per-rank traces the
         # workers exported into one timeline + straggler report.
         _merge_traces(args.trace_dir)
+    if ports_dir:
+        _merge_ports(ports_dir)
     if timed_out:
         rc = 124  # timeout(1) convention
         # Re-state the verdict next to the exit code (the at-alarm

@@ -56,7 +56,15 @@
 #    artifacts land, the doctor renders an "SLO" section, and the
 #    capacity planner answers "2 replicas" bit-exactly twice) plus
 #    the planner bench gate (every committed plan row feasible AND
-#    deterministic).
+#    deterministic);
+#  - a telemetry smoke (2-replica virtual cluster with the fleet
+#    telemetry plane armed: every source folds into the front door's
+#    collector, /fleet + fleet-labeled Prometheus render, a seeded
+#    burn frame fires exactly one edge-triggered alert and clears,
+#    the watch --once render is byte-stable, the doctor gains a
+#    "Fleet alerts" section) plus the telemetry bench gate (paired
+#    plane-off/plane-on trace: exact token parity, bounded
+#    overhead).
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -207,7 +215,7 @@ fi
 doctor_rc=0
 for scenario in stalled_rank sem_leak slow_link clean \
         lossy_transport slow_request replayed_fault \
-        socket_partition; do
+        socket_partition fleet_alert; do
     if ! JAX_PLATFORMS=cpu python -m \
             triton_distributed_tpu.observability.doctor \
             "tests/data/incidents/$scenario" -q \
@@ -1164,6 +1172,122 @@ slo_rc=$?
 echo "$slo_log" | tail -3
 if [ "$slo_rc" -ne 0 ]; then
     echo "SLO_SMOKE=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
+# Telemetry smoke: the fleet telemetry plane end-to-end in-process —
+# a 2-replica virtual cluster with the plane armed must fold frames
+# from every source into the front door's collector, render the
+# fleet-labeled Prometheus exposition and the /fleet status body, a
+# seeded SLO-burn frame must fire EXACTLY one edge-triggered alert
+# and clear on the falling edge, the watch CLI's --once render over
+# the written artifacts must be byte-stable, and the doctor must
+# pick the artifacts up into a "Fleet alerts" section with the
+# firing rule in the verdict.
+telemetry_log=$(JAX_PLATFORMS=cpu python - <<'EOF' 2>&1
+import json, os, tempfile
+import jax
+from triton_distributed_tpu.observability import feedback
+from triton_distributed_tpu.observability.doctor import (
+    diagnose, render_markdown)
+from triton_distributed_tpu.observability.lineage import (
+    get_lineage_recorder)
+from triton_distributed_tpu.observability.metrics import get_registry
+from triton_distributed_tpu.observability.telemetry import (
+    AlertEngine, FleetCollector, fleet_prometheus, fleet_status,
+    validate_alert, validate_telemetry)
+from triton_distributed_tpu.observability.watch import snapshot_once
+from triton_distributed_tpu.serving import (
+    ClusterConfig, SchedulerConfig, ServingCluster, ToyConfig,
+    ToyModel)
+
+get_registry().clear()
+get_lineage_recorder().clear()
+feedback.clear_recent_decisions()
+
+model = ToyModel(ToyConfig(vocab_size=61, hidden=16, max_seq_len=64))
+params = model.init_params(jax.random.key(0))
+cluster = ServingCluster(model, params, ClusterConfig(
+    n_replicas=2,
+    scheduler=SchedulerConfig(num_slots=2, prefill_buckets=(8, 16)),
+    telemetry_interval_s=0.25))
+for i in range(6):
+    cluster.submit([1 + i, 2, 3, 4], 4 + (i % 2), seed=i,
+                   arrival_time=0.0)
+done = cluster.drain()
+assert len(done) == 6, [r.state for r in done]
+
+# Every local source folded into the front door's collector.
+fleet = cluster.fleet
+assert fleet is not None and fleet.collector.folded > 0
+assert fleet.collector.sources() == [
+    "replica-0", "replica-1", "router-0"], fleet.collector.sources()
+for f in fleet.frames:
+    validate_telemetry(f)
+
+# The aggregated /fleet body + fleet-labeled Prometheus exposition.
+status = fleet_status()
+assert status["fleet"] is not None, status
+assert len(status["fleet"]["table"]) == 3, status["fleet"]
+prom = fleet_prometheus()
+assert prom and 'src="replica-0"' in prom, prom[:400]
+
+# Seeded burn: one edge-triggered alert, silent while held, cleared
+# on the falling edge.
+c2 = FleetCollector()
+eng = AlertEngine()
+def burn_frame(seq, ts, burn):
+    return {"schema": 1, "kind": "telemetry", "ts": ts,
+            "src": {"rank": 1, "role": "replica", "index": 0},
+            "seq": seq, "full": seq == 0,
+            "counters": {}, "histograms": {},
+            "gauges": {"serving_slo_burn_max": burn}}
+c2.fold(burn_frame(0, 0.5, 5.0))
+fired = eng.evaluate(1.0, c2)
+assert [e["rule"] for e in fired] == ["slo_burn"], fired
+assert eng.evaluate(1.5, c2) == []
+c2.fold(burn_frame(1, 2.0, 0.1))
+cleared = eng.evaluate(2.5, c2)
+assert [e["state"] for e in cleared] == ["cleared"], cleared
+for e in eng.events:
+    validate_alert(e)
+
+# Artifacts -> byte-stable watch render -> doctor section.
+d = tempfile.mkdtemp(prefix="tdt-telemetry-")
+fleet.write_artifacts(d)
+from triton_distributed_tpu.observability.telemetry import (
+    write_alerts_artifact, write_telemetry_artifact)
+write_telemetry_artifact(d, [burn_frame(0, 0.5, 5.0)], rank=7)
+write_alerts_artifact(d, eng.events)
+screen = snapshot_once([d])
+assert screen == snapshot_once([d])
+assert "replica-0" in screen and "router-0" in screen, screen
+report = diagnose([d])
+assert report["fleet"]["frames"] > 0, report.get("fleet")
+md = render_markdown(report)
+assert "## Fleet alerts" in md
+print("TELEMETRY_SMOKE=ok")
+EOF
+)
+telemetry_rc=$?
+echo "$telemetry_log" | tail -3
+if [ "$telemetry_rc" -ne 0 ]; then
+    echo "TELEMETRY_SMOKE=FAILED"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
+# Telemetry bench gate: the paired plane-off/plane-on serving trace
+# must hold EXACT token parity with bounded overhead and a
+# non-empty plane.
+if JAX_PLATFORMS=cpu python benchmark/bench_telemetry.py \
+        --out /tmp/_t1_telemetry.json > /dev/null \
+   && python scripts/check_bench_regression.py \
+        --fresh /tmp/_t1_telemetry.json \
+        --baselines /tmp/_t1_nonexistent_baselines.json > /dev/null
+then
+    echo "TELEMETRY_BENCH=ok"
+else
+    echo "TELEMETRY_BENCH=FAILED"
     [ "$rc" -eq 0 ] && rc=1
 fi
 

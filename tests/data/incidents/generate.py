@@ -56,7 +56,7 @@ AXIS = "tp"
 
 SCENARIOS = ("stalled_rank", "sem_leak", "slow_link", "clean",
              "lossy_transport", "slow_request", "replayed_fault",
-             "socket_partition")
+             "socket_partition", "fleet_alert")
 
 
 def _write(scenario: str, name: str, payload, truncate_at=None):
@@ -697,6 +697,97 @@ def gen_socket_partition():
             f.write(json.dumps(row) + "\n")
 
 
+def gen_fleet_alert():
+    """The fleet telemetry plane's page: a chaos-suppressed heartbeat
+    (``stale_hb`` on replica-1) killed the replica in the router's
+    eyes, the router's telemetry frames carried the dead routing row
+    to the front-door collector, and the alert engine fired
+    ``replica_dead`` naming the victim — recorded as one ``firing``
+    transition in ``alerts.jsonl``.  The doctor's "Fleet alerts"
+    section must reconstruct the firing set from the transition log
+    and its verdict must name the rule AND the victim (the same names
+    the live watch CLI showed).  Timestamps are CLUSTER-CLOCK
+    seconds."""
+    s = "fleet_alert"
+
+    def frame(role, rank, index, seq, ts, full, gauges=None,
+              counters=None, **extras):
+        return {"schema": 1, "kind": "telemetry", "ts": ts,
+                "src": {"rank": rank, "role": role, "index": index},
+                "seq": seq, "full": full,
+                "counters": counters or {}, "gauges": gauges or {},
+                "histograms": {}, **extras}
+
+    def routing(dead):
+        rows = [
+            {"id": 0, "name": "replica-0", "alive": True,
+             "quarantined": False, "fail_reason": None,
+             "hb_age_s": 0.002, "routed": 5, "queue_depth": 0,
+             "active_slots": 1, "last_step_s": 0.001},
+            {"id": 1, "name": "replica-1", "alive": not dead,
+             "quarantined": False,
+             "fail_reason": "heartbeat_loss" if dead else None,
+             "hb_age_s": 0.8 if dead else 0.003,
+             "routed": 3, "queue_depth": 0, "active_slots": 0,
+             "last_step_s": 0.001},
+        ]
+        return {"replicas": rows}
+
+    frames = [
+        frame("replica", 1, 0, 0, 0.5, True,
+              gauges={"serving_queue_depth": 0.0,
+                      "serving_active_slots": 1.0,
+                      "serving_slot_occupancy": 0.5,
+                      "serving_decode_step_us": 1000.0},
+              counters={"cluster_replica_routed_total": 5.0},
+              signals={"ts": 0.5, "queue_depth": 0,
+                       "active_slots": 1, "kv_occupancy": 0.5,
+                       "step_us": 1000.0, "link_busy": 0.0}),
+        frame("replica", 2, 1, 0, 0.5, True,
+              gauges={"serving_queue_depth": 0.0,
+                      "serving_active_slots": 0.0,
+                      "serving_slot_occupancy": 0.0,
+                      "serving_decode_step_us": 1000.0},
+              counters={"cluster_replica_routed_total": 3.0},
+              signals={"ts": 0.5, "queue_depth": 0,
+                       "active_slots": 0, "kv_occupancy": 0.0,
+                       "step_us": 1000.0, "link_busy": 0.0}),
+        frame("router", 0, 0, 0, 0.5, True,
+              gauges={"serving_queue_depth": 0.0},
+              routing=routing(dead=False)),
+        frame("replica", 1, 0, 1, 1.5, False,
+              counters={"cluster_replica_routed_total": 8.0}),
+        # Replica-1 goes silent (its heartbeats are suppressed: no
+        # more frames), and the router's next frame carries the dead
+        # routing row the alert engine pages on.
+        frame("router", 0, 0, 1, 1.5, False,
+              routing=routing(dead=True)),
+    ]
+    alerts = [
+        {"schema": 1, "kind": "alert", "ts": 1.5,
+         "rule": "replica_dead", "severity": "page",
+         "target": "replica-1", "state": "firing",
+         "inputs": {"fail_reason": "heartbeat_loss",
+                    "hb_age_s": 0.8}},
+    ]
+    faults = [
+        {"schema": 1, "kind": "fault", "ts": 0.7,
+         "fault": "stale_hb", "target": "replica-1",
+         "inputs": {"window": [0.7, 2.0]}, "seed": 99},
+    ]
+    d = os.path.join(HERE, s)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "telemetry-rank-0.jsonl"), "w") as f:
+        for row in frames:
+            f.write(json.dumps(row) + "\n")
+    with open(os.path.join(d, "alerts.jsonl"), "w") as f:
+        for row in alerts:
+            f.write(json.dumps(row) + "\n")
+    with open(os.path.join(d, "faults.jsonl"), "w") as f:
+        for row in faults:
+            f.write(json.dumps(row) + "\n")
+
+
 def generate(clean_first: bool = True):
     import shutil
     for scenario in SCENARIOS:
@@ -718,6 +809,7 @@ def generate(clean_first: bool = True):
     gen_slow_request()
     gen_replayed_fault()
     gen_socket_partition()
+    gen_fleet_alert()
     return [os.path.join(HERE, sc) for sc in SCENARIOS]
 
 

@@ -1,0 +1,623 @@
+"""Fleet telemetry plane (`observability/telemetry.py`,
+`observability/watch.py`): delta encoding under loss, the alert-rule
+corpus, the watch CLI golden, and the plane's zero-token-impact
+contract.
+
+The load-bearing assertions:
+
+- **Loss model.**  Folding a frame stream with drops, reorders and
+  duplicates converges to the same per-source snapshot as the clean
+  stream once the next keyframe lands — and a duplicate or stale
+  frame can never roll a key backward.
+- **Alert discipline.**  Every rule edge-triggers: one ``firing`` on
+  the rising edge, silence while held, ``cleared`` on the falling
+  edge, re-arm after.  Falsy inputs and stale sources never fire.
+- **Token parity.**  A seeded cluster trace with the telemetry plane
+  armed is token-for-token identical to the same trace with the
+  plane off — observation never perturbs the serving path.
+- **Watch golden.**  ``watch --once --from-dir`` over the committed
+  ``fleet_alert`` incident corpus renders byte-identically to the
+  pinned screen, naming the same victim replica the doctor blames.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import pytest
+
+from triton_distributed_tpu.observability.telemetry import (
+    AlertEngine,
+    DeltaEncoder,
+    FleetCollector,
+    TELEMETRY_SCHEMA,
+    TelemetryPublisher,
+    load_alerts,
+    load_telemetry,
+    telemetry_source,
+    validate_alert,
+    validate_telemetry,
+    write_alerts_artifact,
+    write_telemetry_artifact,
+)
+from triton_distributed_tpu.observability.watch import (
+    fold_dir,
+    firing_from_events,
+    render,
+    snapshot_once,
+)
+from triton_distributed_tpu.serving import (
+    ClusterConfig,
+    SchedulerConfig,
+    ServingCluster,
+    ToyConfig,
+    ToyModel,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLEET_ALERT_DIR = os.path.join(REPO, "tests", "data", "incidents",
+                               "fleet_alert")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_decision_state():
+    """Same hygiene as test_cluster: the parity runs record routing
+    decisions into process-global rings that later test files assert
+    on by length."""
+    from triton_distributed_tpu.observability import feedback
+    from triton_distributed_tpu.observability.lineage import (
+        get_lineage_recorder)
+    from triton_distributed_tpu.observability.recorder import (
+        get_flight_recorder)
+    feedback.clear_recent_decisions()
+    yield
+    feedback.clear_recent_decisions()
+    get_flight_recorder().clear()
+    get_lineage_recorder().clear()
+
+
+# ---------------------------------------------------------------------------
+# Frame fixtures
+# ---------------------------------------------------------------------------
+
+def _frame(seq, ts, *, src=None, full=False, gauges=None,
+           counters=None, **extras):
+    f = {
+        "schema": TELEMETRY_SCHEMA, "kind": "telemetry",
+        "ts": float(ts),
+        "src": src or telemetry_source(rank=1, role="replica",
+                                       index=0),
+        "seq": int(seq), "full": bool(full),
+        "counters": counters or {}, "gauges": gauges or {},
+        "histograms": {},
+    }
+    f.update(extras)
+    return f
+
+
+class _Mutable:
+    """A snapshot function whose registry the test mutates between
+    encodes."""
+
+    def __init__(self, **gauges):
+        self.gauges = dict(gauges)
+        self.counters = {}
+
+    def __call__(self):
+        return {"counters": dict(self.counters),
+                "gauges": dict(self.gauges), "histograms": {}}
+
+
+# ---------------------------------------------------------------------------
+# Delta encoding under the loss model
+# ---------------------------------------------------------------------------
+
+class TestDeltaEncoding:
+    def test_first_frame_is_keyframe_and_idle_source_goes_quiet(self):
+        snap = _Mutable(serving_queue_depth=2.0,
+                        serving_active_slots=1.0)
+        enc = DeltaEncoder(snap, telemetry_source(
+            rank=1, role="replica", index=0))
+        f0 = enc.encode(0.5)
+        assert f0["full"] and f0["seq"] == 0
+        assert f0["gauges"] == {"serving_queue_depth": 2.0,
+                                "serving_active_slots": 1.0}
+        # Nothing changed: no frame, no seq burn.
+        assert enc.encode(1.0) is None
+        assert enc.encode(1.5) is None
+
+    def test_delta_carries_only_changed_keys_cumulative(self):
+        snap = _Mutable(serving_queue_depth=2.0,
+                        serving_active_slots=1.0)
+        enc = DeltaEncoder(snap, telemetry_source(
+            rank=1, role="replica", index=0))
+        enc.encode(0.5)
+        snap.gauges["serving_queue_depth"] = 7.0
+        f1 = enc.encode(1.0)
+        assert not f1["full"] and f1["seq"] == 1
+        # Cumulative value, changed key only.
+        assert f1["gauges"] == {"serving_queue_depth": 7.0}
+
+    def test_seq_is_strictly_monotonic_across_emits(self):
+        snap = _Mutable(g=0.0)
+        enc = DeltaEncoder(snap, telemetry_source(
+            rank=1, role="replica", index=0), full_every=3)
+        seqs = []
+        for i in range(8):
+            snap.gauges["g"] = float(i)
+            frame = enc.encode(float(i))
+            assert frame is not None
+            seqs.append(frame["seq"])
+        assert seqs == list(range(8))
+        assert [s for s in seqs
+                if s % 3 == 0] == [0, 3, 6]   # keyframe cadence
+
+    def _stream(self, n=12, full_every=4):
+        """A deterministic frame stream from a mutating source."""
+        snap = _Mutable(serving_queue_depth=0.0)
+        enc = DeltaEncoder(snap, telemetry_source(
+            rank=1, role="replica", index=0), full_every=full_every)
+        frames = []
+        for i in range(n):
+            snap.gauges["serving_queue_depth"] = float(i)
+            if i == 3:
+                snap.gauges["serving_active_slots"] = 2.0
+            snap.counters["cluster_replica_routed_total"] = float(i)
+            frames.append(enc.encode(0.5 * i))
+        assert all(f is not None for f in frames)
+        return frames
+
+    def _folded(self, frames):
+        c = FleetCollector()
+        for f in frames:
+            c.fold(f)
+        [key] = c.sources()
+        return c, c.source_state(key)["snapshot"]
+
+    def test_fold_duplicates_are_rejected_not_applied(self):
+        frames = self._stream()
+        c, snap = self._folded(frames)
+        folded = c.folded
+        for f in frames:
+            assert c.fold(dict(f)) is False
+        assert c.rejected == len(frames)
+        assert c.folded == folded
+        [key] = c.sources()
+        assert c.source_state(key)["snapshot"] == snap
+
+    def test_fold_reordered_stream_converges_to_clean_fold(self):
+        frames = self._stream()
+        _, clean = self._folded(frames)
+        shuffled = [frames[i] for i in
+                    (4, 0, 7, 2, 1, 11, 3, 9, 5, 10, 6, 8)]
+        _, out = self._folded(shuffled)
+        assert out == clean
+
+    def test_fold_with_drops_repairs_at_next_keyframe(self):
+        frames = self._stream(n=12, full_every=4)
+        _, clean = self._folded(frames)
+        # Drop deltas 1, 2, 5 (never a keyframe: seqs 0, 4, 8 are
+        # full).  The stream still ends beyond a keyframe, so the
+        # folded state must equal the clean fold.
+        kept = [f for f in frames if f["seq"] not in (1, 2, 5)]
+        _, out = self._folded(kept)
+        assert out == clean
+
+    def test_stale_frame_never_rolls_a_key_backward(self):
+        c = FleetCollector()
+        c.fold(_frame(0, 0.5, full=True,
+                      gauges={"serving_queue_depth": 1.0}))
+        c.fold(_frame(2, 1.5, gauges={"serving_queue_depth": 9.0}))
+        # A replayed older delta arrives after the newer one.
+        c.fold(_frame(1, 1.0, gauges={"serving_queue_depth": 4.0}))
+        [key] = c.sources()
+        snap = c.source_state(key)["snapshot"]
+        assert snap["gauges"]["serving_queue_depth"] == 9.0
+
+    def test_fresh_keyframe_is_authoritative_over_dead_keys(self):
+        c = FleetCollector()
+        c.fold(_frame(0, 0.5, full=True,
+                      gauges={"serving_queue_depth": 1.0,
+                              "serving_spec_accept_rate": 0.8}))
+        # The source's registry dropped the spec gauge; the next
+        # keyframe must erase it fleet-side too.
+        c.fold(_frame(4, 2.5, full=True,
+                      gauges={"serving_queue_depth": 3.0}))
+        [key] = c.sources()
+        snap = c.source_state(key)["snapshot"]
+        assert "serving_spec_accept_rate" not in snap["gauges"]
+        assert snap["gauges"]["serving_queue_depth"] == 3.0
+
+    def test_publisher_honors_cadence_and_forces_keyframe_restart(
+            self):
+        snap = _Mutable(g=1.0)
+        pub = TelemetryPublisher(
+            snap, telemetry_source(rank=1, role="replica", index=0),
+            interval_s=1.0)
+        assert pub.maybe_publish(0.0) is not None
+        snap.gauges["g"] = 2.0
+        assert pub.maybe_publish(0.5) is None       # not due yet
+        f = pub.maybe_publish(1.0)
+        assert f is not None and f["gauges"] == {"g": 2.0}
+
+    def test_validators_reject_malformed(self):
+        good = _frame(0, 0.5, full=True)
+        assert validate_telemetry(good) is good
+        with pytest.raises(ValueError):
+            validate_telemetry({**good, "schema": 99})
+        with pytest.raises(ValueError):
+            validate_telemetry({**good, "seq": -1})
+        bad = {k: v for k, v in good.items() if k != "src"}
+        with pytest.raises(ValueError):
+            validate_telemetry(bad)
+        with pytest.raises(ValueError):
+            validate_alert({"schema": TELEMETRY_SCHEMA,
+                            "kind": "alert", "ts": 0.0,
+                            "rule": "x", "severity": "warn",
+                            "target": "y", "state": "exploded",
+                            "inputs": {}})
+
+
+# ---------------------------------------------------------------------------
+# Alert-rule corpus
+# ---------------------------------------------------------------------------
+
+def _engine_with(collector_frames, now=1.0):
+    c = FleetCollector()
+    for f in collector_frames:
+        c.fold(f)
+    return AlertEngine(), c
+
+
+class TestAlertRules:
+    def test_slo_burn_fires_holds_clears_and_rearms(self):
+        eng, c = _engine_with([_frame(
+            0, 0.5, full=True,
+            gauges={"serving_slo_burn_max": 5.0})])
+        out = eng.evaluate(1.0, c)
+        assert [(e["rule"], e["state"], e["severity"], e["target"])
+                for e in out] == [("slo_burn", "firing", "page",
+                                   "replica-1")]
+        assert out[0]["inputs"]["burn_max"] == 5.0
+        # Held: silent while the condition persists.
+        assert eng.evaluate(1.5, c) == []
+        assert [e["rule"] for e in eng.firing()] == ["slo_burn"]
+        # Falling edge: one cleared event carrying the firing ts.
+        c.fold(_frame(1, 2.0,
+                      gauges={"serving_slo_burn_max": 0.5}))
+        cleared = eng.evaluate(2.5, c)
+        assert [(e["state"], e["inputs"]["fired_ts"])
+                for e in cleared] == [("cleared", 1.0)]
+        assert eng.firing() == []
+        # Re-arm: the same condition fires a second time.
+        c.fold(_frame(2, 3.0,
+                      gauges={"serving_slo_burn_max": 6.0}))
+        again = eng.evaluate(3.5, c)
+        assert [(e["rule"], e["state"]) for e in again] == [
+            ("slo_burn", "firing")]
+        for e in eng.events:
+            validate_alert(e)
+
+    def test_kv_page_pressure_and_quarantine_warn(self):
+        eng, c = _engine_with([
+            _frame(0, 0.5, full=True,
+                   gauges={"serving_kv_page_occupancy": 0.95}),
+            _frame(0, 0.5, full=True,
+                   src=telemetry_source(rank=0, role="router",
+                                        index=0),
+                   routing={"replicas": [
+                       {"name": "replica-0", "alive": True,
+                        "quarantined": True,
+                        "fail_reason": "straggler"}]}),
+        ])
+        out = eng.evaluate(1.0, c)
+        assert [(e["rule"], e["severity"], e["target"])
+                for e in out] == [
+            ("kv_page_pressure", "warn", "replica-1"),
+            ("replica_quarantined", "warn", "replica-0")]
+
+    def test_replica_dead_pages_and_names_the_victim(self):
+        eng, c = _engine_with([_frame(
+            0, 0.5, full=True,
+            src=telemetry_source(rank=0, role="router", index=0),
+            routing={"replicas": [
+                {"name": "replica-1", "alive": False,
+                 "fail_reason": "heartbeat_loss",
+                 "hb_age_s": 0.8}]})])
+        out = eng.evaluate(1.0, c)
+        assert [(e["rule"], e["severity"], e["target"])
+                for e in out] == [("replica_dead", "page",
+                                   "replica-1")]
+        assert out[0]["inputs"] == {"fail_reason": "heartbeat_loss",
+                                    "hb_age_s": 0.8}
+
+    def test_anomaly_sustained_thresholds_on_min_z(self):
+        eng, c = _engine_with([_frame(
+            0, 0.5, full=True,
+            anomaly={"decode_step_us": 4.2,
+                     "collective_us": 2.9})])
+        out = eng.evaluate(1.0, c)
+        # Only the key at/above z_threshold=3 fires, target names
+        # source AND baseline key.
+        assert [(e["rule"], e["target"]) for e in out] == [
+            ("anomaly_sustained", "replica-1:decode_step_us")]
+
+    def test_falsy_inputs_never_fire(self):
+        eng, c = _engine_with([
+            _frame(0, 0.5, full=True,
+                   gauges={"serving_slo_burn_max": 0.0,
+                           "serving_kv_page_occupancy": 0.0},
+                   anomaly={"decode_step_us": 0.0},
+                   routing={"replicas": [
+                       {"name": "replica-0", "alive": True,
+                        "quarantined": False}]}),
+        ])
+        assert eng.evaluate(1.0, c) == []
+        assert eng.firing() == [] and eng.events == []
+
+    def test_stale_source_never_evaluates(self):
+        eng, c = _engine_with([_frame(
+            0, 0.5, full=True,
+            gauges={"serving_slo_burn_max": 99.0})])
+        # Way past stale_after_s: the fossil gauge stays silent.
+        assert eng.evaluate(1000.0, c) == []
+        # And a stale-out while firing clears the alert rather than
+        # keeping it alive on fossil data.
+        fired = eng.evaluate(1.0, c)
+        assert [e["rule"] for e in fired] == ["slo_burn"]
+        cleared = eng.evaluate(1000.0, c)
+        assert [e["state"] for e in cleared] == ["cleared"]
+
+
+# ---------------------------------------------------------------------------
+# Artifacts round-trip
+# ---------------------------------------------------------------------------
+
+class TestArtifacts:
+    def test_write_load_roundtrip_and_empty_writes_nothing(
+            self, tmp_path):
+        frames = [_frame(0, 0.5, full=True,
+                         gauges={"serving_slo_burn_max": 5.0}),
+                  _frame(1, 1.0,
+                         gauges={"serving_slo_burn_max": 0.5})]
+        path = write_telemetry_artifact(str(tmp_path), frames,
+                                        rank=3)
+        assert os.path.basename(path) == "telemetry-rank-3.jsonl"
+        assert load_telemetry(path) == frames
+        eng, c = _engine_with([frames[0]])
+        eng.evaluate(1.0, c)
+        c.fold(frames[1])
+        eng.evaluate(1.5, c)
+        assert [e["state"] for e in eng.events] == ["firing",
+                                                    "cleared"]
+        apath = write_alerts_artifact(str(tmp_path), eng.events)
+        back = load_alerts(apath)
+        assert back == eng.events
+        # Golden discipline: nothing fired, nothing emitted -> no file.
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert write_telemetry_artifact(str(empty), []) is None
+        assert write_alerts_artifact(str(empty), []) is None
+        assert os.listdir(empty) == []
+
+
+# ---------------------------------------------------------------------------
+# Watch CLI
+# ---------------------------------------------------------------------------
+
+class TestWatch:
+    def test_firing_from_events_last_transition_wins(self):
+        events = [
+            {"ts": 1.0, "rule": "slo_burn", "target": "replica-1",
+             "state": "firing", "severity": "page"},
+            {"ts": 2.0, "rule": "slo_burn", "target": "replica-1",
+             "state": "cleared", "severity": "page"},
+            {"ts": 2.5, "rule": "replica_dead", "target": "replica-2",
+             "state": "firing", "severity": "page"},
+        ]
+        firing = firing_from_events(events)
+        assert [(e["rule"], e["target"]) for e in firing] == [
+            ("replica_dead", "replica-2")]
+
+    def test_snapshot_once_matches_golden_and_is_byte_stable(self):
+        got = snapshot_once([FLEET_ALERT_DIR])
+        assert got == snapshot_once([FLEET_ALERT_DIR])
+        golden = os.path.join(REPO, "tests", "data", "incidents",
+                              "fleet_alert", "watch.txt")
+        with open(golden) as f:
+            want = f.read()
+        assert got == want
+        # The victim the alert names is the victim the table shows
+        # dead — one story across watch, alerts and doctor.
+        assert "replica_dead on replica-1" in got
+        assert "DEAD" in got
+
+    def test_cli_once_from_dir_equals_inprocess_render(self):
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "triton_distributed_tpu.observability.watch",
+             "--once", "--from-dir", FLEET_ALERT_DIR],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stderr
+        assert proc.stdout == snapshot_once([FLEET_ALERT_DIR])
+
+    def test_cli_from_dir_without_once_refuses(self):
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "triton_distributed_tpu.observability.watch",
+             "--from-dir", FLEET_ALERT_DIR],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 2
+        assert "--once" in proc.stderr
+
+    def test_fold_dir_skips_torn_artifacts(self, tmp_path):
+        write_telemetry_artifact(str(tmp_path), [
+            _frame(0, 0.5, full=True,
+                   gauges={"serving_queue_depth": 1.0})], rank=0)
+        (tmp_path / "telemetry-rank-1.jsonl").write_text(
+            "{not json\n")
+        collector, alerts = fold_dir([str(tmp_path)])
+        assert collector.sources() == ["replica-1"]
+        assert alerts == []
+
+    def test_render_empty_status(self):
+        text = render({"table": [], "alerts": []})
+        assert "(no sources yet)" in text
+        assert "alerts: none firing" in text
+
+
+# ---------------------------------------------------------------------------
+# Token parity: plane armed == plane off
+# ---------------------------------------------------------------------------
+
+def _trace(n=6):
+    gens = [6, 9, 7, 11, 6, 8][:n]
+    return [dict(prompt=[1 + i, 2 + (i % 3), 3, 4, 5 + (i % 2)],
+                 max_new_tokens=g, seed=100 + i,
+                 arrival_time=0.002 * (i % 4))
+            for i, g in enumerate(gens)]
+
+
+def _run_cluster(toy, telemetry_interval_s):
+    model, params = toy
+    sc = SchedulerConfig(num_slots=3, prefill_buckets=(8, 16, 32),
+                         temperature=0.8, top_k=8)
+    cluster = ServingCluster(
+        model, params,
+        ClusterConfig(n_replicas=2, scheduler=sc,
+                      telemetry_interval_s=telemetry_interval_s))
+    for t in _trace():
+        cluster.submit(**t)
+    done = cluster.drain()
+    tokens = [r.tokens for r in sorted(done,
+                                       key=lambda r: r.record_id)]
+    return cluster, tokens
+
+
+class TestTokenParity:
+    def test_plane_on_matches_plane_off_token_for_token(self):
+        model = ToyModel(ToyConfig(vocab_size=61, hidden=16,
+                                   max_seq_len=64))
+        params = model.init_params(jax.random.key(0))
+        toy = (model, params)
+        off_cluster, off_tokens = _run_cluster(toy, None)
+        on_cluster, on_tokens = _run_cluster(toy, 0.25)
+        assert on_tokens == off_tokens
+        # And the plane actually observed the run: frames from the
+        # router and both replicas folded into the front door.
+        assert off_cluster.fleet is None
+        fleet = on_cluster.fleet
+        assert fleet is not None and fleet.collector.folded > 0
+        assert fleet.collector.sources() == [
+            "replica-0", "replica-1", "router-0"]
+        rows = fleet.collector.fleet_table()
+        assert [r["role"] for r in rows] == [
+            "replica", "replica", "router"]
+
+    def test_chaos_killed_replica_fires_replica_dead_end_to_end(
+            self, tmp_path):
+        """A replica killed mid-trace fires a ``replica_dead`` alert
+        through the live plane, and watch, the alerts artifact, and
+        the doctor verdict all name the SAME victim."""
+        from triton_distributed_tpu.observability.doctor import (
+            diagnose)
+        from triton_distributed_tpu.serving.cluster import (
+            RouterConfig)
+        model = ToyModel(ToyConfig(vocab_size=61, hidden=16,
+                                   max_seq_len=64))
+        params = model.init_params(jax.random.key(0))
+        sc = SchedulerConfig(num_slots=3,
+                             prefill_buckets=(8, 16, 32))
+        cluster = ServingCluster(
+            model, params,
+            ClusterConfig(n_replicas=2, scheduler=sc,
+                          router=RouterConfig(dead_after_s=0.01),
+                          telemetry_interval_s=0.05,
+                          artifact_dir=str(tmp_path)))
+        for t in _trace():
+            cluster.submit(**t)
+        for _ in range(6):
+            cluster.step()
+        cluster.kill_replica(1)
+        done = cluster.drain()
+        assert len(done) == len(_trace()), [r.state for r in done]
+        firing = cluster.fleet.engine.firing()
+        assert [(e["rule"], e["target"]) for e in firing
+                if e["rule"] == "replica_dead"] == [
+            ("replica_dead", "replica-1")]
+        # The artifacts landed with the run; one consistent story.
+        alerts = load_alerts(str(tmp_path / "alerts.jsonl"))
+        assert [(e["rule"], e["target"], e["state"])
+                for e in alerts if e["rule"] == "replica_dead"] == [
+            ("replica_dead", "replica-1", "firing")]
+        screen = snapshot_once([str(tmp_path)])
+        assert "replica_dead on replica-1" in screen
+        report = diagnose([str(tmp_path)])
+        assert "replica_dead" in report["verdict"]
+        assert "replica-1" in report["verdict"]
+
+    @pytest.mark.slow
+    def test_socket_run_plane_on_matches_plane_off(self, tmp_path):
+        """The acceptance-criteria run: a REAL 2-process socket
+        cluster with the wire telemetry plane armed produces
+        token-for-token the same results as the same launch with the
+        plane off — and the front door's artifact folds frames from
+        the remote replica."""
+        def launch(out_dir, telemetry):
+            env = {k: v for k, v in os.environ.items()
+                   if not k.startswith(("TDT_", "JAX_"))}
+            env["JAX_PLATFORMS"] = "cpu"
+            if telemetry:
+                env["TDT_TELEMETRY"] = "1"
+                env["TDT_TELEMETRY_INTERVAL"] = "0.2"
+            return subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "scripts", "launch.py"),
+                 "--cpu", "--roles", "router:1,replica:1",
+                 "--timeout", "180",
+                 os.path.join(REPO, "scripts", "cluster_worker.py"),
+                 "--out", str(out_dir),
+                 "--requests", "5", "--seed", "13"],
+                capture_output=True, text=True, timeout=240,
+                env=env, cwd=REPO)
+
+        off_dir = tmp_path / "off"
+        on_dir = tmp_path / "on"
+        for d, telemetry in ((off_dir, False), (on_dir, True)):
+            d.mkdir()
+            proc = launch(d, telemetry)
+            assert proc.returncode == 0, proc.stderr[-2000:]
+        with open(off_dir / "results.json") as f:
+            off_results = json.load(f)
+        with open(on_dir / "results.json") as f:
+            on_results = json.load(f)
+        assert ([r["tokens"] for r in on_results]
+                == [r["tokens"] for r in off_results])
+        # Plane off: no telemetry artifacts at all.  Plane on: the
+        # front door folded the remote replica's wire frames.
+        assert not list(off_dir.glob("rank-*/telemetry*.jsonl"))
+        tel = list(on_dir.glob("rank-0/telemetry*.jsonl"))
+        assert len(tel) == 1, list(on_dir.rglob("*"))
+        frames = load_telemetry(str(tel[0]))
+        roles = {f["src"]["role"] for f in frames}
+        assert roles == {"router", "replica"}, roles
+
+    def test_plane_writes_artifacts_watchable_post_mortem(
+            self, tmp_path):
+        model = ToyModel(ToyConfig(vocab_size=61, hidden=16,
+                                   max_seq_len=64))
+        params = model.init_params(jax.random.key(0))
+        cluster, _ = _run_cluster((model, params), 0.25)
+        cluster.fleet.write_artifacts(str(tmp_path))
+        tel = [p for p in os.listdir(tmp_path)
+               if p.startswith("telemetry-rank-")]
+        assert len(tel) == 1
+        frames = load_telemetry(os.path.join(tmp_path, tel[0]))
+        assert frames and all(
+            validate_telemetry(f) for f in frames)
+        text = snapshot_once([str(tmp_path)])
+        assert "replica-1" in text and "router-0" in text

@@ -123,6 +123,31 @@ from triton_distributed_tpu.observability.exporter import (  # noqa: F401
     read_heartbeats,
     start_metrics_server,
 )
+from triton_distributed_tpu.observability.telemetry import (  # noqa: F401
+    ALERT_FIELDS,
+    AlertEngine,
+    DeltaEncoder,
+    FleetCollector,
+    TELEMETRY_FIELDS,
+    TelemetryPublisher,
+    current_alert_engine,
+    current_fleet,
+    fleet_prometheus,
+    fleet_status,
+    load_alerts,
+    load_telemetry,
+    set_fleet_collector,
+    signal_fields,
+    snapshot_gauges,
+    sustained_anomalies,
+    telemetry_enabled,
+    telemetry_extras,
+    telemetry_source,
+    validate_alert,
+    validate_telemetry,
+    write_alerts_artifact,
+    write_telemetry_artifact,
+)
 from triton_distributed_tpu.observability.recorder import (  # noqa: F401
     FlightRecorder,
     get_flight_recorder,

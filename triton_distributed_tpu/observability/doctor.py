@@ -192,6 +192,8 @@ class Artifacts:
         self.slo_state: Optional[dict] = None
         self.timeseries: List[dict] = []
         self.replay: List[dict] = []
+        self.telemetry: List[dict] = []
+        self.alerts: List[dict] = []
         self._discover()
 
     def _glob(self, pattern: str) -> List[str]:
@@ -286,6 +288,26 @@ class Artifacts:
             # File order preserved — the row stream IS the recorded
             # log (sorting would scramble the clock chunks).
             self.replay = load_jsonl_rows(replay_files)
+        tel_files = self._glob("telemetry*.jsonl")
+        alert_files = self._glob("alerts.jsonl")
+        if tel_files or alert_files:
+            from triton_distributed_tpu.observability.telemetry import (
+                load_alerts, load_telemetry)
+            # Per-file tolerance: a torn telemetry stream degrades the
+            # Fleet section, never kills the report.
+            for p in tel_files:
+                try:
+                    self.telemetry += load_telemetry(p)
+                except (OSError, ValueError):
+                    continue
+            for p in alert_files:
+                try:
+                    self.alerts += load_alerts(p)
+                except (OSError, ValueError):
+                    continue
+            self.alerts.sort(key=lambda e: (_num(e.get("ts")),
+                                            str(e.get("rule")),
+                                            str(e.get("target"))))
 
     def empty(self) -> bool:
         # A router artifact alone is an incident report's worth of
@@ -299,7 +321,8 @@ class Artifacts:
         return not (self.traces or self.flights or self.heartbeats
                     or self.metrics or self.router or self.faults
                     or self.lineage or self.slo_state
-                    or self.timeseries or self.replay)
+                    or self.timeseries or self.replay
+                    or self.telemetry or self.alerts)
 
     def ranks(self) -> List[int]:
         from triton_distributed_tpu.observability.timeline import (
@@ -323,6 +346,10 @@ class Artifacts:
         for rv in self.replay:
             if rv.get("kind") in ("fault_injected", "hop"):
                 ts.append(_num(rv.get("ts")))
+        for tv in self.telemetry:
+            ts.append(_num(tv.get("ts")))
+        for av in self.alerts:
+            ts.append(_num(av.get("ts")))
         for fl in self.flights.values():
             ts.append(float(fl.get("unix_time", 0.0)))
             for ev in fl.get("events", []):
@@ -987,6 +1014,58 @@ def analyze_timeseries(art: Artifacts) -> Optional[dict]:
     }
 
 
+def analyze_fleet(art: Artifacts, now: float) -> Optional[dict]:
+    """Replay the fleet telemetry plane's artifacts
+    (``telemetry*.jsonl`` + ``alerts.jsonl``,
+    `observability.telemetry`) into the report: fold every frame
+    through a fresh :class:`FleetCollector` (the same idempotent fold
+    the live front door ran), summarize the per-source fleet table,
+    and reduce the alert transition log to what was firing at the
+    end.  None — and thus NO report key, keeping pre-telemetry golden
+    reports byte-identical — without either artifact."""
+    if not art.telemetry and not art.alerts:
+        return None
+    from triton_distributed_tpu.observability.telemetry import (
+        FleetCollector)
+    from triton_distributed_tpu.observability.watch import (
+        firing_from_events)
+    collector = FleetCollector()
+    for frame in art.telemetry:
+        collector.fold(frame)
+    table = []
+    for row in collector.fleet_table(now):
+        table.append({k: row.get(k) for k in (
+            "source", "role", "rank", "seq", "age_s", "queue_depth",
+            "active_slots", "kv_page_occupancy", "step_us",
+            "burn_max", "alive", "quarantined", "fail_reason")
+            if k in row})
+    by_rule: Dict[str, int] = {}
+    for e in art.alerts:
+        if e.get("state") == "firing":
+            r = str(e.get("rule", "?"))
+            by_rule[r] = by_rule.get(r, 0) + 1
+    firing = [{
+        "rule": e.get("rule"), "severity": e.get("severity"),
+        "target": e.get("target"), "ts": e.get("ts"),
+        "inputs": (e.get("inputs")
+                   if isinstance(e.get("inputs"), dict) else {}),
+    } for e in firing_from_events(art.alerts)]
+    recent = [{
+        "age_s": round(now - _num(e.get("ts")), 3),
+        "rule": e.get("rule"), "severity": e.get("severity"),
+        "target": e.get("target"), "state": e.get("state"),
+    } for e in art.alerts[-10:]]
+    return {
+        "frames": len(art.telemetry),
+        "sources": collector.sources(),
+        "table": table,
+        "alerts": len(art.alerts),
+        "alerts_by_rule": dict(sorted(by_rule.items())),
+        "firing": firing,
+        "recent_alerts": recent,
+    }
+
+
 def analyze_links(art: Artifacts) -> dict:
     from triton_distributed_tpu.observability import links as _links
     from triton_distributed_tpu.observability.events import KernelEvent
@@ -1232,6 +1311,11 @@ def diagnose(dirs: Sequence[str], *, kernel: Optional[str] = None,
     replay_out = analyze_replay(art)
     if replay_out is not None:
         report["replay"] = replay_out
+    # Fleet telemetry plane: key absent without telemetry*.jsonl /
+    # alerts.jsonl artifacts — same golden discipline.
+    fleet_out = analyze_fleet(art, now)
+    if fleet_out is not None:
+        report["fleet"] = fleet_out
     report["verdict"] = _verdict(report, in_flight)
     return report
 
@@ -1359,6 +1443,19 @@ def _verdict(report: dict, in_flight: Optional[dict]) -> str:
         for c in rpl.get("counterfactuals", []):
             if c.get("clause"):
                 hot_s += f"; counterfactually, {c['clause']}"
+    # Fleet alerts: the verdict NAMES the firing rule and its victim
+    # (clause only exists when a telemetry/alerts artifact was
+    # ingested) — the live plane's page and the post-mortem agree on
+    # who to blame.
+    fleet = report.get("fleet")
+    fleet_s = ""
+    if fleet and fleet.get("firing"):
+        worst = fleet["firing"][0]
+        more = (f" (+{len(fleet['firing']) - 1} more)"
+                if len(fleet["firing"]) > 1 else "")
+        fleet_s = (f"; fleet alert '{worst['rule']}' firing on "
+                   f"{worst['target']}{more}")
+    hot_s += fleet_s
     if stall["first_stalled_rank"] is not None:
         r = stall["first_stalled_rank"]
         what = (f" inside {stall['open_span']!r}"
@@ -1408,6 +1505,10 @@ def _verdict(report: dict, in_flight: Optional[dict]) -> str:
         # A failover IS the incident — it must never read as "no
         # incident detected" with the dead replica in a subclause.
         return "cluster incident" + hot_s + "."
+    if fleet_s:
+        # Same discipline for a firing fleet alert: the page IS the
+        # incident.
+        return "fleet alert firing" + hot_s + "."
     if chaos_s:
         # Faults were injected and everything absorbed them: that is
         # the headline (the run was a chaos schedule, not an
@@ -1718,6 +1819,47 @@ def render_markdown(report: dict) -> str:
         for c in rpl.get("counterfactuals", []):
             lines.append(f"- counterfactually, {c['clause']}")
         lines.append("")
+
+    fleet = report.get("fleet")
+    if fleet:
+        firing = fleet.get("firing") or []
+        head = (f"{len(firing)} alert(s) firing at end of run"
+                if firing else "No alert firing at end of run")
+        lines += ["## Fleet alerts", "",
+                  f"{fleet['frames']} telemetry frame(s) from "
+                  f"{len(fleet.get('sources', []))} source(s); "
+                  f"{fleet['alerts']} alert transition(s)"
+                  + (" — "
+                     + ", ".join(f"{r}×{n}" for r, n in
+                                 fleet["alerts_by_rule"].items())
+                     if fleet.get("alerts_by_rule") else "")
+                  + f". {head}.", ""]
+        for e in firing:
+            inp = ", ".join(f"{k}={v}" for k, v in
+                            sorted(e.get("inputs", {}).items()))
+            lines.append(f"- [{e.get('severity')}] {e.get('rule')} "
+                         f"on {e.get('target')}"
+                         + (f" ({inp})" if inp else ""))
+        if firing:
+            lines.append("")
+        if fleet.get("table"):
+            lines += ["| source | role | seq | queue | slots "
+                      "| kv occ | burn | state |",
+                      "|---|---|---|---|---|---|---|---|"]
+            for row in fleet["table"]:
+                state = ("DEAD" if row.get("alive") is False
+                         else "QUARANTINED" if row.get("quarantined")
+                         else "ok")
+                def v(key):
+                    x = row.get(key)
+                    return "-" if x is None else x
+                lines.append(
+                    f"| {row.get('source')} | {row.get('role')} "
+                    f"| {v('seq')} | {v('queue_depth')} "
+                    f"| {v('active_slots')} "
+                    f"| {v('kv_page_occupancy')} | {v('burn_max')} "
+                    f"| {state} |")
+            lines.append("")
 
     hot = report["links"].get("hot") or []
     if hot:

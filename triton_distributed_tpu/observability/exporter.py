@@ -34,10 +34,25 @@ from triton_distributed_tpu.observability.metrics import (
     _process_index,
     get_registry,
 )
+# The serving-state gauge set mirrored into heartbeat bodies lives in
+# `observability.telemetry` (SNAPSHOT_GAUGES): heartbeat files,
+# heartbeat RPC replies, and telemetry frames all describe a rank
+# through the one shared producer.  Re-exported under the old name
+# for existing importers.
+from triton_distributed_tpu.observability.telemetry import (
+    SNAPSHOT_GAUGES as _HEARTBEAT_GAUGES,  # noqa: F401 (re-export)
+    snapshot_gauges as _snapshot_gauges,
+)
 
 ENV_METRICS_PORT = "TDT_METRICS_PORT"
 ENV_HEARTBEAT_DIR = "TDT_HEARTBEAT_DIR"
 ENV_HEARTBEAT_INTERVAL = "TDT_HEARTBEAT_INTERVAL"
+#: Directory the exporter advertises its actual bound endpoint into
+#: (``ports-rank-<N>.json``): under ``launch.py --roles`` every rank
+#: binds its own port (offset or ephemeral — the parent can't know
+#: it), so the fleet collector and the watch CLI discover endpoints
+#: from these files / the merged ``ports.json`` instead of guessing.
+ENV_PORTS_DIR = "TDT_PORTS_DIR"
 DEFAULT_HEARTBEAT_INTERVAL = 1.0
 
 #: Heartbeats older than this many intervals are reported stale.
@@ -196,6 +211,24 @@ class MetricsServer:
                     body = json.dumps(replay_status(),
                                       default=str).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/fleet/metrics"):
+                    # Fleet-labeled Prometheus aggregate (the folded
+                    # collector state; 404 without a collector, same
+                    # as any unknown path).
+                    from triton_distributed_tpu.observability \
+                        .telemetry import fleet_prometheus
+                    text = fleet_prometheus()
+                    if text is None:
+                        self.send_error(404)
+                        return
+                    body = text.encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.startswith("/fleet"):
+                    from triton_distributed_tpu.observability \
+                        .telemetry import fleet_status
+                    body = json.dumps(fleet_status(),
+                                      default=str).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
@@ -235,10 +268,68 @@ def start_metrics_server(port: int = 0,
     return MetricsServer(port=port, registry=registry)
 
 
+def ports_path(directory: str, rank: Optional[int] = None) -> str:
+    rank = _process_index() if rank is None else rank
+    return os.path.join(directory, f"ports-rank-{rank}.json")
+
+
+def _advertise_port(server: MetricsServer) -> None:
+    """Write this rank's actual bound endpoint to
+    ``ports-rank-<N>.json`` when ``TDT_PORTS_DIR`` is set — under
+    ``launch.py --roles`` ports are per-rank (offset or ephemeral),
+    so the collector/watch discover endpoints from these files
+    instead of guessing.  Atomic tmp+rename; failures are swallowed
+    (endpoint advertisement must not kill a serving rank)."""
+    directory = os.environ.get(ENV_PORTS_DIR)
+    if not directory:
+        return
+    try:
+        os.makedirs(directory, exist_ok=True)
+        path = ports_path(directory)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({
+                "schema": 1,
+                "rank": _process_index(),
+                "role": os.environ.get("TDT_ROLE", "process"),
+                "role_index": int(os.environ.get(
+                    "TDT_ROLE_INDEX", "0")),
+                "pid": os.getpid(),
+                "metrics_addr": f"127.0.0.1:{server.port}",
+            }, f)
+        os.replace(tmp, path)
+    except (OSError, ValueError):
+        pass
+
+
+def read_ports(directory: str) -> Dict[int, dict]:
+    """{rank: endpoint record} from the per-rank ``ports-rank-*.json``
+    files and/or a merged ``ports.json`` (the launcher writes the
+    merge at teardown; live readers see the per-rank files first)."""
+    out: Dict[int, dict] = {}
+    merged = os.path.join(directory, "ports.json")
+    try:
+        with open(merged) as f:
+            for rec in json.load(f).get("ranks", []):
+                out[int(rec["rank"])] = rec
+    except (OSError, ValueError, KeyError):
+        pass
+    for path in glob.glob(os.path.join(directory,
+                                       "ports-rank-*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            out[int(rec["rank"])] = rec
+        except (OSError, ValueError, KeyError):
+            continue
+    return out
+
+
 def maybe_start_metrics_server() -> Optional[MetricsServer]:
     """Start the process-global /metrics server iff
     ``TDT_METRICS_PORT`` is set (0 picks an ephemeral port); safe to
-    call twice."""
+    call twice.  The actual bound endpoint is advertised into
+    ``TDT_PORTS_DIR`` when that is set."""
     global _SERVER
     port = os.environ.get(ENV_METRICS_PORT)
     if not port:  # unset or explicitly emptied to disable
@@ -251,6 +342,7 @@ def maybe_start_metrics_server() -> Optional[MetricsServer]:
                 # Port taken or malformed env: health export must not
                 # kill the serving process.
                 return None
+            _advertise_port(_SERVER)
         return _SERVER
 
 
@@ -316,49 +408,8 @@ def request_table(n: int = 50) -> dict:
 # Heartbeat files
 # ---------------------------------------------------------------------------
 
-#: Serving-state gauges mirrored into the heartbeat body: a stalled
-#: rank's last beat then says what the scheduler was carrying when it
-#: stopped (doctor folds these into its rank table).  The paged-KV
-#: gauges ride along so doctor can call out page pressure (a rank
-#: thrashing on preemption/eviction) in incident reports.
 #: How many recent decision summaries a heartbeat carries.
 _HEARTBEAT_DECISIONS = 5
-
-_HEARTBEAT_GAUGES = ("serving_queue_depth", "serving_active_slots",
-                     "serving_slot_occupancy",
-                     "serving_kv_bytes_in_use",
-                     "serving_kv_pages_free", "serving_kv_pages_used",
-                     "serving_kv_page_occupancy",
-                     "serving_prefix_cache_pages",
-                     # Peer placement signals: a router rank scores
-                     # replicas from these heartbeat fields when it
-                     # has no in-process snapshot
-                     # (serving.cluster.router.heartbeat_signals).
-                     "serving_decode_step_us",
-                     # Speculative-decoding accept rate (absent until
-                     # the first verify round, so non-speculative
-                     # heartbeat bodies are byte-identical): the
-                     # doctor calls out a collapse below 0.3.
-                     "serving_spec_accept_rate",
-                     # KV-tier admission accounting (paged mode only,
-                     # absent elsewhere — same golden discipline):
-                     # the doctor's "KV tier" per-tier hit table and
-                     # its degraded-read verdict note read these.
-                     "serving_kvtier_hit_device",
-                     "serving_kvtier_hit_host",
-                     "serving_kvtier_hit_peer",
-                     "serving_kvtier_hit_disk",
-                     "serving_kvtier_miss",
-                     "serving_kvtier_fallbacks",
-                     "serving_kvtier_warm_tiers",
-                     "serving_kvtier_dropped_evictions",
-                     # SLO error budgets (absent until a tracker ever
-                     # observed a request — policy-free heartbeat
-                     # bodies are byte-identical): worst burn rate
-                     # and smallest remaining budget across classes,
-                     # label-free aggregates of the per-class gauges.
-                     "serving_slo_burn_max",
-                     "serving_slo_budget_min")
 
 
 def heartbeat_payload() -> dict:
@@ -377,9 +428,7 @@ def heartbeat_payload() -> dict:
         "last_span": last.name if last is not None else None,
         "open_spans": [s.name for s in tracer.open_spans()],
     }
-    reg = get_registry()
-    serving = {name: v for name in _HEARTBEAT_GAUGES
-               if (v := reg.peek(name)) is not None}
+    serving = _snapshot_gauges(get_registry())
     if serving:
         payload["serving"] = serving
     # Last few control decisions ride along (key absent when the

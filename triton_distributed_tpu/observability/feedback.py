@@ -120,6 +120,12 @@ class Signals:
     contended_links: Tuple[str, ...] = ()
     gauges: Dict[str, float] = dataclasses.field(default_factory=dict)
     store: Optional[object] = None        # anomaly.BaselineStore
+    #: The process's live fleet collector when the telemetry plane is
+    #: armed (`telemetry.current_fleet`) — consumers that want the
+    #: FLEET-wide view (every source's folded gauges, routing rows)
+    #: read it from here; None in every plane-off process, so static
+    #: paths are untouched.
+    fleet: Optional[object] = None        # telemetry.FleetCollector
 
     def fresh(self, now: Optional[float] = None,
               staleness_s: float = STALENESS_S) -> bool:
@@ -261,9 +267,12 @@ class SignalBus:
             v = reg.peek(name)
             if v is not None:
                 gauges[name] = float(v)
+        from triton_distributed_tpu.observability.telemetry import (
+            current_fleet)
         return Signals(ts=now, link_utilization=util,
                        contended_links=tuple(sorted(set(contended))),
-                       gauges=gauges, store=self._live_store())
+                       gauges=gauges, store=self._live_store(),
+                       fleet=current_fleet())
 
     def read(self, now: Optional[float] = None) -> Signals:
         """The one consumer entry point: a throttled snapshot."""

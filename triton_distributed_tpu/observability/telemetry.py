@@ -1,0 +1,870 @@
+"""Fleet telemetry plane: delta-encoded per-rank snapshots, a
+front-door collector that folds them into one fleet view, and a
+deterministic alert engine over the folded state.
+
+PR 18 made the cluster multi-process, but every live surface stayed
+per-process: each role exports its own ``/metrics``, signals cross
+the wire only as heartbeat-reply piggybacks, and the only fleet-wide
+view is the post-mortem doctor.  This module is the live half:
+
+- every role process runs a :class:`TelemetryPublisher` that
+  delta-encodes its registry (counters / gauges / histograms changed
+  since the last frame, cumulative values — never diffs) plus small
+  "extras" blobs (last-N decision / lineage summaries, SLO burn,
+  anomaly sustained-z, the router's routing table) into schema-v1
+  telemetry frames at heartbeat cadence;
+- frames ride a new ``TELEMETRY`` frame kind on the existing socket
+  wire (`serving.cluster.net.telemetry`), fire-and-forget — the
+  encoding is loss-tolerant by construction (see below), so a dropped
+  frame costs staleness, never correctness;
+- the front door folds them with a :class:`FleetCollector` — O(one
+  folded snapshot per source; cell-level merges on demand via the
+  PR-18 pod hierarchy's cell labels) — and serves the aggregate as
+  ``/fleet`` JSON and fleet-labeled Prometheus on the exporter;
+- a :class:`AlertEngine` evaluates deterministic rules (SLO burn,
+  sustained anomaly z, dead/quarantined transitions, KV-page
+  pressure) over the folded state, records schema-v1
+  :data:`ALERT_FIELDS` events to ``alerts.jsonl``, and re-arms on
+  clear — the same edge-trigger discipline `observability.slo` uses
+  for its burn alerts.
+
+Delta semantics (the loss model): each frame carries a per-source
+monotonic ``seq`` and the CUMULATIVE value of every key that changed
+since the previous frame; every ``full_every``-th frame is a keyframe
+carrying everything.  The collector keeps ``(seq, value)`` per key
+and applies a key only when the frame's seq exceeds the stored one —
+so duplicated frames are no-ops, reordered frames never roll a key
+backward, and a dropped frame's keys are repaired by the next
+keyframe.  Folding is idempotent and commutative per key.
+
+Everything degrades to today's behavior when no collector is present,
+and ``TDT_OBSERVABILITY=0`` keeps the hot hooks allocation-free (the
+plane itself only arms via explicit config/env, per the golden
+discipline every observability feature follows).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import weakref
+from typing import Callable, Dict, List, Optional, Tuple
+
+from triton_distributed_tpu.observability.metrics import (
+    MetricsRegistry,
+    _process_index,
+    count_metric,
+    get_registry,
+    merge_snapshots,
+)
+
+TELEMETRY_SCHEMA = 1
+
+#: Required fields of one telemetry frame (optional extras —
+#: ``signals`` / ``decisions`` / ``lineage`` / ``slo`` / ``anomaly``
+#: / ``routing`` — are absent when empty, so idle frames stay small
+#: and byte-stable).
+TELEMETRY_FIELDS = ("schema", "kind", "ts", "src", "seq", "full",
+                    "counters", "gauges", "histograms")
+TELEMETRY_EXTRAS = ("signals", "decisions", "lineage", "slo",
+                    "anomaly", "routing")
+
+#: Required fields of one alert event.
+ALERT_FIELDS = ("schema", "kind", "ts", "rule", "severity", "target",
+                "state", "inputs")
+ALERT_STATES = ("firing", "cleared")
+
+#: Artifact names the doctor globs for.
+TELEMETRY_GLOB = "telemetry*.jsonl"
+ALERTS_FILE = "alerts.jsonl"
+
+#: Every Nth frame is a keyframe (carries all keys, repairs drops).
+DEFAULT_FULL_EVERY = 10
+
+#: Alert rules never evaluate a source whose last frame is older than
+#: this (a silent source must not keep firing from fossil gauges; its
+#: death surfaces through the router's routing rows instead).
+STALE_AFTER_S = 10.0
+
+#: Default rule thresholds — burn mirrors `slo.SLOPolicy`'s alert
+#: threshold, z mirrors `anomaly.Z_THRESHOLD`, page pressure mirrors
+#: the doctor's PAGE_PRESSURE_OCCUPANCY.
+BURN_THRESHOLD = 2.0
+Z_THRESHOLD = 3.0
+PAGE_PRESSURE = 0.9
+
+ENV_TELEMETRY = "TDT_TELEMETRY"
+ENV_TELEMETRY_INTERVAL = "TDT_TELEMETRY_INTERVAL"
+
+
+def telemetry_enabled() -> bool:
+    """Socket-path opt-in: role processes publish telemetry iff
+    ``TDT_TELEMETRY`` is truthy (the in-process cluster arms via
+    ``ClusterConfig.telemetry_interval_s`` instead)."""
+    return os.environ.get(ENV_TELEMETRY, "").lower() in (
+        "1", "on", "true", "yes")
+
+
+# ---------------------------------------------------------------------------
+# Shared snapshot producers (the one-snapshot-function satellite)
+# ---------------------------------------------------------------------------
+
+#: Serving-state gauges mirrored into heartbeat bodies AND telemetry
+#: frames: the single source of truth for "which gauges describe what
+#: a rank is carrying" (the heartbeat-file writer, the heartbeat RPC
+#: reply, and the telemetry publisher all read this tuple through
+#: :func:`snapshot_gauges` instead of hand-rolling their own lists).
+SNAPSHOT_GAUGES = ("serving_queue_depth", "serving_active_slots",
+                   "serving_slot_occupancy",
+                   "serving_kv_bytes_in_use",
+                   "serving_kv_pages_free", "serving_kv_pages_used",
+                   "serving_kv_page_occupancy",
+                   "serving_prefix_cache_pages",
+                   # Peer placement signals: a router rank scores
+                   # replicas from these fields when it has no
+                   # in-process snapshot
+                   # (serving.cluster.router.heartbeat_signals).
+                   "serving_decode_step_us",
+                   # Speculative-decoding accept rate (absent until
+                   # the first verify round, so non-speculative
+                   # bodies are byte-identical): the doctor calls out
+                   # a collapse below 0.3.
+                   "serving_spec_accept_rate",
+                   # KV-tier admission accounting (paged mode only,
+                   # absent elsewhere — same golden discipline).
+                   "serving_kvtier_hit_device",
+                   "serving_kvtier_hit_host",
+                   "serving_kvtier_hit_peer",
+                   "serving_kvtier_hit_disk",
+                   "serving_kvtier_miss",
+                   "serving_kvtier_fallbacks",
+                   "serving_kvtier_warm_tiers",
+                   "serving_kvtier_dropped_evictions",
+                   # SLO error budgets (absent until a tracker ever
+                   # observed a request): worst burn rate and
+                   # smallest remaining budget across classes.
+                   "serving_slo_burn_max",
+                   "serving_slo_budget_min")
+
+
+def snapshot_gauges(registry: Optional[MetricsRegistry] = None
+                    ) -> dict:
+    """``{name: value}`` for every :data:`SNAPSHOT_GAUGES` gauge that
+    exists in the registry (peek, never register: ranks that never
+    serve must not grow serving gauges)."""
+    reg = registry or get_registry()
+    return {name: v for name in SNAPSHOT_GAUGES
+            if (v := reg.peek(name)) is not None}
+
+
+#: The routing-signal field set every producer shares: the in-process
+#: `Replica.signals`, the heartbeat-reply mirror in `net.remote`, and
+#: the ``signals`` extra of replica telemetry frames are all built by
+#: this one function.
+SIGNAL_FIELDS = ("ts", "queue_depth", "active_slots", "kv_occupancy",
+                 "step_us", "link_busy")
+
+
+def signal_fields(*, ts: float, queue_depth: int, active_slots: int,
+                  kv_occupancy: float, step_us: float,
+                  link_busy: float) -> dict:
+    """The one routing-score snapshot shape (see
+    `serving.cluster.router.ClusterRouter._score` for the consumer)."""
+    return {
+        "ts": float(ts),
+        "queue_depth": int(queue_depth),
+        "active_slots": int(active_slots),
+        "kv_occupancy": float(kv_occupancy),
+        "step_us": float(step_us),
+        "link_busy": float(link_busy),
+    }
+
+
+def telemetry_source(rank: Optional[int] = None,
+                     role: Optional[str] = None,
+                     index: Optional[int] = None,
+                     cell: Optional[int] = None) -> dict:
+    """The ``src`` identity block of a frame (rank/role default from
+    the launch env, same resolution the registry's meta uses)."""
+    src = {
+        "rank": _process_index() if rank is None else int(rank),
+        "role": (role if role is not None
+                 else os.environ.get("TDT_ROLE", "process")),
+        "index": (int(os.environ.get("TDT_ROLE_INDEX", "0"))
+                  if index is None else int(index)),
+    }
+    if cell is not None:
+        src["cell"] = int(cell)
+    return src
+
+
+def telemetry_extras(n: int = 5) -> dict:
+    """Process-global extras for a frame: last-``n`` decision and
+    lineage summaries plus anomaly sustained-z for tracked baselines.
+    Keys absent when the producing subsystem never fired — idle
+    frames carry no extras at all."""
+    out: dict = {}
+    from triton_distributed_tpu.observability.feedback import (
+        recent_decision_summaries)
+    decisions = recent_decision_summaries(n)
+    if decisions:
+        out["decisions"] = decisions
+    from triton_distributed_tpu.observability.lineage import (
+        lineage_summaries)
+    lineage = lineage_summaries(n)
+    if lineage:
+        out["lineage"] = lineage
+    z = sustained_anomalies()
+    if z:
+        out["anomaly"] = z
+    return out
+
+
+def sustained_anomalies(store=None) -> dict:
+    """``{baseline_key: sustained_z}`` for every tracked key whose
+    sustained z-score is currently computable (None scores — too few
+    samples, no sustained run — are omitted; the alert engine applies
+    the threshold, not the publisher)."""
+    from triton_distributed_tpu.observability.anomaly import (
+        get_baseline_store)
+    store = store or get_baseline_store()
+    out = {}
+    for key in store.keys():
+        z = store.sustained_z(key)
+        if z is not None:
+            out[key] = round(float(z), 4)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Schema validation
+# ---------------------------------------------------------------------------
+
+def validate_telemetry(frame: dict) -> dict:
+    """Schema-v1 check for one telemetry frame; raises ``ValueError``
+    on violations, returns the frame for chaining."""
+    if not isinstance(frame, dict):
+        raise ValueError(f"telemetry frame must be a dict, got "
+                         f"{type(frame).__name__}")
+    missing = [f for f in TELEMETRY_FIELDS if f not in frame]
+    if missing:
+        raise ValueError(f"telemetry frame missing fields: {missing}")
+    if frame["schema"] != TELEMETRY_SCHEMA:
+        raise ValueError(f"telemetry schema {frame['schema']!r} != "
+                         f"{TELEMETRY_SCHEMA}")
+    if frame["kind"] != "telemetry":
+        raise ValueError(f"telemetry kind {frame['kind']!r}")
+    if not isinstance(frame["src"], dict) or "rank" not in frame["src"] \
+            or "role" not in frame["src"]:
+        raise ValueError(f"telemetry src malformed: {frame['src']!r}")
+    if not isinstance(frame["seq"], int) or frame["seq"] < 0:
+        raise ValueError(f"telemetry seq {frame['seq']!r}")
+    for kind in ("counters", "gauges", "histograms"):
+        if not isinstance(frame[kind], dict):
+            raise ValueError(f"telemetry {kind} must be a dict")
+    return frame
+
+
+def validate_alert(event: dict) -> dict:
+    """Schema-v1 check for one alert event; raises ``ValueError`` on
+    violations, returns the event for chaining."""
+    if not isinstance(event, dict):
+        raise ValueError(f"alert event must be a dict, got "
+                         f"{type(event).__name__}")
+    missing = [f for f in ALERT_FIELDS if f not in event]
+    if missing:
+        raise ValueError(f"alert event missing fields: {missing}")
+    if event["schema"] != TELEMETRY_SCHEMA:
+        raise ValueError(f"alert schema {event['schema']!r}")
+    if event["kind"] != "alert":
+        raise ValueError(f"alert kind {event['kind']!r}")
+    if event["state"] not in ALERT_STATES:
+        raise ValueError(f"alert state {event['state']!r} not in "
+                         f"{ALERT_STATES}")
+    if not isinstance(event["inputs"], dict):
+        raise ValueError("alert inputs must be a dict")
+    return event
+
+
+# ---------------------------------------------------------------------------
+# Publisher side: delta encoding
+# ---------------------------------------------------------------------------
+
+class DeltaEncoder:
+    """Delta-encodes successive registry snapshots into telemetry
+    frames: each frame carries the cumulative value of every key that
+    changed since the previous frame, under a monotonic per-source
+    ``seq``; every ``full_every``-th frame is a keyframe carrying
+    everything (drop repair).  Extras blobs are change-detected the
+    same way (whole-blob granularity)."""
+
+    def __init__(self, snapshot_fn: Callable[[], dict], src: dict,
+                 full_every: int = DEFAULT_FULL_EVERY):
+        self._snapshot_fn = snapshot_fn
+        self.src = dict(src)
+        self.full_every = max(int(full_every), 1)
+        self._seq = 0
+        self._last: Dict[str, dict] = {
+            "counters": {}, "gauges": {}, "histograms": {}}
+        self._last_extras: Dict[str, str] = {}
+
+    def encode(self, now: float, extras: Optional[dict] = None,
+               force_full: bool = False) -> Optional[dict]:
+        """The next frame, or None when nothing changed and no
+        keyframe is due (idle sources go quiet, they don't spam)."""
+        snap = self._snapshot_fn()
+        full = force_full or (self._seq % self.full_every == 0)
+        frame = {
+            "schema": TELEMETRY_SCHEMA, "kind": "telemetry",
+            "ts": float(now), "src": dict(self.src),
+            "seq": self._seq, "full": bool(full),
+            "counters": {}, "gauges": {}, "histograms": {},
+        }
+        changed = False
+        for kind in ("counters", "gauges", "histograms"):
+            cur = snap.get(kind, {})
+            last = self._last[kind]
+            for key, v in cur.items():
+                if full or last.get(key) != v:
+                    frame[kind][key] = v
+                    changed = True
+            self._last[kind] = dict(cur)
+        for name, blob in sorted((extras or {}).items()):
+            enc = json.dumps(blob, sort_keys=True, default=str)
+            if full or self._last_extras.get(name) != enc:
+                frame[name] = blob
+                changed = True
+            self._last_extras[name] = enc
+        if not changed and not full:
+            return None
+        self._seq += 1
+        return frame
+
+
+class TelemetryPublisher:
+    """One source's cadence-gated frame producer: wraps a
+    :class:`DeltaEncoder`, publishes at most once per ``interval_s``
+    on the caller's clock, and hands each frame to ``sink`` (the wire
+    sender, or the in-process collector's ``fold``)."""
+
+    def __init__(self, snapshot_fn: Callable[[], dict], src: dict,
+                 interval_s: float = 1.0,
+                 full_every: int = DEFAULT_FULL_EVERY,
+                 extras_fn: Optional[Callable[[], dict]] = None,
+                 sink: Optional[Callable[[dict], object]] = None):
+        self.encoder = DeltaEncoder(snapshot_fn, src,
+                                    full_every=full_every)
+        self.interval_s = float(interval_s)
+        self.extras_fn = extras_fn
+        self.sink = sink
+        self._next_at = -float("inf")
+        self.published = 0
+
+    @property
+    def src(self) -> dict:
+        return self.encoder.src
+
+    def publish(self, now: float) -> Optional[dict]:
+        """Encode and emit one frame immediately (None when idle and
+        no keyframe due)."""
+        extras = self.extras_fn() if self.extras_fn is not None else None
+        frame = self.encoder.encode(now, extras=extras)
+        if frame is None:
+            return None
+        self.published += 1
+        count_metric("fleet_telemetry_frames_total",
+                     role=frame["src"]["role"])
+        if self.sink is not None:
+            self.sink(frame)
+        return frame
+
+    def maybe_publish(self, now: float) -> Optional[dict]:
+        """Cadence gate: publish iff ``interval_s`` elapsed since the
+        last emission on this clock."""
+        if now < self._next_at:
+            return None
+        frame = self.publish(now)
+        self._next_at = (now if frame is None
+                         else now + self.interval_s)
+        return frame
+
+
+# ---------------------------------------------------------------------------
+# Collector side: idempotent fold
+# ---------------------------------------------------------------------------
+
+def _src_key(src: dict) -> str:
+    return f"{src.get('role', '?')}-{src.get('rank', '?')}"
+
+
+class _Source:
+    """Folded state of one telemetry source: ``(seq, value)`` per key
+    so replayed/reordered frames can never roll a key backward."""
+
+    __slots__ = ("src", "last_seq", "last_ts", "seqs", "values",
+                 "extras", "extra_seqs", "frames")
+
+    def __init__(self, src: dict):
+        self.src = dict(src)
+        self.last_seq = -1
+        self.last_ts = -float("inf")
+        self.seqs: Dict[Tuple[str, str], int] = {}
+        self.values: Dict[Tuple[str, str], object] = {}
+        self.extras: Dict[str, object] = {}
+        self.extra_seqs: Dict[str, int] = {}
+        self.frames = 0
+
+    def snapshot(self) -> dict:
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        for (kind, key), v in self.values.items():
+            out[kind][key] = v
+        return out
+
+
+class FleetCollector:
+    """Folds telemetry frames from many sources into one fleet view.
+
+    State is one folded snapshot per source (O(sources); per-cell and
+    fleet-wide merges are computed on demand from those, so a pod's
+    front door never holds more than the PR-18 hierarchy already
+    made it responsible for).  `fold` is thread-safe: the socket
+    listener folds from reader threads while the router's event loop
+    reads tables.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._sources: Dict[str, _Source] = {}
+        self.folded = 0
+        self.rejected = 0
+
+    # -- fold ------------------------------------------------------------
+
+    def fold(self, frame: dict) -> bool:
+        """Apply one frame; returns True iff anything was applied.
+        Idempotent: duplicated or reordered frames never regress a
+        key (see the module docstring's loss model)."""
+        validate_telemetry(frame)
+        seq = frame["seq"]
+        with self._lock:
+            s = self._sources.setdefault(_src_key(frame["src"]),
+                                         _Source(frame["src"]))
+            if frame["src"].get("cell") is not None:
+                s.src["cell"] = frame["src"]["cell"]
+            applied = False
+            if frame["full"] and seq > s.last_seq:
+                # A fresh keyframe is authoritative: keys absent from
+                # it no longer exist at the source (registry cleared).
+                s.seqs = {}
+                s.values = {}
+                s.extras = {k: v for k, v in s.extras.items()
+                            if s.extra_seqs.get(k, -1) > seq}
+                applied = True
+            for kind in ("counters", "gauges", "histograms"):
+                for key, v in frame[kind].items():
+                    k = (kind, key)
+                    if seq > s.seqs.get(k, -1):
+                        s.seqs[k] = seq
+                        s.values[k] = v
+                        applied = True
+            for name in TELEMETRY_EXTRAS:
+                if name in frame and seq > s.extra_seqs.get(name, -1):
+                    s.extra_seqs[name] = seq
+                    s.extras[name] = frame[name]
+                    applied = True
+            if seq > s.last_seq:
+                s.last_seq = seq
+                s.last_ts = max(s.last_ts, float(frame["ts"]))
+                applied = True
+            if applied:
+                s.frames += 1
+                self.folded += 1
+            else:
+                self.rejected += 1
+                count_metric("fleet_telemetry_rejected_total")
+            return applied
+
+    # -- views -----------------------------------------------------------
+
+    def sources(self) -> List[str]:
+        with self._lock:
+            return sorted(self._sources)
+
+    def source_state(self, key: str) -> Optional[dict]:
+        """One source's folded view: src identity, freshness, folded
+        snapshot, extras."""
+        with self._lock:
+            s = self._sources.get(key)
+            if s is None:
+                return None
+            return {"src": dict(s.src), "last_seq": s.last_seq,
+                    "last_ts": s.last_ts, "frames": s.frames,
+                    "snapshot": s.snapshot(),
+                    "extras": dict(s.extras)}
+
+    def fleet_snapshot(self) -> dict:
+        """All sources merged (`metrics.merge_snapshots`: counters and
+        histogram buckets sum exactly, gauges keep min/mean/max)."""
+        with self._lock:
+            snaps = [s.snapshot() for _, s in sorted(
+                self._sources.items())]
+        return merge_snapshots(snaps)
+
+    def cell_snapshot(self, cell: int) -> dict:
+        """One cell's merge — the O(cell) view a pod front door
+        serves per `net.hierarchy` cell."""
+        with self._lock:
+            snaps = [s.snapshot() for _, s in sorted(
+                self._sources.items())
+                if s.src.get("cell") == cell]
+        return merge_snapshots(snaps)
+
+    def labeled_snapshot(self) -> dict:
+        """A prometheus-renderable snapshot where every key carries
+        ``role=`` / ``src=`` (and ``cell=`` when known) labels — the
+        fleet-aggregated exposition the front door serves."""
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {},
+                     "meta": {"rank": _process_index(),
+                              "schema": TELEMETRY_SCHEMA,
+                              "fleet": True}}
+        with self._lock:
+            items = sorted(self._sources.items())
+            for key, s in items:
+                pairs = [("role", s.src.get("role", "?")),
+                         ("src", key)]
+                if s.src.get("cell") is not None:
+                    pairs.append(("cell", s.src["cell"]))
+                for (kind, mkey), v in s.values.items():
+                    if mkey.endswith("}"):
+                        # A source-side label set (e.g. the role= on
+                        # fleet_telemetry_frames_total) wins over the
+                        # fleet labels — duplicate label names are
+                        # invalid exposition.
+                        head, _, labels = mkey[:-1].partition("{")
+                        have = {p.partition("=")[0]
+                                for p in labels.split(",")}
+                        extra = ",".join(
+                            f'{k}="{v2}"' for k, v2 in pairs
+                            if k not in have)
+                        labeled = (f"{head}{{{labels},{extra}}}"
+                                   if extra else mkey)
+                    else:
+                        extra = ",".join(f'{k}="{v2}"'
+                                         for k, v2 in pairs)
+                        labeled = f"{mkey}{{{extra}}}"
+                    out[kind][labeled] = v
+        return out
+
+    def fleet_table(self, now: Optional[float] = None) -> List[dict]:
+        """Per-source operator rows (the watch CLI's fleet table and
+        half of ``/fleet``): health, queue/slots/pages, step cost,
+        SLO burn — every field pulled from the folded gauges and the
+        router's routing rows."""
+        rows = []
+        with self._lock:
+            items = sorted(self._sources.items())
+            routing = {}
+            for _, s in items:
+                for row in (s.extras.get("routing") or {}).get(
+                        "replicas", []):
+                    routing[row.get("name")] = row
+            for key, s in items:
+                g = {mkey: v for (kind, mkey), v in s.values.items()
+                     if kind == "gauges"}
+                sig = s.extras.get("signals") or {}
+                row = {
+                    "source": key,
+                    "role": s.src.get("role", "?"),
+                    "rank": s.src.get("rank"),
+                    "last_ts": s.last_ts,
+                    "seq": s.last_seq,
+                    "queue_depth": g.get(
+                        "serving_queue_depth",
+                        sig.get("queue_depth")),
+                    "active_slots": g.get(
+                        "serving_active_slots",
+                        sig.get("active_slots")),
+                    "kv_page_occupancy": g.get(
+                        "serving_kv_page_occupancy",
+                        sig.get("kv_occupancy")),
+                    "step_us": g.get("serving_decode_step_us",
+                                     sig.get("step_us")),
+                    "burn_max": g.get("serving_slo_burn_max"),
+                }
+                if now is not None:
+                    row["age_s"] = round(float(now) - s.last_ts, 6)
+                name = f"replica-{s.src.get('index')}"
+                r = routing.get(name)
+                if s.src.get("role") == "replica" and r is not None:
+                    row["alive"] = bool(r.get("alive", True))
+                    row["quarantined"] = bool(
+                        r.get("quarantined", False))
+                    if r.get("fail_reason"):
+                        row["fail_reason"] = r["fail_reason"]
+                if s.src.get("cell") is not None:
+                    row["cell"] = s.src["cell"]
+                rows.append(row)
+        return rows
+
+    def routing_rows(self) -> List[dict]:
+        """The freshest folded routing table's replica rows (the
+        router source's ``routing`` extra; [] when no router frame
+        folded yet)."""
+        with self._lock:
+            rows: List[dict] = []
+            for _, s in sorted(self._sources.items()):
+                t = s.extras.get("routing")
+                if t:
+                    rows = list(t.get("replicas", []))
+        return rows
+
+    def status(self, now: Optional[float] = None) -> dict:
+        """The ``/fleet`` JSON body (minus the alert section, which
+        the engine owns)."""
+        with self._lock:
+            folded, rejected = self.folded, self.rejected
+        return {
+            "schema": TELEMETRY_SCHEMA,
+            "sources": self.sources(),
+            "frames_folded": folded,
+            "frames_rejected": rejected,
+            "table": self.fleet_table(now),
+            "aggregate": self.fleet_snapshot(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Alert engine
+# ---------------------------------------------------------------------------
+
+class AlertEngine:
+    """Deterministic rules over the collector's folded state.
+
+    Each rule maps one source's folded gauges/extras to zero or more
+    ``(rule, target, severity, inputs)`` conditions.  The engine
+    edge-triggers: a condition fires ONE ``firing`` event on its
+    rising edge, stays silent while it persists, emits ``cleared``
+    when it stops holding, and re-arms — exactly the
+    `slo.SLOTracker._alerting` discipline, fleet-wide.  Stale
+    sources (no frame within ``stale_after_s``) never evaluate, so a
+    fossil gauge cannot keep an alert alive.
+    """
+
+    def __init__(self, stale_after_s: float = STALE_AFTER_S,
+                 burn_threshold: float = BURN_THRESHOLD,
+                 z_threshold: float = Z_THRESHOLD,
+                 page_pressure: float = PAGE_PRESSURE):
+        self.stale_after_s = float(stale_after_s)
+        self.burn_threshold = float(burn_threshold)
+        self.z_threshold = float(z_threshold)
+        self.page_pressure = float(page_pressure)
+        #: (rule, target) -> the firing event (active conditions).
+        self._active: Dict[Tuple[str, str], dict] = {}
+        #: Every transition event, in order (the alerts.jsonl body).
+        self.events: List[dict] = []
+
+    # -- conditions ------------------------------------------------------
+
+    def _conditions(self, collector: FleetCollector, now: float
+                    ) -> Dict[Tuple[str, str], dict]:
+        held: Dict[Tuple[str, str], dict] = {}
+
+        def hold(rule, target, severity, inputs):
+            held[(rule, target)] = {"severity": severity,
+                                    "inputs": inputs}
+
+        for key in collector.sources():
+            s = collector.source_state(key)
+            if now - s["last_ts"] > self.stale_after_s:
+                continue
+            gauges = s["snapshot"]["gauges"]
+            burn = gauges.get("serving_slo_burn_max")
+            if burn is not None and burn > self.burn_threshold:
+                hold("slo_burn", key, "page",
+                     {"burn_max": burn,
+                      "threshold": self.burn_threshold})
+            occ = gauges.get("serving_kv_page_occupancy")
+            if occ is not None and occ > self.page_pressure:
+                hold("kv_page_pressure", key, "warn",
+                     {"occupancy": occ,
+                      "threshold": self.page_pressure})
+            for akey, z in sorted(
+                    (s["extras"].get("anomaly") or {}).items()):
+                # `sustained_z` is the MIN of the last-n z's (see
+                # `anomaly.BaselineStore`): >= threshold means every
+                # recent observation was at least that anomalous.
+                if float(z) >= self.z_threshold:
+                    hold("anomaly_sustained", f"{key}:{akey}",
+                         "warn", {"sustained_z": z,
+                                  "threshold": self.z_threshold})
+            for row in (s["extras"].get("routing") or {}).get(
+                    "replicas", []):
+                name = row.get("name", "?")
+                if not row.get("alive", True):
+                    hold("replica_dead", name, "page",
+                         {"fail_reason": row.get("fail_reason"),
+                          "hb_age_s": row.get("hb_age_s")})
+                elif row.get("quarantined"):
+                    hold("replica_quarantined", name, "warn",
+                         {"fail_reason": row.get("fail_reason")})
+        return held
+
+    # -- evaluation ------------------------------------------------------
+
+    def evaluate(self, now: float, collector: FleetCollector
+                 ) -> List[dict]:
+        """One deterministic pass; returns the transition events it
+        appended (rising edges fire, falling edges clear)."""
+        held = self._conditions(collector, now)
+        out: List[dict] = []
+        for cond_key in sorted(held):
+            rule, target = cond_key
+            if cond_key in self._active:
+                continue
+            event = validate_alert({
+                "schema": TELEMETRY_SCHEMA, "kind": "alert",
+                "ts": float(now), "rule": rule,
+                "severity": held[cond_key]["severity"],
+                "target": target, "state": "firing",
+                "inputs": held[cond_key]["inputs"],
+            })
+            self._active[cond_key] = event
+            count_metric("fleet_alerts_total", rule=rule)
+            out.append(event)
+        for cond_key in sorted(k for k in self._active
+                               if k not in held):
+            rule, target = cond_key
+            fired = self._active.pop(cond_key)
+            out.append(validate_alert({
+                "schema": TELEMETRY_SCHEMA, "kind": "alert",
+                "ts": float(now), "rule": rule,
+                "severity": fired["severity"], "target": target,
+                "state": "cleared",
+                "inputs": {"fired_ts": fired["ts"]},
+            }))
+        self.events.extend(out)
+        return out
+
+    def firing(self) -> List[dict]:
+        """Currently-active alerts, deterministic order."""
+        return [self._active[k] for k in sorted(self._active)]
+
+
+# ---------------------------------------------------------------------------
+# Artifacts
+# ---------------------------------------------------------------------------
+
+def telemetry_path(directory: str, rank: Optional[int] = None) -> str:
+    rank = _process_index() if rank is None else int(rank)
+    return os.path.join(directory, f"telemetry-rank-{rank}.jsonl")
+
+
+def write_telemetry_artifact(directory: str, frames,
+                             rank: Optional[int] = None
+                             ) -> Optional[str]:
+    """``telemetry-rank-<N>.jsonl`` — one frame per line (atomic
+    tmp+rename; None and no file when ``frames`` is empty, per the
+    golden discipline)."""
+    frames = [f for f in frames if f]
+    if not frames:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    path = telemetry_path(directory, rank)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for frame in frames:
+            f.write(json.dumps(frame, default=str) + "\n")
+    os.replace(tmp, path)
+    return path
+
+
+def write_alerts_artifact(directory: str, events
+                          ) -> Optional[str]:
+    """``alerts.jsonl`` — one transition event per line (atomic;
+    None and no file when no alert ever transitioned)."""
+    events = [e for e in events if e]
+    if not events:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, ALERTS_FILE)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for e in events:
+            f.write(json.dumps(e, default=str) + "\n")
+    os.replace(tmp, path)
+    return path
+
+
+def _load_jsonl(path: str, validate: Callable[[dict], dict]
+                ) -> List[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            out.append(validate(json.loads(line)))
+    return out
+
+
+def load_telemetry(path: str) -> List[dict]:
+    """Parse one ``telemetry*.jsonl`` (validating every frame)."""
+    return _load_jsonl(path, validate_telemetry)
+
+
+def load_alerts(path: str) -> List[dict]:
+    """Parse one ``alerts.jsonl`` (validating every event)."""
+    return _load_jsonl(path, validate_alert)
+
+
+# ---------------------------------------------------------------------------
+# Process-global registration (the exporter's /fleet endpoint)
+# ---------------------------------------------------------------------------
+
+_COLLECTOR: Optional[weakref.ref] = None
+_ENGINE: Optional[weakref.ref] = None
+
+
+def set_fleet_collector(collector: Optional[FleetCollector],
+                        engine: Optional[AlertEngine] = None) -> None:
+    """Register the process's live collector (weakly — a collector
+    dying with its cluster must not pin the old fleet view)."""
+    global _COLLECTOR, _ENGINE
+    _COLLECTOR = weakref.ref(collector) if collector is not None \
+        else None
+    _ENGINE = weakref.ref(engine) if engine is not None else None
+
+
+def current_fleet() -> Optional[FleetCollector]:
+    return _COLLECTOR() if _COLLECTOR is not None else None
+
+
+def current_alert_engine() -> Optional[AlertEngine]:
+    return _ENGINE() if _ENGINE is not None else None
+
+
+def fleet_status(now: Optional[float] = None) -> dict:
+    """The ``/fleet`` JSON body: collector status + firing alerts
+    (``{"fleet": null}`` in a process without a collector — same
+    contract as ``/routing``'s null router)."""
+    collector = current_fleet()
+    if collector is None:
+        return {"schema": TELEMETRY_SCHEMA, "rank": _process_index(),
+                "fleet": None}
+    body = collector.status(now)
+    engine = current_alert_engine()
+    body["alerts"] = engine.firing() if engine is not None else []
+    return {"schema": TELEMETRY_SCHEMA, "rank": _process_index(),
+            "fleet": body}
+
+
+def fleet_prometheus() -> Optional[str]:
+    """Fleet-labeled Prometheus exposition of the folded aggregate
+    (None without a collector) — what ``/fleet/metrics`` serves."""
+    collector = current_fleet()
+    if collector is None:
+        return None
+    from triton_distributed_tpu.observability.exporter import (
+        prometheus_text)
+    return prometheus_text(snapshot=collector.labeled_snapshot())

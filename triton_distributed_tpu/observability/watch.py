@@ -1,0 +1,302 @@
+"""Live fleet operator view: one terminal table over the telemetry
+plane.
+
+Two modes::
+
+    # Live: poll a front door's /fleet endpoint every --interval
+    python -m triton_distributed_tpu.observability.watch \
+        --url http://127.0.0.1:9100
+
+    # Live, endpoint discovered from the launch's ports.json
+    python -m triton_distributed_tpu.observability.watch \
+        --ports-dir /tmp/run
+
+    # Deterministic snapshot: fold a run's telemetry/alerts artifacts
+    # and render once (what the golden test pins)
+    python -m triton_distributed_tpu.observability.watch \
+        --once --from-dir /tmp/run
+
+The render is a pure function of the folded state (``render``), so
+``--once`` over a fixed artifact directory is byte-stable — the watch
+golden in ``tests/test_telemetry.py`` gates it.  Live mode is the
+same render over ``/fleet`` JSON, redrawn per poll.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from triton_distributed_tpu.observability.telemetry import (
+    ALERTS_FILE,
+    TELEMETRY_GLOB,
+    FleetCollector,
+    load_alerts,
+    load_telemetry,
+)
+
+
+# ---------------------------------------------------------------------------
+# Artifact folding (--once --from-dir)
+# ---------------------------------------------------------------------------
+
+def fold_dir(dirs: Sequence[str]) -> Tuple[FleetCollector, List[dict]]:
+    """Fold every ``telemetry*.jsonl`` under the directories (and
+    their per-rank ``rank-<N>/`` subdirectories) into one collector,
+    and load every ``alerts.jsonl``.  Unreadable files are skipped —
+    a torn artifact degrades the view, never crashes it."""
+    collector = FleetCollector()
+    alerts: List[dict] = []
+    tel_files: List[str] = []
+    alert_files: List[str] = []
+    for d in dirs:
+        for sub in ("", "rank-*"):
+            tel_files += glob.glob(os.path.join(d, sub,
+                                                TELEMETRY_GLOB))
+            alert_files += glob.glob(os.path.join(d, sub,
+                                                  ALERTS_FILE))
+    for p in sorted(set(tel_files)):
+        try:
+            frames = load_telemetry(p)
+        except (OSError, ValueError):
+            continue
+        for frame in frames:
+            collector.fold(frame)
+    for p in sorted(set(alert_files)):
+        try:
+            alerts += load_alerts(p)
+        except (OSError, ValueError):
+            continue
+    alerts.sort(key=lambda e: (float(e.get("ts", 0.0)),
+                               str(e.get("rule")),
+                               str(e.get("target"))))
+    return collector, alerts
+
+
+def firing_from_events(events: Sequence[dict]) -> List[dict]:
+    """Reconstruct the currently-firing set from a transition log:
+    per (rule, target), the last transition wins."""
+    last: Dict[Tuple[str, str], dict] = {}
+    for e in events:
+        last[(str(e.get("rule")), str(e.get("target")))] = e
+    return [last[k] for k in sorted(last)
+            if last[k].get("state") == "firing"]
+
+
+# ---------------------------------------------------------------------------
+# Rendering (pure: the golden-tested surface)
+# ---------------------------------------------------------------------------
+
+_COLUMNS = ("source", "role", "rank", "seq", "age_s", "health",
+            "queue", "slots", "kv_occ", "step_us", "burn")
+
+
+def _cell(value, ndigits: Optional[int] = None) -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, bool):
+        return "yes" if value else "no"
+    if isinstance(value, float):
+        return format(round(value, 3 if ndigits is None else ndigits),
+                      "g")
+    return str(value)
+
+
+def _health(row: dict) -> str:
+    if row.get("alive") is False:
+        return "DEAD"
+    if row.get("quarantined"):
+        return "QUARANTINED"
+    return "ok"
+
+
+def _table_lines(rows: Sequence[dict]) -> List[str]:
+    grid = [list(_COLUMNS)]
+    for row in rows:
+        grid.append([
+            _cell(row.get("source")),
+            _cell(row.get("role")),
+            _cell(row.get("rank")),
+            _cell(row.get("seq")),
+            _cell(row.get("age_s")),
+            _health(row),
+            _cell(row.get("queue_depth")),
+            _cell(row.get("active_slots")),
+            _cell(row.get("kv_page_occupancy")),
+            _cell(row.get("step_us")),
+            _cell(row.get("burn_max")),
+        ])
+    widths = [max(len(r[i]) for r in grid)
+              for i in range(len(_COLUMNS))]
+    return ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+            for r in grid]
+
+
+def render(status: dict) -> str:
+    """The watch screen for one ``/fleet``-shaped status body
+    (``fleet_table`` rows + ``alerts`` + optional ``decisions``).
+    Pure — byte-stable for fixed input, the golden contract."""
+    table = status.get("table") or []
+    alerts = status.get("alerts") or []
+    lines = [
+        f"fleet: {len(table)} source(s), "
+        f"{status.get('frames_folded', 0)} frame(s) folded, "
+        f"{status.get('frames_rejected', 0)} rejected",
+        "",
+    ]
+    lines += _table_lines(table) if table else ["(no sources yet)"]
+    lines.append("")
+    if alerts:
+        lines.append(f"alerts: {len(alerts)} firing")
+        for e in alerts:
+            inputs = ", ".join(
+                f"{k}={_cell(v)}" for k, v in
+                sorted((e.get("inputs") or {}).items()))
+            lines.append(f"  [{e.get('severity')}] {e.get('rule')} "
+                         f"on {e.get('target')}"
+                         + (f": {inputs}" if inputs else ""))
+    else:
+        lines.append("alerts: none firing")
+    decisions = status.get("decisions") or []
+    if decisions:
+        lines += ["", "recent decisions:"]
+        for d in decisions[-5:]:
+            lines.append(f"  {d.get('consumer')}/{d.get('op')} -> "
+                         f"{d.get('choice')}")
+    return "\n".join(lines) + "\n"
+
+
+def _recent_decisions(collector: FleetCollector) -> List[dict]:
+    """Decision summaries across every folded source, time-ordered."""
+    out: List[dict] = []
+    for key in collector.sources():
+        s = collector.source_state(key)
+        out += list(s["extras"].get("decisions") or [])
+    out.sort(key=lambda d: float(d.get("ts", 0.0)))
+    return out
+
+
+def snapshot_once(dirs: Sequence[str]) -> str:
+    """The ``--once --from-dir`` render: deterministic given the
+    artifact files (no clock read — ages are omitted)."""
+    collector, alert_log = fold_dir(dirs)
+    status = collector.status()
+    status["alerts"] = firing_from_events(alert_log)
+    decisions = _recent_decisions(collector)
+    if decisions:
+        status["decisions"] = decisions
+    return render(status)
+
+
+# ---------------------------------------------------------------------------
+# Live mode (poll a front door)
+# ---------------------------------------------------------------------------
+
+def _discover_url(ports_dir: str) -> Optional[str]:
+    """The router rank's /fleet endpoint from the launch's merged
+    ``ports.json`` (or per-rank files when the run is still up)."""
+    from triton_distributed_tpu.observability.exporter import (
+        read_ports)
+    ranks = read_ports(ports_dir)
+    for _, info in sorted(ranks.items()):
+        if info.get("role") == "router" and info.get("metrics_addr"):
+            return f"http://{info['metrics_addr']}"
+    for _, info in sorted(ranks.items()):
+        if info.get("metrics_addr"):
+            return f"http://{info['metrics_addr']}"
+    return None
+
+
+def _fetch_fleet(url: str, timeout: float = 3.0) -> Optional[dict]:
+    from urllib.request import urlopen
+    try:
+        with urlopen(f"{url.rstrip('/')}/fleet",
+                     timeout=timeout) as resp:
+            doc = json.load(resp)
+    except (OSError, ValueError):
+        return None
+    return doc.get("fleet")
+
+
+def watch_live(url: str, interval_s: float, once: bool = False,
+               out=None) -> int:
+    out = out or sys.stdout
+    while True:
+        fleet = _fetch_fleet(url)
+        if fleet is None:
+            text = (f"watch: no fleet at {url}/fleet (collector not "
+                    "armed, or front door gone)\n")
+        else:
+            # Frame timestamps ride the CLUSTER clock (t0-relative),
+            # so this process cannot compute ages from its own wall
+            # clock; staleness shows through the seq/last_ts columns.
+            text = render(fleet)
+        if not once:
+            out.write("\x1b[2J\x1b[H")
+        out.write(text)
+        out.flush()
+        if once:
+            return 0 if fleet is not None else 1
+        time.sleep(interval_s)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m triton_distributed_tpu.observability.watch",
+        description="Live operator view over the fleet telemetry "
+                    "plane (or a deterministic --once snapshot of a "
+                    "run's telemetry artifacts).")
+    ap.add_argument("--url", default=None,
+                    help="front-door exporter base URL "
+                         "(e.g. http://127.0.0.1:9100)")
+    ap.add_argument("--ports-dir", default=None, metavar="DIR",
+                    help="discover the front door from this launch "
+                         "run's ports.json")
+    ap.add_argument("--from-dir", default=None, action="append",
+                    metavar="DIR",
+                    help="fold this run directory's telemetry*.jsonl "
+                         "/ alerts.jsonl artifacts instead of "
+                         "polling (repeatable)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one snapshot and exit (required "
+                         "with --from-dir; deterministic there)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="live poll interval in seconds")
+    args = ap.parse_args(argv)
+
+    if args.from_dir:
+        if not args.once:
+            print("watch: --from-dir is a post-mortem fold; use "
+                  "--once with it", file=sys.stderr)
+            return 2
+        sys.stdout.write(snapshot_once(args.from_dir))
+        return 0
+    url = args.url
+    if url is None and args.ports_dir:
+        url = _discover_url(args.ports_dir)
+        if url is None:
+            print(f"watch: no advertised endpoints under "
+                  f"{args.ports_dir} (ports.json missing?)",
+                  file=sys.stderr)
+            return 2
+    if url is None:
+        print("watch: need --url, --ports-dir, or --from-dir",
+              file=sys.stderr)
+        return 2
+    try:
+        return watch_live(url, args.interval, once=args.once)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -139,6 +139,21 @@ class ClusterConfig:
     #: (default) = no ring, no samples, no artifact.
     timeseries_interval_s: Optional[float] = None
     timeseries_capacity: int = 256
+    #: Fleet telemetry plane (`observability.telemetry`): when set,
+    #: every local replica (and the router process itself) publishes
+    #: delta-encoded telemetry frames at this virtual-clock cadence
+    #: into a `FleetCollector`; the folded state feeds the
+    #: `AlertEngine` (``alerts.jsonl``) and the exporter's ``/fleet``
+    #: endpoints, and `write_artifact` adds
+    #: ``telemetry-rank-<N>.jsonl``.  None (default) = no collector,
+    #: no frames, no artifacts — byte-identical to the pre-telemetry
+    #: tree.  Under the socket fabric the remote ranks publish
+    #: themselves over the ``TELEMETRY`` wire instead
+    #: (`net.telemetry`); only the router source publishes locally.
+    telemetry_interval_s: Optional[float] = None
+    #: Every Nth telemetry frame is a keyframe (drop repair — see the
+    #: loss model in `observability.telemetry`).
+    telemetry_full_every: int = 10
     #: Record & replay (`observability.replay`): when set, a
     #: `RunRecorder` captures every nondeterministic input crossing
     #: the cluster seams into ``<record_dir>/replay.jsonl``, enough
@@ -234,7 +249,8 @@ class ServingCluster:
                  clock: Optional[Callable[[], float]] = None,
                  clock_advance: Optional[Callable[[float], None]] = None,
                  fault_injector: Optional[FaultInjector] = None,
-                 fabric=None):
+                 fabric=None, fleet_collector=None,
+                 alert_engine=None):
         self.config = cfg = config or ClusterConfig()
         #: Chaos seam (`serving.cluster.chaos`): consulted at every
         #: heartbeat write and wire send.  The default injector has
@@ -357,6 +373,17 @@ class ServingCluster:
                 import TimeSeriesRing
             self.timeseries = TimeSeriesRing(
                 cfg.timeseries_interval_s, cfg.timeseries_capacity)
+        #: Fleet telemetry plane (`observability.telemetry`) — built
+        #: only when an interval is configured (the networked front
+        #: door additionally hands in the collector its wire listener
+        #: already folds into, `net.fabric.connect_cluster`).  None =
+        #: no publishers, no collector, byte-identical behavior.
+        self.fleet: Optional[_FleetPlane] = None
+        if (cfg.telemetry_interval_s is not None
+                or fleet_collector is not None):
+            self.fleet = _FleetPlane(
+                self, cfg, collector=fleet_collector,
+                engine=alert_engine, remote=fabric is not None)
         _register(self)
         self._update_gauges()
         if self._recorder is not None:
@@ -483,6 +510,8 @@ class ServingCluster:
             self.timeseries.maybe_sample(now)
         if self.slo is not None:
             self.slo.check(now)
+        if self.fleet is not None:
+            self.fleet.tick(now)
         if not progressed:
             self._advance(now)
         return {"now": now, "stepped": stepped,
@@ -1478,6 +1507,8 @@ class ServingCluster:
                 json.dump(self.slo.state_dict(self._clock()), f,
                           indent=1, default=str)
             os.replace(stmp, spath)
+        if self.fleet is not None:
+            self.fleet.write_artifacts(directory)
         if self._recorder is not None:
             self._recorder.flush(list(self._lineage_ids), self._open)
         return path
@@ -1491,6 +1522,136 @@ class ServingCluster:
         reg.gauge("cluster_replicas_configured").set(len(self.replicas))
         reg.gauge("cluster_replicas_alive").set(
             sum(1 for r in self.replicas if r.routable))
+
+
+# ---------------------------------------------------------------------------
+# Fleet telemetry plane (observability.telemetry, in-process half)
+# ---------------------------------------------------------------------------
+
+
+class _FleetPlane:
+    """The cluster's half of the fleet telemetry plane: the collector
+    + alert engine the front door owns, plus cadence-gated publishers
+    for every LOCAL source — each virtual replica, and the router
+    process itself.  Remote sources (socket fabric) publish
+    themselves and fold in through the wire listener
+    (`net.telemetry.TelemetryListener`) instead, so ``remote=True``
+    builds only the router publisher.
+
+    Everything runs on the cluster's own clock via the ``now``
+    handed to :meth:`tick` — the plane never reads a clock itself,
+    so record/replay logs and plane-off token streams stay
+    bit-identical.
+    """
+
+    def __init__(self, cluster: ServingCluster, cfg: ClusterConfig,
+                 collector=None, engine=None, remote: bool = False):
+        from triton_distributed_tpu.observability.metrics import (
+            get_registry)
+        from triton_distributed_tpu.observability.telemetry import (
+            AlertEngine, FleetCollector, TelemetryPublisher,
+            set_fleet_collector, telemetry_extras, telemetry_source)
+        self.cluster = cluster
+        self.interval_s = float(cfg.telemetry_interval_s
+                                if cfg.telemetry_interval_s
+                                is not None else 1.0)
+        self.collector = collector or FleetCollector()
+        self.engine = engine or AlertEngine()
+        #: Every frame this process published (the artifact body) —
+        #: bounded: a long-running server must not retain frames
+        #: forever.
+        self.frames: Deque[dict] = collections.deque(maxlen=4096)
+        self.publishers: List[TelemetryPublisher] = []
+        self._now = 0.0
+        self._next_eval = -float("inf")
+
+        def fold(frame: dict) -> None:
+            self.collector.fold(frame)
+            self.frames.append(frame)
+
+        reg = get_registry()
+
+        def router_snapshot() -> dict:
+            return reg.snapshot()
+
+        def router_extras() -> dict:
+            extras = telemetry_extras()
+            # The routing table rows ride the router's frames — the
+            # alert engine's dead/quarantined rules and the watch
+            # CLI's health column read them.  Built on the plane's
+            # own `now`, never a fresh clock read.
+            extras["routing"] = {
+                "replicas": [r.table_row(self._now)
+                             for r in cluster.replicas]}
+            return extras
+
+        self.publishers.append(TelemetryPublisher(
+            router_snapshot,
+            telemetry_source(role="router", index=0),
+            interval_s=self.interval_s,
+            full_every=cfg.telemetry_full_every,
+            extras_fn=router_extras, sink=fold))
+        if not remote:
+            for rep in cluster.replicas:
+                self.publishers.append(self._replica_publisher(
+                    rep, cfg, fold))
+        set_fleet_collector(self.collector, self.engine)
+
+    def _replica_publisher(self, rep, cfg: ClusterConfig, sink):
+        from triton_distributed_tpu.observability.telemetry import (
+            TelemetryPublisher, telemetry_source)
+        occ_gauge = ("serving_kv_page_occupancy"
+                     if rep.scheduler.paged
+                     else "serving_slot_occupancy")
+
+        def snapshot() -> dict:
+            sig = rep.signals(self._now)
+            return {
+                "counters": {
+                    "cluster_replica_routed_total":
+                        float(rep.routed_total)},
+                "gauges": {
+                    "serving_queue_depth": sig["queue_depth"],
+                    "serving_active_slots": sig["active_slots"],
+                    occ_gauge: sig["kv_occupancy"],
+                    "serving_decode_step_us": sig["step_us"],
+                },
+                "histograms": {},
+            }
+
+        def extras() -> dict:
+            return {"signals": rep.signals(self._now)}
+
+        return TelemetryPublisher(
+            snapshot,
+            telemetry_source(rank=rep.rank, role="replica",
+                             index=rep.id),
+            interval_s=self.interval_s,
+            full_every=cfg.telemetry_full_every,
+            extras_fn=extras, sink=sink)
+
+    def tick(self, now: float) -> None:
+        """One event-loop pass: publish due frames, evaluate alert
+        rules at the same cadence."""
+        self._now = now
+        for pub in self.publishers:
+            pub.maybe_publish(now)
+        if now >= self._next_eval:
+            self.engine.evaluate(now, self.collector)
+            self._next_eval = now + self.interval_s
+
+    def write_artifacts(self, directory: str) -> None:
+        """Flush one final frame per publisher (end-of-run state must
+        land even when the run dies between cadences), run a final
+        rule pass, and write ``telemetry-rank-<N>.jsonl`` +
+        ``alerts.jsonl``."""
+        from triton_distributed_tpu.observability.telemetry import (
+            write_alerts_artifact, write_telemetry_artifact)
+        for pub in self.publishers:
+            pub.publish(self._now)
+        self.engine.evaluate(self._now, self.collector)
+        write_telemetry_artifact(directory, list(self.frames))
+        write_alerts_artifact(directory, self.engine.events)
 
 
 # ---------------------------------------------------------------------------

@@ -92,6 +92,10 @@ class NetFabric:
                  dial_timeout_s: float = 30.0):
         self.directory = directory
         self.rank = _rank(rank)
+        #: The front door's telemetry collector listener when the
+        #: fleet plane is armed (`connect_cluster` sets it); closed
+        #: at `shutdown` after the hosts' final flushes landed.
+        self.telemetry_listener = None
         self.channels: Dict[int, Channel] = {}
         for r, info in sorted(directory.ranks.items()):
             if r == self.rank or info["role"] == "router":
@@ -125,12 +129,20 @@ class NetFabric:
 
     def shutdown(self) -> None:
         """Orderly teardown: BYE every host (their serve loops end
-        and the role processes exit 0)."""
+        and the role processes exit 0).  The telemetry listener (if
+        armed) outlives the BYEs briefly so the hosts' final pump
+        flushes still fold, then closes."""
         for ch in self.channels.values():
             try:
                 ch.bye()
             except NetError:
                 pass
+        if self.telemetry_listener is not None:
+            # Hosts flush one last frame on pump stop (after their
+            # serve loop ends on BYE); give those a short window.
+            time.sleep(0.2)
+            self.telemetry_listener.stop()
+            self.telemetry_listener = None
 
 
 # ---------------------------------------------------------------------------
@@ -145,16 +157,50 @@ def connect_cluster(model, params, config, *,
     """Router-role runner: rendezvous, dial the fleet, and return
     ``(cluster, fabric)`` — a `ServingCluster` on remote proxies and
     the real wall clock.  Call ``fabric.shutdown()`` after the run so
-    the role processes exit."""
+    the role processes exit.
+
+    When the fleet telemetry plane is armed (``TDT_TELEMETRY`` or
+    ``config.telemetry_interval_s``), the router opens a collector
+    listener BEFORE rendezvous and registers its address as the
+    router rank's directory addr (the slot was ``"-"`` — routers
+    expose no data-plane listener, and the lock-step driver channel
+    cannot carry host-initiated frames): every host reads it from the
+    directory and dials a second, telemetry-only connection
+    (`net.telemetry`).
+    """
+    from triton_distributed_tpu.observability.telemetry import (
+        ENV_TELEMETRY_INTERVAL, AlertEngine, FleetCollector,
+        telemetry_enabled)
     from triton_distributed_tpu.serving.cluster.cluster import (
         ServingCluster)
+    from triton_distributed_tpu.serving.cluster.net.telemetry import (
+        TelemetryListener)
     rank = _rank(rank)
-    d = rendezvous(rank, "router", _index(None), "-", server=server)
+    if telemetry_enabled() and config.telemetry_interval_s is None:
+        try:
+            config.telemetry_interval_s = float(os.environ.get(
+                ENV_TELEMETRY_INTERVAL, "1.0"))
+        except ValueError:
+            config.telemetry_interval_s = 1.0
+    collector = engine = listener = None
+    addr = "-"
+    if config.telemetry_interval_s is not None:
+        collector = FleetCollector()
+        engine = AlertEngine()
+        listener = TelemetryListener(collector)
+        addr = listener.addr
+    d = rendezvous(rank, "router", _index(None), addr, server=server)
     clock = cluster_clock(d.t0)
     fabric = NetFabric(d, rank)
+    fabric.telemetry_listener = listener
     cluster = ServingCluster(model, params, config, clock=clock,
                              fault_injector=fault_injector,
-                             fabric=fabric)
+                             fabric=fabric, fleet_collector=collector,
+                             alert_engine=engine)
+    if listener is not None and cluster.fleet is not None:
+        # Wire-folded frames (remote sources) land in the front
+        # door's telemetry artifact alongside its own.
+        listener.attach_tap(cluster.fleet.frames.append)
     return cluster, fabric
 
 
@@ -176,7 +222,13 @@ def run_replica(model, params, config, *,
     clock = cluster_clock(d.t0)
     rep = Replica(index, model, params, config.scheduler, clock,
                   step_time_s=config.step_time_s)
+    rep.rank = rank
     service = ReplicaHost(rep)
+    from triton_distributed_tpu.serving.cluster.net.telemetry import (
+        maybe_start_pump)
+    pump = maybe_start_pump(
+        d, clock, role="replica", index=index, rank=rank,
+        signals_fn=lambda: rep.signals(clock()))
     srv.settimeout(accept_timeout_s)
     try:
         sock, _ = srv.accept()
@@ -186,7 +238,11 @@ def run_replica(model, params, config, *,
             f"{accept_timeout_s}s") from None
     finally:
         srv.close()
-    serve_connection(sock, rank, service.dispatch)
+    try:
+        serve_connection(sock, rank, service.dispatch)
+    finally:
+        if pump is not None:
+            pump.stop()
     return rep
 
 
@@ -203,13 +259,18 @@ def run_prefill(model, params, config, *,
     rank = _rank(rank)
     index = _index(index)
     srv = _node.listen(host)
-    rendezvous(rank, "prefill", index, _node.addr_of(srv),
-               server=server)
+    d = rendezvous(rank, "prefill", index, _node.addr_of(srv),
+                   server=server)
+    clock = cluster_clock(d.t0)
     worker = PrefillWorker(index, model, params,
                            _buckets(model, config.scheduler),
                            pad_id=config.scheduler.pad_id,
                            prefill_time_s=config.prefill_time_s)
     service = PrefillHost(worker)
+    from triton_distributed_tpu.serving.cluster.net.telemetry import (
+        maybe_start_pump)
+    pump = maybe_start_pump(d, clock, role="prefill", index=index,
+                            rank=rank)
     srv.settimeout(accept_timeout_s)
     try:
         sock, _ = srv.accept()
@@ -219,7 +280,11 @@ def run_prefill(model, params, config, *,
             f"{accept_timeout_s}s") from None
     finally:
         srv.close()
-    serve_connection(sock, rank, service.dispatch)
+    try:
+        serve_connection(sock, rank, service.dispatch)
+    finally:
+        if pump is not None:
+            pump.stop()
     return worker
 
 

@@ -47,6 +47,10 @@ SHIP = 3        # a KV/prefix shipment's bytes (body = npz payload)
 CALL = 4        # RPC request (meta.method + args; body optional)
 REPLY = 5       # RPC response (meta.rid matches the CALL)
 BYE = 6         # orderly shutdown
+TELEMETRY = 7   # fleet telemetry frame (meta = the schema-v1 frame
+                # dict, observability.telemetry; fire-and-forget on a
+                # dedicated host->front-door connection, NEVER on the
+                # lock-step driver channel)
 
 #: Refuse absurd frames before allocating for them (a corrupted
 #: length field must not trigger a multi-GB recv buffer).

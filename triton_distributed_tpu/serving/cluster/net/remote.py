@@ -402,15 +402,21 @@ class RemoteReplica:
         return self.base_step_s * self.straggle_factor
 
     def signals(self, now: float) -> dict:
+        # The mirrored reply dict was built by the shared
+        # `observability.telemetry.signal_fields` producer on the
+        # host; re-shape through the same function so proxy and local
+        # replica are field-for-field identical.
+        from triton_distributed_tpu.observability.telemetry import (
+            signal_fields)
         sig = dict(self._signals or ())
-        return {
-            "ts": self.hb_ts,
-            "queue_depth": sig.get("queue_depth", 0),
-            "active_slots": sig.get("active_slots", 0),
-            "kv_occupancy": sig.get("kv_occupancy", 0.0),
-            "step_us": self.last_step_s * 1e6,
-            "link_busy": float(self.link_busy),
-        }
+        return signal_fields(
+            ts=self.hb_ts,
+            queue_depth=sig.get("queue_depth", 0),
+            active_slots=sig.get("active_slots", 0),
+            kv_occupancy=sig.get("kv_occupancy", 0.0),
+            step_us=self.last_step_s * 1e6,
+            link_busy=self.link_busy,
+        )
 
     def table_row(self, now: float) -> dict:
         sig = self._signals or {}

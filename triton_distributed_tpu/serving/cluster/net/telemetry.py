@@ -1,0 +1,235 @@
+"""Telemetry over the real wire: the front door's collector listener
+and the hosts' fire-and-forget frame senders.
+
+The data-plane star (`net.node`) is lock-step — after a CALL the next
+frame on that socket must be the REPLY, and hosts never initiate
+frames toward the driver.  Telemetry therefore rides a SECOND,
+dedicated connection per host: the router opens a
+:class:`TelemetryListener` before rendezvous and registers its
+address as the router rank's directory addr (`net.fabric` — the slot
+was ``"-"`` before, routers expose no data-plane listener), every
+host reads it from the `Directory` and dials once, then pushes
+``TELEMETRY`` frames whenever its publisher has one.  No replies, no
+acks: the delta encoding is loss-tolerant (`observability.telemetry`,
+module docstring), so a broken telemetry socket degrades the fleet
+view to staleness and never touches the serving path.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+from triton_distributed_tpu.observability.telemetry import (
+    FleetCollector, TelemetryPublisher)
+from triton_distributed_tpu.serving.cluster.net import node as _node
+from triton_distributed_tpu.serving.cluster.net.frame import (
+    FrameError, TELEMETRY, recv_frame, send_frame)
+
+
+class TelemetryListener:
+    """The front door's collector socket: accept every host's
+    telemetry connection, read TELEMETRY frames until EOF, fold each
+    into the collector.  One daemon reader thread per connection —
+    folding is thread-safe (`FleetCollector.fold` locks), and a
+    malformed frame tears down only its own connection."""
+
+    def __init__(self, collector: FleetCollector,
+                 host: str = "127.0.0.1"):
+        self.collector = collector
+        #: Optional per-folded-frame callback (`attach_tap`): the
+        #: front-door cluster logs wire-folded frames into its
+        #: telemetry artifact through this, so the post-mortem view
+        #: covers REMOTE sources too.  Frames folded before a tap is
+        #: attached are buffered (bounded) and flushed on attach.
+        self.tap = None
+        self._early: list = []
+        self._srv = _node.listen(host)
+        self._closing = False
+        self._threads: list = []
+        self._accept = threading.Thread(
+            target=self._accept_loop, name="tdt-telemetry-accept",
+            daemon=True)
+        self._accept.start()
+
+    @property
+    def addr(self) -> str:
+        return _node.addr_of(self._srv)
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                sock, _ = self._srv.accept()
+            except OSError:
+                return  # listener closed
+            t = threading.Thread(
+                target=self._read_loop, args=(sock,),
+                name="tdt-telemetry-read", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        try:
+            while True:
+                got = recv_frame(sock)
+                if got is None:
+                    return
+                kind, meta, _ = got
+                if kind != TELEMETRY:
+                    continue  # telemetry-only socket: ignore strays
+                try:
+                    self.collector.fold(meta)
+                except ValueError:
+                    # A schema-violating frame is the sender's bug;
+                    # dropping it keeps the fold idempotence intact.
+                    continue
+                tap = self.tap
+                if tap is not None:
+                    tap(meta)
+                elif len(self._early) < 1024:
+                    self._early.append(meta)
+        except (OSError, FrameError):
+            return  # this host's stream broke: staleness, not error
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def attach_tap(self, tap) -> None:
+        """Install the folded-frame callback and flush frames that
+        arrived before the consumer existed."""
+        early, self._early = self._early, []
+        for frame in early:
+            tap(frame)
+        self.tap = tap
+
+    def stop(self) -> None:
+        self._closing = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class TelemetrySender:
+    """One host's fire-and-forget frame pusher: dial the front door
+    lazily, send each frame as one TELEMETRY push, and on ANY wire
+    error drop the frame, close, and re-dial on the next send.
+    Telemetry must never take a serving rank down."""
+
+    def __init__(self, addr: str, dial_timeout_s: float = 5.0):
+        self.addr = addr
+        self.dial_timeout_s = float(dial_timeout_s)
+        self._sock: Optional[socket.socket] = None
+
+    def send(self, frame: dict) -> bool:
+        """True iff the frame left this process."""
+        try:
+            if self._sock is None:
+                self._sock = _node.connect(
+                    self.addr, timeout=self.dial_timeout_s)
+            send_frame(self._sock, TELEMETRY, frame)
+            return True
+        except (OSError, ValueError):
+            self.close()
+            return False
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+class TelemetryPump:
+    """A host rank's background publisher: every ``interval_s`` of
+    wall time (the host's serve loop is blocked in ``recv``, so
+    cadence cannot ride the cluster event loop here), encode one
+    delta frame from the publisher and push it through the sender.
+    Daemon thread; ``stop()`` flushes one final frame so short runs
+    always deliver their last state."""
+
+    def __init__(self, publisher: TelemetryPublisher,
+                 sender: TelemetrySender, clock,
+                 interval_s: float = 1.0):
+        self.publisher = publisher
+        self.sender = sender
+        self._clock = clock
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="tdt-telemetry-pump", daemon=True)
+
+    def start(self) -> "TelemetryPump":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._beat()
+            self._stop.wait(self.interval_s)
+
+    def _beat(self) -> None:
+        try:
+            frame = self.publisher.publish(self._clock())
+        except Exception:  # noqa: BLE001 — a snapshot hiccup must
+            return         # not kill the pump (next beat retries)
+        if frame is not None:
+            self.sender.send(frame)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2 * self.interval_s)
+        self._beat()  # final flush: deliver the end-of-run state
+        self.sender.close()
+
+
+def maybe_start_pump(directory, clock, *, role: str, index: int,
+                     rank: int, signals_fn=None
+                     ) -> Optional[TelemetryPump]:
+    """Start this host rank's telemetry pump iff ``TDT_TELEMETRY`` is
+    armed AND the rendezvous directory advertises a front-door
+    collector address (the router registers its listener as its
+    directory addr when the plane is on; ``"-"`` means no plane).
+    Returns the started pump, or None when the plane stays off."""
+    import os
+
+    from triton_distributed_tpu.observability.metrics import (
+        get_registry)
+    from triton_distributed_tpu.observability.telemetry import (
+        ENV_TELEMETRY_INTERVAL, TelemetryPublisher, telemetry_enabled,
+        telemetry_extras, telemetry_source)
+    if not telemetry_enabled():
+        return None
+    addr = None
+    for r in directory.by_role("router"):
+        a = directory.addr(r)
+        if a and a != "-":
+            addr = a
+    if addr is None:
+        return None
+    try:
+        interval = float(os.environ.get(ENV_TELEMETRY_INTERVAL,
+                                        "1.0"))
+    except ValueError:
+        interval = 1.0
+    reg = get_registry()
+
+    def extras() -> dict:
+        out = telemetry_extras()
+        if signals_fn is not None:
+            sig = signals_fn()
+            if sig:
+                out["signals"] = sig
+        return out
+
+    publisher = TelemetryPublisher(
+        reg.snapshot,
+        telemetry_source(rank=rank, role=role, index=index),
+        interval_s=interval, extras_fn=extras)
+    return TelemetryPump(publisher, TelemetrySender(addr), clock,
+                         interval_s=interval).start()

@@ -157,18 +157,23 @@ class Replica:
 
     def signals(self, now: float) -> dict:
         """The routing-score snapshot the router scores from (see
-        `router.ClusterRouter._score` for the formula; the same
-        fields ride heartbeat files in a multi-process deployment)."""
+        `router.ClusterRouter._score` for the formula).  Built by the
+        shared `observability.telemetry.signal_fields` producer — the
+        heartbeat RPC reply (`net.remote`) and telemetry frames carry
+        this exact dict, so every transport describes a replica
+        identically."""
+        from triton_distributed_tpu.observability.telemetry import (
+            signal_fields)
         s = self.scheduler
-        return {
-            "ts": self.hb_ts,
-            "queue_depth": len(s._queue),
-            "active_slots": s.slots.active_slots,
-            "kv_occupancy": (s.slots.page_occupancy if s.paged
-                             else s.slots.occupancy),
-            "step_us": self.last_step_s * 1e6,
-            "link_busy": float(self.link_busy),
-        }
+        return signal_fields(
+            ts=self.hb_ts,
+            queue_depth=len(s._queue),
+            active_slots=s.slots.active_slots,
+            kv_occupancy=(s.slots.page_occupancy if s.paged
+                          else s.slots.occupancy),
+            step_us=self.last_step_s * 1e6,
+            link_busy=self.link_busy,
+        )
 
     def table_row(self, now: float) -> dict:
         """One `/routing` / router-artifact row."""
